@@ -1,0 +1,28 @@
+"""kubernetes_tpu — a TPU-native cluster-scheduling framework.
+
+A ground-up re-design of the kube-scheduler (reference:
+``pkg/scheduler/schedule_one.go`` — ``findNodesThatFitPod`` /
+``prioritizeNodes``) plus the surrounding control-plane machinery
+(store/watch, informers, controllers, node runtime, CLI) where the
+per-pod Filter/Score plugin chain is inverted into dense
+pods x nodes x resources tensors evaluated in one jitted JAX program,
+sharded over a TPU mesh.
+
+Layout:
+  api/         core/v1-analog typed objects (Pod, Node, quantities, selectors)
+  encode/      cluster objects -> bucketed static-shape tensors (Snapshot)
+  ops/         tensor plugin terms: feasibility masks, score terms, topology
+  models/      the jitted scheduling step + gang batcher ("flagship model")
+  sched/       scheduler framework: queue, cache, profiles, oracle, main loop
+  parallel/    device mesh, shardings, collectives
+  store/       etcd-analog versioned store + watch + HTTP apiserver
+  client/      client-go analog: informers, workqueue, leader election
+  controllers/ reconcile loops (deployment, replicaset, job, nodelifecycle, gc)
+  kubelet/     hollow node runtime (status, heartbeats)
+  proxy/       service -> endpoint rule computation
+  cli/         ktpu command-line client
+  config/      component config (SchedulerConfiguration), feature gates
+  metrics/     prometheus-style registry
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,83 @@
+"""Label / node-selector matching semantics (host-side oracle path).
+
+Reference: ``staging/src/k8s.io/apimachinery/pkg/labels/selector.go``
+(``Requirement.Matches``) and
+``staging/src/k8s.io/component-helpers/scheduling/corev1/nodeaffinity``
+(``MatchNodeSelectorTerms``). The tensor encoder (encode/snapshot.py) compiles
+the same semantics to int-set tables; keep the two in lock-step — parity tests
+diff them directly.
+
+Operator semantics (labels lib):
+  In           key exists and value in set
+  NotIn        key absent OR value not in set
+  Exists       key present
+  DoesNotExist key absent
+  Gt / Lt      key present, integer-parsed value strictly greater/less
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kubernetes_tpu.api.types import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    LabelSelector,
+    NodeSelectorTerm,
+    Requirement,
+)
+
+
+def requirement_matches(req: Requirement, labels: dict[str, str]) -> bool:
+    present = req.key in labels
+    value = labels.get(req.key)
+    if req.operator == OP_IN:
+        return present and value in req.values
+    if req.operator == OP_NOT_IN:
+        return (not present) or value not in req.values
+    if req.operator == OP_EXISTS:
+        return present
+    if req.operator == OP_DOES_NOT_EXIST:
+        return not present
+    if req.operator in (OP_GT, OP_LT):
+        if not present or not req.values:
+            return False
+        try:
+            lhs, rhs = int(value), int(req.values[0])
+        except (TypeError, ValueError):
+            return False
+        return lhs > rhs if req.operator == OP_GT else lhs < rhs
+    raise ValueError(f"unknown operator {req.operator!r}")
+
+
+def node_selector_term_matches(term: NodeSelectorTerm, labels: dict[str, str],
+                               fields: Optional[dict[str, str]] = None) -> bool:
+    """A term with no expressions and no fields matches nothing (reference:
+    nodeaffinity lazy errs). matchFields evaluate against node fields
+    (metadata.name), matchExpressions against labels; both must hold."""
+    if not term.match_expressions and not term.match_fields:
+        return False
+    return (all(requirement_matches(e, labels) for e in term.match_expressions)
+            and all(requirement_matches(e, fields or {}) for e in term.match_fields))
+
+
+def node_selector_matches(terms: list[NodeSelectorTerm], labels: dict[str, str],
+                          fields: Optional[dict[str, str]] = None) -> bool:
+    """OR over terms; an empty term list matches nothing."""
+    return any(node_selector_term_matches(t, labels, fields) for t in terms)
+
+
+def node_fields(node_name: str) -> dict[str, str]:
+    """The node field set visible to matchFields."""
+    return {"metadata.name": node_name}
+
+
+def label_selector_matches(selector: Optional[LabelSelector], labels: dict[str, str]) -> bool:
+    """nil selector matches nothing; empty selector matches everything."""
+    if selector is None:
+        return False
+    return all(requirement_matches(r, labels) for r in selector.requirements())

@@ -1,0 +1,16 @@
+"""Test harness config: force an 8-device virtual CPU mesh BEFORE jax import.
+
+Multi-chip shardings are validated on virtual CPU devices
+(xla_force_host_platform_device_count); the driver's dryrun_multichip does the
+same. Real-TPU benchmarking happens only in bench.py.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

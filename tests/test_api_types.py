@@ -1,0 +1,132 @@
+"""API object model: quantities, selectors, wire round-trip.
+
+Golden cases mirror the reference's table-driven tests
+(apimachinery resource quantity tests; labels selector tests)."""
+
+import pytest
+
+from kubernetes_tpu.api import (
+    LabelSelector,
+    Pod,
+    Requirement,
+    Taint,
+    Toleration,
+    parse_quantity,
+    to_bytes,
+    to_milli,
+)
+from kubernetes_tpu.api.selectors import (
+    label_selector_matches,
+    node_selector_matches,
+    requirement_matches,
+)
+from kubernetes_tpu.api.types import NodeSelectorTerm
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+@pytest.mark.parametrize("s,expected", [
+    ("100m", 0.1), ("1", 1.0), ("2.5", 2.5), ("1Gi", 2**30), ("512Mi", 512 * 2**20),
+    ("1k", 1000.0), ("1M", 1e6), ("1e3", 1000.0), ("0", 0.0), ("16Ki", 16384.0),
+])
+def test_parse_quantity(s, expected):
+    assert parse_quantity(s) == pytest.approx(expected)
+
+
+def test_quantity_canonical_units():
+    assert to_milli("250m") == 250
+    assert to_milli("2") == 2000
+    assert to_bytes("1Gi") == 2**30
+
+
+def test_parse_quantity_invalid():
+    with pytest.raises(ValueError):
+        parse_quantity("abc")
+
+
+def test_pod_resource_requests_max_of_init_and_sum():
+    pod = (make_pod("p").req({"cpu": "100m", "memory": "1Gi"})
+           .container_req({"cpu": "200m"})
+           .init_req({"cpu": "500m"})
+           .overhead({"cpu": "50m"}).obj())
+    reqs = pod.resource_requests()
+    # sum(containers)=300m; max(init)=500m -> effective 500m, + overhead 50m
+    assert reqs["cpu"] == 550
+    assert reqs["memory"] == 2**30
+    assert reqs["pods"] == 1
+
+
+@pytest.mark.parametrize("op,values,labels,want", [
+    ("In", ["a", "b"], {"k": "a"}, True),
+    ("In", ["a"], {"k": "z"}, False),
+    ("In", ["a"], {}, False),
+    ("NotIn", ["a"], {"k": "b"}, True),
+    ("NotIn", ["a"], {"k": "a"}, False),
+    ("NotIn", ["a"], {}, True),          # absent key matches NotIn
+    ("Exists", [], {"k": "x"}, True),
+    ("Exists", [], {}, False),
+    ("DoesNotExist", [], {}, True),
+    ("DoesNotExist", [], {"k": "x"}, False),
+    ("Gt", ["5"], {"k": "7"}, True),
+    ("Gt", ["5"], {"k": "5"}, False),
+    ("Lt", ["5"], {"k": "3"}, True),
+    ("Gt", ["5"], {}, False),
+    ("Gt", ["5"], {"k": "notanum"}, False),
+])
+def test_requirement_matches(op, values, labels, want):
+    assert requirement_matches(Requirement("k", op, values), labels) is want
+
+
+def test_node_selector_or_of_terms():
+    terms = [
+        NodeSelectorTerm(match_expressions=[Requirement("zone", "In", ["a"])]),
+        NodeSelectorTerm(match_expressions=[Requirement("zone", "In", ["b"])]),
+    ]
+    assert node_selector_matches(terms, {"zone": "b"})
+    assert not node_selector_matches(terms, {"zone": "c"})
+    assert not node_selector_matches([], {"zone": "a"})
+    # empty term matches nothing
+    assert not node_selector_matches([NodeSelectorTerm()], {"zone": "a"})
+
+
+def test_label_selector_nil_vs_empty():
+    assert not label_selector_matches(None, {"a": "b"})
+    assert label_selector_matches(LabelSelector(), {"a": "b"})  # empty matches all
+    sel = LabelSelector(match_labels={"app": "web"},
+                        match_expressions=[Requirement("tier", "NotIn", ["db"])])
+    assert label_selector_matches(sel, {"app": "web", "tier": "fe"})
+    assert not label_selector_matches(sel, {"app": "web", "tier": "db"})
+    assert not label_selector_matches(sel, {"tier": "fe"})
+
+
+@pytest.mark.parametrize("tol,taint,want", [
+    (Toleration(operator="Exists"), Taint("any", "v", "NoSchedule"), True),
+    (Toleration(key="k", operator="Exists"), Taint("k", "v", "NoSchedule"), True),
+    (Toleration(key="k", operator="Exists"), Taint("other", "v", "NoSchedule"), False),
+    (Toleration(key="k", operator="Equal", value="v"), Taint("k", "v", "NoSchedule"), True),
+    (Toleration(key="k", operator="Equal", value="w"), Taint("k", "v", "NoSchedule"), False),
+    (Toleration(key="k", operator="Equal", value="v", effect="NoExecute"),
+     Taint("k", "v", "NoSchedule"), False),
+])
+def test_toleration_tolerates(tol, taint, want):
+    assert tol.tolerates(taint) is want
+
+
+def test_wire_roundtrip():
+    pod = (make_pod("web-1", "prod").label("app", "web")
+           .req({"cpu": "500m", "memory": "256Mi"})
+           .toleration(key="gpu", operator="Exists", effect="NoSchedule")
+           .node_affinity_in("zone", ["us-a", "us-b"])
+           .pod_anti_affinity("kubernetes.io/hostname", {"app": "web"})
+           .spread(1, "zone", "DoNotSchedule", {"app": "web"})
+           .host_port(8080).priority(100).obj())
+    d = pod.to_dict()
+    pod2 = Pod.from_dict(d)
+    assert pod2.to_dict() == d
+    assert pod2.spec.affinity.pod_anti_affinity.required[0].topology_key == "kubernetes.io/hostname"
+    assert pod2.resource_requests() == pod.resource_requests()
+
+    node = (make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": "110"})
+            .taint("dedicated", "ml", "NoSchedule").label("zone", "us-a").obj())
+    from kubernetes_tpu.api.types import Node
+    assert Node.from_dict(node.to_dict()).to_dict() == node.to_dict()
+    assert node.allocatable_canonical()["cpu"] == 4000
