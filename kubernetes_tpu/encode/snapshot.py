@@ -1,0 +1,680 @@
+"""Snapshot encoder: cluster objects -> bucketed static-shape tensors.
+
+This is the TPU analog of the scheduler cache snapshot
+(``pkg/scheduler/internal/cache/snapshot.go`` — immutable per-cycle view). The
+Go scheduler hands each plugin a ``*NodeInfo``; we hand the jitted scheduling
+step two pytrees:
+
+  ClusterTensors  node-side state: allocatable/requested [N,R], labels [N,K],
+                  taints, used host-ports, images, plus existing-pods tensors
+                  [E,...] for relational plugins (spread / inter-pod affinity).
+  PodBatch        pod-side state for the P pods being scheduled this step:
+                  requests [P,R], tolerations, node-selector & affinity terms
+                  compiled to int-set tables, spread constraints, host-ports.
+
+All strings are interned (encode/dictionary.py); all comparisons downstream
+are integer equality. All dims are bucketed to powers of two so XLA recompiles
+only when the cluster crosses a bucket boundary, not on every churn.
+
+Design notes:
+- Node names are injected as a pseudo-label ``metadata.name`` so matchFields
+  terms compile through the same expression machinery as matchExpressions.
+- Topology domains need no dictionary: for a topology key k, two nodes are in
+  the same domain iff ``node_labels[:, k]`` agree; domain aggregation becomes
+  one-hot matmuls on the MXU (see ops/topology.py).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Optional
+
+import numpy as np
+from flax import struct
+
+from kubernetes_tpu.api.types import (
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+    EFFECT_PREFER_NO_SCHEDULE,
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    TOL_OP_EXISTS,
+    LabelSelector,
+    Node,
+    NodeSelectorTerm,
+    Pod,
+    Requirement,
+)
+from kubernetes_tpu.encode.dictionary import StringTable, next_bucket
+from kubernetes_tpu.encode.scaling import UNLIMITED, scale_allocatable, scale_request
+
+# --- integer op/effect codes used inside tensors -------------------------------
+
+OPC = {OP_IN: 0, OP_NOT_IN: 1, OP_EXISTS: 2, OP_DOES_NOT_EXIST: 3, OP_GT: 4, OP_LT: 5}
+EFFECTC = {EFFECT_NO_SCHEDULE: 0, EFFECT_PREFER_NO_SCHEDULE: 1, EFFECT_NO_EXECUTE: 2}
+TOLOPC_EQUAL, TOLOPC_EXISTS = 0, 1
+PROTOC = {"TCP": 0, "UDP": 1, "SCTP": 2}
+NODE_NAME_LABEL = "metadata.name"
+WILDCARD_IP = "0.0.0.0"
+# Taint the NodeUnschedulable plugin synthesizes for .spec.unschedulable
+# (reference: nodeunschedulable/node_unschedulable.go). Pre-interned so its
+# key id is the Python-level constant UNSCHED_TAINT_KEY_ID.
+UNSCHED_TAINT_KEY = "node.kubernetes.io/unschedulable"
+NODE_NAME_KEY_ID = 0
+UNSCHED_TAINT_KEY_ID = 1
+EMPTY_VALUE_ID = 0  # "" pre-interned: empty taint values / tolerations compare to it
+
+
+class TermSet(struct.PyTreeNode):
+    """Compiled node-selector terms: OR over terms, AND over exprs within a term.
+
+    Shapes: key/op/num/expr_valid [P,T,X]; vals [P,T,X,V]; term_valid [P,T];
+    weight [P,T] (1.0 for required terms); has_any [P].
+    """
+
+    key: Any
+    op: Any
+    vals: Any
+    num: Any
+    expr_valid: Any
+    term_valid: Any
+    weight: Any
+    has_any: Any
+
+
+class SelectorSet(struct.PyTreeNode):
+    """Compiled label selectors (AND of exprs), e.g. pod-affinity term selectors
+    or spread-constraint selectors. Shapes: key/op/expr_valid [..., X];
+    vals [..., X, V]; valid [...] marks real (non-pad) selectors.
+    A valid selector with zero exprs matches everything (empty selector);
+    invalid (pad) selectors match nothing.
+    """
+
+    key: Any
+    op: Any
+    vals: Any
+    expr_valid: Any
+    valid: Any
+
+
+def _selset_arrays(shape_prefix: tuple[int, ...], AX: int, AV: int) -> dict:
+    return dict(
+        key=np.full(shape_prefix + (AX,), -1, np.int32),
+        op=np.zeros(shape_prefix + (AX,), np.int32),
+        vals=np.full(shape_prefix + (AX, AV), -1, np.int32),
+        expr_valid=np.zeros(shape_prefix + (AX,), bool),
+        valid=np.zeros(shape_prefix, bool),
+    )
+
+
+def _selset_fill(arrs: dict, idx: tuple[int, ...], valid: bool, exprs: list):
+    arrs["valid"][idx] = valid
+    for x_idx, (kid, opc, vals, _num) in enumerate(exprs):
+        arrs["key"][idx + (x_idx,)] = kid
+        arrs["op"][idx + (x_idx,)] = opc
+        arrs["expr_valid"][idx + (x_idx,)] = True
+        for v_idx, v in enumerate(vals):
+            arrs["vals"][idx + (x_idx, v_idx)] = v
+
+
+class ClusterTensors(struct.PyTreeNode):
+    allocatable: Any      # [N,R] int32 (scaled units; missing "pods" -> UNLIMITED)
+    requested: Any        # [N,R] int32
+    node_valid: Any       # [N] bool
+    unschedulable: Any    # [N] bool
+    node_labels: Any      # [N,K] int32 value-id, -1 absent
+    label_value_num: Any  # [V] float32 integer-parse of value strings (NaN if not)
+    taint_key: Any        # [N,T] int32
+    taint_val: Any        # [N,T] int32
+    taint_effect: Any     # [N,T] int32
+    taint_valid: Any      # [N,T] bool
+    port_proto: Any       # [N,PRT] int32
+    port_port: Any        # [N,PRT] int32
+    port_ip: Any          # [N,PRT] int32 (0 = wildcard 0.0.0.0)
+    port_valid: Any       # [N,PRT] bool
+    node_images: Any      # [N,I] int32 image-id, -1 pad
+    image_sizes: Any      # [IMG] float32 bytes
+    epod_node: Any        # [E] int32 node index of existing pod
+    epod_ns: Any          # [E] int32 namespace id
+    epod_labels: Any      # [E,K] int32
+    epod_valid: Any       # [E] bool
+    # existing pods' REQUIRED anti-affinity terms (symmetry veto)
+    ea_sel: "SelectorSet"  # [E,ET,...]
+    ea_topo: Any           # [E,ET] int32
+    ea_valid: Any          # [E,ET] bool
+
+
+class PodBatch(struct.PyTreeNode):
+    requests: Any      # [P,R] int32
+    pod_valid: Any     # [P] bool
+    priority: Any      # [P] int32
+    forced_node: Any   # [P] int32: -1 none, -2 named node unknown
+    pod_ns: Any        # [P] int32
+    pod_labels: Any    # [P,K] int32
+    tol_key: Any       # [P,TOL] int32 (-1 = empty key -> matches all keys)
+    tol_op: Any        # [P,TOL] int32
+    tol_val: Any       # [P,TOL] int32
+    tol_effect: Any    # [P,TOL] int32 (-1 = all effects)
+    tol_valid: Any     # [P,TOL] bool
+    sel_key: Any       # [P,S] int32 nodeSelector (AND of equality)
+    sel_val: Any       # [P,S] int32
+    sel_valid: Any     # [P,S] bool
+    req_terms: TermSet   # required node affinity (+ matchFields)
+    pref_terms: TermSet  # preferred node affinity, weight per term
+    port_proto: Any    # [P,PP] int32
+    port_port: Any     # [P,PP] int32
+    port_ip: Any       # [P,PP] int32
+    port_valid: Any    # [P,PP] bool
+    pod_images: Any    # [P,CI] int32
+    image_bytes: Any   # [P] float32 total bytes of pod's images (ImageLocality cap)
+    # --- relational terms (spread / inter-pod affinity), see ops/topology.py ---
+    aff_sel: SelectorSet    # [P,AT,...] required pod-affinity selectors
+    aff_topo: Any           # [P,AT] int32 topology key-id
+    aff_valid: Any          # [P,AT] bool
+    anti_sel: SelectorSet   # [P,BT,...] required anti-affinity selectors
+    anti_topo: Any          # [P,BT] int32
+    anti_valid: Any         # [P,BT] bool
+    paff_sel: SelectorSet   # [P,CT,...] preferred pod-affinity selectors
+    paff_topo: Any          # [P,CT] int32
+    paff_weight: Any        # [P,CT] float32 (negative for preferred anti-affinity)
+    paff_valid: Any         # [P,CT] bool
+    sc_sel: SelectorSet     # [P,SC,...] spread-constraint selectors
+    sc_topo: Any            # [P,SC] int32
+    sc_maxskew: Any         # [P,SC] int32
+    sc_hard: Any            # [P,SC] bool (DoNotSchedule)
+    sc_valid: Any           # [P,SC] bool
+
+
+@dataclass
+class SnapshotMeta:
+    """Host-side static metadata accompanying the tensors (NOT a pytree)."""
+
+    keys: StringTable
+    values: StringTable
+    namespaces: StringTable
+    ips: StringTable
+    images: StringTable
+    resources: list[str] = dc_field(default_factory=list)
+    node_names: list[str] = dc_field(default_factory=list)
+    node_index: dict[str, int] = dc_field(default_factory=dict)
+    pod_keys: list[str] = dc_field(default_factory=list)  # keys of the encoded batch
+    topo_keys: tuple[int, ...] = ()  # distinct topology key-ids in play (static)
+    generation: int = 0
+
+
+def _resource_union(nodes: list[Node], pods: list[Pod]) -> list[str]:
+    seen = ["cpu", "memory", "pods"]
+    seen_set = set(seen)
+    for n in nodes:
+        for r in n.status.allocatable:
+            if r not in seen_set:
+                seen.append(r)
+                seen_set.add(r)
+    for p in pods:
+        for r in p.resource_requests():
+            if r not in seen_set:
+                seen.append(r)
+                seen_set.add(r)
+    return seen
+
+
+class SnapshotEncoder:
+    """Persistent encoder: intern tables survive across snapshots so ids are
+    stable and incremental re-encoding stays cheap."""
+
+    def __init__(self):
+        self.keys = StringTable([NODE_NAME_LABEL, UNSCHED_TAINT_KEY])
+        self.values = StringTable([""])
+        self.namespaces = StringTable(["default"])
+        self.ips = StringTable([WILDCARD_IP])
+        self.images = StringTable()
+        self._image_sizes: list[float] = []
+        self._cluster_topo_keys: set[int] = set()
+        self.generation = 0
+
+    # -- small helpers ------------------------------------------------------
+
+    def _intern_image(self, name: str, size: float = 0.0) -> int:
+        i = self.images.intern(name)
+        if i == len(self._image_sizes):
+            self._image_sizes.append(float(size))
+        elif size:
+            self._image_sizes[i] = max(self._image_sizes[i], float(size))
+        return i
+
+    def _label_ids(self, labels: dict[str, str], extra: dict[str, str] | None = None):
+        out = {}
+        for k, v in {**labels, **(extra or {})}.items():
+            out[self.keys.intern(k)] = self.values.intern(v)
+        return out
+
+    # -- cluster side -------------------------------------------------------
+
+    def encode_cluster(self, nodes: list[Node], bound_pods: list[Pod],
+                       pending_pods: Optional[list[Pod]] = None,
+                       ) -> tuple[ClusterTensors, SnapshotMeta]:
+        """Encode node-side state. ``bound_pods`` are pods already assigned
+        (their requests fold into ``requested`` and they populate the
+        existing-pods tensors). ``pending_pods`` only widen the resource axis so
+        cluster and batch tensors agree on R."""
+        self.generation += 1
+        resources = _resource_union(nodes, bound_pods + list(pending_pods or []))
+        R = len(resources)
+        N = next_bucket(len(nodes), minimum=1)
+
+        node_index = {n.metadata.name: i for i, n in enumerate(nodes)}
+        # Pre-intern all labels so the key bucket covers everything.
+        node_label_ids = [self._label_ids(n.metadata.labels, {NODE_NAME_LABEL: n.metadata.name})
+                          for n in nodes]
+        epods = [p for p in bound_pods if p.spec.node_name in node_index]
+        epod_label_ids = [self._label_ids(p.metadata.labels) for p in epods]
+        # existing pods' required anti-affinity terms (symmetry veto) — compile
+        # before fixing K so their keys are covered by the bucket.
+        ea_terms: list[list] = []
+        for p in epods:
+            aff = p.spec.affinity
+            pan = aff.pod_anti_affinity if aff else None
+            terms = []
+            for t in (pan.required if pan else []):
+                valid, exprs = self._compile_selector(t.label_selector)
+                terms.append((self.keys.intern(t.topology_key), valid, exprs))
+            ea_terms.append(terms)
+        self._cluster_topo_keys = {k for ts in ea_terms for (k, _, _) in ts}
+        K = next_bucket(len(self.keys), minimum=1)
+
+        allocatable = np.zeros((N, R), np.int32)
+        requested = np.zeros((N, R), np.int32)
+        node_valid = np.zeros(N, bool)
+        unschedulable = np.zeros(N, bool)
+        node_labels = np.full((N, K), -1, np.int32)
+        T = next_bucket(max((len(n.spec.taints) for n in nodes), default=0))
+        taint_key = np.full((N, T), -1, np.int32)
+        taint_val = np.full((N, T), -1, np.int32)
+        taint_effect = np.full((N, T), -1, np.int32)
+        taint_valid = np.zeros((N, T), bool)
+
+        ports_per_node: list[list[tuple[str, str, int]]] = [[] for _ in range(N)]
+        for p in epods:
+            ni = node_index[p.spec.node_name]
+            for trip in p.host_ports():
+                ports_per_node[ni].append(trip)
+        PRT = next_bucket(max((len(x) for x in ports_per_node), default=0))
+        port_proto = np.full((N, PRT), -1, np.int32)
+        port_port = np.full((N, PRT), -1, np.int32)
+        port_ip = np.full((N, PRT), -1, np.int32)
+        port_valid = np.zeros((N, PRT), bool)
+
+        I = next_bucket(max((len(n.status.images) for n in nodes), default=0))
+        node_images = np.full((N, I), -1, np.int32)
+
+        for i, n in enumerate(nodes):
+            node_valid[i] = True
+            unschedulable[i] = n.spec.unschedulable
+            alloc = n.allocatable_canonical()
+            for r_idx, r in enumerate(resources):
+                if r in alloc:
+                    allocatable[i, r_idx] = min(scale_allocatable(r, alloc[r]), UNLIMITED)
+                elif r == "pods":
+                    allocatable[i, r_idx] = UNLIMITED
+            for kid, vid in node_label_ids[i].items():
+                node_labels[i, kid] = vid
+            for t_idx, t in enumerate(n.spec.taints):
+                taint_key[i, t_idx] = self.keys.intern(t.key)
+                taint_val[i, t_idx] = self.values.intern(t.value)
+                taint_effect[i, t_idx] = EFFECTC.get(t.effect, 0)
+                taint_valid[i, t_idx] = True
+            for img_idx, img in enumerate(n.status.images):
+                if img.names:
+                    node_images[i, img_idx] = self._intern_image(img.names[0], img.size_bytes)
+            for pt_idx, (ip, proto, port) in enumerate(ports_per_node[i]):
+                port_proto[i, pt_idx] = PROTOC.get(proto, 3)
+                port_port[i, pt_idx] = port
+                port_ip[i, pt_idx] = self.ips.intern(ip)
+                port_valid[i, pt_idx] = True
+
+        # Fold bound pods into requested[N,R].
+        for p in epods:
+            ni = node_index[p.spec.node_name]
+            reqs = p.resource_requests()
+            for r_idx, r in enumerate(resources):
+                if r in reqs:
+                    requested[ni, r_idx] += scale_request(r, reqs[r])
+
+        E = next_bucket(len(epods))
+        epod_node = np.full(E, -1, np.int32)
+        epod_ns = np.full(E, -1, np.int32)
+        epod_labels = np.full((E, K), -1, np.int32)
+        epod_valid = np.zeros(E, bool)
+        for e, p in enumerate(epods):
+            epod_node[e] = node_index[p.spec.node_name]
+            epod_ns[e] = self.namespaces.intern(p.metadata.namespace)
+            for kid, vid in epod_label_ids[e].items():
+                epod_labels[e, kid] = vid
+            epod_valid[e] = True
+
+        ET = next_bucket(max((len(t) for t in ea_terms), default=0))
+        EAX = next_bucket(max((len(ex) for ts in ea_terms for (_, _, ex) in ts), default=0))
+        EAV = next_bucket(max((len(v) for ts in ea_terms for (_, _, ex) in ts
+                               for (_, _, v, _) in ex), default=0))
+        ea_arrs = _selset_arrays((E, ET), EAX, EAV)
+        ea_topo = np.full((E, ET), -1, np.int32)
+        ea_valid = np.zeros((E, ET), bool)
+        for e, terms in enumerate(ea_terms):
+            for t_idx, (topo, valid, exprs) in enumerate(terms):
+                ea_topo[e, t_idx] = topo
+                ea_valid[e, t_idx] = True
+                _selset_fill(ea_arrs, (e, t_idx), valid, exprs)
+
+        V = next_bucket(len(self.values), minimum=1)
+        label_value_num = np.full(V, np.nan, np.float32)
+        nums = self.values.numeric_values()
+        label_value_num[:len(nums)] = np.asarray(nums, np.float32)
+
+        IMG = next_bucket(len(self._image_sizes), minimum=1)
+        image_sizes = np.zeros(IMG, np.float32)
+        image_sizes[:len(self._image_sizes)] = self._image_sizes
+
+        meta = SnapshotMeta(
+            keys=self.keys, values=self.values, namespaces=self.namespaces,
+            ips=self.ips, images=self.images, resources=resources,
+            node_names=[n.metadata.name for n in nodes], node_index=node_index,
+            topo_keys=tuple(sorted(self._cluster_topo_keys)),
+            generation=self.generation,
+        )
+        ct = ClusterTensors(
+            allocatable=allocatable, requested=requested, node_valid=node_valid,
+            unschedulable=unschedulable, node_labels=node_labels,
+            label_value_num=label_value_num,
+            taint_key=taint_key, taint_val=taint_val, taint_effect=taint_effect,
+            taint_valid=taint_valid,
+            port_proto=port_proto, port_port=port_port, port_ip=port_ip,
+            port_valid=port_valid,
+            node_images=node_images, image_sizes=image_sizes,
+            epod_node=epod_node, epod_ns=epod_ns, epod_labels=epod_labels,
+            epod_valid=epod_valid,
+            ea_sel=SelectorSet(**ea_arrs), ea_topo=ea_topo, ea_valid=ea_valid,
+        )
+        return ct, meta
+
+    # -- selector compilation ----------------------------------------------
+
+    def _compile_requirement(self, req: Requirement):
+        kid = self.keys.intern(req.key)
+        opc = OPC[req.operator]
+        vals = [self.values.intern(v) for v in req.values]
+        num = math.nan
+        if req.operator in (OP_GT, OP_LT) and req.values:
+            try:
+                num = float(int(req.values[0]))
+            except (TypeError, ValueError):
+                num = math.nan
+        return kid, opc, vals, num
+
+    def _compile_terms(self, term_weight_pairs: list[tuple[NodeSelectorTerm, float]],
+                       caps: tuple[int, int, int]):
+        """-> per-pod lists ready for array fill: [(weight, [exprs...])]."""
+        out = []
+        for term, weight in term_weight_pairs:
+            exprs = []
+            for e in term.match_expressions:
+                exprs.append(self._compile_requirement(e))
+            for e in term.match_fields:
+                # matchFields address node fields; metadata.name is the only
+                # field the reference supports. It rides the pseudo-label.
+                exprs.append(self._compile_requirement(
+                    Requirement(NODE_NAME_LABEL, e.operator, e.values)))
+            out.append((weight, exprs))
+        return out
+
+    def _compile_selector(self, sel: Optional[LabelSelector]):
+        """LabelSelector -> (valid, [compiled exprs]); None -> invalid
+        (nil matches nothing), empty -> valid with no exprs (matches all)."""
+        if sel is None:
+            return (False, [])
+        return (True, [self._compile_requirement(r) for r in sel.requirements()])
+
+    # -- pod side -----------------------------------------------------------
+
+    def encode_pods(self, pods: list[Pod], meta: SnapshotMeta) -> PodBatch:
+        P = next_bucket(len(pods), minimum=1)
+        R = len(meta.resources)
+        meta.pod_keys = [p.key for p in pods]
+
+        # First pass: compile everything host-side, find bucket sizes.
+        compiled = []
+        for p in pods:
+            aff = p.spec.affinity
+            na = aff.node_affinity if aff else None
+            req_pairs = [(t, 1.0) for t in (na.required if na else [])]
+            pref_pairs = [(t.preference, float(t.weight)) for t in (na.preferred if na else [])]
+            req_terms = self._compile_terms(req_pairs, (0, 0, 0))
+            pref_terms = self._compile_terms(pref_pairs, (0, 0, 0))
+            sel = [(self.keys.intern(k), self.values.intern(v))
+                   for k, v in sorted(p.spec.node_selector.items())]
+            tols = []
+            for t in p.spec.tolerations:
+                tols.append((
+                    self.keys.intern(t.key) if t.key else -1,
+                    TOLOPC_EXISTS if t.operator == TOL_OP_EXISTS else TOLOPC_EQUAL,
+                    self.values.intern(t.value) if t.value else self.values.intern(""),
+                    EFFECTC[t.effect] if t.effect else -1,
+                ))
+            ports = [(PROTOC.get(proto, 3), port, self.ips.intern(ip))
+                     for (ip, proto, port) in p.host_ports()]
+            images = []
+            for c in p.spec.containers:
+                if c.image:
+                    images.append(self._intern_image(c.image))
+            pa = aff.pod_affinity if aff else None
+            pan = aff.pod_anti_affinity if aff else None
+            own_ns = self.namespaces.intern(p.metadata.namespace)
+
+            def _pod_terms(terms):
+                out = []
+                for t in terms:
+                    valid, exprs = self._compile_selector(t.label_selector)
+                    out.append((self.keys.intern(t.topology_key), valid, exprs))
+                return out
+
+            aff_req = _pod_terms(pa.required if pa else [])
+            anti_req = _pod_terms(pan.required if pan else [])
+            paff = []
+            for wt in (pa.preferred if pa else []):
+                kid = self.keys.intern(wt.term.topology_key)
+                valid, exprs = self._compile_selector(wt.term.label_selector)
+                paff.append((kid, valid, exprs, float(wt.weight)))
+            for wt in (pan.preferred if pan else []):
+                kid = self.keys.intern(wt.term.topology_key)
+                valid, exprs = self._compile_selector(wt.term.label_selector)
+                paff.append((kid, valid, exprs, -float(wt.weight)))
+            spreads = []
+            for sc in p.spec.topology_spread_constraints:
+                valid, exprs = self._compile_selector(sc.label_selector)
+                spreads.append((self.keys.intern(sc.topology_key), valid, exprs,
+                                int(sc.max_skew),
+                                sc.when_unsatisfiable == "DoNotSchedule"))
+            labels = self._label_ids(p.metadata.labels)
+            compiled.append(dict(
+                pod=p, req_terms=req_terms, pref_terms=pref_terms, sel=sel,
+                tols=tols, ports=ports, images=images, labels=labels, ns=own_ns,
+                aff_req=aff_req, anti_req=anti_req, paff=paff, spreads=spreads,
+            ))
+
+        K = next_bucket(len(self.keys), minimum=1)
+
+        def _bucket(fn, minimum=0):
+            return next_bucket(max((fn(c) for c in compiled), default=0), minimum=minimum)
+
+        TREQ = _bucket(lambda c: len(c["req_terms"]))
+        TPREF = _bucket(lambda c: len(c["pref_terms"]))
+        X = _bucket(lambda c: max((len(e) for _, e in c["req_terms"] + c["pref_terms"]),
+                                  default=0))
+        VV = _bucket(lambda c: max((len(v) for _, ex in c["req_terms"] + c["pref_terms"]
+                                    for (_, _, v, _) in ex), default=0))
+        S = _bucket(lambda c: len(c["sel"]))
+        TOL = _bucket(lambda c: len(c["tols"]))
+        PP = _bucket(lambda c: len(c["ports"]))
+        CI = _bucket(lambda c: len(c["images"]))
+        AT = _bucket(lambda c: len(c["aff_req"]))
+        BT = _bucket(lambda c: len(c["anti_req"]))
+        CT = _bucket(lambda c: len(c["paff"]))
+        SC = _bucket(lambda c: len(c["spreads"]))
+        AX = _bucket(lambda c: max((len(e) for (_, _, e) in c["aff_req"] + c["anti_req"]), default=0))
+        AX = max(AX, _bucket(lambda c: max((len(e) for (_, _, e, _) in c["paff"]), default=0)))
+        AX = max(AX, _bucket(lambda c: max((len(e) for (_, _, e, _, _) in c["spreads"]), default=0)))
+        AV = _bucket(lambda c: max((len(v) for (_, _, e) in c["aff_req"] + c["anti_req"]
+                                    for (_, _, v, _) in e), default=0))
+        AV = max(AV, _bucket(lambda c: max((len(v) for (_, _, e, _) in c["paff"]
+                                            for (_, _, v, _) in e), default=0)))
+        AV = max(AV, _bucket(lambda c: max((len(v) for (_, _, e, _, _) in c["spreads"]
+                                            for (_, _, v, _) in e), default=0)))
+
+        def _new_termset(T):
+            return dict(
+                key=np.full((P, T, X), -1, np.int32),
+                op=np.zeros((P, T, X), np.int32),
+                vals=np.full((P, T, X, VV), -1, np.int32),
+                num=np.full((P, T, X), np.nan, np.float32),
+                expr_valid=np.zeros((P, T, X), bool),
+                term_valid=np.zeros((P, T), bool),
+                weight=np.zeros((P, T), np.float32),
+                has_any=np.zeros(P, bool),
+            )
+
+        req_a = _new_termset(TREQ)
+        pref_a = _new_termset(TPREF)
+
+        def _fill_terms(arrs, p_idx, terms):
+            arrs["has_any"][p_idx] = len(terms) > 0
+            for t_idx, (weight, exprs) in enumerate(terms):
+                arrs["term_valid"][p_idx, t_idx] = True
+                arrs["weight"][p_idx, t_idx] = weight
+                for x_idx, (kid, opc, vals, num) in enumerate(exprs):
+                    arrs["key"][p_idx, t_idx, x_idx] = kid
+                    arrs["op"][p_idx, t_idx, x_idx] = opc
+                    arrs["num"][p_idx, t_idx, x_idx] = num
+                    arrs["expr_valid"][p_idx, t_idx, x_idx] = True
+                    for v_idx, v in enumerate(vals):
+                        arrs["vals"][p_idx, t_idx, x_idx, v_idx] = v
+
+        def _new_selset(shape_prefix):
+            return _selset_arrays(shape_prefix, AX, AV)
+
+        _fill_sel = _selset_fill
+
+        requests = np.zeros((P, R), np.int32)
+        pod_valid = np.zeros(P, bool)
+        priority = np.zeros(P, np.int32)
+        forced_node = np.full(P, -1, np.int32)
+        pod_ns = np.full(P, -1, np.int32)
+        pod_labels = np.full((P, K), -1, np.int32)
+        tol_key = np.full((P, TOL), -1, np.int32)
+        tol_op = np.zeros((P, TOL), np.int32)
+        tol_val = np.full((P, TOL), -1, np.int32)
+        tol_effect = np.full((P, TOL), -1, np.int32)
+        tol_valid = np.zeros((P, TOL), bool)
+        sel_key = np.full((P, S), -1, np.int32)
+        sel_val = np.full((P, S), -1, np.int32)
+        sel_valid = np.zeros((P, S), bool)
+        pport_proto = np.full((P, PP), -1, np.int32)
+        pport_port = np.full((P, PP), -1, np.int32)
+        pport_ip = np.full((P, PP), -1, np.int32)
+        pport_valid = np.zeros((P, PP), bool)
+        pod_images = np.full((P, CI), -1, np.int32)
+        image_bytes = np.zeros(P, np.float32)
+        aff_sel = _new_selset((P, AT))
+        aff_topo = np.full((P, AT), -1, np.int32)
+        aff_valid = np.zeros((P, AT), bool)
+        anti_sel = _new_selset((P, BT))
+        anti_topo = np.full((P, BT), -1, np.int32)
+        anti_valid = np.zeros((P, BT), bool)
+        paff_sel = _new_selset((P, CT))
+        paff_topo = np.full((P, CT), -1, np.int32)
+        paff_weight = np.zeros((P, CT), np.float32)
+        paff_valid = np.zeros((P, CT), bool)
+        sc_sel = _new_selset((P, SC))
+        sc_topo = np.full((P, SC), -1, np.int32)
+        sc_maxskew = np.ones((P, SC), np.int32)
+        sc_hard = np.zeros((P, SC), bool)
+        sc_valid = np.zeros((P, SC), bool)
+
+        for i, c in enumerate(compiled):
+            p: Pod = c["pod"]
+            pod_valid[i] = True
+            priority[i] = p.spec.priority
+            pod_ns[i] = c["ns"]
+            if p.spec.node_name:
+                forced_node[i] = meta.node_index.get(p.spec.node_name, -2)
+            reqs = p.resource_requests()
+            for r_idx, r in enumerate(meta.resources):
+                if r in reqs:
+                    requests[i, r_idx] = scale_request(r, reqs[r])
+            for kid, vid in c["labels"].items():
+                pod_labels[i, kid] = vid
+            for t_idx, (kid, opc, vid, eff) in enumerate(c["tols"]):
+                tol_key[i, t_idx] = kid
+                tol_op[i, t_idx] = opc
+                tol_val[i, t_idx] = vid
+                tol_effect[i, t_idx] = eff
+                tol_valid[i, t_idx] = True
+            for s_idx, (kid, vid) in enumerate(c["sel"]):
+                sel_key[i, s_idx] = kid
+                sel_val[i, s_idx] = vid
+                sel_valid[i, s_idx] = True
+            _fill_terms(req_a, i, c["req_terms"])
+            _fill_terms(pref_a, i, c["pref_terms"])
+            for pt_idx, (proto, port, ip) in enumerate(c["ports"]):
+                pport_proto[i, pt_idx] = proto
+                pport_port[i, pt_idx] = port
+                pport_ip[i, pt_idx] = ip
+                pport_valid[i, pt_idx] = True
+            for ci_idx, img in enumerate(c["images"]):
+                pod_images[i, ci_idx] = img
+                image_bytes[i] += self._image_sizes[img]
+            for a_idx, (topo, valid, exprs) in enumerate(c["aff_req"]):
+                aff_topo[i, a_idx] = topo
+                aff_valid[i, a_idx] = True
+                _fill_sel(aff_sel, (i, a_idx), valid, exprs)
+            for a_idx, (topo, valid, exprs) in enumerate(c["anti_req"]):
+                anti_topo[i, a_idx] = topo
+                anti_valid[i, a_idx] = True
+                _fill_sel(anti_sel, (i, a_idx), valid, exprs)
+            for a_idx, (topo, valid, exprs, w) in enumerate(c["paff"]):
+                paff_topo[i, a_idx] = topo
+                paff_weight[i, a_idx] = w
+                paff_valid[i, a_idx] = True
+                _fill_sel(paff_sel, (i, a_idx), valid, exprs)
+            for a_idx, (topo, valid, exprs, skew, hard) in enumerate(c["spreads"]):
+                sc_topo[i, a_idx] = topo
+                sc_maxskew[i, a_idx] = skew
+                sc_hard[i, a_idx] = hard
+                sc_valid[i, a_idx] = True
+                _fill_sel(sc_sel, (i, a_idx), valid, exprs)
+
+        batch_topo = {int(k) for k in np.concatenate([
+            aff_topo[aff_valid], anti_topo[anti_valid],
+            paff_topo[paff_valid], sc_topo[sc_valid]]).tolist()} if P else set()
+        meta.topo_keys = tuple(sorted(set(meta.topo_keys) | batch_topo))
+
+        return PodBatch(
+            requests=requests, pod_valid=pod_valid, priority=priority,
+            forced_node=forced_node, pod_ns=pod_ns, pod_labels=pod_labels,
+            tol_key=tol_key, tol_op=tol_op, tol_val=tol_val, tol_effect=tol_effect,
+            tol_valid=tol_valid,
+            sel_key=sel_key, sel_val=sel_val, sel_valid=sel_valid,
+            req_terms=TermSet(**req_a), pref_terms=TermSet(**pref_a),
+            port_proto=pport_proto, port_port=pport_port, port_ip=pport_ip,
+            port_valid=pport_valid,
+            pod_images=pod_images, image_bytes=image_bytes,
+            aff_sel=SelectorSet(**aff_sel), aff_topo=aff_topo, aff_valid=aff_valid,
+            anti_sel=SelectorSet(**anti_sel), anti_topo=anti_topo, anti_valid=anti_valid,
+            paff_sel=SelectorSet(**paff_sel), paff_topo=paff_topo,
+            paff_weight=paff_weight, paff_valid=paff_valid,
+            sc_sel=SelectorSet(**sc_sel), sc_topo=sc_topo, sc_maxskew=sc_maxskew,
+            sc_hard=sc_hard, sc_valid=sc_valid,
+        )

@@ -1,0 +1,70 @@
+"""The flagship jitted program: one scheduling step for a batch of P pods.
+
+This is the inversion of the reference's hot path (SURVEY §3.1): where
+``schedule_one.go`` runs pop -> PreFilter -> 16-goroutine Filter loop ->
+Score loop -> NormalizeScore -> selectHost *per pod*, here the whole
+Filter/Score/Normalize/Select pipeline is a single XLA program over the
+[P, N] batch:
+
+    feasible[P,N] = AND of plugin masks        (ops/filters.py, ops/topology.py)
+    scores[P,N]   = sum_w w * normalize(raw)   (ops/scores.py)
+    choice[P]     = argmax + seeded tie-break
+
+Gang conflict resolution (capacity, anti-affinity among batch members) lives
+in models/gang.py and calls back into this step between rounds.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from kubernetes_tpu.encode.snapshot import ClusterTensors, PodBatch
+from kubernetes_tpu.ops import topology
+from kubernetes_tpu.ops.filters import run_filters
+from kubernetes_tpu.ops.scores import combined_score, select_host
+
+
+class StepResult(struct.PyTreeNode):
+    choice: jnp.ndarray     # [P] int32 node index (valid only where assigned)
+    assigned: jnp.ndarray   # [P] bool
+    feasible: jnp.ndarray   # [P,N] bool
+    scores: jnp.ndarray     # [P,N] float32 (-inf infeasible)
+
+
+def evaluate(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
+             weights=None, fit_strategy: str = "LeastAllocated",
+             topo_keys: tuple[int, ...] = ()) -> StepResult:
+    """Filter + score + select for the whole batch, assuming an EMPTY batch
+    context (no intra-batch interactions — gang.py supplies those).
+
+    ``topo_keys``: static tuple of distinct topology key-ids in play
+    (meta.topo_keys) — unrolls into a handful of [N,N] domain matmuls."""
+    feasible = run_filters(ct, pb)
+    feasible &= topology.spread_mask(ct, pb, topo_keys)
+    feasible &= topology.interpod_required_mask(ct, pb, topo_keys)
+    feasible &= topology.interpod_symmetry_mask(ct, pb, topo_keys)
+    extra = {
+        "PodTopologySpread": (topology.spread_score_raw(ct, pb, topo_keys),
+                              "default_reverse"),
+        "InterPodAffinity": (topology.interpod_score_raw(ct, pb, topo_keys),
+                             "minmax"),
+    }
+    scores = combined_score(ct, pb, feasible, weights=weights, extra_raw=extra,
+                            fit_strategy=fit_strategy)
+    choice, has = select_host(scores, seed=seed)
+    return StepResult(choice=choice.astype(jnp.int32),
+                      assigned=has & jnp.any(feasible, axis=-1),
+                      feasible=feasible, scores=scores)
+
+
+@partial(jax.jit, static_argnames=("seed", "fit_strategy", "topo_keys"))
+def schedule_step(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
+                  fit_strategy: str = "LeastAllocated",
+                  topo_keys: tuple[int, ...] = ()) -> StepResult:
+    """Jitted single-shot evaluate (default weights)."""
+    return evaluate(ct, pb, seed=seed, fit_strategy=fit_strategy,
+                    topo_keys=topo_keys)

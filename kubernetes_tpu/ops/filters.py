@@ -1,0 +1,133 @@
+"""Feasibility masks — the in-tree Filter plugins as boolean tensor terms.
+
+Reference semantics, plugin by plugin (pkg/scheduler/framework/plugins/):
+  NodeUnschedulable  nodeunschedulable/node_unschedulable.go
+  NodeName           nodename/node_name.go
+  NodeResourcesFit   noderesources/fit.go
+  TaintToleration    tainttoleration/taint_toleration.go
+  NodeAffinity       nodeaffinity/node_affinity.go (+ nodeSelector)
+  NodePorts          nodeports/node_ports.go
+
+Each term is a pure function (ClusterTensors, PodBatch) -> mask [P,N] bool;
+`run_filters` ANDs them. The Go scheduler short-circuits per node inside 16
+goroutines (framework/parallelize); here every (pod, node) pair evaluates in
+one fused XLA program — the "hot loop #1" of SURVEY §3.1 with the loop axis
+turned into a tensor axis.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.encode.snapshot import (
+    EMPTY_VALUE_ID,
+    TOLOPC_EXISTS,
+    UNSCHED_TAINT_KEY_ID,
+    ClusterTensors,
+    PodBatch,
+)
+from kubernetes_tpu.ops.exprs import eval_term_set, gather_values
+
+
+def fit_mask(ct: ClusterTensors, pb: PodBatch):
+    """NodeResourcesFit: requests fit into allocatable - requested, per resource."""
+    free = ct.allocatable - ct.requested              # [N,R]
+    return jnp.all(pb.requests[:, None, :] <= free[None, :, :], axis=-1)
+
+
+def node_name_mask(ct: ClusterTensors, pb: PodBatch):
+    """NodeName: spec.nodeName equality (forced_node -2 = named node unknown)."""
+    N = ct.node_valid.shape[0]
+    forced = pb.forced_node
+    return (forced == -1)[:, None] | (forced[:, None] == jnp.arange(N)[None, :])
+
+
+def _tolerated_any(pb: PodBatch, taint_key, taint_val, taint_effect):
+    """[P, *taint_shape] — any toleration of the pod tolerates each taint.
+
+    Reference: v1.Toleration.ToleratesTaint. Toleration arrays are [P,TOL];
+    taints broadcast with shape [*taint_shape].
+    """
+    tshape = (1,) * taint_key.ndim
+    tol_key = pb.tol_key.reshape(pb.tol_key.shape + tshape)          # [P,TOL,1*]
+    tol_op = pb.tol_op.reshape(tol_key.shape)
+    tol_val = pb.tol_val.reshape(tol_key.shape)
+    tol_effect = pb.tol_effect.reshape(tol_key.shape)
+    tol_valid = pb.tol_valid.reshape(tol_key.shape)
+    tk = taint_key[None, None]
+    key_ok = (tol_key == -1) | (tol_key == tk)
+    effect_ok = (tol_effect == -1) | (tol_effect == taint_effect[None, None])
+    value_ok = (tol_op == TOLOPC_EXISTS) | (tol_val == taint_val[None, None])
+    return jnp.any(tol_valid & key_ok & effect_ok & value_ok, axis=1)  # [P,*taint]
+
+
+def taint_toleration_mask(ct: ClusterTensors, pb: PodBatch):
+    """TaintToleration filter: every NoSchedule/NoExecute taint must be tolerated."""
+    tol = _tolerated_any(pb, ct.taint_key, ct.taint_val, ct.taint_effect)  # [P,N,T]
+    hard = ct.taint_valid & ((ct.taint_effect == 0) | (ct.taint_effect == 2))
+    return jnp.all(~hard[None] | tol, axis=-1)
+
+
+def untolerated_prefer_count(ct: ClusterTensors, pb: PodBatch):
+    """TaintToleration score input: # of intolerable PreferNoSchedule taints [P,N]."""
+    tol = _tolerated_any(pb, ct.taint_key, ct.taint_val, ct.taint_effect)
+    soft = ct.taint_valid & (ct.taint_effect == 1)
+    return jnp.sum(soft[None] & ~tol, axis=-1).astype(jnp.float32)
+
+
+def unschedulable_mask(ct: ClusterTensors, pb: PodBatch):
+    """NodeUnschedulable: .spec.unschedulable fails unless the pod tolerates the
+    synthetic node.kubernetes.io/unschedulable:NoSchedule taint."""
+    key = jnp.full((1,), UNSCHED_TAINT_KEY_ID, jnp.int32)
+    val = jnp.full((1,), EMPTY_VALUE_ID, jnp.int32)
+    eff = jnp.zeros((1,), jnp.int32)  # NoSchedule
+    tol = _tolerated_any(pb, key, val, eff)[:, 0]  # [P]
+    return ~ct.unschedulable[None, :] | tol[:, None]
+
+
+def node_affinity_mask(ct: ClusterTensors, pb: PodBatch):
+    """NodeAffinity required terms AND spec.nodeSelector (both must hold)."""
+    # nodeSelector: AND of exact-match requirements.
+    v = gather_values(ct.node_labels, pb.sel_key)          # [N,P,S]
+    sel_ok = (v == pb.sel_val[None]) | ~pb.sel_valid[None]
+    sel_ok = jnp.all(sel_ok, axis=-1)                      # [N,P]
+    # required affinity: OR over terms.
+    term = eval_term_set(pb.req_terms, ct.node_labels, ct.label_value_num)  # [N,P,T]
+    req_ok = jnp.any(term, axis=-1) | ~pb.req_terms.has_any[None]           # [N,P]
+    return (sel_ok & req_ok).T
+
+
+def node_ports_mask(ct: ClusterTensors, pb: PodBatch):
+    """NodePorts: no (protocol, port, ip) conflict with ports already in use.
+    0.0.0.0 (ip id 0) conflicts with every ip."""
+    pp = pb.port_port[:, :, None, None]     # [P,PP,1,1]
+    np_ = ct.port_port[None, None]          # [1,1,N,PRT]
+    port_eq = pp == np_
+    proto_eq = pb.port_proto[:, :, None, None] == ct.port_proto[None, None]
+    pip = pb.port_ip[:, :, None, None]
+    nip = ct.port_ip[None, None]
+    ip_clash = (pip == nip) | (pip == 0) | (nip == 0)
+    valid = pb.port_valid[:, :, None, None] & ct.port_valid[None, None]
+    conflict = jnp.any(valid & port_eq & proto_eq & ip_clash, axis=(1, 3))  # [P,N]
+    return ~conflict
+
+
+# Ordered registry: name -> mask fn. Relational filters (PodTopologySpread,
+# InterPodAffinity) live in ops/topology.py and join in models/schedule_step.
+FILTERS = {
+    "NodeUnschedulable": unschedulable_mask,
+    "NodeName": node_name_mask,
+    "NodeResourcesFit": fit_mask,
+    "NodeAffinity": node_affinity_mask,
+    "TaintToleration": taint_toleration_mask,
+    "NodePorts": node_ports_mask,
+}
+
+
+def run_filters(ct: ClusterTensors, pb: PodBatch, enabled=None):
+    """AND of all enabled filter masks, plus validity gates. -> [P,N] bool."""
+    mask = pb.pod_valid[:, None] & ct.node_valid[None, :]
+    for name, fn in FILTERS.items():
+        if enabled is None or name in enabled:
+            mask = mask & fn(ct, pb)
+    return mask

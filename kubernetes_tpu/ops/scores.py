@@ -1,0 +1,210 @@
+"""Score terms — the in-tree Score plugins as additive [P,N] float tensors.
+
+Reference semantics (pkg/scheduler/framework/plugins/):
+  NodeResourcesFit/LeastAllocated   noderesources/least_allocated.go
+  NodeResourcesBalancedAllocation   noderesources/balanced_allocation.go
+  ImageLocality                     imagelocality/image_locality.go
+  NodeAffinity (preferred)          nodeaffinity/node_affinity.go Score
+  TaintToleration (PreferNoSchedule) tainttoleration/taint_toleration.go
+
+The Go framework runs Score per (plugin, node) in goroutines, then
+NormalizeScore per plugin, then multiplies by plugin weight and sums
+(framework/runtime/framework.go RunScorePlugins). Here each plugin is one
+broadcasted tensor expression producing raw [P,N]; normalization is a
+max/min reduction over the node axis (the lax.psum/pmax point when the node
+axis is sharded); the weighted sum is a single fused combine.
+
+All normalize helpers mask infeasible nodes out of the reductions the same
+way the reference only scores feasible nodes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.encode.snapshot import ClusterTensors, PodBatch
+from kubernetes_tpu.ops.exprs import eval_term_set
+from kubernetes_tpu.ops.filters import untolerated_prefer_count
+
+MAX_NODE_SCORE = 100.0
+
+# ImageLocality constants (image_locality.go).
+_MB = 1024.0 * 1024.0
+IMG_MIN_THRESHOLD = 23.0 * _MB
+IMG_MAX_CONTAINER_THRESHOLD = 1000.0 * _MB
+
+# Reference default plugin weights (default_plugins.go).
+DEFAULT_WEIGHTS = {
+    "NodeResourcesFit": 1.0,
+    "NodeResourcesBalancedAllocation": 1.0,
+    "ImageLocality": 1.0,
+    "NodeAffinity": 2.0,
+    "TaintToleration": 3.0,
+    "PodTopologySpread": 2.0,
+    "InterPodAffinity": 2.0,
+}
+
+
+def _cpu_mem_fractions(ct: ClusterTensors, pb: PodBatch):
+    """Utilization fraction (requested+pod)/allocatable for cpu & memory -> [P,N,2].
+
+    Resource axis positions 0,1 are always cpu,memory (encoder fixes the
+    order). UNLIMITED/zero allocatable scores as fraction 0 (or 1 when the pod
+    actually requests it), matching the oracle.
+    """
+    from kubernetes_tpu.encode.scaling import UNLIMITED
+    alloc = ct.allocatable[None, :, :2].astype(jnp.float32)        # [1,N,2]
+    used = (ct.requested[None, :, :2] + pb.requests[:, None, :2]).astype(jnp.float32)
+    frac = used / jnp.maximum(alloc, 1.0)
+    degenerate = (ct.allocatable[None, :, :2] <= 0) | (ct.allocatable[None, :, :2] >= UNLIMITED)
+    requests_it = pb.requests[:, None, :2] > 0
+    frac = jnp.where(degenerate, jnp.where(requests_it, 1.0, 0.0), frac)
+    return jnp.clip(frac, 0.0, 1.0)
+
+
+def least_allocated(ct: ClusterTensors, pb: PodBatch):
+    """mean over {cpu, memory} of 100 * (1 - fraction)."""
+    frac = _cpu_mem_fractions(ct, pb)
+    return jnp.mean(MAX_NODE_SCORE * (1.0 - frac), axis=-1)
+
+
+def most_allocated(ct: ClusterTensors, pb: PodBatch):
+    """MostAllocated strategy (bin-packing): mean of 100 * fraction."""
+    frac = _cpu_mem_fractions(ct, pb)
+    return jnp.mean(MAX_NODE_SCORE * frac, axis=-1)
+
+
+def requested_to_capacity_ratio(ct: ClusterTensors, pb: PodBatch,
+                                shape_x=(0.0, 1.0), shape_y=(0.0, 10.0)):
+    """RequestedToCapacityRatio strategy: piecewise-linear bin-packing curve
+    over utilization (requested_to_capacity_ratio.go). Default shape maps
+    utilization 0->0, 1->10 (scaled to 0-100)."""
+    frac = jnp.mean(_cpu_mem_fractions(ct, pb), axis=-1)
+    x0, x1 = shape_x
+    y0, y1 = shape_y
+    t = jnp.clip((frac - x0) / jnp.maximum(x1 - x0, 1e-9), 0.0, 1.0)
+    return (y0 + t * (y1 - y0)) * (MAX_NODE_SCORE / max(y1, y0, 1e-9))
+
+
+def balanced_allocation(ct: ClusterTensors, pb: PodBatch):
+    """100 * (1 - std(fractions)) over {cpu, memory}."""
+    frac = _cpu_mem_fractions(ct, pb)
+    mean = jnp.mean(frac, axis=-1, keepdims=True)
+    std = jnp.sqrt(jnp.mean((frac - mean) ** 2, axis=-1))
+    return MAX_NODE_SCORE * (1.0 - std)
+
+
+def image_locality(ct: ClusterTensors, pb: PodBatch):
+    """Threshold ramp over summed scaled sizes of pod images present on node.
+
+    scaled size = size_bytes * (#nodes with image / #nodes).
+    """
+    CI = pb.pod_images.shape[1]
+    if CI == 0 or ct.node_images.shape[1] == 0:
+        return jnp.zeros(pb.pod_valid.shape + ct.node_valid.shape, jnp.float32)
+    # present[n, img_table] via scatter-free compare: [N,I] vs pod [P,CI]
+    pod_img = pb.pod_images[:, :, None, None]              # [P,CI,1,1]
+    node_img = ct.node_images[None, None, :, :]            # [1,1,N,I]
+    present = jnp.any((pod_img == node_img) & (pod_img >= 0), axis=-1)  # [P,CI,N]
+    # spread factor: #nodes having each pod image / total valid nodes
+    per_node = jnp.any((pod_img == node_img) & (pod_img >= 0), axis=-1)  # [P,CI,N]
+    num_with = jnp.sum(per_node & ct.node_valid[None, None, :], axis=-1,
+                       keepdims=True).astype(jnp.float32)               # [P,CI,1]
+    total = jnp.maximum(jnp.sum(ct.node_valid).astype(jnp.float32), 1.0)
+    IMG = ct.image_sizes.shape[0]
+    sizes = ct.image_sizes[jnp.clip(pb.pod_images, 0, max(IMG - 1, 0))]  # [P,CI]
+    sizes = jnp.where(pb.pod_images >= 0, sizes, 0.0)
+    ssum = jnp.sum(present * sizes[:, :, None] * (num_with / total), axis=1)  # [P,N]
+    n_images = jnp.sum(pb.pod_images >= 0, axis=1).astype(jnp.float32)   # [P]
+    max_thr = IMG_MAX_CONTAINER_THRESHOLD * jnp.maximum(n_images, 1.0)
+    val = (ssum - IMG_MIN_THRESHOLD) / (max_thr[:, None] - IMG_MIN_THRESHOLD)
+    return jnp.clip(val, 0.0, 1.0) * MAX_NODE_SCORE
+
+
+def node_affinity_preferred_raw(ct: ClusterTensors, pb: PodBatch):
+    """Raw sum of matching preferred-term weights [P,N] (normalized later)."""
+    term = eval_term_set(pb.pref_terms, ct.node_labels, ct.label_value_num)  # [N,P,T]
+    return jnp.sum(jnp.where(term, pb.pref_terms.weight[None], 0.0), axis=-1).T
+
+
+def taint_toleration_raw(ct: ClusterTensors, pb: PodBatch):
+    """Raw count of intolerable PreferNoSchedule taints [P,N] (reverse-normalized)."""
+    return untolerated_prefer_count(ct, pb)
+
+
+def default_normalize(raw, feasible, reverse: bool):
+    """helper.DefaultNormalizeScore over the node axis, feasible nodes only."""
+    masked = jnp.where(feasible, raw, 0.0)
+    mx = jnp.max(masked, axis=-1, keepdims=True)
+    safe = jnp.maximum(mx, 1e-9)
+    s = raw * MAX_NODE_SCORE / safe
+    s = jnp.where(mx > 0, s, jnp.where(reverse, 0.0, 0.0))
+    out = MAX_NODE_SCORE - s if reverse else s
+    # max==0: reference gives all-100 when reversed, all-0 otherwise.
+    return jnp.where(mx > 0, out, MAX_NODE_SCORE if reverse else 0.0)
+
+
+def minmax_normalize(raw, feasible):
+    """InterPodAffinity-style min-max normalize to 0-100 over feasible nodes."""
+    big = jnp.float32(3.4e38)
+    mn = jnp.min(jnp.where(feasible, raw, big), axis=-1, keepdims=True)
+    mx = jnp.max(jnp.where(feasible, raw, -big), axis=-1, keepdims=True)
+    diff = mx - mn
+    out = (raw - mn) * MAX_NODE_SCORE / jnp.maximum(diff, 1e-9)
+    return jnp.where(diff > 0, out, 0.0)
+
+
+def combined_score(ct: ClusterTensors, pb: PodBatch, feasible, weights=None,
+                   extra_raw=None, fit_strategy: str = "LeastAllocated"):
+    """Weighted sum of normalized plugin scores [P,N]; -inf on infeasible.
+
+    ``extra_raw``: dict name -> (raw [P,N], normalize_kind) for relational
+    plugins computed elsewhere (spread / inter-pod affinity), where
+    normalize_kind in {"default", "default_reverse", "minmax"}.
+    """
+    w = dict(DEFAULT_WEIGHTS)
+    if weights:
+        w.update(weights)
+    fit_fn = {"LeastAllocated": least_allocated, "MostAllocated": most_allocated,
+              "RequestedToCapacityRatio": requested_to_capacity_ratio}[fit_strategy]
+    total = jnp.zeros(feasible.shape, jnp.float32)
+    if w.get("NodeResourcesFit"):
+        total += w["NodeResourcesFit"] * fit_fn(ct, pb)
+    if w.get("NodeResourcesBalancedAllocation"):
+        total += w["NodeResourcesBalancedAllocation"] * balanced_allocation(ct, pb)
+    if w.get("ImageLocality"):
+        total += w["ImageLocality"] * image_locality(ct, pb)
+    if w.get("NodeAffinity"):
+        raw = node_affinity_preferred_raw(ct, pb)
+        total += w["NodeAffinity"] * default_normalize(raw, feasible, reverse=False)
+    if w.get("TaintToleration"):
+        raw = taint_toleration_raw(ct, pb)
+        total += w["TaintToleration"] * default_normalize(raw, feasible, reverse=True)
+    for name, (raw, kind) in (extra_raw or {}).items():
+        if not w.get(name):
+            continue
+        if kind == "default":
+            s = default_normalize(raw, feasible, reverse=False)
+        elif kind == "default_reverse":
+            s = default_normalize(raw, feasible, reverse=True)
+        else:
+            s = minmax_normalize(raw, feasible)
+        total += w[name] * s
+    return jnp.where(feasible, total, -jnp.inf)
+
+
+def select_host(scores, seed: int = 0):
+    """argmax with seeded deterministic tie-break -> (node idx [P], has_node [P]).
+
+    Matches oracle.tie_break: among max-score nodes pick min of
+    ((n * 2654435761) ^ seed) & 0x3fffffff.
+    """
+    N = scores.shape[-1]
+    has = jnp.any(jnp.isfinite(scores), axis=-1)
+    best = jnp.max(scores, axis=-1, keepdims=True)
+    is_best = scores == best
+    tb = ((jnp.arange(N, dtype=jnp.uint32) * jnp.uint32(2654435761))
+          ^ jnp.uint32(seed)) & jnp.uint32(0x3FFFFFFF)
+    key = jnp.where(is_best, tb[None, :].astype(jnp.int32), jnp.int32(0x7FFFFFFF))
+    choice = jnp.argmin(key, axis=-1)
+    return choice, has

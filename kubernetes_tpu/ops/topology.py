@@ -1,0 +1,162 @@
+"""Relational plugins: PodTopologySpread and InterPodAffinity as MXU matmuls.
+
+Reference semantics:
+  PodTopologySpread  podtopologyspread/{common,filtering,scoring}.go
+  InterPodAffinity   interpodaffinity/{filtering,scoring}.go (incl. the
+                     existing-pod anti-affinity *symmetry* veto)
+
+The reference precomputes per-domain pod counts in PreFilter with pods x nodes
+Go loops. The TPU design factors the counting into one-hot matmuls:
+
+    match[E,P,T]   selector match of each term against existing pods
+    cnt_pn[P,T,N]  = match x onehot(epod_node)        (contraction over E)
+    cnt_dom[P,T,N] = cnt_pn x same_domain_k[N,N]      (contraction over N)
+
+same_domain_k is per *distinct topology key* (zone, hostname, ...), a static
+Python tuple at trace time — there are only ever a handful, so the loop
+unrolls into a few [N,N] matmuls that XLA tiles onto the systolic array.
+
+Namespace semantics: terms currently apply to the incoming pod's own
+namespace (explicit ``namespaces`` lists are honored by the oracle but not yet
+encoded tensor-side — TODO round 2).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.encode.snapshot import ClusterTensors, PodBatch
+from kubernetes_tpu.ops.exprs import eval_selector_set
+
+
+def _term_match_epods(ct: ClusterTensors, sel, pod_ns):
+    """Selector match per (existing pod, pod, term) incl. namespace + validity.
+    sel: SelectorSet with leading dims [P,T]. -> [E,P,T] float32."""
+    m = eval_selector_set(sel, ct.epod_labels)               # [E,P,T]
+    ns_ok = ct.epod_ns[:, None] == pod_ns[None, :]           # [E,P]
+    return (m & ns_ok[:, :, None] & ct.epod_valid[:, None, None]).astype(jnp.float32)
+
+
+def _domain_counts(ct: ClusterTensors, match_ept, term_topo, topo_keys):
+    """-> (cnt_dom [P,T,N] f32, node_has_key [P,T,N] bool).
+
+    cnt_dom[p,t,n] = # existing pods matching term (p,t) whose node shares
+    node n's domain for the term's topology key. Nodes lacking the key have
+    has_key False and count 0.
+    """
+    N = ct.node_valid.shape[0]
+    onehot = (ct.epod_node[:, None] == jnp.arange(N)[None, :]).astype(jnp.float32)
+    cnt_pn = jnp.einsum("ept,en->ptn", match_ept, onehot)     # [P,T,N]
+    cnt_dom = jnp.zeros_like(cnt_pn)
+    has_key = jnp.zeros(cnt_pn.shape, bool)
+    K = ct.node_labels.shape[1]
+    for k in topo_keys:
+        if k < 0 or k >= K:
+            continue
+        dv = ct.node_labels[:, k]                             # [N]
+        present = dv >= 0
+        same = ((dv[:, None] == dv[None, :]) & present[:, None] & present[None, :])
+        agg = jnp.einsum("ptn,nm->ptm", cnt_pn, same.astype(jnp.float32))
+        sel = term_topo == k                                  # [P,T]
+        cnt_dom = jnp.where(sel[..., None], agg, cnt_dom)
+        has_key = has_key | (sel[..., None] & present[None, None, :])
+    return cnt_dom, has_key
+
+
+# ------------------------------------------------------------------- spread
+
+def spread_mask(ct: ClusterTensors, pb: PodBatch, topo_keys: tuple[int, ...] = ()):
+    """DoNotSchedule constraints: count(domain) + self - min(domain counts)
+    must not exceed maxSkew; nodes lacking the topology key are infeasible."""
+    if pb.sc_valid.shape[1] == 0:
+        return jnp.ones(pb.pod_valid.shape + ct.node_valid.shape, bool)
+    match = _term_match_epods(ct, pb.sc_sel, pb.pod_ns)       # [E,P,S]
+    cnt, has_key = _domain_counts(ct, match, pb.sc_topo, topo_keys)  # [P,S,N]
+    # does the pod match its own constraint selector? (it lands in the domain)
+    self_m = eval_selector_set(pb.sc_sel, pb.pod_labels)      # [Pt,P,S] over all pods
+    P = pb.pod_valid.shape[0]
+    self_match = self_m[jnp.arange(P), jnp.arange(P), :]      # [P,S]
+    big = jnp.float32(3.4e38)
+    eligible = has_key & ct.node_valid[None, None, :]
+    min_cnt = jnp.min(jnp.where(eligible, cnt, big), axis=-1, keepdims=True)
+    min_cnt = jnp.where(jnp.any(eligible, axis=-1, keepdims=True), min_cnt, 0.0)
+    skew = cnt + self_match[..., None].astype(jnp.float32) - min_cnt
+    ok = has_key & (skew <= pb.sc_maxskew[..., None].astype(jnp.float32))
+    active = (pb.sc_valid & pb.sc_hard)[..., None]            # soft/pad -> neutral
+    return jnp.all(ok | ~active, axis=1)                      # [P,N]
+
+
+def spread_score_raw(ct: ClusterTensors, pb: PodBatch, topo_keys: tuple[int, ...] = ()):
+    """ScheduleAnyway constraints: raw = sum of matching counts in the node's
+    domain (fewer is better; reverse-normalized by the caller)."""
+    P, N = pb.pod_valid.shape[0], ct.node_valid.shape[0]
+    if pb.sc_valid.shape[1] == 0:
+        return jnp.zeros((P, N), jnp.float32)
+    match = _term_match_epods(ct, pb.sc_sel, pb.pod_ns)
+    cnt, has_key = _domain_counts(ct, match, pb.sc_topo, topo_keys)
+    active = (pb.sc_valid & ~pb.sc_hard)[..., None]
+    return jnp.sum(jnp.where(active & has_key, cnt, 0.0), axis=1)
+
+
+# ------------------------------------------------------- inter-pod affinity
+
+def interpod_required_mask(ct: ClusterTensors, pb: PodBatch,
+                           topo_keys: tuple[int, ...] = ()):
+    """Required affinity: every term needs >=1 matching existing pod in the
+    node's domain. Required anti-affinity: no matching existing pod in the
+    node's domain (nodes lacking the key satisfy anti trivially)."""
+    P, N = pb.pod_valid.shape[0], ct.node_valid.shape[0]
+    out = jnp.ones((P, N), bool)
+    if pb.aff_valid.shape[1] > 0:
+        match = _term_match_epods(ct, pb.aff_sel, pb.pod_ns)
+        cnt, has_key = _domain_counts(ct, match, pb.aff_topo, topo_keys)
+        ok = has_key & (cnt >= 1.0)
+        out &= jnp.all(ok | ~pb.aff_valid[..., None], axis=1)
+    if pb.anti_valid.shape[1] > 0:
+        match = _term_match_epods(ct, pb.anti_sel, pb.pod_ns)
+        cnt, has_key = _domain_counts(ct, match, pb.anti_topo, topo_keys)
+        viol = has_key & (cnt >= 1.0)
+        out &= jnp.all(~viol | ~pb.anti_valid[..., None], axis=1)
+    return out
+
+
+def interpod_symmetry_mask(ct: ClusterTensors, pb: PodBatch,
+                           topo_keys: tuple[int, ...] = ()):
+    """Existing pods' required anti-affinity vetoes the newcomer: if existing
+    pod e has an anti term whose selector matches the incoming pod and node n
+    shares e's domain for that term's key -> n infeasible
+    (interpodaffinity/filtering.go existingPodAntiAffinityMap)."""
+    P, N = pb.pod_valid.shape[0], ct.node_valid.shape[0]
+    if ct.ea_valid.shape[1] == 0:
+        return jnp.ones((P, N), bool)
+    # match of each existing anti term against incoming pods: [P,E,ET]
+    m = eval_selector_set(ct.ea_sel, pb.pod_labels)           # [P,E,ET]
+    ns_ok = pb.pod_ns[:, None] == ct.epod_ns[None, :]         # [P,E]
+    m = m & ns_ok[:, :, None] & ct.epod_valid[None, :, None] & ct.ea_valid[None]
+    veto = jnp.zeros((P, N), bool)
+    K = ct.node_labels.shape[1]
+    for k in topo_keys:
+        if k < 0 or k >= K:
+            continue
+        dv = ct.node_labels[:, k]                             # [N]
+        E = ct.epod_node.shape[0]
+        dv_e = dv[jnp.clip(ct.epod_node, 0, max(N - 1, 0))]
+        dv_e = jnp.where(ct.epod_node >= 0, dv_e, -1)         # [E]
+        wm = jnp.any(m & (ct.ea_topo == k)[None], axis=-1)    # [P,E]
+        same = (dv_e[:, None] == dv[None, :]) & (dv_e[:, None] >= 0)  # [E,N]
+        veto |= jnp.einsum("pe,en->pn", wm.astype(jnp.float32),
+                           same.astype(jnp.float32)) > 0.0
+    return ~veto
+
+
+def interpod_score_raw(ct: ClusterTensors, pb: PodBatch,
+                       topo_keys: tuple[int, ...] = ()):
+    """Preferred (anti)affinity of the incoming pod: +/-weight per matching
+    existing pod in the node's domain. -> raw [P,N] (min-max normalized later)."""
+    P, N = pb.pod_valid.shape[0], ct.node_valid.shape[0]
+    if pb.paff_valid.shape[1] == 0:
+        return jnp.zeros((P, N), jnp.float32)
+    match = _term_match_epods(ct, pb.paff_sel, pb.pod_ns)
+    cnt, has_key = _domain_counts(ct, match, pb.paff_topo, topo_keys)  # [P,C,N]
+    w = jnp.where(pb.paff_valid, pb.paff_weight, 0.0)[..., None]
+    return jnp.sum(jnp.where(has_key, cnt, 0.0) * w, axis=1)

@@ -1,0 +1,476 @@
+"""Oracle scheduler — the serial, readable reference implementation.
+
+Semantics mirror the reference's scheduling cycle
+(``pkg/scheduler/schedule_one.go``: ``findNodesThatFitPod`` ->
+``prioritizeNodes`` -> ``selectHost``) pod-by-pod over typed API objects. It
+exists for three jobs:
+
+1. Parity target: every tensor op in ops/ is tested against it.
+2. CPU fallback path: clusters without a TPU run this scheduler.
+3. Semantic documentation: this file is the plain-English statement of what
+   the fused tensor program computes.
+
+Resource arithmetic uses the SAME scaled integer units as the tensor path
+(encode/scaling.py) and scores use float32, so parity is exact, not
+approximate. Plugin weights default to the reference's
+(pkg/scheduler/apis/config/v1/default_plugins.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from kubernetes_tpu.api.selectors import (
+    label_selector_matches,
+    node_fields,
+    node_selector_matches,
+)
+from kubernetes_tpu.api.types import (
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+    EFFECT_PREFER_NO_SCHEDULE,
+    Node,
+    NodeSelectorTerm,
+    Pod,
+    Requirement,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.encode.scaling import UNLIMITED, scale_allocatable, scale_request
+
+UNSCHED_TAINT = Taint(key="node.kubernetes.io/unschedulable", effect=EFFECT_NO_SCHEDULE)
+
+# Reference default plugin score weights (default_plugins.go).
+DEFAULT_WEIGHTS = {
+    "NodeResourcesFit": 1.0,
+    "NodeResourcesBalancedAllocation": 1.0,
+    "ImageLocality": 1.0,
+    "NodeAffinity": 2.0,
+    "TaintToleration": 3.0,
+    "PodTopologySpread": 2.0,
+    "InterPodAffinity": 2.0,
+}
+
+# ImageLocality constants (image_locality.go): mb, minThreshold, maxContainerThreshold.
+_MB = 1024 * 1024
+IMG_MIN_THRESHOLD = 23 * _MB
+IMG_MAX_CONTAINER_THRESHOLD = 1000 * _MB
+
+
+def tie_break(n: int, seed: int) -> int:
+    """Deterministic tie-break among max-score nodes: the reference reservoir-
+    samples with math/rand (schedule_one.go selectHost); we use a seeded
+    multiplicative hash so TPU and oracle agree bit-for-bit."""
+    return (((n * 2654435761) & 0xFFFFFFFF) ^ seed) & 0x3FFFFFFF
+
+
+@dataclass
+class NodeState:
+    node: Node
+    allocatable: dict[str, int] = field(default_factory=dict)  # scaled units
+    requested: dict[str, int] = field(default_factory=dict)
+    pods: list[Pod] = field(default_factory=list)
+
+    @classmethod
+    def build(cls, node: Node) -> "NodeState":
+        alloc = {r: scale_allocatable(r, q) for r, q in node.allocatable_canonical().items()}
+        alloc.setdefault("pods", UNLIMITED)
+        return cls(node=node, allocatable=alloc)
+
+    def add_pod(self, pod: Pod):
+        self.pods.append(pod)
+        for r, q in pod.resource_requests().items():
+            self.requested[r] = self.requested.get(r, 0) + scale_request(r, q)
+
+    def remove_pod(self, pod: Pod):
+        self.pods = [p for p in self.pods if p.metadata.uid != pod.metadata.uid]
+        for r, q in pod.resource_requests().items():
+            self.requested[r] = self.requested.get(r, 0) - scale_request(r, q)
+
+    @property
+    def labels(self) -> dict[str, str]:
+        return self.node.metadata.labels
+
+
+def tolerates_all(tolerations: list[Toleration], taints: list[Taint],
+                  effects: tuple[str, ...]) -> bool:
+    for t in taints:
+        if t.effect in effects and not any(tol.tolerates(t) for tol in tolerations):
+            return False
+    return True
+
+
+class FailReason:
+    UNSCHEDULABLE = "node(s) were unschedulable"
+    NODE_NAME = "node(s) didn't match the requested node name"
+    RESOURCES = "Insufficient resources"
+    AFFINITY = "node(s) didn't match Pod's node affinity/selector"
+    TAINT = "node(s) had untolerated taint"
+    PORTS = "node(s) didn't have free ports"
+    SPREAD = "node(s) didn't satisfy topology spread constraints"
+    POD_AFFINITY = "node(s) didn't match pod affinity rules"
+    POD_ANTI_AFFINITY = "node(s) didn't satisfy existing pods anti-affinity rules"
+
+
+class OracleScheduler:
+    """Serial scheduler over NodeState list. Mutating: ``assume`` folds
+    assignments in, mirroring Cache.AssumePod optimism."""
+
+    def __init__(self, nodes: list[Node], bound_pods: Optional[list[Pod]] = None,
+                 weights: Optional[dict[str, float]] = None, seed: int = 0):
+        self.states = [NodeState.build(n) for n in nodes]
+        self.node_index = {n.metadata.name: i for i, n in enumerate(nodes)}
+        self.weights = dict(weights or DEFAULT_WEIGHTS)
+        self.seed = seed
+        for p in bound_pods or []:
+            i = self.node_index.get(p.spec.node_name)
+            if i is not None:
+                self.states[i].add_pod(p)
+
+    # ---- filters ---------------------------------------------------------
+
+    def _filter_one(self, pod: Pod, st: NodeState, ni: int) -> Optional[str]:
+        node = st.node
+        if node.spec.unschedulable and not any(
+                t.tolerates(UNSCHED_TAINT) for t in pod.spec.tolerations):
+            return FailReason.UNSCHEDULABLE
+        if pod.spec.node_name and pod.spec.node_name != node.metadata.name:
+            return FailReason.NODE_NAME
+        for r, q in pod.resource_requests().items():
+            need = scale_request(r, q)
+            if need > st.allocatable.get(r, 0) - st.requested.get(r, 0):
+                return FailReason.RESOURCES
+        if not self._node_affinity_ok(pod, node):
+            return FailReason.AFFINITY
+        if not tolerates_all(pod.spec.tolerations, node.spec.taints,
+                             (EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE)):
+            return FailReason.TAINT
+        if self._ports_conflict(pod, st):
+            return FailReason.PORTS
+        if not self._spread_ok(pod, st):
+            return FailReason.SPREAD
+        r = self._interpod_ok(pod, st)
+        if r is not None:
+            return r
+        return None
+
+    def _node_affinity_ok(self, pod: Pod, node: Node) -> bool:
+        labels, fields = node.metadata.labels, node_fields(node.metadata.name)
+        for k, v in pod.spec.node_selector.items():
+            if labels.get(k) != v:
+                return False
+        aff = pod.spec.affinity
+        na = aff.node_affinity if aff else None
+        if na and na.required:
+            if not node_selector_matches(na.required, labels, fields):
+                return False
+        return True
+
+    def _ports_conflict(self, pod: Pod, st: NodeState) -> bool:
+        used = [hp for p in st.pods for hp in p.host_ports()]
+        for (ip, proto, port) in pod.host_ports():
+            for (uip, uproto, uport) in used:
+                if port == uport and proto == uproto and (
+                        ip == uip or ip == "0.0.0.0" or uip == "0.0.0.0"):
+                    return True
+        return False
+
+    # ---- topology spread -------------------------------------------------
+
+    def _domain_counts(self, pod: Pod, sc: TopologySpreadConstraint):
+        """(counts per domain value, global min over eligible domains).
+
+        Eligible domains = domains of nodes that pass the constraint's node
+        requirements (here: have the topology key). Counts include only pods
+        matching the selector in the incoming pod's namespace.
+        """
+        counts: dict[str, int] = {}
+        for st in self.states:
+            dv = st.labels.get(sc.topology_key)
+            if dv is None:
+                continue
+            counts.setdefault(dv, 0)
+            for p in st.pods:
+                if (p.metadata.namespace == pod.metadata.namespace
+                        and label_selector_matches(sc.label_selector, p.metadata.labels)):
+                    counts[dv] += 1
+        return counts
+
+    def _spread_ok(self, pod: Pod, st: NodeState) -> bool:
+        for sc in pod.spec.topology_spread_constraints:
+            if sc.when_unsatisfiable != "DoNotSchedule":
+                continue
+            dv = st.labels.get(sc.topology_key)
+            if dv is None:
+                return False  # node without the key can't satisfy the constraint
+            counts = self._domain_counts(pod, sc)
+            self_match = label_selector_matches(sc.label_selector, pod.metadata.labels)
+            min_count = min(counts.values()) if counts else 0
+            if counts.get(dv, 0) + (1 if self_match else 0) - min_count > sc.max_skew:
+                return False
+        return True
+
+    # ---- inter-pod affinity ---------------------------------------------
+
+    def _term_matches_pod(self, term, own_ns: str, target: Pod) -> bool:
+        nss = term.namespaces or [own_ns]
+        return (target.metadata.namespace in nss
+                and label_selector_matches(term.label_selector, target.metadata.labels))
+
+    def _domain_has_match(self, topology_key: str, dv: str, pred) -> bool:
+        for st in self.states:
+            if st.labels.get(topology_key) != dv:
+                continue
+            for p in st.pods:
+                if pred(p):
+                    return True
+        return False
+
+    def _interpod_ok(self, pod: Pod, st: NodeState) -> Optional[str]:
+        aff = pod.spec.affinity
+        pa = aff.pod_affinity if aff else None
+        pan = aff.pod_anti_affinity if aff else None
+        ns = pod.metadata.namespace
+        # Required affinity: each term needs >=1 matching existing pod in this
+        # node's domain. (The reference also lets a term match the incoming pod
+        # itself for self-affinity bootstrap; the gang batcher handles that.)
+        for term in (pa.required if pa else []):
+            dv = st.labels.get(term.topology_key)
+            if dv is None or not self._domain_has_match(
+                    term.topology_key, dv, lambda p: self._term_matches_pod(term, ns, p)):
+                return FailReason.POD_AFFINITY
+        # Required anti-affinity: no matching existing pod in this domain.
+        for term in (pan.required if pan else []):
+            dv = st.labels.get(term.topology_key)
+            if dv is not None and self._domain_has_match(
+                    term.topology_key, dv, lambda p: self._term_matches_pod(term, ns, p)):
+                return FailReason.POD_ANTI_AFFINITY
+        # Symmetry: existing pods' required anti-affinity veto the newcomer.
+        dv_cache = st.labels
+        for other_st in self.states:
+            for p in other_st.pods:
+                paff = p.spec.affinity
+                pananti = paff.pod_anti_affinity if paff else None
+                for term in (pananti.required if pananti else []):
+                    if not self._term_matches_pod(term, p.metadata.namespace, pod):
+                        continue
+                    dv = dv_cache.get(term.topology_key)
+                    if dv is not None and other_st.labels.get(term.topology_key) == dv:
+                        return FailReason.POD_ANTI_AFFINITY
+        return None
+
+    def feasible(self, pod: Pod):
+        """-> (mask list[bool], reasons dict node_name -> reason)."""
+        mask, reasons = [], {}
+        for i, st in enumerate(self.states):
+            r = self._filter_one(pod, st, i)
+            mask.append(r is None)
+            if r is not None:
+                reasons[st.node.metadata.name] = r
+        return mask, reasons
+
+    # ---- scores ----------------------------------------------------------
+
+    def score(self, pod: Pod, mask: list[bool]) -> np.ndarray:
+        """Weighted sum of normalized plugin scores; -inf for infeasible."""
+        N = len(self.states)
+        total = np.zeros(N, np.float32)
+        for name, fn in [
+            ("NodeResourcesFit", self._score_least_allocated),
+            ("NodeResourcesBalancedAllocation", self._score_balanced),
+            ("ImageLocality", self._score_image_locality),
+            ("NodeAffinity", self._score_node_affinity),
+            ("TaintToleration", self._score_taints),
+            ("PodTopologySpread", self._score_spread),
+            ("InterPodAffinity", self._score_interpod),
+        ]:
+            w = self.weights.get(name, 0.0)
+            if w:
+                total += np.float32(w) * fn(pod, mask).astype(np.float32)
+        return np.where(np.asarray(mask), total, -np.inf).astype(np.float32)
+
+    def _fractions(self, pod: Pod, st: NodeState):
+        reqs = pod.resource_requests()
+        out = []
+        for r in ("cpu", "memory"):
+            alloc = st.allocatable.get(r, 0)
+            if alloc <= 0 or alloc >= UNLIMITED:
+                out.append(np.float32(0) if r not in reqs else np.float32(1))
+                continue
+            used = st.requested.get(r, 0) + scale_request(r, reqs.get(r, 0))
+            out.append(np.float32(used) / np.float32(alloc))
+        return out
+
+    def _score_least_allocated(self, pod: Pod, mask) -> np.ndarray:
+        """least_allocated.go: mean over {cpu,memory} of 100*(alloc-used)/alloc."""
+        out = np.zeros(len(self.states), np.float32)
+        for i, st in enumerate(self.states):
+            fr = self._fractions(pod, st)
+            out[i] = np.float32(
+                sum(np.float32(100) * (np.float32(1) - np.clip(f, 0, 1)) for f in fr)
+                / np.float32(len(fr)))
+        return out
+
+    def _score_balanced(self, pod: Pod, mask) -> np.ndarray:
+        """balanced_allocation.go: 100 * (1 - std(fractions))."""
+        out = np.zeros(len(self.states), np.float32)
+        for i, st in enumerate(self.states):
+            fr = np.asarray(self._fractions(pod, st), np.float32)
+            fr = np.clip(fr, 0, 1)
+            mean = fr.mean(dtype=np.float32)
+            std = np.sqrt(((fr - mean) ** 2).mean(dtype=np.float32))
+            out[i] = np.float32(100) * (np.float32(1) - std)
+        return out
+
+    def _score_image_locality(self, pod: Pod, mask) -> np.ndarray:
+        """image_locality.go: sum of scaled sizes of present images -> threshold ramp."""
+        N = len(self.states)
+        imgs = [c.image for c in pod.spec.containers if c.image]
+        out = np.zeros(N, np.float32)
+        if not imgs:
+            return out
+        have = [set(n.names[0] for n in st.node.status.images if n.names)
+                for st in self.states]
+        num_nodes_with = {im: sum(im in h for h in have) for im in imgs}
+        sizes = {}
+        for st in self.states:
+            for n in st.node.status.images:
+                if n.names:
+                    sizes[n.names[0]] = max(sizes.get(n.names[0], 0), n.size_bytes)
+        max_threshold = IMG_MAX_CONTAINER_THRESHOLD * max(len(imgs), 1)
+        for i, st in enumerate(self.states):
+            ssum = np.float32(0)
+            for im in imgs:
+                if im in have[i]:
+                    spread = np.float32(num_nodes_with[im]) / np.float32(max(N, 1))
+                    ssum += np.float32(sizes.get(im, 0)) * spread
+            val = (ssum - np.float32(IMG_MIN_THRESHOLD)) / np.float32(
+                max_threshold - IMG_MIN_THRESHOLD)
+            out[i] = np.clip(val, 0, 1) * np.float32(100)
+        return out
+
+    def _score_node_affinity(self, pod: Pod, mask) -> np.ndarray:
+        """Sum of matching preferred-term weights, DefaultNormalizeScore to 0-100."""
+        aff = pod.spec.affinity
+        na = aff.node_affinity if aff else None
+        raw = np.zeros(len(self.states), np.float32)
+        for t in (na.preferred if na else []):
+            for i, st in enumerate(self.states):
+                from kubernetes_tpu.api.selectors import node_selector_term_matches
+                if node_selector_term_matches(t.preference, st.labels,
+                                              node_fields(st.node.metadata.name)):
+                    raw[i] += np.float32(t.weight)
+        return _default_normalize(raw, reverse=False)
+
+    def _score_taints(self, pod: Pod, mask) -> np.ndarray:
+        raw = np.zeros(len(self.states), np.float32)
+        for i, st in enumerate(self.states):
+            c = 0
+            for t in st.node.spec.taints:
+                if t.effect == EFFECT_PREFER_NO_SCHEDULE and not any(
+                        tol.tolerates(t) for tol in pod.spec.tolerations):
+                    c += 1
+            raw[i] = c
+        return _default_normalize(raw, reverse=True)
+
+    def _score_spread(self, pod: Pod, mask) -> np.ndarray:
+        """ScheduleAnyway constraints only (scoring.go PreScore): fewer
+        matching pods in the node's domain is better."""
+        N = len(self.states)
+        raw = np.zeros(N, np.float32)
+        has_any = False
+        for sc in pod.spec.topology_spread_constraints:
+            if sc.when_unsatisfiable != "ScheduleAnyway":
+                continue
+            has_any = True
+            counts = self._domain_counts(pod, sc)
+            for i, st in enumerate(self.states):
+                dv = st.labels.get(sc.topology_key)
+                raw[i] += np.float32(counts.get(dv, 0) if dv is not None else 0)
+        if not has_any:
+            return np.zeros(N, np.float32)
+        return _default_normalize(raw, reverse=True)
+
+    def _score_interpod(self, pod: Pod, mask) -> np.ndarray:
+        """Preferred inter-pod (anti)affinity of the incoming pod: +/- weight per
+        matching existing pod in the node's domain."""
+        aff = pod.spec.affinity
+        pa = aff.pod_affinity if aff else None
+        pan = aff.pod_anti_affinity if aff else None
+        N = len(self.states)
+        raw = np.zeros(N, np.float32)
+        ns = pod.metadata.namespace
+        terms = [(t.weight, t.term) for t in (pa.preferred if pa else [])]
+        terms += [(-t.weight, t.term) for t in (pan.preferred if pan else [])]
+        if not terms:
+            return raw
+        for w, term in terms:
+            # count matching pods per domain value
+            counts: dict[str, int] = {}
+            for st in self.states:
+                dv = st.labels.get(term.topology_key)
+                if dv is None:
+                    continue
+                counts.setdefault(dv, 0)
+                for p in st.pods:
+                    if self._term_matches_pod(term, ns, p):
+                        counts[dv] += 1
+            for i, st in enumerate(self.states):
+                dv = st.labels.get(term.topology_key)
+                if dv is not None:
+                    raw[i] += np.float32(w) * np.float32(counts.get(dv, 0))
+        return _minmax_normalize(raw)
+
+    # ---- cycle -----------------------------------------------------------
+
+    def select_host(self, scores: np.ndarray) -> Optional[int]:
+        if not np.isfinite(scores).any():
+            return None
+        best = np.max(scores)
+        cands = [i for i in range(len(scores)) if scores[i] == best]
+        return min(cands, key=lambda n: tie_break(n, self.seed))
+
+    def schedule_one(self, pod: Pod):
+        """-> (node index or None, reasons). Does NOT assume; caller decides."""
+        mask, reasons = self.feasible(pod)
+        if not any(mask):
+            return None, reasons
+        scores = self.score(pod, mask)
+        return self.select_host(scores), reasons
+
+    def assume(self, pod: Pod, node_idx: int):
+        pod.spec.node_name = self.states[node_idx].node.metadata.name
+        self.states[node_idx].add_pod(pod)
+
+    def schedule_all(self, pods: list[Pod]):
+        """Serial loop over the batch (ScheduleOne x N). -> list of node idx/None."""
+        out = []
+        for pod in pods:
+            ni, _ = self.schedule_one(pod)
+            if ni is not None:
+                self.assume(pod, ni)
+            out.append(ni)
+        return out
+
+
+def _default_normalize(raw: np.ndarray, reverse: bool) -> np.ndarray:
+    """helper.DefaultNormalizeScore: scale raw to 0-100 by max; reverse flips."""
+    mx = np.max(raw) if raw.size else np.float32(0)
+    if mx <= 0:
+        return np.full_like(raw, np.float32(100) if reverse else np.float32(0))
+    s = raw * np.float32(100) / np.float32(mx)
+    return np.float32(100) - s if reverse else s
+
+
+def _minmax_normalize(raw: np.ndarray) -> np.ndarray:
+    """InterPodAffinity normalize: min-max to 0-100 (scoring.go NormalizeScore)."""
+    if raw.size == 0:
+        return raw
+    mn, mx = np.min(raw), np.max(raw)
+    if mx == mn:
+        return np.zeros_like(raw)
+    return (raw - mn) * np.float32(100) / np.float32(mx - mn)

@@ -1,0 +1,244 @@
+"""Filter-mask parity: tensor path (ops/filters.py) vs oracle (sched/oracle.py).
+
+Golden cases mirror the reference's plugin unit tests (fit_test.go,
+taint_toleration_test.go, node_affinity_test.go, ...); the fuzzer sweeps random
+clusters and diffs full [P,N] masks bit-for-bit.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import Requirement
+from kubernetes_tpu.encode.snapshot import SnapshotEncoder
+from kubernetes_tpu.ops.filters import FILTERS, run_filters
+from kubernetes_tpu.sched.oracle import OracleScheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def tensor_mask(nodes, pods, bound=None, enabled=None):
+    enc = SnapshotEncoder()
+    ct, meta = enc.encode_cluster(nodes, bound or [], pending_pods=pods)
+    pb = enc.encode_pods(pods, meta)
+    mask = np.asarray(run_filters(ct, pb, enabled=enabled))
+    return mask[:len(pods), :len(nodes)]
+
+
+def oracle_mask(nodes, pods, bound=None):
+    orc = OracleScheduler(nodes, bound or [])
+    return np.asarray([orc.feasible(p)[0] for p in pods])
+
+
+def assert_parity(nodes, pods, bound=None):
+    tm = tensor_mask(nodes, pods, bound)
+    om = oracle_mask(nodes, pods, bound)
+    np.testing.assert_array_equal(tm, om,
+                                  err_msg=f"pods={[p.key for p in pods]} nodes={[n.name for n in nodes]}")
+    return tm
+
+
+# ---------------------------------------------------------------- golden cases
+
+def test_resources_fit():
+    nodes = [make_node(f"n{i}").capacity({"cpu": c, "memory": "4Gi", "pods": "10"}).obj()
+             for i, c in enumerate(["1", "2", "4"])]
+    pods = [make_pod(f"p{r}").req({"cpu": r}).obj() for r in ["500m", "1500m", "3", "8"]]
+    tm = assert_parity(nodes, pods)
+    np.testing.assert_array_equal(tm, [
+        [True, True, True], [False, True, True], [False, False, True], [False, False, False]])
+
+
+def test_fit_counts_bound_pods():
+    nodes = [make_node("n0").capacity({"cpu": "2", "pods": "10"}).obj()]
+    bound = [make_pod("b0").req({"cpu": "1500m"}).node("n0").obj()]
+    pods = [make_pod("p0").req({"cpu": "1"}).obj(),
+            make_pod("p1").req({"cpu": "500m"}).obj()]
+    tm = assert_parity(nodes, pods, bound)
+    np.testing.assert_array_equal(tm, [[False], [True]])
+
+
+def test_pods_capacity_resource():
+    nodes = [make_node("n0").capacity({"cpu": "64", "pods": "2"}).obj()]
+    bound = [make_pod(f"b{i}").node("n0").obj() for i in range(2)]
+    pods = [make_pod("p0").obj()]
+    tm = assert_parity(nodes, pods, bound)
+    assert not tm[0, 0]
+
+
+def test_extended_resource_absent_on_node():
+    nodes = [make_node("n0").capacity({"cpu": "4", "pods": "10"}).obj(),
+             make_node("n1").capacity({"cpu": "4", "pods": "10", "example.com/gpu": "2"}).obj()]
+    pods = [make_pod("p0").req({"example.com/gpu": "1"}).obj()]
+    tm = assert_parity(nodes, pods)
+    np.testing.assert_array_equal(tm, [[False, True]])
+
+
+def test_unschedulable_and_toleration():
+    nodes = [make_node("n0").unschedulable().obj(),
+             make_node("n1").obj()]
+    for n in nodes:
+        n.status.allocatable = {"cpu": "4", "pods": "10"}
+    pods = [make_pod("p0").obj(),
+            make_pod("p1").toleration(key="node.kubernetes.io/unschedulable",
+                                      operator="Exists", effect="NoSchedule").obj()]
+    tm = assert_parity(nodes, pods)
+    np.testing.assert_array_equal(tm, [[False, True], [True, True]])
+
+
+def test_node_name():
+    nodes = [make_node("n0").capacity({"cpu": "1"}).obj(),
+             make_node("n1").capacity({"cpu": "1"}).obj()]
+    pods = [make_pod("p0").node("n1").obj(), make_pod("p1").node("missing").obj()]
+    tm = assert_parity(nodes, pods)
+    np.testing.assert_array_equal(tm, [[False, True], [False, False]])
+
+
+def test_taints_and_tolerations():
+    nodes = [
+        make_node("plain").capacity({"cpu": "4"}).obj(),
+        make_node("tainted").capacity({"cpu": "4"}).taint("dedicated", "ml", "NoSchedule").obj(),
+        make_node("prefer").capacity({"cpu": "4"}).taint("slow", "", "PreferNoSchedule").obj(),
+        make_node("exec").capacity({"cpu": "4"}).taint("evict", "now", "NoExecute").obj(),
+    ]
+    pods = [
+        make_pod("bare").obj(),
+        make_pod("tol-eq").toleration(key="dedicated", operator="Equal", value="ml",
+                                      effect="NoSchedule").obj(),
+        make_pod("tol-exists").toleration(operator="Exists").obj(),  # tolerates everything
+        make_pod("tol-wrongval").toleration(key="dedicated", operator="Equal", value="web").obj(),
+    ]
+    tm = assert_parity(nodes, pods)
+    np.testing.assert_array_equal(tm, [
+        [True, False, True, False],
+        [True, True, True, False],
+        [True, True, True, True],
+        [True, False, True, False]])
+
+
+def test_node_selector_and_affinity():
+    nodes = [
+        make_node("a").capacity({"cpu": "4"}).label("zone", "us-a").label("disk", "ssd").obj(),
+        make_node("b").capacity({"cpu": "4"}).label("zone", "us-b").obj(),
+        make_node("c").capacity({"cpu": "4"}).label("zone", "us-c").label("gen", "7").obj(),
+    ]
+    pods = [
+        make_pod("sel").node_selector({"zone": "us-b"}).obj(),
+        make_pod("aff-in").node_affinity_in("zone", ["us-a", "us-c"]).obj(),
+        make_pod("aff-notin").node_affinity_expr(Requirement("zone", "NotIn", ["us-a"])).obj(),
+        make_pod("aff-exists").node_affinity_expr(Requirement("disk", "Exists")).obj(),
+        make_pod("aff-dne").node_affinity_expr(Requirement("disk", "DoesNotExist")).obj(),
+        make_pod("aff-gt").node_affinity_expr(Requirement("gen", "Gt", ["5"])).obj(),
+        make_pod("aff-and").node_affinity_expr(
+            Requirement("zone", "In", ["us-a", "us-b"]), Requirement("disk", "Exists")).obj(),
+        # OR of two terms
+        make_pod("aff-or").node_affinity_in("zone", ["us-a"]).node_affinity_in("zone", ["us-b"]).obj(),
+        # selector AND affinity must both hold
+        make_pod("sel+aff").node_selector({"disk": "ssd"}).node_affinity_in("zone", ["us-b"]).obj(),
+    ]
+    tm = assert_parity(nodes, pods)
+    np.testing.assert_array_equal(tm, [
+        [False, True, False],
+        [True, False, True],
+        [False, True, True],
+        [True, False, False],
+        [False, True, True],
+        [False, False, True],
+        [True, False, False],
+        [True, True, False],
+        [False, False, False]])
+
+
+def test_match_fields():
+    nodes = [make_node("n0").capacity({"cpu": "1"}).obj(),
+             make_node("n1").capacity({"cpu": "1"}).obj()]
+    from kubernetes_tpu.api.types import NodeSelectorTerm, Requirement as R, NodeAffinity, Affinity
+    pod = make_pod("pin").obj()
+    pod.spec.affinity = Affinity(node_affinity=NodeAffinity(required=[
+        NodeSelectorTerm(match_fields=[R("metadata.name", "In", ["n1"])])]))
+    tm = assert_parity(nodes, [pod])
+    np.testing.assert_array_equal(tm, [[False, True]])
+
+
+def test_host_ports():
+    nodes = [make_node("n0").capacity({"cpu": "4"}).obj(),
+             make_node("n1").capacity({"cpu": "4"}).obj()]
+    bound = [make_pod("b0").host_port(8080).node("n0").obj(),
+             make_pod("b1").host_port(9090, host_ip="10.0.0.1").node("n1").obj()]
+    pods = [
+        make_pod("same-port").host_port(8080).obj(),
+        make_pod("diff-port").host_port(8081).obj(),
+        make_pod("udp-same").host_port(8080, protocol="UDP").obj(),
+        make_pod("ip-overlap").host_port(9090, host_ip="10.0.0.1").obj(),
+        make_pod("ip-disjoint").host_port(9090, host_ip="10.0.0.2").obj(),
+        make_pod("ip-wild").host_port(9090).obj(),  # 0.0.0.0 clashes with 10.0.0.1
+    ]
+    tm = assert_parity(nodes, pods, bound)
+    np.testing.assert_array_equal(tm, [
+        [False, True], [True, True], [True, True],
+        [True, False], [True, True], [True, False]])
+
+
+# ------------------------------------------------------------------ fuzz sweep
+
+ZONES = ["us-a", "us-b", "us-c"]
+DISKS = ["ssd", "hdd"]
+
+
+def random_node(rng: random.Random, i: int):
+    w = make_node(f"n{i}").capacity({
+        "cpu": str(rng.choice([1, 2, 4, 8])),
+        "memory": f"{rng.choice([2, 4, 8])}Gi",
+        "pods": str(rng.choice([3, 10]))})
+    if rng.random() < 0.5:
+        w.label("zone", rng.choice(ZONES))
+    if rng.random() < 0.3:
+        w.label("disk", rng.choice(DISKS))
+    if rng.random() < 0.3:
+        w.label("gen", str(rng.randint(1, 9)))
+    if rng.random() < 0.2:
+        w.taint("dedicated", rng.choice(["ml", "web"]),
+                rng.choice(["NoSchedule", "PreferNoSchedule", "NoExecute"]))
+    if rng.random() < 0.1:
+        w.unschedulable()
+    return w.obj()
+
+
+def random_pod(rng: random.Random, i: int, node_names):
+    w = make_pod(f"p{i}").req({
+        "cpu": rng.choice(["100m", "500m", "1", "2"]),
+        "memory": rng.choice(["64Mi", "512Mi", "2Gi"])})
+    if rng.random() < 0.25:
+        w.node_selector({"zone": rng.choice(ZONES)})
+    if rng.random() < 0.3:
+        op = rng.choice(["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"])
+        key = rng.choice(["zone", "disk", "gen", "nope"])
+        vals = ([str(rng.randint(0, 9))] if op in ("Gt", "Lt")
+                else rng.sample(ZONES + DISKS, k=rng.randint(1, 2)))
+        w.node_affinity_expr(Requirement(key, op, [] if op in ("Exists", "DoesNotExist") else vals))
+    if rng.random() < 0.2:
+        w.toleration(key="dedicated", operator=rng.choice(["Equal", "Exists"]),
+                     value=rng.choice(["ml", "web", ""]),
+                     effect=rng.choice(["NoSchedule", "", "NoExecute"]))
+    if rng.random() < 0.1:
+        w.toleration(operator="Exists")
+    if rng.random() < 0.15:
+        w.host_port(rng.choice([80, 8080]), protocol=rng.choice(["TCP", "UDP"]))
+    if rng.random() < 0.1:
+        w.node(rng.choice(node_names + ["ghost"]))
+    return w.obj()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_filter_parity(seed):
+    rng = random.Random(seed)
+    n_nodes, n_bound, n_pods = rng.randint(1, 12), rng.randint(0, 8), rng.randint(1, 10)
+    nodes = [random_node(rng, i) for i in range(n_nodes)]
+    names = [n.metadata.name for n in nodes]
+    bound = []
+    for i in range(n_bound):
+        p = random_pod(rng, 100 + i, names)
+        p.spec.node_name = rng.choice(names)
+        bound.append(p)
+    pods = [random_pod(rng, i, names) for i in range(n_pods)]
+    assert_parity(nodes, pods, bound)
