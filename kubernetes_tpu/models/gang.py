@@ -1,0 +1,233 @@
+"""Gang batcher — schedule P pods per device step with conflict resolution.
+
+The reference schedules one pod at a time (``schedule_one.go`` ScheduleOne);
+batching P pods against one snapshot introduces intra-batch conflicts the
+serial loop never sees:
+
+  capacity     two batch members both fit node n, but not together
+  relational   anti-affinity/spread/affinity between batch members
+
+Design: iterative propose/commit rounds, all tensor-side:
+
+  1. evaluate() all uncommitted pods against cluster state + already-committed
+     batch members (committed members occupy pre-padded "extension" slots of
+     the existing-pods tensors).
+  2. every pod proposes its argmax node.
+  3. capacity acceptance per node: proposals sorted by (node, rank) with
+     rank = (-priority, batch index); segmented exclusive prefix-sums of
+     requests accept the prefix that fits (sort + cumsum, no scatter loops).
+  4. relational veto: an accepted pod is rejected if a higher-rank pod
+     accepted THIS round conflicts (anti-affinity either direction, shared
+     hard-spread domain, or required-affinity forcing co-location). The veto
+     is conservative — rejected pods simply re-propose next round against the
+     updated state, so committed state is always sequentially valid.
+  5. fold acceptances into requested[N,R] + extension slots; repeat.
+
+``serial=True`` caps acceptance at one pod per round (highest rank), which
+reproduces the reference's serial semantics exactly — the parity tests diff it
+against the oracle's ScheduleOne loop bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from kubernetes_tpu.encode.snapshot import ClusterTensors, PodBatch, SelectorSet
+from kubernetes_tpu.models.schedule_step import evaluate
+
+
+class GangState(struct.PyTreeNode):
+    requested: jnp.ndarray    # [N,R] current (base + committed batch members)
+    committed: jnp.ndarray    # [P] bool
+    assignment: jnp.ndarray   # [P] int32, -1 unassigned
+    rounds: jnp.ndarray       # scalar int32
+
+
+def _pad_axis(a: np.ndarray, axis: int, size: int, fill):
+    if a.shape[axis] == size:
+        return a
+    pads = [(0, 0)] * a.ndim
+    pads[axis] = (0, size - a.shape[axis])
+    return np.pad(a, pads, constant_values=fill)
+
+
+def extend_cluster(ct: ClusterTensors, pb: PodBatch) -> ClusterTensors:
+    """Host-side: widen the existing-pods tensors with P extension slots for
+    batch members (invalid until committed) so relational plugins see committed
+    members. Anti-affinity term buckets are unified by padding."""
+    E = int(ct.epod_valid.shape[0])
+    P = int(pb.pod_valid.shape[0])
+    K = max(int(ct.epod_labels.shape[1]), int(pb.pod_labels.shape[1]))
+
+    epod_labels = np.concatenate([
+        _pad_axis(np.asarray(ct.epod_labels), 1, K, -1),
+        _pad_axis(np.asarray(pb.pod_labels), 1, K, -1)], axis=0)
+    # unify anti-affinity term buckets: [E,ET,...] with [P,BT,...]
+    ET = max(int(ct.ea_valid.shape[1]), int(pb.anti_valid.shape[1]))
+    AX = max(int(ct.ea_sel.key.shape[2]) if ct.ea_sel.key.ndim == 3 else 0,
+             int(pb.anti_sel.key.shape[2]) if pb.anti_sel.key.ndim == 3 else 0)
+    AV = max(int(ct.ea_sel.vals.shape[3]) if ct.ea_sel.vals.ndim == 4 else 0,
+             int(pb.anti_sel.vals.shape[3]) if pb.anti_sel.vals.ndim == 4 else 0)
+
+    def pad_sel(sel: SelectorSet, T):
+        key = _pad_axis(_pad_axis(np.asarray(sel.key), 1, T, -1), 2, AX, -1)
+        op = _pad_axis(_pad_axis(np.asarray(sel.op), 1, T, 0), 2, AX, 0)
+        vals = _pad_axis(_pad_axis(_pad_axis(np.asarray(sel.vals), 1, T, -1), 2, AX, -1),
+                         3, AV, -1)
+        ev = _pad_axis(_pad_axis(np.asarray(sel.expr_valid), 1, T, False), 2, AX, False)
+        valid = _pad_axis(np.asarray(sel.valid), 1, T, False)
+        return key, op, vals, ev, valid
+
+    ek, eo, ev_, ee, eval_ = pad_sel(ct.ea_sel, ET)
+    pk, po, pv, pe, pval = pad_sel(pb.anti_sel, ET)
+    ea_sel = SelectorSet(
+        key=np.concatenate([ek, pk]), op=np.concatenate([eo, po]),
+        vals=np.concatenate([ev_, pv]), expr_valid=np.concatenate([ee, pe]),
+        valid=np.concatenate([eval_, pval]))
+    ea_topo = np.concatenate([_pad_axis(np.asarray(ct.ea_topo), 1, ET, -1),
+                              _pad_axis(np.asarray(pb.anti_topo), 1, ET, -1)])
+    ea_valid = np.concatenate([_pad_axis(np.asarray(ct.ea_valid), 1, ET, False),
+                               _pad_axis(np.asarray(pb.anti_valid), 1, ET, False)])
+    return ct.replace(
+        epod_node=np.concatenate([np.asarray(ct.epod_node), np.full(P, -1, np.int32)]),
+        epod_ns=np.concatenate([np.asarray(ct.epod_ns), np.asarray(pb.pod_ns)]),
+        epod_labels=epod_labels,
+        epod_valid=np.concatenate([np.asarray(ct.epod_valid), np.zeros(P, bool)]),
+        ea_sel=ea_sel, ea_topo=ea_topo, ea_valid=ea_valid,
+    )
+
+
+def _segmented_capacity_accept(choice, want, rank, requests, free_at_choice):
+    """Per-node priority-ordered capacity acceptance.
+
+    choice [P] proposed node; want [P] proposal live; rank [P] lower = first;
+    requests [P,R]; free_at_choice [P,R] free capacity on the proposed node.
+    Returns accept [P] bool. Uses sort + segmented exclusive cumsum.
+    """
+    P = choice.shape[0]
+    node_key = jnp.where(want, choice, jnp.int32(0x3FFFFFFF))
+    order = jnp.lexsort((rank, node_key))          # group by node, rank within
+    sn = node_key[order]
+    req_s = jnp.where(want[order, None], requests[order], 0)
+    cs = jnp.cumsum(req_s, axis=0)
+    seg_start = jnp.concatenate([jnp.ones(1, bool), sn[1:] != sn[:-1]])
+    # prefix before my segment = running max of (cs - req) at segment starts
+    # (valid because cs is monotone: requests are non-negative)
+    base = jnp.where(seg_start[:, None], cs - req_s, jnp.iinfo(jnp.int32).min)
+    base = jax.lax.associative_scan(jnp.maximum, base, axis=0)
+    excl = cs - req_s - base                        # in-segment exclusive prefix
+    fits = jnp.all(excl + req_s <= free_at_choice[order], axis=-1)
+    accept_sorted = fits & want[order]
+    accept = jnp.zeros(P, bool).at[order].set(accept_sorted)
+    return accept
+
+
+def _relational_veto(ct: ClusterTensors, pb: PodBatch, choice, accept, rank,
+                     topo_keys: tuple[int, ...]):
+    """Reject accepted pods conflicting with a higher-rank pod accepted this
+    round (anti-affinity both directions, shared hard-spread domain, required
+    affinity forcing co-location). Conservative; rejects re-propose next round."""
+    from kubernetes_tpu.ops.exprs import eval_selector_set
+    P = pb.pod_valid.shape[0]
+    K = ct.node_labels.shape[1]
+    higher = (rank[None, :] < rank[:, None]) & accept[None, :] & accept[:, None]  # [q,p]
+    conflict = jnp.zeros((P, P), bool)
+    for k in topo_keys:
+        if k < 0 or k >= K:
+            continue
+        dv = ct.node_labels[:, k]                                   # [N]
+        dvc = dv[jnp.clip(choice, 0, dv.shape[0] - 1)]              # [P] chosen domain
+        same = (dvc[:, None] == dvc[None, :]) & (dvc[:, None] >= 0)  # [q,p]
+        ns_eq = pb.pod_ns[:, None] == pb.pod_ns[None, :]
+        if pb.anti_valid.shape[1] > 0:
+            m = eval_selector_set(pb.anti_sel, pb.pod_labels)       # [p_t, q, BT]
+            qt = (pb.anti_topo == k) & pb.anti_valid                # [q,BT]
+            # q's term matches p: m[p, q, t]
+            q_hits_p = jnp.any(m & qt[None], axis=-1).T             # [q,p]
+            conflict |= q_hits_p & same & ns_eq
+            # symmetry: p's anti term matches q -> q (lower rank) rejected
+            conflict |= q_hits_p.T & same & ns_eq
+        if pb.sc_valid.shape[1] > 0:
+            m = eval_selector_set(pb.sc_sel, pb.pod_labels)         # [p_t, q, SC]
+            qt = (pb.sc_topo == k) & pb.sc_valid & pb.sc_hard
+            q_hits_p = jnp.any(m & qt[None], axis=-1).T
+            conflict |= q_hits_p & same & ns_eq
+        if pb.aff_valid.shape[1] > 0:
+            m = eval_selector_set(pb.aff_sel, pb.pod_labels)        # [p_t, q, AT]
+            qt = (pb.aff_topo == k) & pb.aff_valid
+            q_hits_p = jnp.any(m & qt[None], axis=-1).T
+            # required affinity: must be in SAME domain as matching member
+            conflict |= q_hits_p & ~same & ns_eq
+    veto = jnp.any(conflict & higher, axis=1)
+    return accept & ~veto
+
+
+@partial(jax.jit, static_argnames=("seed", "fit_strategy", "topo_keys", "serial"))
+def gang_round(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
+               seed: int = 0, fit_strategy: str = "LeastAllocated",
+               topo_keys: tuple[int, ...] = (), serial: bool = False):
+    """One propose/accept/fold round. Returns (new_state, n_accepted)."""
+    E = ct_ext.epod_valid.shape[0] - state.committed.shape[0]
+    P = state.committed.shape[0]
+    N = ct_ext.node_valid.shape[0]
+    # wire committed members into extension slots
+    ct_round = ct_ext.replace(
+        requested=state.requested,
+        epod_node=ct_ext.epod_node.at[E:].set(state.assignment),
+        epod_valid=ct_ext.epod_valid.at[E:].set(state.committed),
+    )
+    pb_round = pb.replace(pod_valid=pb.pod_valid & ~state.committed)
+    res = evaluate(ct_round, pb_round, seed=seed,
+                   fit_strategy=fit_strategy, topo_keys=topo_keys)
+    want = res.assigned & ~state.committed & pb.pod_valid
+    # rank: priority desc, batch index asc; non-proposing pods rank last
+    prio_key = jnp.where(want, -pb.priority, jnp.iinfo(jnp.int32).max)
+    order0 = jnp.lexsort((jnp.arange(P), prio_key))
+    rank = jnp.zeros(P, jnp.int32).at[order0].set(jnp.arange(P, dtype=jnp.int32))
+    free = ct_round.allocatable - state.requested                   # [N,R]
+    free_at_choice = free[jnp.clip(res.choice, 0, N - 1)]
+    accept = _segmented_capacity_accept(res.choice, want, rank, pb.requests,
+                                        free_at_choice)
+    accept = _relational_veto(ct_round, pb, res.choice, accept, rank, topo_keys)
+    if serial:
+        # keep only the single best-rank acceptance -> exact serial semantics
+        best = jnp.min(jnp.where(accept, rank, jnp.iinfo(jnp.int32).max))
+        accept = accept & (rank == best)
+    onehot = (res.choice[:, None] == jnp.arange(N)[None, :]) & accept[:, None]
+    add = jnp.einsum("pn,pr->nr", onehot.astype(jnp.int32), pb.requests)
+    new_state = GangState(
+        requested=state.requested + add,
+        committed=state.committed | accept,
+        assignment=jnp.where(accept, res.choice, state.assignment),
+        rounds=state.rounds + 1,
+    )
+    return new_state, jnp.sum(accept)
+
+
+def gang_schedule(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
+                  fit_strategy: str = "LeastAllocated",
+                  topo_keys: tuple[int, ...] = (), serial: bool = False,
+                  max_rounds: int = 64):
+    """Drive rounds until convergence. Returns (assignment [P] np.int32 with -1
+    for unschedulable, rounds_used)."""
+    P = int(pb.pod_valid.shape[0])
+    state = GangState(
+        requested=jnp.asarray(ct.requested),
+        committed=jnp.zeros(P, bool),
+        assignment=jnp.full(P, -1, jnp.int32),
+        rounds=jnp.zeros((), jnp.int32),
+    )
+    ct_ext = extend_cluster(ct, pb)
+    limit = P if serial else max_rounds
+    for _ in range(max(limit, 1)):
+        state, n = gang_round(ct_ext, pb, state, seed=seed,
+                              fit_strategy=fit_strategy, topo_keys=topo_keys,
+                              serial=serial)
+        if int(n) == 0:
+            break
+    return np.asarray(state.assignment), int(state.rounds)

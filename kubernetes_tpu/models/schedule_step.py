@@ -47,12 +47,15 @@ def evaluate(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
     feasible &= topology.spread_mask(ct, pb, topo_keys)
     feasible &= topology.interpod_required_mask(ct, pb, topo_keys)
     feasible &= topology.interpod_symmetry_mask(ct, pb, topo_keys)
-    extra = {
-        "PodTopologySpread": (topology.spread_score_raw(ct, pb, topo_keys),
-                              "default_reverse"),
-        "InterPodAffinity": (topology.interpod_score_raw(ct, pb, topo_keys),
-                             "minmax"),
-    }
+    extra = {}
+    if pb.sc_valid.shape[1] > 0:
+        extra["PodTopologySpread"] = (
+            topology.spread_score_raw(ct, pb, topo_keys), "default_reverse",
+            jnp.any(pb.sc_valid & ~pb.sc_hard, axis=1))
+    if pb.paff_valid.shape[1] > 0:
+        extra["InterPodAffinity"] = (
+            topology.interpod_score_raw(ct, pb, topo_keys), "minmax",
+            jnp.any(pb.paff_valid, axis=1))
     scores = combined_score(ct, pb, feasible, weights=weights, extra_raw=extra,
                             fit_strategy=fit_strategy)
     choice, has = select_host(scores, seed=seed)

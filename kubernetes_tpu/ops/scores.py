@@ -158,9 +158,11 @@ def combined_score(ct: ClusterTensors, pb: PodBatch, feasible, weights=None,
                    extra_raw=None, fit_strategy: str = "LeastAllocated"):
     """Weighted sum of normalized plugin scores [P,N]; -inf on infeasible.
 
-    ``extra_raw``: dict name -> (raw [P,N], normalize_kind) for relational
-    plugins computed elsewhere (spread / inter-pod affinity), where
-    normalize_kind in {"default", "default_reverse", "minmax"}.
+    ``extra_raw``: dict name -> (raw [P,N], normalize_kind, active [P] | None)
+    for relational plugins computed elsewhere (spread / inter-pod affinity),
+    normalize_kind in {"default", "default_reverse", "minmax"}. ``active``
+    marks pods whose PreScore would NOT skip — inactive pods contribute 0
+    (the reference skips the plugin entirely, so no normalized floor).
     """
     w = dict(DEFAULT_WEIGHTS)
     if weights:
@@ -180,7 +182,7 @@ def combined_score(ct: ClusterTensors, pb: PodBatch, feasible, weights=None,
     if w.get("TaintToleration"):
         raw = taint_toleration_raw(ct, pb)
         total += w["TaintToleration"] * default_normalize(raw, feasible, reverse=True)
-    for name, (raw, kind) in (extra_raw or {}).items():
+    for name, (raw, kind, active) in (extra_raw or {}).items():
         if not w.get(name):
             continue
         if kind == "default":
@@ -189,6 +191,8 @@ def combined_score(ct: ClusterTensors, pb: PodBatch, feasible, weights=None,
             s = default_normalize(raw, feasible, reverse=True)
         else:
             s = minmax_normalize(raw, feasible)
+        if active is not None:
+            s = jnp.where(active[:, None], s, 0.0)
         total += w[name] * s
     return jnp.where(feasible, total, -jnp.inf)
 
@@ -196,15 +200,18 @@ def combined_score(ct: ClusterTensors, pb: PodBatch, feasible, weights=None,
 def select_host(scores, seed: int = 0):
     """argmax with seeded deterministic tie-break -> (node idx [P], has_node [P]).
 
-    Matches oracle.tie_break: among max-score nodes pick min of
-    ((n * 2654435761) ^ seed) & 0x3fffffff.
+    Matches oracle.tie_break exactly; the salt varies per batch position so
+    equal-score pods spread across tied nodes instead of piling onto one
+    (the reference gets the same effect from per-pod math/rand sampling).
     """
-    N = scores.shape[-1]
+    P, N = scores.shape
     has = jnp.any(jnp.isfinite(scores), axis=-1)
     best = jnp.max(scores, axis=-1, keepdims=True)
     is_best = scores == best
-    tb = ((jnp.arange(N, dtype=jnp.uint32) * jnp.uint32(2654435761))
-          ^ jnp.uint32(seed)) & jnp.uint32(0x3FFFFFFF)
-    key = jnp.where(is_best, tb[None, :].astype(jnp.int32), jnp.int32(0x7FFFFFFF))
+    salt = ((jnp.uint32(seed) + jnp.arange(P, dtype=jnp.uint32))
+            * jnp.uint32(2246822519))
+    tb = ((jnp.arange(N, dtype=jnp.uint32)[None, :] * jnp.uint32(2654435761))
+          ^ salt[:, None]) & jnp.uint32(0x3FFFFFFF)
+    key = jnp.where(is_best, tb.astype(jnp.int32), jnp.int32(0x7FFFFFFF))
     choice = jnp.argmin(key, axis=-1)
     return choice, has

@@ -110,8 +110,20 @@ def interpod_required_mask(ct: ClusterTensors, pb: PodBatch,
     if pb.aff_valid.shape[1] > 0:
         match = _term_match_epods(ct, pb.aff_sel, pb.pod_ns)
         cnt, has_key = _domain_counts(ct, match, pb.aff_topo, topo_keys)
-        ok = has_key & (cnt >= 1.0)
-        out &= jnp.all(ok | ~pb.aff_valid[..., None], axis=1)
+        valid = pb.aff_valid[..., None]                         # [P,T,1]
+        # filtering.go satisfyPodAffinity: every term's topology key must
+        # exist on the node, unconditionally.
+        has_all_keys = jnp.all(has_key | ~valid, axis=1)        # [P,N]
+        sat = jnp.all((has_key & (cnt >= 1.0)) | ~valid, axis=1)
+        # Bootstrap: only when NO term has a matching pair cluster-wide AND
+        # the incoming pod matches ALL its own term selectors (the first pod
+        # of a self-affine gang).
+        self_m = eval_selector_set(pb.aff_sel, pb.pod_labels)   # [Pt,P,T]
+        self_match = self_m[jnp.arange(P), jnp.arange(P), :]    # [P,T]
+        none_any_all = jnp.all(~jnp.any(cnt >= 1.0, axis=-1) | ~pb.aff_valid, axis=1)
+        self_all = jnp.all(self_match | ~pb.aff_valid, axis=1)
+        bootstrap = none_any_all & self_all                     # [P]
+        out &= has_all_keys & (sat | bootstrap[:, None])
     if pb.anti_valid.shape[1] > 0:
         match = _term_match_epods(ct, pb.anti_sel, pb.pod_ns)
         cnt, has_key = _domain_counts(ct, match, pb.anti_topo, topo_keys)

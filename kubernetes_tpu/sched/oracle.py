@@ -61,11 +61,13 @@ IMG_MIN_THRESHOLD = 23 * _MB
 IMG_MAX_CONTAINER_THRESHOLD = 1000 * _MB
 
 
-def tie_break(n: int, seed: int) -> int:
+def tie_break(n: int, seed: int, salt: int = 0) -> int:
     """Deterministic tie-break among max-score nodes: the reference reservoir-
     samples with math/rand (schedule_one.go selectHost); we use a seeded
-    multiplicative hash so TPU and oracle agree bit-for-bit."""
-    return (((n * 2654435761) & 0xFFFFFFFF) ^ seed) & 0x3FFFFFFF
+    multiplicative hash so TPU and oracle agree bit-for-bit. ``salt`` is the
+    pod's batch position (ops/scores.select_host uses the same mixing)."""
+    s = ((seed + salt) * 2246822519) & 0xFFFFFFFF
+    return (((n * 2654435761) & 0xFFFFFFFF) ^ s) & 0x3FFFFFFF
 
 
 @dataclass
@@ -133,7 +135,7 @@ class OracleScheduler:
 
     # ---- filters ---------------------------------------------------------
 
-    def _filter_one(self, pod: Pod, st: NodeState, ni: int) -> Optional[str]:
+    def _filter_one(self, pod: Pod, st: NodeState, ni: int, ctx: dict) -> Optional[str]:
         node = st.node
         if node.spec.unschedulable and not any(
                 t.tolerates(UNSCHED_TAINT) for t in pod.spec.tolerations):
@@ -151,12 +153,71 @@ class OracleScheduler:
             return FailReason.TAINT
         if self._ports_conflict(pod, st):
             return FailReason.PORTS
-        if not self._spread_ok(pod, st):
+        if not self._spread_ok(st, ctx):
             return FailReason.SPREAD
-        r = self._interpod_ok(pod, st)
+        r = self._interpod_ok(st, ctx)
         if r is not None:
             return r
         return None
+
+    def _pod_ctx(self, pod: Pod) -> dict:
+        """Node-independent precomputation for one pod (the PreFilter analog):
+        per-constraint domain counts, affinity pair counts + bootstrap flag,
+        and the symmetry veto set. Computed ONCE per pod, not per node."""
+        aff = pod.spec.affinity
+        pa = aff.pod_affinity if aff else None
+        pan = aff.pod_anti_affinity if aff else None
+        ns = pod.metadata.namespace
+        spread = []
+        for sc in pod.spec.topology_spread_constraints:
+            if sc.when_unsatisfiable != "DoNotSchedule":
+                continue
+            counts = self._domain_counts(pod, sc)
+            self_match = label_selector_matches(sc.label_selector, pod.metadata.labels)
+            min_count = min(counts.values()) if counts else 0
+            spread.append((sc, counts, min_count, self_match))
+        aff_counts = []
+        for term in (pa.required if pa else []):
+            counts: dict[str, int] = {}
+            for st in self.states:
+                dv = st.labels.get(term.topology_key)
+                if dv is None:
+                    continue
+                for p in st.pods:
+                    if self._term_matches_pod(term, ns, p):
+                        counts[dv] = counts.get(dv, 0) + 1
+            aff_counts.append((term, counts))
+        # filtering.go bootstrap: NO term has a matching pair anywhere AND the
+        # incoming pod matches ALL its own terms.
+        bootstrap = (bool(aff_counts)
+                     and all(not c for _, c in aff_counts)
+                     and all(self._term_matches_pod(t, ns, pod) for t, _ in aff_counts))
+        anti_counts = []
+        for term in (pan.required if pan else []):
+            counts = {}
+            for st in self.states:
+                dv = st.labels.get(term.topology_key)
+                if dv is None:
+                    continue
+                for p in st.pods:
+                    if self._term_matches_pod(term, ns, p):
+                        counts[dv] = counts.get(dv, 0) + 1
+            anti_counts.append((term, counts))
+        # Symmetry: (topology_key, domain value) pairs where some existing
+        # pod's required anti-affinity matches this pod.
+        sym_veto: set[tuple[str, str]] = set()
+        for other_st in self.states:
+            for p in other_st.pods:
+                paff = p.spec.affinity
+                pananti = paff.pod_anti_affinity if paff else None
+                for term in (pananti.required if pananti else []):
+                    if not self._term_matches_pod(term, p.metadata.namespace, pod):
+                        continue
+                    dv = other_st.labels.get(term.topology_key)
+                    if dv is not None:
+                        sym_veto.add((term.topology_key, dv))
+        return dict(spread=spread, aff=aff_counts, bootstrap=bootstrap,
+                    anti=anti_counts, sym=sym_veto)
 
     def _node_affinity_ok(self, pod: Pod, node: Node) -> bool:
         labels, fields = node.metadata.labels, node_fields(node.metadata.name)
@@ -200,16 +261,11 @@ class OracleScheduler:
                     counts[dv] += 1
         return counts
 
-    def _spread_ok(self, pod: Pod, st: NodeState) -> bool:
-        for sc in pod.spec.topology_spread_constraints:
-            if sc.when_unsatisfiable != "DoNotSchedule":
-                continue
+    def _spread_ok(self, st: NodeState, ctx: dict) -> bool:
+        for sc, counts, min_count, self_match in ctx["spread"]:
             dv = st.labels.get(sc.topology_key)
             if dv is None:
                 return False  # node without the key can't satisfy the constraint
-            counts = self._domain_counts(pod, sc)
-            self_match = label_selector_matches(sc.label_selector, pod.metadata.labels)
-            min_count = min(counts.values()) if counts else 0
             if counts.get(dv, 0) + (1 if self_match else 0) - min_count > sc.max_skew:
                 return False
         return True
@@ -221,53 +277,38 @@ class OracleScheduler:
         return (target.metadata.namespace in nss
                 and label_selector_matches(term.label_selector, target.metadata.labels))
 
-    def _domain_has_match(self, topology_key: str, dv: str, pred) -> bool:
-        for st in self.states:
-            if st.labels.get(topology_key) != dv:
-                continue
-            for p in st.pods:
-                if pred(p):
-                    return True
-        return False
-
-    def _interpod_ok(self, pod: Pod, st: NodeState) -> Optional[str]:
-        aff = pod.spec.affinity
-        pa = aff.pod_affinity if aff else None
-        pan = aff.pod_anti_affinity if aff else None
-        ns = pod.metadata.namespace
-        # Required affinity: each term needs >=1 matching existing pod in this
-        # node's domain. (The reference also lets a term match the incoming pod
-        # itself for self-affinity bootstrap; the gang batcher handles that.)
-        for term in (pa.required if pa else []):
-            dv = st.labels.get(term.topology_key)
-            if dv is None or not self._domain_has_match(
-                    term.topology_key, dv, lambda p: self._term_matches_pod(term, ns, p)):
+    def _interpod_ok(self, st: NodeState, ctx: dict) -> Optional[str]:
+        # Required affinity (filtering.go satisfyPodAffinity): every term's
+        # topology key must exist on the node; every term needs a matching pod
+        # in the node's domain, OR the global bootstrap applies.
+        if ctx["aff"]:
+            sat = True
+            for term, counts in ctx["aff"]:
+                dv = st.labels.get(term.topology_key)
+                if dv is None:
+                    return FailReason.POD_AFFINITY
+                if counts.get(dv, 0) <= 0:
+                    sat = False
+            if not sat and not ctx["bootstrap"]:
                 return FailReason.POD_AFFINITY
-        # Required anti-affinity: no matching existing pod in this domain.
-        for term in (pan.required if pan else []):
+        # Required anti-affinity: no matching existing pod in this domain
+        # (node without the key satisfies trivially).
+        for term, counts in ctx["anti"]:
             dv = st.labels.get(term.topology_key)
-            if dv is not None and self._domain_has_match(
-                    term.topology_key, dv, lambda p: self._term_matches_pod(term, ns, p)):
+            if dv is not None and counts.get(dv, 0) > 0:
                 return FailReason.POD_ANTI_AFFINITY
         # Symmetry: existing pods' required anti-affinity veto the newcomer.
-        dv_cache = st.labels
-        for other_st in self.states:
-            for p in other_st.pods:
-                paff = p.spec.affinity
-                pananti = paff.pod_anti_affinity if paff else None
-                for term in (pananti.required if pananti else []):
-                    if not self._term_matches_pod(term, p.metadata.namespace, pod):
-                        continue
-                    dv = dv_cache.get(term.topology_key)
-                    if dv is not None and other_st.labels.get(term.topology_key) == dv:
-                        return FailReason.POD_ANTI_AFFINITY
+        for key, dv in ctx["sym"]:
+            if st.labels.get(key) == dv:
+                return FailReason.POD_ANTI_AFFINITY
         return None
 
     def feasible(self, pod: Pod):
         """-> (mask list[bool], reasons dict node_name -> reason)."""
+        ctx = self._pod_ctx(pod)
         mask, reasons = [], {}
         for i, st in enumerate(self.states):
-            r = self._filter_one(pod, st, i)
+            r = self._filter_one(pod, st, i, ctx)
             mask.append(r is None)
             if r is not None:
                 reasons[st.node.metadata.name] = r
@@ -279,6 +320,7 @@ class OracleScheduler:
         """Weighted sum of normalized plugin scores; -inf for infeasible."""
         N = len(self.states)
         total = np.zeros(N, np.float32)
+        fmask = np.asarray(mask, bool)
         for name, fn in [
             ("NodeResourcesFit", self._score_least_allocated),
             ("NodeResourcesBalancedAllocation", self._score_balanced),
@@ -290,8 +332,8 @@ class OracleScheduler:
         ]:
             w = self.weights.get(name, 0.0)
             if w:
-                total += np.float32(w) * fn(pod, mask).astype(np.float32)
-        return np.where(np.asarray(mask), total, -np.inf).astype(np.float32)
+                total += np.float32(w) * fn(pod, fmask).astype(np.float32)
+        return np.where(fmask, total, -np.inf).astype(np.float32)
 
     def _fractions(self, pod: Pod, st: NodeState):
         reqs = pod.resource_requests()
@@ -364,7 +406,7 @@ class OracleScheduler:
                 if node_selector_term_matches(t.preference, st.labels,
                                               node_fields(st.node.metadata.name)):
                     raw[i] += np.float32(t.weight)
-        return _default_normalize(raw, reverse=False)
+        return _default_normalize(raw, mask, reverse=False)
 
     def _score_taints(self, pod: Pod, mask) -> np.ndarray:
         raw = np.zeros(len(self.states), np.float32)
@@ -375,7 +417,7 @@ class OracleScheduler:
                         tol.tolerates(t) for tol in pod.spec.tolerations):
                     c += 1
             raw[i] = c
-        return _default_normalize(raw, reverse=True)
+        return _default_normalize(raw, mask, reverse=True)
 
     def _score_spread(self, pod: Pod, mask) -> np.ndarray:
         """ScheduleAnyway constraints only (scoring.go PreScore): fewer
@@ -393,7 +435,7 @@ class OracleScheduler:
                 raw[i] += np.float32(counts.get(dv, 0) if dv is not None else 0)
         if not has_any:
             return np.zeros(N, np.float32)
-        return _default_normalize(raw, reverse=True)
+        return _default_normalize(raw, mask, reverse=True)
 
     def _score_interpod(self, pod: Pod, mask) -> np.ndarray:
         """Preferred inter-pod (anti)affinity of the incoming pod: +/- weight per
@@ -423,54 +465,60 @@ class OracleScheduler:
                 dv = st.labels.get(term.topology_key)
                 if dv is not None:
                     raw[i] += np.float32(w) * np.float32(counts.get(dv, 0))
-        return _minmax_normalize(raw)
+        return _minmax_normalize(raw, mask)
 
     # ---- cycle -----------------------------------------------------------
 
-    def select_host(self, scores: np.ndarray) -> Optional[int]:
+    def select_host(self, scores: np.ndarray, salt: int = 0) -> Optional[int]:
         if not np.isfinite(scores).any():
             return None
         best = np.max(scores)
         cands = [i for i in range(len(scores)) if scores[i] == best]
-        return min(cands, key=lambda n: tie_break(n, self.seed))
+        return min(cands, key=lambda n: tie_break(n, self.seed, salt))
 
-    def schedule_one(self, pod: Pod):
+    def schedule_one(self, pod: Pod, salt: int = 0):
         """-> (node index or None, reasons). Does NOT assume; caller decides."""
         mask, reasons = self.feasible(pod)
         if not any(mask):
             return None, reasons
         scores = self.score(pod, mask)
-        return self.select_host(scores), reasons
+        return self.select_host(scores, salt), reasons
 
     def assume(self, pod: Pod, node_idx: int):
         pod.spec.node_name = self.states[node_idx].node.metadata.name
         self.states[node_idx].add_pod(pod)
 
     def schedule_all(self, pods: list[Pod]):
-        """Serial loop over the batch (ScheduleOne x N). -> list of node idx/None."""
-        out = []
-        for pod in pods:
-            ni, _ = self.schedule_one(pod)
+        """Serial loop over the batch (ScheduleOne x N) in activeQ order —
+        priority desc, then arrival (list) order, exactly like the reference's
+        PrioritySort queue and the gang batcher's rank. The tie-break salt
+        stays the pod's original batch position. Results in input order."""
+        order = sorted(range(len(pods)), key=lambda i: (-pods[i].spec.priority, i))
+        out: list[Optional[int]] = [None] * len(pods)
+        for i in order:
+            ni, _ = self.schedule_one(pods[i], salt=i)
             if ni is not None:
-                self.assume(pod, ni)
-            out.append(ni)
+                self.assume(pods[i], ni)
+            out[i] = ni
         return out
 
 
-def _default_normalize(raw: np.ndarray, reverse: bool) -> np.ndarray:
-    """helper.DefaultNormalizeScore: scale raw to 0-100 by max; reverse flips."""
-    mx = np.max(raw) if raw.size else np.float32(0)
+def _default_normalize(raw: np.ndarray, mask: np.ndarray, reverse: bool) -> np.ndarray:
+    """helper.DefaultNormalizeScore over feasible nodes: scale raw to 0-100 by
+    max; reverse flips."""
+    mx = np.max(raw[mask]) if mask.any() else np.float32(0)
     if mx <= 0:
         return np.full_like(raw, np.float32(100) if reverse else np.float32(0))
     s = raw * np.float32(100) / np.float32(mx)
     return np.float32(100) - s if reverse else s
 
 
-def _minmax_normalize(raw: np.ndarray) -> np.ndarray:
-    """InterPodAffinity normalize: min-max to 0-100 (scoring.go NormalizeScore)."""
-    if raw.size == 0:
-        return raw
-    mn, mx = np.min(raw), np.max(raw)
+def _minmax_normalize(raw: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    """InterPodAffinity normalize over feasible nodes: min-max to 0-100
+    (scoring.go NormalizeScore)."""
+    if raw.size == 0 or not mask.any():
+        return np.zeros_like(raw)
+    mn, mx = np.min(raw[mask]), np.max(raw[mask])
     if mx == mn:
         return np.zeros_like(raw)
     return (raw - mn) * np.float32(100) / np.float32(mx - mn)

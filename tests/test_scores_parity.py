@@ -1,0 +1,140 @@
+"""Score parity: jitted schedule step (models/schedule_step.py) vs oracle.
+
+Strategy: for a batch of pods evaluated against the SAME cluster state (no
+intra-batch folding), total weighted scores must match the oracle to float32
+tolerance and the selected host must be score-equivalent (same best score;
+identical node when the seeded tie-break applies)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import Requirement
+from kubernetes_tpu.encode.snapshot import SnapshotEncoder
+from kubernetes_tpu.models.schedule_step import schedule_step
+from kubernetes_tpu.sched.oracle import OracleScheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+from test_filters_parity import random_node, random_pod
+
+
+def run_both(nodes, pods, bound=None, seed=0):
+    enc = SnapshotEncoder()
+    ct, meta = enc.encode_cluster(nodes, bound or [], pending_pods=pods)
+    pb = enc.encode_pods(pods, meta)
+    res = schedule_step(ct, pb, seed=seed, topo_keys=meta.topo_keys)
+    orc = OracleScheduler(nodes, bound or [], seed=seed)
+    return res, orc, len(pods), len(nodes)
+
+
+def assert_score_parity(nodes, pods, bound=None, seed=0):
+    res, orc, P, N = run_both(nodes, pods, bound, seed)
+    scores = np.asarray(res.scores)[:P, :N]
+    choices = np.asarray(res.choice)[:P]
+    assigned = np.asarray(res.assigned)[:P]
+    for i, pod in enumerate(pods):
+        mask, _ = orc.feasible(pod)
+        oscores = orc.score(pod, mask)
+        np.testing.assert_allclose(
+            np.where(np.isfinite(scores[i]), scores[i], -1e30),
+            np.where(np.isfinite(oscores), oscores, -1e30),
+            rtol=1e-4, atol=1e-3, err_msg=f"pod {pod.key}")
+        osel = orc.select_host(oscores)
+        if osel is None:
+            assert not assigned[i], f"pod {pod.key}: oracle unschedulable, tpu assigned"
+        else:
+            assert assigned[i], f"pod {pod.key}: tpu unschedulable, oracle chose {osel}"
+            # score-equivalence: the chosen node's score equals the oracle best
+            np.testing.assert_allclose(scores[i, choices[i]], oscores[osel],
+                                       rtol=1e-4, atol=1e-3)
+
+
+def test_least_allocated_prefers_empty_node():
+    nodes = [make_node("full").capacity({"cpu": "4", "memory": "8Gi"}).obj(),
+             make_node("empty").capacity({"cpu": "4", "memory": "8Gi"}).obj()]
+    bound = [make_pod("b").req({"cpu": "3", "memory": "6Gi"}).node("full").obj()]
+    pods = [make_pod("p").req({"cpu": "1", "memory": "1Gi"}).obj()]
+    res, orc, P, N = run_both(nodes, pods, bound)
+    assert np.asarray(res.choice)[0] == 1  # empty node wins LeastAllocated
+    assert_score_parity(nodes, pods, bound)
+
+
+def test_balanced_allocation_prefers_even_usage():
+    # node0 would end up cpu-heavy; node1 balanced
+    nodes = [make_node("skew").capacity({"cpu": "4", "memory": "32Gi"}).obj(),
+             make_node("even").capacity({"cpu": "4", "memory": "4Gi"}).obj()]
+    pods = [make_pod("p").req({"cpu": "2", "memory": "2Gi"}).obj()]
+    assert_score_parity(nodes, pods)
+
+
+def test_preferred_node_affinity_weights():
+    nodes = [make_node("a").capacity({"cpu": "4"}).label("zone", "us-a").obj(),
+             make_node("b").capacity({"cpu": "4"}).label("zone", "us-b").obj(),
+             make_node("c").capacity({"cpu": "4"}).label("zone", "us-c").obj()]
+    pods = [make_pod("p")
+            .preferred_node_affinity(80, Requirement("zone", "In", ["us-b"]))
+            .preferred_node_affinity(20, Requirement("zone", "In", ["us-c"]))
+            .obj()]
+    res, orc, P, N = run_both(nodes, pods)
+    assert np.asarray(res.choice)[0] == 1
+    assert_score_parity(nodes, pods)
+
+
+def test_prefer_no_schedule_taint_scores_lower():
+    nodes = [make_node("clean").capacity({"cpu": "4"}).obj(),
+             make_node("soft").capacity({"cpu": "4"})
+             .taint("slow", "", "PreferNoSchedule").obj()]
+    pods = [make_pod("p").obj()]
+    res, orc, P, N = run_both(nodes, pods)
+    assert np.asarray(res.choice)[0] == 0
+    assert_score_parity(nodes, pods)
+
+
+def test_image_locality():
+    gb = 1024 * 1024 * 1024
+    nodes = [make_node("has").capacity({"cpu": "4"}).image("big:latest", gb).obj(),
+             make_node("not").capacity({"cpu": "4"}).obj()]
+    pods = [make_pod("p").image("big:latest").obj()]
+    res, orc, P, N = run_both(nodes, pods)
+    assert np.asarray(res.choice)[0] == 0
+    assert_score_parity(nodes, pods)
+
+
+def test_tie_break_determinism():
+    nodes = [make_node(f"n{i}").capacity({"cpu": "4"}).obj() for i in range(5)]
+    pods = [make_pod("p").obj()]
+    for seed in (0, 1, 7, 12345):
+        res, orc, P, N = run_both(nodes, pods, seed=seed)
+        oscores = orc.score(pods[0], orc.feasible(pods[0])[0])
+        orc.seed = seed
+        assert np.asarray(res.choice)[0] == orc.select_host(oscores)
+
+
+def test_all_infeasible():
+    nodes = [make_node("tiny").capacity({"cpu": "1"}).obj()]
+    pods = [make_pod("p").req({"cpu": "4"}).obj()]
+    res, orc, P, N = run_both(nodes, pods)
+    assert not np.asarray(res.assigned)[0]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_score_parity(seed):
+    rng = random.Random(1000 + seed)
+    n_nodes, n_bound, n_pods = rng.randint(2, 10), rng.randint(0, 6), rng.randint(1, 8)
+    nodes = [random_node(rng, i) for i in range(n_nodes)]
+    names = [n.metadata.name for n in nodes]
+    bound = []
+    for i in range(n_bound):
+        p = random_pod(rng, 100 + i, names)
+        p.spec.node_name = rng.choice(names)
+        bound.append(p)
+    pods = [random_pod(rng, i, names) for i in range(n_pods)]
+    # add preferred affinity + images to exercise score paths
+    for i, p in enumerate(pods):
+        if rng.random() < 0.4:
+            w = make_pod("tmp")
+            w.pod = p
+            w.preferred_node_affinity(rng.randint(1, 100),
+                                      Requirement("zone", "In", [rng.choice(["us-a", "us-b"])]))
+    assert_score_parity(nodes, pods, bound, seed=seed)
