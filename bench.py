@@ -1,0 +1,91 @@
+"""Benchmark: scheduling throughput (pods/sec) on the real TPU chip.
+
+Headline config (BASELINE.json #5): 10k heterogeneous pods (spread + affinity
++ taints + selectors) onto 5k nodes, gang-batched. The metric mirrors
+scheduler_perf's SchedulingThroughput: scheduling *decisions* per second —
+the filter/score/select cycle — which is the part the reference measures and
+the part lifted onto the TPU. Host-side snapshot encoding happens once per
+cluster and is reported separately on stderr (it amortizes across cycles in
+the live scheduler via incremental updates).
+
+vs_baseline: ratio against 300 pods/s — the mid-range of upstream
+scheduler_perf thresholds for comparable workloads (BASELINE.md; the
+reference publishes no in-repo numbers, "published": {}).
+
+Env knobs: BENCH_WORKLOAD (default MixedHeterogeneous), BENCH_PODS,
+BENCH_NODES, BENCH_BATCH (default 1024).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_PODS_PER_SEC = 300.0
+
+
+def main():
+    import numpy as np
+
+    from benchmarks.workloads import WORKLOADS
+    from kubernetes_tpu.encode.snapshot import SnapshotEncoder
+    from kubernetes_tpu.models.gang import gang_schedule
+
+    name = os.environ.get("BENCH_WORKLOAD", "MixedHeterogeneous")
+    n_pods = int(os.environ.get("BENCH_PODS", "10000"))
+    n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
+    batch = int(os.environ.get("BENCH_BATCH", "1024"))
+
+    t0 = time.time()
+    nodes, pods = WORKLOADS[name](pods=n_pods, nodes=n_nodes)
+    print(f"[bench] workload {name}: {len(pods)} pods x {len(nodes)} nodes "
+          f"(gen {time.time()-t0:.1f}s)", file=sys.stderr)
+
+    t0 = time.time()
+    enc = SnapshotEncoder()
+    ct, meta = enc.encode_cluster(nodes, [], pending_pods=pods)
+    batches = [pods[i:i + batch] for i in range(0, len(pods), batch)]
+    pbs = [enc.encode_pods(b, meta) for b in batches]
+    topo_keys = meta.topo_keys
+    print(f"[bench] encode: {time.time()-t0:.1f}s "
+          f"({len(batches)} batches of {batch})", file=sys.stderr)
+
+    # Warmup: compile the gang round on the first batch shape.
+    t0 = time.time()
+    gang_schedule(ct, pbs[0], topo_keys=topo_keys, max_rounds=2)
+    print(f"[bench] warmup/compile: {time.time()-t0:.1f}s", file=sys.stderr)
+
+    # Timed: schedule every batch, carrying committed capacity forward.
+    t0 = time.time()
+    scheduled = 0
+    requested = np.asarray(ct.requested)
+    total_rounds = 0
+    for pb, chunk in zip(pbs, batches):
+        ct_run = ct.replace(requested=requested)
+        assignment, rounds = gang_schedule(ct_run, pb, topo_keys=topo_keys)
+        total_rounds += rounds
+        a = assignment[:len(chunk)]
+        scheduled += int((a >= 0).sum())
+        # fold accepted requests into the carried cluster state
+        reqs = np.asarray(pb.requests)[:len(chunk)]
+        valid = a >= 0
+        np.add.at(requested, a[valid], reqs[valid])
+    dt = time.time() - t0
+    throughput = scheduled / dt if dt > 0 else 0.0
+    print(f"[bench] scheduled {scheduled}/{len(pods)} pods in {dt:.2f}s "
+          f"({total_rounds} gang rounds)", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": f"scheduling throughput ({name} {len(pods)}x{len(nodes)})",
+        "value": round(throughput, 1),
+        "unit": "pods/sec",
+        "vs_baseline": round(throughput / BASELINE_PODS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

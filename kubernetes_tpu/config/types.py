@@ -1,0 +1,134 @@
+"""Component configuration — the KubeSchedulerConfiguration analog.
+
+Reference: ``staging/src/k8s.io/kube-scheduler/config/v1/types.go``
+(``KubeSchedulerConfiguration``, ``KubeSchedulerProfile``, ``Plugins``) and
+``pkg/scheduler/apis/config/`` (internal + defaults + validation).
+
+Profiles gate the whole behavior: each profile names a scheduler, the plugin
+sets it enables/disables, per-plugin weights, and the scoring strategy. The
+TPU batch knobs live here too (batch size, gang rounds) — they replace the
+reference's ``parallelism`` / ``percentageOfNodesToScore`` (kept as accepted
+compat fields; the TPU path always scores all nodes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import yaml
+
+from kubernetes_tpu.ops.filters import FILTERS
+from kubernetes_tpu.ops.scores import DEFAULT_WEIGHTS
+
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+ALL_FILTER_PLUGINS = tuple(FILTERS) + ("PodTopologySpread", "InterPodAffinity")
+ALL_SCORE_PLUGINS = tuple(DEFAULT_WEIGHTS)
+FIT_STRATEGIES = ("LeastAllocated", "MostAllocated", "RequestedToCapacityRatio")
+
+
+@dataclass
+class Profile:
+    """KubeSchedulerProfile analog."""
+
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    disabled_filters: list[str] = field(default_factory=list)
+    score_weights: dict[str, float] = field(default_factory=dict)  # override/disable(0)
+    fit_strategy: str = "LeastAllocated"
+    percentage_of_nodes_to_score: int = 0  # compat; TPU path scores all nodes
+
+    @property
+    def enabled_filters(self) -> Optional[set]:
+        if not self.disabled_filters:
+            return None
+        return {f for f in ALL_FILTER_PLUGINS if f not in self.disabled_filters}
+
+    def weights(self) -> dict[str, float]:
+        w = dict(DEFAULT_WEIGHTS)
+        w.update(self.score_weights)
+        return w
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Profile":
+        return cls(
+            scheduler_name=d.get("schedulerName", DEFAULT_SCHEDULER_NAME),
+            disabled_filters=list(d.get("disabledFilters") or []),
+            score_weights={k: float(v) for k, v in (d.get("scoreWeights") or {}).items()},
+            fit_strategy=d.get("fitStrategy", "LeastAllocated"),
+            percentage_of_nodes_to_score=int(d.get("percentageOfNodesToScore", 0)),
+        )
+
+
+@dataclass
+class SchedulerConfiguration:
+    profiles: list[Profile] = field(default_factory=lambda: [Profile()])
+    batch_size: int = 256          # pods per gang step (pop_batch max)
+    max_gang_rounds: int = 64
+    seed: int = 0
+    backoff_initial_s: float = 1.0
+    backoff_max_s: float = 10.0
+    assume_ttl_s: float = 30.0
+    client_qps: float = 0.0        # 0 = uncapped (reference default: 50)
+    parallelism: int = 16          # compat field; unused on TPU
+    leader_elect: bool = False
+
+    def profile_for(self, scheduler_name: str) -> Optional[Profile]:
+        for p in self.profiles:
+            if p.scheduler_name == scheduler_name:
+                return p
+        return None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SchedulerConfiguration":
+        cfg = cls()
+        if d.get("profiles"):
+            cfg.profiles = [Profile.from_dict(p) for p in d["profiles"]]
+        for yaml_key, attr in [
+            ("batchSize", "batch_size"), ("maxGangRounds", "max_gang_rounds"),
+            ("seed", "seed"), ("backoffInitialSeconds", "backoff_initial_s"),
+            ("backoffMaxSeconds", "backoff_max_s"), ("assumeTTLSeconds", "assume_ttl_s"),
+            ("clientQPS", "client_qps"), ("parallelism", "parallelism"),
+            ("leaderElect", "leader_elect"),
+        ]:
+            if yaml_key in d:
+                setattr(cfg, attr, type(getattr(cfg, attr))(d[yaml_key]))
+        return cfg
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "SchedulerConfiguration":
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f) or {})
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def validate(cfg: SchedulerConfiguration):
+    """pkg/scheduler/apis/config/validation analog: fail fast on bad config."""
+    if not cfg.profiles:
+        raise ValidationError("at least one profile required")
+    seen = set()
+    for p in cfg.profiles:
+        if not p.scheduler_name:
+            raise ValidationError("profile schedulerName must be non-empty")
+        if p.scheduler_name in seen:
+            raise ValidationError(f"duplicate profile {p.scheduler_name!r}")
+        seen.add(p.scheduler_name)
+        if p.fit_strategy not in FIT_STRATEGIES:
+            raise ValidationError(f"unknown fitStrategy {p.fit_strategy!r}")
+        for name in p.disabled_filters:
+            if name not in ALL_FILTER_PLUGINS:
+                raise ValidationError(f"unknown filter plugin {name!r}")
+        for name, w in p.score_weights.items():
+            if name not in ALL_SCORE_PLUGINS:
+                raise ValidationError(f"unknown score plugin {name!r}")
+            if w < 0:
+                raise ValidationError(f"negative weight for {name!r}")
+        if not 0 <= p.percentage_of_nodes_to_score <= 100:
+            raise ValidationError("percentageOfNodesToScore must be in [0,100]")
+    if cfg.batch_size < 1:
+        raise ValidationError("batchSize must be >= 1")
+    if cfg.max_gang_rounds < 1:
+        raise ValidationError("maxGangRounds must be >= 1")

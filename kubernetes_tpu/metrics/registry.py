@@ -1,0 +1,187 @@
+"""Metrics registry — Prometheus-style counters/gauges/histograms.
+
+Reference: ``staging/src/k8s.io/component-base/metrics/`` (registry with
+stability classes) and ``pkg/scheduler/metrics/metrics.go`` (the scheduler
+SLIs). Text exposition follows the Prometheus format so existing dashboards
+scrape unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_right
+from typing import Optional
+
+DEFAULT_BUCKETS = (0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5,
+                   1.0, 2.0, 5.0, 10.0)
+
+
+def _label_key(labels: Optional[dict]) -> tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, labels: Optional[dict] = None, by: float = 1.0):
+        k = _label_key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + by
+
+    def get(self, labels: Optional[dict] = None) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            for k, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(k)} {v}")
+        return out
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_)
+        self._values: dict[tuple, float] = {}
+
+    def set(self, value: float, labels: Optional[dict] = None):
+        with self._lock:
+            self._values[_label_key(labels)] = value
+
+    def get(self, labels: Optional[dict] = None) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for k, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(k)} {v}")
+        return out
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help_="", buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_)
+        self.buckets = tuple(buckets)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, value: float, labels: Optional[dict] = None):
+        k = _label_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(k, [0] * len(self.buckets))
+            i = bisect_right(self.buckets, value)
+            for j in range(i, len(self.buckets)):
+                counts[j] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._totals[k] = self._totals.get(k, 0) + 1
+
+    def time(self, labels: Optional[dict] = None):
+        return _Timer(self, labels)
+
+    def percentile(self, q: float, labels: Optional[dict] = None) -> float:
+        """Approximate quantile from bucket boundaries (upper bound)."""
+        k = _label_key(labels)
+        with self._lock:
+            total = self._totals.get(k, 0)
+            if not total:
+                return 0.0
+            target = q * total
+            for b, c in zip(self.buckets, self._counts.get(k, [])):
+                if c >= target:
+                    return b
+            return float("inf")
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for k in sorted(self._totals):
+                for b, c in zip(self.buckets, self._counts[k]):
+                    lk = k + (("le", str(b)),)
+                    out.append(f"{self.name}_bucket{_fmt_labels(lk)} {c}")
+                lk = k + (("le", "+Inf"),)
+                out.append(f"{self.name}_bucket{_fmt_labels(lk)} {self._totals[k]}")
+                out.append(f"{self.name}_sum{_fmt_labels(k)} {self._sums[k]}")
+                out.append(f"{self.name}_count{_fmt_labels(k)} {self._totals[k]}")
+        return out
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels):
+        self.hist, self.labels = hist, labels
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.time() - self.t0, self.labels)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, m):
+        with self._lock:
+            if m.name in self._metrics:
+                return self._metrics[m.name]
+            self._metrics[m.name] = m
+            return m
+
+    def counter(self, name, help_="") -> Counter:
+        return self._register(Counter(name, help_))
+
+    def gauge(self, name, help_="") -> Gauge:
+        return self._register(Gauge(name, help_))
+
+    def histogram(self, name, help_="", buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram(name, help_, buckets))
+
+    def expose_text(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines = []
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# Scheduler SLIs (pkg/scheduler/metrics/metrics.go analogs).
+SCHEDULE_ATTEMPTS = REGISTRY.counter(
+    "scheduler_schedule_attempts_total",
+    "Scheduling attempts by result (scheduled|unschedulable|error)")
+ATTEMPT_DURATION = REGISTRY.histogram(
+    "scheduler_scheduling_attempt_duration_seconds",
+    "End-to-end scheduling attempt latency by result")
+BATCH_DURATION = REGISTRY.histogram(
+    "scheduler_gang_batch_duration_seconds",
+    "Device-side gang batch latency")
+E2E_DURATION = REGISTRY.histogram(
+    "scheduler_pod_scheduling_sli_duration_seconds",
+    "Pod queue-add to bound latency")
+QUEUE_DEPTH = REGISTRY.gauge(
+    "scheduler_pending_pods", "Pending pods by queue (active|backoff|unschedulable)")
+GANG_ROUNDS = REGISTRY.histogram(
+    "scheduler_gang_rounds", "Conflict-resolution rounds per gang batch",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64))

@@ -45,6 +45,7 @@ class GangState(struct.PyTreeNode):
     requested: jnp.ndarray    # [N,R] current (base + committed batch members)
     committed: jnp.ndarray    # [P] bool
     assignment: jnp.ndarray   # [P] int32, -1 unassigned
+    tried: jnp.ndarray        # [P] bool (serial mode: attempted exactly once)
     rounds: jnp.ndarray       # scalar int32
 
 
@@ -167,11 +168,15 @@ def _relational_veto(ct: ClusterTensors, pb: PodBatch, choice, accept, rank,
     return accept & ~veto
 
 
-@partial(jax.jit, static_argnames=("seed", "fit_strategy", "topo_keys", "serial"))
+@partial(jax.jit, static_argnames=("seed", "fit_strategy", "topo_keys", "serial",
+                                   "weights", "enabled_filters"))
 def gang_round(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
                seed: int = 0, fit_strategy: str = "LeastAllocated",
-               topo_keys: tuple[int, ...] = (), serial: bool = False):
-    """One propose/accept/fold round. Returns (new_state, n_accepted)."""
+               topo_keys: tuple[int, ...] = (), serial: bool = False,
+               weights: tuple = (), enabled_filters: tuple = ()):
+    """One propose/accept/fold round. Returns (new_state, progress) where
+    progress counts acceptances (plus serial-mode attempts) — the driver stops
+    at 0."""
     E = ct_ext.epod_valid.shape[0] - state.committed.shape[0]
     P = state.committed.shape[0]
     N = ct_ext.node_valid.shape[0]
@@ -183,8 +188,24 @@ def gang_round(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
     )
     pb_round = pb.replace(pod_valid=pb.pod_valid & ~state.committed)
     res = evaluate(ct_round, pb_round, seed=seed,
-                   fit_strategy=fit_strategy, topo_keys=topo_keys)
+                   fit_strategy=fit_strategy, topo_keys=topo_keys,
+                   weights=dict(weights) if weights else None,
+                   enabled_filters=frozenset(enabled_filters) if enabled_filters else None)
     want = res.assigned & ~state.committed & pb.pod_valid
+    tried = state.tried
+    n_attempted = jnp.int32(0)
+    if serial:
+        # Exact ScheduleOne semantics: attempt pods once each, in a-priori
+        # (priority desc, index asc) order — a pod that fails is NOT retried
+        # even if later commits would make it feasible.
+        untried = ~state.committed & ~tried & pb.pod_valid
+        tprio = jnp.where(untried, -pb.priority, jnp.iinfo(jnp.int32).max)
+        torder = jnp.lexsort((jnp.arange(P), tprio))
+        target = torder[0]
+        is_target = (jnp.arange(P) == target) & untried[target]
+        want = want & is_target
+        tried = tried | is_target
+        n_attempted = jnp.sum(is_target).astype(jnp.int32)
     # rank: priority desc, batch index asc; non-proposing pods rank last
     prio_key = jnp.where(want, -pb.priority, jnp.iinfo(jnp.int32).max)
     order0 = jnp.lexsort((jnp.arange(P), prio_key))
@@ -194,40 +215,43 @@ def gang_round(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
     accept = _segmented_capacity_accept(res.choice, want, rank, pb.requests,
                                         free_at_choice)
     accept = _relational_veto(ct_round, pb, res.choice, accept, rank, topo_keys)
-    if serial:
-        # keep only the single best-rank acceptance -> exact serial semantics
-        best = jnp.min(jnp.where(accept, rank, jnp.iinfo(jnp.int32).max))
-        accept = accept & (rank == best)
     onehot = (res.choice[:, None] == jnp.arange(N)[None, :]) & accept[:, None]
     add = jnp.einsum("pn,pr->nr", onehot.astype(jnp.int32), pb.requests)
     new_state = GangState(
         requested=state.requested + add,
         committed=state.committed | accept,
         assignment=jnp.where(accept, res.choice, state.assignment),
+        tried=tried,
         rounds=state.rounds + 1,
     )
-    return new_state, jnp.sum(accept)
+    return new_state, jnp.sum(accept) + n_attempted
 
 
 def gang_schedule(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
                   fit_strategy: str = "LeastAllocated",
                   topo_keys: tuple[int, ...] = (), serial: bool = False,
-                  max_rounds: int = 64):
+                  max_rounds: int = 64, weights=None, enabled_filters=None):
     """Drive rounds until convergence. Returns (assignment [P] np.int32 with -1
-    for unschedulable, rounds_used)."""
+    for unschedulable, rounds_used). ``weights`` (plugin->weight) and
+    ``enabled_filters`` (set of filter names) carry the active profile's
+    plugin configuration; they are static for jit purposes."""
     P = int(pb.pod_valid.shape[0])
     state = GangState(
         requested=jnp.asarray(ct.requested),
         committed=jnp.zeros(P, bool),
         assignment=jnp.full(P, -1, jnp.int32),
+        tried=jnp.zeros(P, bool),
         rounds=jnp.zeros((), jnp.int32),
     )
     ct_ext = extend_cluster(ct, pb)
+    weights_t = tuple(sorted(weights.items())) if weights else ()
+    filters_t = tuple(sorted(enabled_filters)) if enabled_filters else ()
     limit = P if serial else max_rounds
     for _ in range(max(limit, 1)):
         state, n = gang_round(ct_ext, pb, state, seed=seed,
                               fit_strategy=fit_strategy, topo_keys=topo_keys,
-                              serial=serial)
+                              serial=serial, weights=weights_t,
+                              enabled_filters=filters_t)
         if int(n) == 0:
             break
     return np.asarray(state.assignment), int(state.rounds)

@@ -37,16 +37,24 @@ class StepResult(struct.PyTreeNode):
 
 def evaluate(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
              weights=None, fit_strategy: str = "LeastAllocated",
-             topo_keys: tuple[int, ...] = ()) -> StepResult:
+             topo_keys: tuple[int, ...] = (),
+             enabled_filters=None) -> StepResult:
     """Filter + score + select for the whole batch, assuming an EMPTY batch
     context (no intra-batch interactions — gang.py supplies those).
 
     ``topo_keys``: static tuple of distinct topology key-ids in play
-    (meta.topo_keys) — unrolls into a handful of [N,N] domain matmuls."""
-    feasible = run_filters(ct, pb)
-    feasible &= topology.spread_mask(ct, pb, topo_keys)
-    feasible &= topology.interpod_required_mask(ct, pb, topo_keys)
-    feasible &= topology.interpod_symmetry_mask(ct, pb, topo_keys)
+    (meta.topo_keys) — unrolls into a handful of [N,N] domain matmuls.
+    ``weights`` / ``enabled_filters``: the active profile's plugin config
+    (None = reference defaults / all filters)."""
+    def _on(name):
+        return enabled_filters is None or name in enabled_filters
+
+    feasible = run_filters(ct, pb, enabled=enabled_filters)
+    if _on("PodTopologySpread"):
+        feasible &= topology.spread_mask(ct, pb, topo_keys)
+    if _on("InterPodAffinity"):
+        feasible &= topology.interpod_required_mask(ct, pb, topo_keys)
+        feasible &= topology.interpod_symmetry_mask(ct, pb, topo_keys)
     extra = {}
     if pb.sc_valid.shape[1] > 0:
         extra["PodTopologySpread"] = (

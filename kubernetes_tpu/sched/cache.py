@@ -1,0 +1,137 @@
+"""Scheduler cache — cluster state aggregation + assume/expire + snapshots.
+
+Reference: ``pkg/scheduler/internal/cache/cache.go`` (``cacheImpl``:
+AssumePod/FinishBinding/ForgetPod/UpdateSnapshot with generation counters).
+
+The TPU twist: the expensive artifact is not per-node NodeInfo structs but the
+encoded ClusterTensors. ``snapshot()`` re-encodes only when the cluster
+generation moved (any node/pod add/update/remove or assume/forget), and the
+persistent SnapshotEncoder keeps intern tables stable across snapshots so
+re-encoding is allocation-churn only, not dictionary churn.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from kubernetes_tpu.api.types import Node, Pod, deep_copy
+from kubernetes_tpu.encode.snapshot import ClusterTensors, SnapshotEncoder, SnapshotMeta
+
+
+class SchedulerCache:
+    def __init__(self, assume_ttl: float = 30.0):
+        self._lock = threading.Lock()
+        self._nodes: dict[str, Node] = {}
+        self._pods: dict[str, Pod] = {}          # bound (confirmed) pods by key
+        self._assumed: dict[str, tuple[Pod, float]] = {}  # key -> (pod, deadline)
+        self._generation = 0
+        self._encoder = SnapshotEncoder()
+        self._cached: Optional[tuple[int, ClusterTensors, SnapshotMeta]] = None
+        self.assume_ttl = assume_ttl
+
+    # ---- node events -----------------------------------------------------
+
+    def add_node(self, node: Node):
+        with self._lock:
+            self._nodes[node.metadata.name] = node
+            self._generation += 1
+
+    def update_node(self, node: Node):
+        self.add_node(node)
+
+    def remove_node(self, name: str):
+        with self._lock:
+            self._nodes.pop(name, None)
+            self._generation += 1
+
+    # ---- pod events ------------------------------------------------------
+
+    def add_pod(self, pod: Pod):
+        """Bound pod observed (informer). Confirms an assume if present."""
+        with self._lock:
+            if not pod.spec.node_name:
+                return
+            self._assumed.pop(pod.key, None)
+            self._pods[pod.key] = pod
+            self._generation += 1
+
+    def update_pod(self, pod: Pod):
+        self.add_pod(pod)
+
+    def remove_pod(self, pod_key: str):
+        with self._lock:
+            existed = self._pods.pop(pod_key, None) or self._assumed.pop(pod_key, None)
+            if existed:
+                self._generation += 1
+
+    # ---- optimistic binding ---------------------------------------------
+
+    def assume(self, pod: Pod, node_name: str):
+        """Optimistically treat the pod as bound NOW (AssumePod); the binding
+        confirms via add_pod or expires after assume_ttl. Stores a COPY — the
+        caller's pod object stays unbound so a failed binding can requeue it
+        cleanly (the reference deep-copies into the cache for the same reason)."""
+        with self._lock:
+            p = deep_copy(pod)
+            p.spec.node_name = node_name
+            self._assumed[p.key] = (p, time.time() + self.assume_ttl)
+            self._generation += 1
+
+    def finish_binding(self, pod_key: str):
+        """Binding RPC done; keep assumed until the watch confirms (TTL holds)."""
+
+    def forget(self, pod_key: str):
+        """Binding failed: drop the assumption (ForgetPod)."""
+        with self._lock:
+            if self._assumed.pop(pod_key, None):
+                self._generation += 1
+
+    def _expire_assumed_locked(self):
+        now = time.time()
+        expired = [k for k, (_, dl) in self._assumed.items() if dl < now]
+        for k in expired:
+            del self._assumed[k]
+        if expired:
+            self._generation += 1
+
+    # ---- snapshot --------------------------------------------------------
+
+    def snapshot(self, pending_pods: Optional[list[Pod]] = None):
+        """-> (nodes list, ClusterTensors, SnapshotMeta). Cached by generation.
+
+        ``pending_pods`` widen the resource axis; passing a batch with a new
+        extended resource invalidates the cached encoding (rare).
+        """
+        with self._lock:
+            self._expire_assumed_locked()
+            nodes = list(self._nodes.values())
+            bound = list(self._pods.values()) + [p for p, _ in self._assumed.values()]
+            gen = self._generation
+            if self._cached is not None and self._cached[0] == gen:
+                _, ct, meta = self._cached
+                known = set(meta.resources)
+                if not any(r not in known for p in (pending_pods or [])
+                           for r in p.resource_requests()):
+                    return nodes, ct, meta
+            ct, meta = self._encoder.encode_cluster(nodes, bound,
+                                                    pending_pods=pending_pods)
+            self._cached = (gen, ct, meta)
+            return nodes, ct, meta
+
+    def encode_pods(self, pods: list[Pod], meta: SnapshotMeta):
+        with self._lock:
+            return self._encoder.encode_pods(pods, meta)
+
+    def bound_pods(self, include_assumed: bool = True) -> list[Pod]:
+        with self._lock:
+            out = list(self._pods.values())
+            if include_assumed:
+                out += [p for p, _ in self._assumed.values()]
+            return out
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {"nodes": len(self._nodes), "pods": len(self._pods),
+                    "assumed": len(self._assumed), "generation": self._generation}

@@ -1,0 +1,83 @@
+"""Preemption — the PostFilter plugin (victim search + nomination).
+
+Reference: ``pkg/scheduler/framework/plugins/defaultpreemption/
+default_preemption.go`` (``SelectVictimsOnNode``) and
+``framework/preemption/preemption.go`` (``Evaluator``, ``DryRunPreemption``).
+
+Round-1 implementation simulates on the oracle (host-side): the reference's
+DryRunPreemption is itself a per-node simulation loop, and preemption runs
+only for pods that already failed the (fast) main cycle, so the volume is low.
+A tensorized dry-run (vmap over candidate victim prefixes) is a later round's
+optimization.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.sched.oracle import OracleScheduler
+
+
+@dataclass
+class PreemptionResult:
+    node_name: str
+    victims: list[Pod]  # sorted by priority asc (evict lowest first)
+
+
+def find_candidate(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
+                   ) -> Optional[PreemptionResult]:
+    """Find the best node + minimal victim set enabling ``pod`` to schedule.
+
+    Per node: remove lower-priority pods lowest-first until feasible, then
+    reprieve (re-add highest-first while staying feasible) — mirrors
+    SelectVictimsOnNode. Candidate selection mirrors pickOneNodeForPreemption:
+    min highest-victim-priority, then min victim count, then node order.
+    """
+    best: Optional[tuple] = None
+    for i, node in enumerate(nodes):
+        victims = _victims_on_node(nodes, bound_pods, pod, node)
+        if victims is None:
+            continue
+        key = (max((v.spec.priority for v in victims), default=-1), len(victims), i)
+        if best is None or key < best[0]:
+            best = (key, node.metadata.name, victims)
+    if best is None:
+        return None
+    return PreemptionResult(node_name=best[1],
+                            victims=sorted(best[2], key=lambda p: p.spec.priority))
+
+
+def _victims_on_node(nodes, bound_pods, pod, node) -> Optional[list[Pod]]:
+    on_node = [p for p in bound_pods if p.spec.node_name == node.metadata.name]
+    lower = sorted([p for p in on_node if p.spec.priority < pod.spec.priority],
+                   key=lambda p: p.spec.priority)
+    if not lower:
+        return None
+    ni = next(i for i, n in enumerate(nodes) if n.metadata.name == node.metadata.name)
+
+    def feasible_without(removed: set[str]) -> bool:
+        remaining = [p for p in bound_pods if p.metadata.uid not in removed]
+        orc = OracleScheduler(nodes, remaining)
+        mask, _ = orc.feasible(pod)
+        return bool(mask[ni])
+
+    removed: set[str] = set()
+    victims: list[Pod] = []
+    ok = False
+    for v in lower:
+        removed.add(v.metadata.uid)
+        victims.append(v)
+        if feasible_without(removed):
+            ok = True
+            break
+    if not ok:
+        return None
+    # Reprieve: re-add highest-priority victims that aren't actually needed.
+    for v in sorted(victims, key=lambda p: -p.spec.priority):
+        trial = removed - {v.metadata.uid}
+        if feasible_without(trial):
+            removed = trial
+            victims = [p for p in victims if p.metadata.uid != v.metadata.uid]
+    return victims

@@ -1,0 +1,186 @@
+"""Scheduling queue — three-tier activeQ / backoffQ / unschedulable map.
+
+Reference: ``pkg/scheduler/internal/queue/scheduling_queue.go``
+(``PriorityQueue``: Add, Pop, AddUnschedulableIfNotPresent,
+MoveAllToActiveOrBackoffQueue). Two deliberate departures for the TPU design:
+
+- ``pop_batch``: the gang batcher wants P pods per device step, so Pop drains
+  up to ``max_batch`` pods at once (priority order preserved). The reference
+  pops exactly one.
+- Queueing hints are event-kind coarse (node-add/pod-delete/...) rather than
+  per-plugin closures; precision hints can layer on later.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kubernetes_tpu.api.types import Pod
+
+# Cluster events that can make unschedulable pods schedulable again
+# (events.go ClusterEvent analog).
+EVENT_NODE_ADD = "NodeAdd"
+EVENT_NODE_UPDATE = "NodeUpdate"
+EVENT_POD_DELETE = "PodDelete"
+EVENT_POD_UPDATE = "PodUpdate"
+EVENT_UNSCHEDULABLE_TIMEOUT = "UnschedulableTimeout"
+
+
+@dataclass(order=True)
+class _QueuedPod:
+    sort_key: tuple
+    pod: Pod = field(compare=False)
+    attempts: int = field(default=0, compare=False)
+    timestamp: float = field(default=0.0, compare=False)
+
+
+class SchedulingQueue:
+    """Thread-safe 3-tier queue with exponential per-pod backoff."""
+
+    def __init__(self, backoff_initial: float = 1.0, backoff_max: float = 10.0,
+                 unschedulable_timeout: float = 60.0):
+        self._lock = threading.Condition()
+        self._active: list[_QueuedPod] = []      # heap: (-priority, seq)
+        self._backoff: list[tuple[float, _QueuedPod]] = []  # heap: (expiry, item)
+        self._unschedulable: dict[str, _QueuedPod] = {}
+        self._keys_queued: set[str] = set()
+        self._seq = itertools.count()
+        self.backoff_initial = backoff_initial
+        self.backoff_max = backoff_max
+        self.unschedulable_timeout = unschedulable_timeout
+        self.closed = False
+
+    def _key(self, pod: Pod) -> str:
+        return pod.key
+
+    def _sort_key(self, pod: Pod):
+        # PrioritySort: priority desc, then FIFO arrival.
+        return (-pod.spec.priority, next(self._seq))
+
+    # ---- producers -------------------------------------------------------
+
+    def add(self, pod: Pod):
+        """New pod (or update making it schedulable): into activeQ."""
+        with self._lock:
+            k = self._key(pod)
+            if k in self._keys_queued:
+                return
+            if pod.spec.scheduling_gates:
+                # SchedulingGates PreEnqueue: hold until gates cleared.
+                self._unschedulable[k] = _QueuedPod(self._sort_key(pod), pod,
+                                                    timestamp=time.time())
+                self._keys_queued.add(k)
+                return
+            heapq.heappush(self._active, _QueuedPod(self._sort_key(pod), pod,
+                                                    timestamp=time.time()))
+            self._keys_queued.add(k)
+            self._lock.notify_all()
+
+    def add_unschedulable(self, pod: Pod, attempts: int):
+        """Failed scheduling attempt: backoffQ (will retry), mirroring
+        AddUnschedulableIfNotPresent with moveRequestCycle semantics folded in."""
+        with self._lock:
+            k = self._key(pod)
+            if k in self._keys_queued and k not in self._unschedulable:
+                return
+            item = _QueuedPod(self._sort_key(pod), pod, attempts=attempts,
+                              timestamp=time.time())
+            delay = min(self.backoff_initial * (2 ** max(attempts - 1, 0)),
+                        self.backoff_max)
+            heapq.heappush(self._backoff, (time.time() + delay, item))
+            self._keys_queued.add(k)
+            self._lock.notify_all()
+
+    def park_unschedulable(self, pod: Pod, attempts: int):
+        """No event expected to help soon: unschedulable map (event-driven requeue)."""
+        with self._lock:
+            k = self._key(pod)
+            self._unschedulable[k] = _QueuedPod(self._sort_key(pod), pod,
+                                                attempts=attempts,
+                                                timestamp=time.time())
+            self._keys_queued.add(k)
+
+    def delete(self, pod: Pod):
+        with self._lock:
+            k = self._key(pod)
+            self._keys_queued.discard(k)
+            self._unschedulable.pop(k, None)
+            self._active = [q for q in self._active if q.pod.key != k]
+            heapq.heapify(self._active)
+            self._backoff = [(e, q) for e, q in self._backoff if q.pod.key != k]
+            heapq.heapify(self._backoff)
+
+    def move_all_to_active_or_backoff(self, event: str):
+        """Cluster event: unschedulable pods get another chance
+        (MoveAllToActiveOrBackoffQueue)."""
+        with self._lock:
+            for k, item in list(self._unschedulable.items()):
+                if item.pod.spec.scheduling_gates:
+                    continue  # still gated; activate_gated handles gate removal
+                del self._unschedulable[k]
+                heapq.heappush(self._active, item)
+            self._lock.notify_all()
+
+    def activate_gated(self, pod: Pod):
+        """Gates removed (pod update): move from unschedulable to activeQ."""
+        with self._lock:
+            k = self._key(pod)
+            item = self._unschedulable.pop(k, None)
+            if item is not None and not pod.spec.scheduling_gates:
+                item.pod = pod
+                heapq.heappush(self._active, item)
+                self._lock.notify_all()
+
+    # ---- consumer --------------------------------------------------------
+
+    def _flush_backoff_locked(self):
+        now = time.time()
+        moved = False
+        while self._backoff and self._backoff[0][0] <= now:
+            _, item = heapq.heappop(self._backoff)
+            heapq.heappush(self._active, item)
+            moved = True
+        # unschedulable timeout sweep
+        for k, item in list(self._unschedulable.items()):
+            if (not item.pod.spec.scheduling_gates
+                    and now - item.timestamp > self.unschedulable_timeout):
+                del self._unschedulable[k]
+                heapq.heappush(self._active, item)
+                moved = True
+        return moved
+
+    def pop_batch(self, max_batch: int = 256, wait: float = 0.5
+                  ) -> list[tuple[Pod, int]]:
+        """Block until >=1 pod is available, then drain up to max_batch in
+        priority order. Returns [(pod, attempts)]."""
+        deadline = time.time() + wait
+        with self._lock:
+            while not self.closed:
+                self._flush_backoff_locked()
+                if self._active:
+                    break
+                timeout = min(0.05, max(deadline - time.time(), 0.01))
+                self._lock.wait(timeout)
+                if time.time() > deadline and not self._active:
+                    return []
+            out = []
+            while self._active and len(out) < max_batch:
+                item = heapq.heappop(self._active)
+                self._keys_queued.discard(item.pod.key)
+                out.append((item.pod, item.attempts))
+            return out
+
+    def close(self):
+        with self._lock:
+            self.closed = True
+            self._lock.notify_all()
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {"active": len(self._active), "backoff": len(self._backoff),
+                    "unschedulable": len(self._unschedulable)}

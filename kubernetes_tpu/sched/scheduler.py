@@ -1,0 +1,176 @@
+"""Scheduler main loop — pop batch -> snapshot -> gang step -> assume/bind.
+
+Reference shape: ``pkg/scheduler/scheduler.go`` (Scheduler.Run) +
+``schedule_one.go`` (scheduleOne / schedulingCycle / bindingCycle), inverted
+for batching: instead of ``wait.Until(ScheduleOne)`` popping one pod, each
+iteration drains up to batch_size pods from the queue, runs ONE device gang
+step for the whole batch, then assumes + binds asynchronously. Binding
+overlaps the next batch's scheduling cycle exactly like the reference's
+``go bindingCycle`` — failures roll back via Cache.forget.
+
+Profiles: pods are grouped by spec.schedulerName; unknown names are ignored
+(the reference leaves such pods to whatever scheduler owns them).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.config.features import DEFAULT_FEATURE_GATE
+from kubernetes_tpu.config.types import SchedulerConfiguration
+from kubernetes_tpu.metrics.registry import (
+    ATTEMPT_DURATION,
+    BATCH_DURATION,
+    GANG_ROUNDS,
+    QUEUE_DEPTH,
+    SCHEDULE_ATTEMPTS,
+)
+from kubernetes_tpu.models.gang import gang_schedule
+from kubernetes_tpu.sched.cache import SchedulerCache
+from kubernetes_tpu.sched import preemption as preemption_mod
+from kubernetes_tpu.sched.queue import SchedulingQueue
+
+# binder(pod, node_name) -> bool success. The client layer supplies the real
+# POST pods/<p>/binding; tests pass a lambda.
+Binder = Callable[[Pod, str], bool]
+
+
+class Scheduler:
+    def __init__(self, cfg: SchedulerConfiguration, cache: SchedulerCache,
+                 queue: SchedulingQueue, binder: Binder,
+                 feature_gate=DEFAULT_FEATURE_GATE,
+                 preemptor: Optional[Callable] = None):
+        self.cfg = cfg
+        self.cache = cache
+        self.queue = queue
+        self.binder = binder
+        self.features = feature_gate
+        self.preemptor = preemptor if preemptor is not None else self._default_preempt
+        self._bind_threads: list[threading.Thread] = []
+
+    # ---- one batch iteration --------------------------------------------
+
+    def run_once(self, wait: float = 0.5) -> int:
+        """Schedule one batch. Returns number of pods bound (or assumed)."""
+        batch = self.queue.pop_batch(self.cfg.batch_size, wait=wait)
+        if not batch:
+            return 0
+        stats = self.queue.stats()
+        for q, v in stats.items():
+            QUEUE_DEPTH.set(v, {"queue": q})
+
+        by_profile: dict[str, list[tuple[Pod, int]]] = {}
+        for pod, attempts in batch:
+            by_profile.setdefault(pod.spec.scheduler_name, []).append((pod, attempts))
+
+        n_bound = 0
+        for sched_name, items in by_profile.items():
+            profile = self.cfg.profile_for(sched_name)
+            if profile is None:
+                # Not ours. The informer layer normally filters these out; if
+                # one slips through, park it rather than losing it.
+                for pod, attempts in items:
+                    self.queue.park_unschedulable(pod, attempts)
+                continue
+            n_bound += self._schedule_group(profile, items)
+        return n_bound
+
+    def _schedule_group(self, profile, items) -> int:
+        t0 = time.time()
+        pods = [p for p, _ in items]
+        nodes, ct, meta = self.cache.snapshot(pending_pods=pods)
+        if not nodes:
+            for pod, attempts in items:
+                self.queue.add_unschedulable(pod, attempts + 1)
+                SCHEDULE_ATTEMPTS.inc({"result": "unschedulable"})
+            return 0
+        pb = self.cache.encode_pods(pods, meta)
+        serial = not self.features.enabled("TPUBatchScheduling")
+        with BATCH_DURATION.time():
+            assignment, rounds = gang_schedule(
+                ct, pb, seed=self.cfg.seed, fit_strategy=profile.fit_strategy,
+                topo_keys=meta.topo_keys, serial=serial,
+                max_rounds=self.cfg.max_gang_rounds,
+                weights=profile.weights(),
+                enabled_filters=profile.enabled_filters)
+        GANG_ROUNDS.observe(rounds)
+
+        n_bound = 0
+        dt = time.time() - t0
+        for (pod, attempts), a in zip(items, assignment[:len(items)]):
+            if a >= 0:
+                node_name = meta.node_names[int(a)]
+                self.cache.assume(pod, node_name)
+                self._bind_async(pod, node_name)
+                SCHEDULE_ATTEMPTS.inc({"result": "scheduled"})
+                ATTEMPT_DURATION.observe(dt, {"result": "scheduled"})
+                n_bound += 1
+            else:
+                self._handle_failure(pod, attempts)
+                ATTEMPT_DURATION.observe(dt, {"result": "unschedulable"})
+        return n_bound
+
+    # ---- failure path: PostFilter / preemption ---------------------------
+
+    def _handle_failure(self, pod: Pod, attempts: int):
+        SCHEDULE_ATTEMPTS.inc({"result": "unschedulable"})
+        nominated = None
+        if pod.spec.priority > 0 and self.features.enabled("PreemptionSimulation"):
+            nominated = self.preemptor(pod)
+        if nominated:
+            # Victims were evicted: retry immediately (no backoff) so the
+            # freed capacity isn't stolen by lower-priority arrivals.
+            pod.status.nominated_node_name = nominated
+            self.queue.add(pod)
+        else:
+            self.queue.add_unschedulable(pod, attempts + 1)
+
+    def _default_preempt(self, pod: Pod) -> Optional[str]:
+        nodes, _, _ = self.cache.snapshot()
+        bound = self.cache.bound_pods(include_assumed=True)
+        res = preemption_mod.find_candidate(nodes, bound, pod)
+        if res is None:
+            return None
+        for v in res.victims:
+            self._evict(v)
+        return res.node_name
+
+    def _evict(self, victim: Pod):
+        """Delete the victim via the binder-side client (overridden by the
+        connected scheduler); cache removal happens via the watch event."""
+        self.cache.remove_pod(victim.key)
+
+    # ---- binding cycle (async, overlaps next batch) ----------------------
+
+    def _bind_async(self, pod: Pod, node_name: str):
+        self._bind_threads = [t for t in self._bind_threads if t.is_alive()]
+        t = threading.Thread(target=self._bind_one, args=(pod, node_name), daemon=True)
+        t.start()
+        self._bind_threads.append(t)
+
+    def _bind_one(self, pod: Pod, node_name: str):
+        try:
+            ok = self.binder(pod, node_name)
+        except Exception:
+            ok = False
+        if ok:
+            self.cache.finish_binding(pod.key)
+        else:
+            self.cache.forget(pod.key)
+            self.queue.add_unschedulable(pod, 1)
+            SCHEDULE_ATTEMPTS.inc({"result": "error"})
+
+    def wait_for_bindings(self, timeout: float = 5.0):
+        for t in list(self._bind_threads):
+            t.join(timeout)
+        self._bind_threads = [t for t in self._bind_threads if t.is_alive()]
+
+    # ---- loop ------------------------------------------------------------
+
+    def run(self, stop: threading.Event):
+        """wait.UntilWithContext(sched.ScheduleOne, 0) analog."""
+        while not stop.is_set() and not self.queue.closed:
+            self.run_once()

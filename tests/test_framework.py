@@ -1,0 +1,268 @@
+"""Scheduler framework: queue, cache, config, metrics, preemption, main loop."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.config.features import FeatureGate
+from kubernetes_tpu.config.types import (
+    Profile,
+    SchedulerConfiguration,
+    ValidationError,
+    validate,
+)
+from kubernetes_tpu.metrics.registry import Registry
+from kubernetes_tpu.sched.cache import SchedulerCache
+from kubernetes_tpu.sched.preemption import find_candidate
+from kubernetes_tpu.sched.queue import SchedulingQueue
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+# ------------------------------------------------------------------- queue
+
+def test_queue_priority_and_fifo_order():
+    q = SchedulingQueue()
+    q.add(make_pod("low").priority(1).obj())
+    q.add(make_pod("high").priority(10).obj())
+    q.add(make_pod("mid").priority(5).obj())
+    batch = q.pop_batch(10, wait=0.1)
+    assert [p.metadata.name for p, _ in batch] == ["high", "mid", "low"]
+
+
+def test_queue_backoff_requeues():
+    q = SchedulingQueue(backoff_initial=0.05, backoff_max=0.1)
+    pod = make_pod("p").obj()
+    q.add_unschedulable(pod, attempts=1)
+    assert q.pop_batch(10, wait=0.01) == []      # still backing off
+    time.sleep(0.07)
+    batch = q.pop_batch(10, wait=0.2)
+    assert len(batch) == 1 and batch[0][1] == 1
+
+
+def test_queue_scheduling_gates_hold_until_cleared():
+    q = SchedulingQueue()
+    pod = make_pod("gated").scheduling_gate("wait-for-quota").obj()
+    q.add(pod)
+    assert q.pop_batch(10, wait=0.05) == []
+    q.move_all_to_active_or_backoff("NodeAdd")
+    assert q.pop_batch(10, wait=0.05) == []      # still gated
+    pod.spec.scheduling_gates = []
+    q.activate_gated(pod)
+    assert len(q.pop_batch(10, wait=0.1)) == 1
+
+
+def test_queue_event_moves_unschedulable():
+    q = SchedulingQueue(unschedulable_timeout=999)
+    q.park_unschedulable(make_pod("stuck").obj(), attempts=3)
+    assert q.pop_batch(10, wait=0.05) == []
+    q.move_all_to_active_or_backoff("NodeAdd")
+    batch = q.pop_batch(10, wait=0.1)
+    assert len(batch) == 1 and batch[0][1] == 3
+
+
+def test_queue_dedup():
+    q = SchedulingQueue()
+    pod = make_pod("p").obj()
+    q.add(pod)
+    q.add(pod)
+    assert len(q.pop_batch(10, wait=0.1)) == 1
+
+
+# ------------------------------------------------------------------- cache
+
+def test_cache_snapshot_caching_and_invalidation():
+    c = SchedulerCache()
+    c.add_node(make_node("n0").capacity({"cpu": "4"}).obj())
+    nodes1, ct1, meta1 = c.snapshot()
+    nodes2, ct2, meta2 = c.snapshot()
+    assert ct1 is ct2, "unchanged cluster must reuse the encoded snapshot"
+    c.add_node(make_node("n1").capacity({"cpu": "8"}).obj())
+    nodes3, ct3, _ = c.snapshot()
+    assert ct3 is not ct1 and len(nodes3) == 2
+
+
+def test_cache_assume_expire_and_confirm():
+    c = SchedulerCache(assume_ttl=0.05)
+    c.add_node(make_node("n0").capacity({"cpu": "4", "pods": "10"}).obj())
+    pod = make_pod("p").req({"cpu": "2"}).obj()
+    c.assume(pod, "n0")
+    _, ct, _ = c.snapshot()
+    assert np.asarray(ct.requested)[0, 0] == 2000  # assumed folded in
+    time.sleep(0.07)
+    _, ct2, _ = c.snapshot()
+    assert np.asarray(ct2.requested)[0, 0] == 0    # expired, forgotten
+    # confirm path: assume then add_pod confirms, no expiry
+    c.assume(pod, "n0")
+    bound = make_pod("p").req({"cpu": "2"}).node("n0").obj()
+    bound.metadata.uid = pod.metadata.uid
+    c.add_pod(bound)
+    time.sleep(0.07)
+    _, ct3, _ = c.snapshot()
+    assert np.asarray(ct3.requested)[0, 0] == 2000
+
+
+def test_cache_forget_rolls_back():
+    c = SchedulerCache()
+    c.add_node(make_node("n0").capacity({"cpu": "4"}).obj())
+    pod = make_pod("p").req({"cpu": "2"}).obj()
+    c.assume(pod, "n0")
+    c.forget(pod.key)
+    _, ct, _ = c.snapshot()
+    assert np.asarray(ct.requested)[0, 0] == 0
+
+
+# ------------------------------------------------------------------- config
+
+def test_config_defaults_and_yaml(tmp_path):
+    cfg = SchedulerConfiguration()
+    validate(cfg)
+    f = tmp_path / "cfg.yaml"
+    f.write_text("""
+batchSize: 128
+profiles:
+- schedulerName: default-scheduler
+  fitStrategy: MostAllocated
+  scoreWeights: {ImageLocality: 0}
+- schedulerName: binpack
+  disabledFilters: [NodePorts]
+""")
+    cfg = SchedulerConfiguration.from_yaml(str(f))
+    validate(cfg)
+    assert cfg.batch_size == 128
+    assert cfg.profile_for("binpack").enabled_filters is not None
+    assert "NodePorts" not in cfg.profile_for("binpack").enabled_filters
+    assert cfg.profile_for("default-scheduler").weights()["ImageLocality"] == 0
+
+
+@pytest.mark.parametrize("mutate,err", [
+    (lambda c: c.profiles.clear(), "at least one"),
+    (lambda c: setattr(c.profiles[0], "fit_strategy", "Bogus"), "fitStrategy"),
+    (lambda c: c.profiles[0].disabled_filters.append("Nope"), "unknown filter"),
+    (lambda c: c.profiles[0].score_weights.update({"NodeAffinity": -1}), "negative"),
+    (lambda c: setattr(c, "batch_size", 0), "batchSize"),
+    (lambda c: c.profiles.append(Profile()), "duplicate"),
+])
+def test_config_validation_rejects(mutate, err):
+    cfg = SchedulerConfiguration()
+    mutate(cfg)
+    with pytest.raises(ValidationError, match=err):
+        validate(cfg)
+
+
+def test_feature_gates():
+    fg = FeatureGate()
+    assert fg.enabled("TPUBatchScheduling")
+    fg.set("TPUBatchScheduling", False)
+    assert not fg.enabled("TPUBatchScheduling")
+    with pytest.raises(ValueError):
+        fg.set("SchedulingGates", False)  # GA locked
+    with pytest.raises(KeyError):
+        fg.enabled("NoSuchGate")
+
+
+# ------------------------------------------------------------------- metrics
+
+def test_metrics_exposition():
+    r = Registry()
+    c = r.counter("test_total", "help text")
+    c.inc({"result": "ok"})
+    c.inc({"result": "ok"})
+    h = r.histogram("test_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    text = r.expose_text()
+    assert 'test_total{result="ok"} 2.0' in text
+    assert 'test_seconds_bucket{le="0.1"} 1' in text
+    assert 'test_seconds_count 2' in text
+    assert h.percentile(0.5) == 0.1
+
+
+# ---------------------------------------------------------------- preemption
+
+def test_preemption_evicts_minimal_lowest_priority():
+    nodes = [make_node("n0").capacity({"cpu": "4", "pods": "10"}).obj()]
+    bound = [make_pod("v-low").req({"cpu": "2"}).priority(1).node("n0").obj(),
+             make_pod("v-mid").req({"cpu": "2"}).priority(5).node("n0").obj()]
+    pod = make_pod("hi").req({"cpu": "2"}).priority(100).obj()
+    res = find_candidate(nodes, bound, pod)
+    assert res is not None and res.node_name == "n0"
+    assert [v.metadata.name for v in res.victims] == ["v-low"]
+
+
+def test_preemption_no_candidate_when_priorities_equal():
+    nodes = [make_node("n0").capacity({"cpu": "4"}).obj()]
+    bound = [make_pod("same").req({"cpu": "4"}).priority(10).node("n0").obj()]
+    pod = make_pod("p").req({"cpu": "2"}).priority(10).obj()
+    assert find_candidate(nodes, bound, pod) is None
+
+
+# ---------------------------------------------------------- scheduler loop
+
+def make_sched(nodes, binder=None, cfg=None):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    queue = SchedulingQueue(backoff_initial=0.05)
+    bound_log = []
+
+    def default_binder(pod, node_name):
+        bound_log.append((pod.metadata.name, node_name))
+        return True
+
+    sched = Scheduler(cfg or SchedulerConfiguration(), cache, queue,
+                      binder or default_binder)
+    return sched, queue, cache, bound_log
+
+
+def test_scheduler_end_to_end_batch():
+    nodes = [make_node(f"n{i}").capacity({"cpu": "4", "pods": "10"}).obj()
+             for i in range(4)]
+    sched, queue, cache, log = make_sched(nodes)
+    for i in range(8):
+        queue.add(make_pod(f"p{i}").req({"cpu": "1"}).obj())
+    n = sched.run_once()
+    sched.wait_for_bindings()
+    assert n == 8 and len(log) == 8
+    assert cache.stats()["assumed"] == 8
+
+
+def test_scheduler_unschedulable_goes_to_backoff():
+    nodes = [make_node("tiny").capacity({"cpu": "1"}).obj()]
+    sched, queue, cache, log = make_sched(nodes)
+    queue.add(make_pod("big").req({"cpu": "8"}).obj())
+    assert sched.run_once() == 0
+    assert queue.stats()["backoff"] == 1
+
+
+def test_scheduler_failed_binding_rolls_back():
+    nodes = [make_node("n0").capacity({"cpu": "4"}).obj()]
+    sched, queue, cache, log = make_sched(nodes, binder=lambda p, n: False)
+    queue.add(make_pod("p").req({"cpu": "1"}).obj())
+    sched.run_once()
+    sched.wait_for_bindings()
+    assert cache.stats()["assumed"] == 0      # forgotten
+    assert queue.stats()["backoff"] == 1      # requeued
+
+
+def test_scheduler_ignores_foreign_scheduler_name():
+    nodes = [make_node("n0").capacity({"cpu": "4"}).obj()]
+    sched, queue, cache, log = make_sched(nodes)
+    queue.add(make_pod("alien").scheduler_name("other-scheduler").obj())
+    assert sched.run_once() == 0
+    assert log == []
+
+
+def test_scheduler_profile_fit_strategy():
+    # MostAllocated profile should bin-pack onto the fuller node
+    nodes = [make_node("fuller").capacity({"cpu": "4", "pods": "10"}).obj(),
+             make_node("empty").capacity({"cpu": "4", "pods": "10"}).obj()]
+    cfg = SchedulerConfiguration(profiles=[Profile(fit_strategy="MostAllocated")])
+    sched, queue, cache, log = make_sched(nodes, cfg=cfg)
+    cache.add_pod(make_pod("seed").req({"cpu": "2"}).node("fuller").obj())
+    queue.add(make_pod("p").req({"cpu": "1"}).obj())
+    sched.run_once()
+    sched.wait_for_bindings()
+    assert log == [("p", "fuller")]

@@ -157,6 +157,31 @@ def test_priority_order_respected_under_scarcity():
     assert assignment[1] == 0 and assignment[0] == -1
 
 
+def test_serial_does_not_retry_failed_pods():
+    """ScheduleOne semantics: a pod that fails is not retried within the batch,
+    even if a later commit would have made it feasible."""
+    nodes = [make_node("n0").capacity({"cpu": "8"}).label("zone", "z1").obj()]
+    A = make_pod("a").pod_affinity("zone", {"app": "web"}).obj()  # needs app=web
+    B = make_pod("b").label("app", "web").obj()
+    ct, pb, meta = encode(nodes, [A, B])
+    a, _ = gang_schedule(ct, pb, topo_keys=meta.topo_keys, serial=True)
+    oracle = OracleScheduler(nodes, []).schedule_all([_unbound(A), _unbound(B)])
+    assert [int(x) for x in a[:2]] == [-1, 0]
+    assert oracle == [None, 0]
+
+
+def test_profile_weights_and_fit_strategy_wiring():
+    nodes = [make_node("fuller").capacity({"cpu": "4", "pods": "10"}).obj(),
+             make_node("empty").capacity({"cpu": "4", "pods": "10"}).obj()]
+    bound = [make_pod("seed").req({"cpu": "2"}).node("fuller").obj()]
+    p = make_pod("p").req({"cpu": "1"}).obj()
+    ct, pb, meta = encode(nodes, [p], bound)
+    a_least, _ = gang_schedule(ct, pb, topo_keys=meta.topo_keys)
+    a_most, _ = gang_schedule(ct, pb, topo_keys=meta.topo_keys,
+                              fit_strategy="MostAllocated")
+    assert int(a_least[0]) == 1 and int(a_most[0]) == 0
+
+
 @pytest.mark.parametrize("seed", range(3))
 def test_fuzz_serial_parity(seed):
     rng = random.Random(2000 + seed)
