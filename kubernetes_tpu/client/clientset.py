@@ -1,0 +1,329 @@
+"""Clientset — typed CRUD + watch against the API server.
+
+Reference: ``staging/src/k8s.io/client-go/kubernetes/clientset.go`` (typed
+clients) and ``rest/request.go``. Two transports share one interface:
+
+  HTTPClient      urllib against a running APIServer (process boundary, like
+                  the reference's always-HTTP client)
+  DirectClient    in-process against an ObjectStore — the fake-clientset
+                  analog (client-go/kubernetes/fake) used by tests and the
+                  single-process benchmark harness.
+
+Resource handles: ``client.pods(ns)``, ``client.nodes()``, ... each with
+create/get/list/update/update_status/delete/watch/bind/evict.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from typing import Callable, Iterator, Optional
+
+from kubernetes_tpu.store.apiserver import ALL_RESOURCES, APPS_RESOURCES
+from kubernetes_tpu.store.store import Event, NotFound, ObjectStore, TooOld
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str, reason: str = ""):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.reason = reason
+
+
+class ResourceClient:
+    """CRUD for one (plural, namespace) pair."""
+
+    def __init__(self, transport, plural: str, namespace: Optional[str]):
+        self._t = transport
+        self.plural = plural
+        self.kind, self.namespaced = ALL_RESOURCES[plural]
+        self.namespace = namespace if self.namespaced else None
+
+    def create(self, obj: dict) -> dict:
+        return self._t.create(self.plural, self.kind, self.namespace, obj)
+
+    def get(self, name: str) -> dict:
+        return self._t.get(self.plural, self.kind, self.namespace, name)
+
+    def list(self, label_selector: Optional[str] = None,
+             field_selector: Optional[str] = None) -> list[dict]:
+        return self._t.list(self.plural, self.kind, self.namespace,
+                            label_selector, field_selector)[0]
+
+    def list_rv(self, **kw) -> tuple[list[dict], int]:
+        return self._t.list(self.plural, self.kind, self.namespace,
+                            kw.get("label_selector"), kw.get("field_selector"))
+
+    def update(self, obj: dict) -> dict:
+        """Optimistic-concurrency update: the object's metadata.resourceVersion
+        is the precondition (409 Conflict on mismatch) — read-modify-write
+        races surface instead of silently last-write-winning."""
+        return self._t.update(self.plural, self.kind, self.namespace, obj, None)
+
+    def update_status(self, obj: dict) -> dict:
+        return self._t.update(self.plural, self.kind, self.namespace, obj, "status")
+
+    def delete(self, name: str) -> dict:
+        return self._t.delete(self.plural, self.kind, self.namespace, name)
+
+    def watch(self, since_rv: int = 0) -> Iterator[Event]:
+        return self._t.watch(self.plural, self.kind, self.namespace, since_rv)
+
+    # pod subresources
+    def bind(self, name: str, node_name: str) -> dict:
+        return self._t.bind(self.namespace, name, node_name)
+
+    def evict(self, name: str) -> dict:
+        return self._t.evict(self.namespace, name)
+
+
+class _Handles:
+    def pods(self, ns: str = "default") -> ResourceClient:
+        return ResourceClient(self, "pods", ns)
+
+    def nodes(self) -> ResourceClient:
+        return ResourceClient(self, "nodes", None)
+
+    def services(self, ns: str = "default") -> ResourceClient:
+        return ResourceClient(self, "services", ns)
+
+    def endpoints(self, ns: str = "default") -> ResourceClient:
+        return ResourceClient(self, "endpoints", ns)
+
+    def leases(self, ns: str = "kube-system") -> ResourceClient:
+        return ResourceClient(self, "leases", ns)
+
+    def resource(self, plural: str, ns: Optional[str] = "default") -> ResourceClient:
+        return ResourceClient(self, plural, ns)
+
+
+class DirectClient(_Handles):
+    """In-process client over an ObjectStore (fake-clientset analog). Reactor
+    hooks: ``prepend_reactor(verb, plural, fn)`` with fn(obj) -> obj | raise."""
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+        self._reactors: list[tuple[str, str, Callable]] = []
+
+    def prepend_reactor(self, verb: str, plural: str, fn: Callable):
+        self._reactors.insert(0, (verb, plural, fn))
+
+    def _react(self, verb: str, plural: str, obj):
+        for v, p, fn in self._reactors:
+            if (v == verb or v == "*") and (p == plural or p == "*"):
+                obj = fn(obj)
+        return obj
+
+    def create(self, plural, kind, ns, obj):
+        obj = self._react("create", plural, obj)
+        obj.setdefault("metadata", {})
+        if ns:
+            obj["metadata"].setdefault("namespace", ns)
+        obj.setdefault("kind", kind)
+        return self.store.create(kind, obj)
+
+    def get(self, plural, kind, ns, name):
+        return self.store.get(kind, ns or "", name)
+
+    def list(self, plural, kind, ns, label_selector, field_selector):
+        sel = None
+        if label_selector or field_selector:
+            from kubernetes_tpu.store.apiserver import _field_label_selector
+            qs = {}
+            if label_selector:
+                qs["labelSelector"] = [label_selector]
+            if field_selector:
+                qs["fieldSelector"] = [field_selector]
+            sel = _field_label_selector(qs)
+        return self.store.list(kind, namespace=ns, selector=sel)
+
+    def update(self, plural, kind, ns, obj, sub):
+        obj = self._react("update", plural, obj)
+        expect = (obj.get("metadata") or {}).get("resourceVersion") or None
+        if sub == "status":
+            cur = self.store.get(kind, ns or obj["metadata"].get("namespace", ""),
+                                 obj["metadata"]["name"])
+            cur["status"] = obj.get("status", {})
+            obj = cur
+            expect = obj["metadata"].get("resourceVersion") or None
+        return self.store.update(kind, obj, expect_rv=expect)
+
+    def delete(self, plural, kind, ns, name):
+        self._react("delete", plural, {"metadata": {"name": name, "namespace": ns}})
+        return self.store.delete(kind, ns or "", name)
+
+    def watch(self, plural, kind, ns, since_rv):
+        w = self.store.watch(kind, since_rv=since_rv)
+        if ns is None:
+            return w
+        return _NamespaceFilteredWatch(w, ns)
+
+    def bind(self, ns, name, node_name):
+        pod = self.store.get("Pod", ns or "", name)
+        if pod.get("spec", {}).get("nodeName"):
+            raise ApiError(409, "pod already bound", "Conflict")
+        pod["spec"]["nodeName"] = node_name
+        # rv precondition closes the check-then-set race between two binders
+        return self.store.update("Pod", pod,
+                                 expect_rv=pod["metadata"]["resourceVersion"])
+
+    def evict(self, ns, name):
+        return self.store.delete("Pod", ns or "", name)
+
+
+class _NamespaceFilteredWatch:
+    def __init__(self, inner, ns):
+        self._inner = inner
+        self._ns = ns
+        self.closed = False
+
+    def get(self, timeout: float = 0.2):
+        ev = self._inner.get(timeout)
+        if ev is None:
+            return None
+        if (ev.object.get("metadata") or {}).get("namespace", "") != self._ns:
+            return None
+        return ev
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        for ev in self._inner:
+            if (ev.object.get("metadata") or {}).get("namespace", "") == self._ns:
+                return ev
+        raise StopIteration
+
+    def stop(self):
+        self._inner.stop()
+
+
+class HTTPClient(_Handles):
+    """urllib transport against an APIServer URL."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _path(self, plural, ns, name=None, sub=None, query=""):
+        group = "/apis/apps/v1" if plural in APPS_RESOURCES else (
+            "/apis/coordination.k8s.io/v1" if plural == "leases" else "/api/v1")
+        p = group
+        if ns:
+            p += f"/namespaces/{ns}"
+        p += f"/{plural}"
+        if name:
+            p += f"/{name}"
+        if sub:
+            p += f"/{sub}"
+        if query:
+            p += "?" + query
+        return self.base + p
+
+    def _req(self, method, url, body=None, headers=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers={"Content-Type": "application/json",
+                                              **(headers or {})})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                status = json.loads(e.read())
+            except Exception:
+                status = {}
+            raise ApiError(e.code, status.get("message", str(e)),
+                           status.get("reason", "")) from None
+
+    def create(self, plural, kind, ns, obj):
+        return self._req("POST", self._path(plural, ns), obj)
+
+    def get(self, plural, kind, ns, name):
+        return self._req("GET", self._path(plural, ns, name))
+
+    def list(self, plural, kind, ns, label_selector, field_selector):
+        import urllib.parse
+        q = {}
+        if label_selector:
+            q["labelSelector"] = label_selector
+        if field_selector:
+            q["fieldSelector"] = field_selector
+        out = self._req("GET", self._path(plural, ns, query=urllib.parse.urlencode(q)))
+        return out.get("items", []), int(out.get("metadata", {})
+                                         .get("resourceVersion", "0"))
+
+    def update(self, plural, kind, ns, obj, sub):
+        name = obj["metadata"]["name"]
+        rv = (obj.get("metadata") or {}).get("resourceVersion")
+        headers = {"If-Match": rv} if rv else {}
+        return self._req("PUT", self._path(plural, ns, name, sub), obj,
+                         headers=headers)
+
+    def delete(self, plural, kind, ns, name):
+        return self._req("DELETE", self._path(plural, ns, name))
+
+    def bind(self, ns, name, node_name):
+        return self._req("POST", self._path("pods", ns, name, "binding"),
+                         {"target": {"kind": "Node", "name": node_name}})
+
+    def evict(self, ns, name):
+        return self._req("POST", self._path("pods", ns, name, "eviction"), {})
+
+    def watch(self, plural, kind, ns, since_rv):
+        return _HTTPWatch(self, plural, ns, since_rv)
+
+
+class _HTTPWatch:
+    """Streaming watch over chunked JSON lines."""
+
+    HEARTBEAT_GRACE = 5.0  # server heartbeats ~1s; silence beyond this = dead
+
+    def __init__(self, client: HTTPClient, plural: str, ns, since_rv: int):
+        self._url = client._path(plural, ns,
+                                 query=f"watch=true&resourceVersion={since_rv}")
+        self.closed = False
+        # read timeout doubles as the liveness window: the server heartbeats
+        # every ~1s, so a blocking readline that times out means a dead peer.
+        self._resp = urllib.request.urlopen(
+            urllib.request.Request(self._url), timeout=self.HEARTBEAT_GRACE)
+        self._lock = threading.Lock()
+
+    def get(self, timeout: float = 0.2) -> Optional[Event]:
+        if self.closed:
+            return None
+        try:
+            line = self._resp.readline()
+        except Exception:  # socket timeout (no heartbeat) or closed
+            self.closed = True
+            return None
+        if not line:
+            self.closed = True
+            return None
+        if line == b"\n":
+            return None  # heartbeat
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            return None
+        rv = int(d["object"].get("metadata", {}).get("resourceVersion", "0"))
+        return Event(d["type"], d["object"], rv)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while not self.closed:
+            ev = self.get(timeout=1.0)
+            if ev is not None:
+                return ev
+        raise StopIteration
+
+    def stop(self):
+        self.closed = True
+        try:
+            self._resp.close()
+        except Exception:
+            pass

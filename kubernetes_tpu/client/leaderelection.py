@@ -1,0 +1,94 @@
+"""Leader election over Lease objects — active-passive HA.
+
+Reference: ``client-go/tools/leaderelection/leaderelection.go``
+(``LeaderElector.Run``: acquire -> renew loop -> OnStartedLeading /
+OnStoppedLeading) with ``resourcelock/leaselock.go`` semantics (holderIdentity
++ renewTime, optimistic-concurrency updates).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from kubernetes_tpu.client.clientset import ApiError
+from kubernetes_tpu.store.store import AlreadyExists, Conflict, NotFound
+
+
+@dataclass
+class LeaderElectionConfig:
+    lock_name: str
+    identity: str
+    lease_duration: float = 15.0
+    renew_deadline: float = 10.0
+    retry_period: float = 2.0
+    on_started_leading: Optional[Callable] = None
+    on_stopped_leading: Optional[Callable] = None
+
+
+class LeaderElector:
+    def __init__(self, leases, cfg: LeaderElectionConfig):
+        self.leases = leases  # ResourceClient for leases
+        self.cfg = cfg
+        self.is_leader = False
+        self._stop = threading.Event()
+
+    def _lease_body(self) -> dict:
+        return {
+            "kind": "Lease", "apiVersion": "coordination.k8s.io/v1",
+            "metadata": {"name": self.cfg.lock_name},
+            "spec": {"holderIdentity": self.cfg.identity,
+                     "leaseDurationSeconds": int(self.cfg.lease_duration),
+                     "renewTime": time.time()},
+        }
+
+    def try_acquire_or_renew(self) -> bool:
+        now = time.time()
+        try:
+            lease = self.leases.get(self.cfg.lock_name)
+        except (NotFound, ApiError):
+            try:
+                self.leases.create(self._lease_body())
+                return True
+            except (AlreadyExists, ApiError, Conflict):
+                return False
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity", "")
+        renew = float(spec.get("renewTime", 0) or 0)
+        expired = now - renew > self.cfg.lease_duration
+        if holder != self.cfg.identity and not expired:
+            return False
+        lease["spec"] = self._lease_body()["spec"]
+        try:
+            self.leases.update(lease)
+            return True
+        except (Conflict, ApiError):
+            return False
+
+    def run(self, stop: Optional[threading.Event] = None):
+        """Block: acquire, then renew until lost or stopped."""
+        stop = stop or self._stop
+        while not stop.is_set():
+            if self.try_acquire_or_renew():
+                if not self.is_leader:
+                    self.is_leader = True
+                    if self.cfg.on_started_leading:
+                        self.cfg.on_started_leading()
+                deadline = time.time() + self.cfg.renew_deadline
+                while not stop.is_set():
+                    time.sleep(self.cfg.retry_period)
+                    if self.try_acquire_or_renew():
+                        deadline = time.time() + self.cfg.renew_deadline
+                    elif time.time() > deadline:
+                        break
+                if self.is_leader:
+                    self.is_leader = False
+                    if self.cfg.on_stopped_leading:
+                        self.cfg.on_stopped_leading()
+            else:
+                time.sleep(self.cfg.retry_period)
+
+    def stop(self):
+        self._stop.set()

@@ -103,28 +103,39 @@ def extend_cluster(ct: ClusterTensors, pb: PodBatch) -> ClusterTensors:
     )
 
 
-def _segmented_capacity_accept(choice, want, rank, requests, free_at_choice):
+def _segmented_capacity_accept(choice, want, rank, requests, free_at_choice,
+                               per_node_cap=None):
     """Per-node priority-ordered capacity acceptance.
 
     choice [P] proposed node; want [P] proposal live; rank [P] lower = first;
-    requests [P,R]; free_at_choice [P,R] free capacity on the proposed node.
+    requests [P,R]; free_at_choice [P,R] free capacity on the proposed node;
+    per_node_cap: scalar max acceptances per node this round (balance guard —
+    batch members share one snapshot, so without a cap equal-score pods pile
+    onto tie-break winners instead of spreading like the serial loop).
     Returns accept [P] bool. Uses sort + segmented exclusive cumsum.
     """
     P = choice.shape[0]
     node_key = jnp.where(want, choice, jnp.int32(0x3FFFFFFF))
     order = jnp.lexsort((rank, node_key))          # group by node, rank within
     sn = node_key[order]
-    req_s = jnp.where(want[order, None], requests[order], 0)
-    cs = jnp.cumsum(req_s, axis=0)
     seg_start = jnp.concatenate([jnp.ones(1, bool), sn[1:] != sn[:-1]])
-    # prefix before my segment = running max of (cs - req) at segment starts
-    # (valid because cs is monotone: requests are non-negative)
-    base = jnp.where(seg_start[:, None], cs - req_s, jnp.iinfo(jnp.int32).min)
-    base = jax.lax.associative_scan(jnp.maximum, base, axis=0)
-    excl = cs - req_s - base                        # in-segment exclusive prefix
-    fits = jnp.all(excl + req_s <= free_at_choice[order], axis=-1)
-    accept_sorted = fits & want[order]
-    accept = jnp.zeros(P, bool).at[order].set(accept_sorted)
+
+    def seg_excl(values):
+        """Segmented exclusive prefix sums along axis 0 (values >= 0)."""
+        cs = jnp.cumsum(values, axis=0)
+        base = jnp.where(seg_start[:, None], cs - values, jnp.iinfo(jnp.int32).min)
+        base = jax.lax.associative_scan(jnp.maximum, base, axis=0)
+        return cs - values - base
+
+    req_s = jnp.where(want[order, None], requests[order], 0)
+    fits = jnp.all(seg_excl(req_s) + req_s <= free_at_choice[order], axis=-1)
+    fits &= want[order]
+    if per_node_cap is not None:
+        # cap counts capacity-FITTING entries only (rejected ones don't burn
+        # slots); a second scan over the fits indicator gives that count.
+        ones = fits[:, None].astype(jnp.int32)
+        fits &= seg_excl(ones)[:, 0] < per_node_cap
+    accept = jnp.zeros(P, bool).at[order].set(fits)
     return accept
 
 
@@ -173,7 +184,8 @@ def _relational_veto(ct: ClusterTensors, pb: PodBatch, choice, accept, rank,
 def gang_round(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
                seed: int = 0, fit_strategy: str = "LeastAllocated",
                topo_keys: tuple[int, ...] = (), serial: bool = False,
-               weights: tuple = (), enabled_filters: tuple = ()):
+               weights: tuple = (), enabled_filters: tuple = (),
+               cap_scale=1):
     """One propose/accept/fold round. Returns (new_state, progress) where
     progress counts acceptances (plus serial-mode attempts) — the driver stops
     at 0."""
@@ -212,8 +224,15 @@ def gang_round(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
     rank = jnp.zeros(P, jnp.int32).at[order0].set(jnp.arange(P, dtype=jnp.int32))
     free = ct_round.allocatable - state.requested                   # [N,R]
     free_at_choice = free[jnp.clip(res.choice, 0, N - 1)]
+    # Balance guard: spread this round's acceptances across the nodes feasible
+    # for someone, approximating the serial loop's load feedback. cap_scale
+    # doubles every round (driver), so strict-preference workloads where the
+    # cap would serialize still converge in O(log P) rounds — early rounds do
+    # the balancing, late rounds drain.
+    distinct = jnp.sum(jnp.any(res.feasible & want[:, None], axis=0))
+    cap = jnp.maximum(1, -(-jnp.sum(want) // jnp.maximum(distinct, 1))) * cap_scale
     accept = _segmented_capacity_accept(res.choice, want, rank, pb.requests,
-                                        free_at_choice)
+                                        free_at_choice, per_node_cap=cap)
     accept = _relational_veto(ct_round, pb, res.choice, accept, rank, topo_keys)
     onehot = (res.choice[:, None] == jnp.arange(N)[None, :]) & accept[:, None]
     add = jnp.einsum("pn,pr->nr", onehot.astype(jnp.int32), pb.requests)
@@ -247,11 +266,14 @@ def gang_schedule(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
     weights_t = tuple(sorted(weights.items())) if weights else ()
     filters_t = tuple(sorted(enabled_filters)) if enabled_filters else ()
     limit = P if serial else max_rounds
+    cap_scale = 1
     for _ in range(max(limit, 1)):
         state, n = gang_round(ct_ext, pb, state, seed=seed,
                               fit_strategy=fit_strategy, topo_keys=topo_keys,
                               serial=serial, weights=weights_t,
-                              enabled_filters=filters_t)
+                              enabled_filters=filters_t,
+                              cap_scale=jnp.int32(cap_scale))
         if int(n) == 0:
             break
+        cap_scale = min(cap_scale * 2, 1 << 20)
     return np.asarray(state.assignment), int(state.rounds)

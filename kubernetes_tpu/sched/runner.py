@@ -1,0 +1,128 @@
+"""Connected scheduler — informers in, bindings out.
+
+Reference: ``cmd/kube-scheduler/app/server.go`` (Run: informers + event
+handlers feeding the queue/cache, then the scheduling loop) and the event
+registration in ``pkg/scheduler/eventhandlers.go``. Optionally wraps the loop
+in leader election (active-passive HA, SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.client.clientset import ApiError
+from kubernetes_tpu.client.informer import InformerFactory, meta_namespace_key
+from kubernetes_tpu.client.leaderelection import LeaderElectionConfig, LeaderElector
+from kubernetes_tpu.config.types import SchedulerConfiguration
+from kubernetes_tpu.sched.cache import SchedulerCache
+from kubernetes_tpu.sched.queue import (
+    EVENT_NODE_ADD,
+    EVENT_NODE_UPDATE,
+    EVENT_POD_DELETE,
+    SchedulingQueue,
+)
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.store.store import ADDED, DELETED, MODIFIED
+
+
+class SchedulerRunner:
+    """Owns informers, cache, queue, scheduler; drives the loop."""
+
+    def __init__(self, client, cfg: Optional[SchedulerConfiguration] = None,
+                 identity: str = "kubernetes-tpu-scheduler"):
+        self.client = client
+        self.cfg = cfg or SchedulerConfiguration()
+        self.cache = SchedulerCache(assume_ttl=self.cfg.assume_ttl_s)
+        self.queue = SchedulingQueue(backoff_initial=self.cfg.backoff_initial_s,
+                                     backoff_max=self.cfg.backoff_max_s)
+        self.scheduler = Scheduler(self.cfg, self.cache, self.queue, self._bind)
+        self.scheduler._evict = self._evict  # preemption deletes via API
+        self.factory = InformerFactory(client)
+        self.identity = identity
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._scheduler_names = {p.scheduler_name for p in self.cfg.profiles}
+
+    # ---- event handlers (pkg/scheduler/eventhandlers.go analog) ----------
+
+    def _on_pod(self, type_, obj, old):
+        try:
+            pod = Pod.from_dict(obj)
+        except Exception:
+            return
+        if type_ == DELETED:
+            self.queue.delete(pod)
+            self.cache.remove_pod(pod.key)
+            self.queue.move_all_to_active_or_backoff(EVENT_POD_DELETE)
+            return
+        if pod.spec.node_name:
+            # bound (or assumed-confirmed) pod
+            self.cache.add_pod(pod)
+            return
+        if pod.spec.scheduler_name not in self._scheduler_names:
+            return
+        if type_ == MODIFIED and not pod.spec.scheduling_gates:
+            self.queue.activate_gated(pod)
+        self.queue.add(pod)
+
+    def _on_node(self, type_, obj, old):
+        try:
+            node = Node.from_dict(obj)
+        except Exception:
+            return
+        if type_ == DELETED:
+            self.cache.remove_node(node.metadata.name)
+        else:
+            self.cache.update_node(node)
+            self.queue.move_all_to_active_or_backoff(
+                EVENT_NODE_ADD if type_ == ADDED else EVENT_NODE_UPDATE)
+
+    # ---- binding via API (DefaultBinder analog) --------------------------
+
+    def _bind(self, pod: Pod, node_name: str) -> bool:
+        try:
+            self.client.pods(pod.metadata.namespace).bind(pod.metadata.name, node_name)
+            return True
+        except (ApiError, Exception):
+            return False
+
+    def _evict(self, victim: Pod):
+        try:
+            self.client.pods(victim.metadata.namespace).evict(victim.metadata.name)
+        except Exception:
+            pass
+        self.cache.remove_pod(victim.key)
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self, wait_sync: float = 10.0):
+        pods = self.factory.informer("pods", None)
+        pods.add_event_handler(self._on_pod)
+        nodes = self.factory.informer("nodes", None)
+        nodes.add_event_handler(self._on_node)
+        self.factory.start_all()
+        self.factory.wait_for_cache_sync(wait_sync)
+
+        if self.cfg.leader_elect:
+            elector = LeaderElector(self.client.leases(), LeaderElectionConfig(
+                lock_name="kubernetes-tpu-scheduler", identity=self.identity,
+                on_started_leading=self._start_loop))
+            t = threading.Thread(target=elector.run, args=(self._stop,), daemon=True)
+            t.start()
+            self._threads.append(t)
+        else:
+            self._start_loop()
+        return self
+
+    def _start_loop(self):
+        t = threading.Thread(target=self.scheduler.run, args=(self._stop,),
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+        self.queue.close()
+        self.factory.stop_all()

@@ -1,0 +1,354 @@
+"""HTTP API server — REST + watch streams over the object store.
+
+Reference shape: ``apiserver/pkg/endpoints/handlers/{create,get,update,delete,
+watch}.go`` behind ``DefaultBuildHandlerChain``; the pod ``binding``
+subresource mirrors ``pkg/registry/core/pod/storage/storage.go``
+(``BindingREST.Create`` -> sets spec.nodeName). JSON only (the reference also
+speaks protobuf); watch is chunked newline-delimited JSON exactly like
+``?watch=true`` upstream.
+
+Paths:
+  /api/v1/nodes[/{name}]
+  /api/v1/namespaces/{ns}/{plural}[/{name}]          pods, services, ...
+  /api/v1/namespaces/{ns}/pods/{name}/binding        POST (bind)
+  /api/v1/namespaces/{ns}/pods/{name}/status         PUT
+  /apis/apps/v1/namespaces/{ns}/{plural}[/{name}]    deployments, replicasets
+  /healthz /readyz /metrics
+
+Admission: ordered list of ``fn(verb, kind, obj) -> obj`` callables; raising
+AdmissionError rejects the request with 400 (webhook-chain analog).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from kubernetes_tpu.metrics.registry import REGISTRY
+from kubernetes_tpu.store.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+    TooOld,
+)
+
+# kind registries: plural -> (kind, namespaced)
+CORE_RESOURCES = {
+    "pods": ("Pod", True),
+    "nodes": ("Node", False),
+    "services": ("Service", True),
+    "endpoints": ("Endpoints", True),
+    "events": ("Event", True),
+    "configmaps": ("ConfigMap", True),
+    "namespaces": ("Namespace", False),
+}
+APPS_RESOURCES = {
+    "deployments": ("Deployment", True),
+    "replicasets": ("ReplicaSet", True),
+    "statefulsets": ("StatefulSet", True),
+    "daemonsets": ("DaemonSet", True),
+    "jobs": ("Job", True),
+}
+COORD_RESOURCES = {"leases": ("Lease", True)}
+
+ALL_RESOURCES = {**CORE_RESOURCES, **APPS_RESOURCES, **COORD_RESOURCES}
+KIND_TO_PLURAL = {k: p for p, (k, _) in ALL_RESOURCES.items()}
+
+
+class AdmissionError(Exception):
+    pass
+
+
+class _BadRequest(Exception):
+    pass
+
+
+class APIServer:
+    def __init__(self, store: Optional[ObjectStore] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.store = store or ObjectStore()
+        self.admission: list[Callable] = []
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # ---- request handling ------------------------------------------------
+
+    def _admit(self, verb: str, kind: str, obj: dict) -> dict:
+        for fn in self.admission:
+            obj = fn(verb, kind, obj) or obj
+        return obj
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send_json(self, code: int, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, code: int, msg: str, reason: str = ""):
+                self._send_json(code, {"kind": "Status", "status": "Failure",
+                                       "message": msg, "reason": reason,
+                                       "code": code})
+
+            def _read_body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                if not n:
+                    return {}
+                raw = self.rfile.read(n)
+                try:
+                    out = json.loads(raw)
+                except json.JSONDecodeError as e:
+                    raise _BadRequest(f"invalid JSON body: {e}") from None
+                if not isinstance(out, dict):
+                    raise _BadRequest("body must be a JSON object")
+                return out
+
+            def _route(self):
+                """-> (plural, kind, namespace|None, name|None, subresource|None)"""
+                parts = [p for p in urlparse(self.path).path.split("/") if p]
+                # /api/v1/... or /apis/<group>/v1/...
+                if parts[:2] == ["api", "v1"]:
+                    rest = parts[2:]
+                elif len(parts) >= 3 and parts[0] == "apis":
+                    rest = parts[3:]
+                else:
+                    return None
+                ns = None
+                if rest and rest[0] == "namespaces" and len(rest) >= 3:
+                    ns, rest = rest[1], rest[2:]
+                elif rest and rest[0] == "namespaces":
+                    rest = ["namespaces"] + rest[1:]
+                if not rest:
+                    return None
+                plural = rest[0]
+                if plural not in ALL_RESOURCES:
+                    return None
+                kind, namespaced = ALL_RESOURCES[plural]
+                name = rest[1] if len(rest) > 1 else None
+                sub = rest[2] if len(rest) > 2 else None
+                return plural, kind, ns, name, sub
+
+            # ---- verbs ---------------------------------------------------
+
+            def do_GET(self):
+                path = urlparse(self.path).path
+                if path in ("/healthz", "/readyz", "/livez"):
+                    body = b"ok"
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/metrics":
+                    body = REGISTRY.expose_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                r = self._route()
+                if r is None:
+                    return self._error(404, f"unknown path {path}")
+                plural, kind, ns, name, _ = r
+                qs = parse_qs(urlparse(self.path).query)
+                if name:
+                    try:
+                        obj = server.store.get(kind, ns or "", name)
+                    except NotFound as e:
+                        return self._error(404, str(e), "NotFound")
+                    return self._send_json(200, obj)
+                if qs.get("watch", ["false"])[0] in ("true", "1"):
+                    return self._watch(kind, qs)
+                sel = _field_label_selector(qs)
+                items, rv = server.store.list(kind, namespace=ns, selector=sel)
+                return self._send_json(200, {
+                    "kind": f"{kind}List", "apiVersion": "v1",
+                    "metadata": {"resourceVersion": str(rv)}, "items": items})
+
+            def _watch(self, kind: str, qs):
+                since = int(qs.get("resourceVersion", ["0"])[0] or 0)
+                try:
+                    w = server.store.watch(kind, since_rv=since)
+                except TooOld:
+                    return self._error(410, "resourceVersion too old", "Expired")
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    idle = 0
+                    while True:
+                        ev = w.get(timeout=0.5)
+                        if ev is None:
+                            idle += 1
+                            if idle >= 2:  # ~1s heartbeat: empty payload line
+                                self.wfile.write(b"1\r\n\n\r\n")
+                                self.wfile.flush()
+                                idle = 0
+                            continue
+                        idle = 0
+                        line = json.dumps({"type": ev.type, "object": ev.object}
+                                          ).encode() + b"\n"
+                        self.wfile.write(hex(len(line))[2:].encode() + b"\r\n"
+                                         + line + b"\r\n")
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                finally:
+                    w.stop()
+
+            def do_POST(self):
+                r = self._route()
+                if r is None:
+                    return self._error(404, "unknown path")
+                plural, kind, ns, name, sub = r
+                try:
+                    body = self._read_body()
+                except _BadRequest as e:
+                    return self._error(400, str(e), "BadRequest")
+                if sub == "binding" and kind == "Pod":
+                    # BindingREST.Create: set spec.nodeName if not already set.
+                    target = body.get("target", {}).get("name", "")
+                    try:
+                        pod = server.store.get("Pod", ns or "", name)
+                    except NotFound as e:
+                        return self._error(404, str(e), "NotFound")
+                    if pod.get("spec", {}).get("nodeName"):
+                        return self._error(409, "pod already bound", "Conflict")
+                    pod["spec"]["nodeName"] = target
+                    pod.setdefault("status", {})["phase"] = "Pending"
+                    try:
+                        # rv precondition: two racing binders -> second gets 409
+                        out = server.store.update(
+                            "Pod", pod,
+                            expect_rv=pod["metadata"]["resourceVersion"])
+                    except Conflict as e:
+                        return self._error(409, str(e), "Conflict")
+                    return self._send_json(201, out)
+                if sub == "eviction" and kind == "Pod":
+                    try:
+                        out = server.store.delete("Pod", ns or "", name)
+                    except NotFound as e:
+                        return self._error(404, str(e), "NotFound")
+                    return self._send_json(200, out)
+                try:
+                    body = server._admit("CREATE", kind, body)
+                except AdmissionError as e:
+                    return self._error(400, str(e), "AdmissionDenied")
+                md = body.setdefault("metadata", {})
+                if ns:
+                    md["namespace"] = ns
+                try:
+                    out = server.store.create(kind, body)
+                except AlreadyExists as e:
+                    return self._error(409, str(e), "AlreadyExists")
+                return self._send_json(201, out)
+
+            def do_PUT(self):
+                r = self._route()
+                if r is None:
+                    return self._error(404, "unknown path")
+                plural, kind, ns, name, sub = r
+                try:
+                    body = self._read_body()
+                except _BadRequest as e:
+                    return self._error(400, str(e), "BadRequest")
+                try:
+                    body = server._admit("UPDATE", kind, body)
+                except AdmissionError as e:
+                    return self._error(400, str(e), "AdmissionDenied")
+                if sub == "status":
+                    try:
+                        cur = server.store.get(kind, ns or "", name)
+                    except NotFound as e:
+                        return self._error(404, str(e), "NotFound")
+                    cur["status"] = body.get("status", body)
+                    body = cur
+                expect = self.headers.get("If-Match") or None
+                try:
+                    out = server.store.update(kind, body, expect_rv=expect)
+                except NotFound as e:
+                    return self._error(404, str(e), "NotFound")
+                except Conflict as e:
+                    return self._error(409, str(e), "Conflict")
+                return self._send_json(200, out)
+
+            def do_DELETE(self):
+                r = self._route()
+                if r is None:
+                    return self._error(404, "unknown path")
+                plural, kind, ns, name, _ = r
+                if name is None:
+                    return self._error(405, "collection delete unsupported")
+                try:
+                    out = server.store.delete(kind, ns or "", name)
+                except NotFound as e:
+                    return self._error(404, str(e), "NotFound")
+                return self._send_json(200, out)
+
+        return Handler
+
+
+def _field_label_selector(qs) -> Optional[Callable[[dict], bool]]:
+    """labelSelector=k=v,k2=v2 and fieldSelector=spec.nodeName=x supported."""
+    lsel = qs.get("labelSelector", [None])[0]
+    fsel = qs.get("fieldSelector", [None])[0]
+    if not lsel and not fsel:
+        return None
+
+    def match(obj: dict) -> bool:
+        if lsel:
+            labels = (obj.get("metadata") or {}).get("labels") or {}
+            for pair in lsel.split(","):
+                if "=" in pair:
+                    k, v = pair.split("=", 1)
+                    if labels.get(k) != v:
+                        return False
+        if fsel:
+            for pair in fsel.split(","):
+                if "=" not in pair:
+                    continue
+                k, v = pair.split("=", 1)
+                cur = obj
+                for part in k.split("."):
+                    cur = (cur or {}).get(part)
+                    if cur is None:
+                        break
+                if (cur or "") != v:
+                    return False
+        return True
+
+    return match

@@ -1,0 +1,225 @@
+"""Versioned object store with watch — the etcd + watch-cache analog.
+
+Reference: ``staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go`` (CRUD +
+watch translation) fronted by ``storage/cacher/cacher.go`` (in-memory watch
+fan-out). One process-local store stands in for both: a monotone
+resourceVersion counter, per-(kind) keyspaces, optimistic-concurrency updates,
+and buffered watch channels with bounded replay ("too old" -> relist, like
+etcd compaction).
+
+Checkpoint/resume: the cluster state IS the checkpoint (SURVEY §5) —
+``save``/``load`` serialize the whole keyspace; components rebuild everything
+else from watches.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+ADDED, MODIFIED, DELETED, ERROR = "ADDED", "MODIFIED", "DELETED", "ERROR"
+
+REPLAY_WINDOW = 1024  # events kept for watch replay before "too old"
+
+
+class Conflict(Exception):
+    """resourceVersion mismatch (optimistic concurrency failure)."""
+
+
+class NotFound(Exception):
+    pass
+
+
+class AlreadyExists(Exception):
+    pass
+
+
+class TooOld(Exception):
+    """Requested watch resourceVersion compacted away; caller must relist."""
+
+
+@dataclass
+class Event:
+    type: str
+    object: dict
+    resource_version: int
+
+
+def obj_key(obj: dict) -> tuple[str, str]:
+    md = obj.get("metadata") or {}
+    return (md.get("namespace") or "", md["name"])
+
+
+class Watcher:
+    def __init__(self, store: "ObjectStore", kind: str, q: "queue.Queue[Event]"):
+        self._store = store
+        self._kind = kind
+        self._q = q
+        self.closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Event:
+        while not self.closed:
+            try:
+                ev = self._q.get(timeout=0.2)
+                return ev
+            except queue.Empty:
+                continue
+        raise StopIteration
+
+    def get(self, timeout: float = 0.2) -> Optional[Event]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self):
+        self.closed = True
+        self._store._drop_watcher(self._kind, self._q)
+
+
+class ObjectStore:
+    """Thread-safe multi-kind object store. Objects are plain dicts in the k8s
+    wire shape; metadata.resourceVersion is stamped on every write."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rv = 0
+        self._data: dict[str, dict[tuple[str, str], dict]] = {}
+        self._history: dict[str, list[Event]] = {}
+        self._watchers: dict[str, list[queue.Queue]] = {}
+
+    # ---- internals -------------------------------------------------------
+
+    def _bump_locked(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _emit_locked(self, kind: str, ev: Event):
+        # Detach the event payload from the authoritative dict: watchers (and
+        # informer caches) must never alias store internals. Event objects are
+        # shared among watchers and treated as immutable, like the reference's
+        # informer-cache convention.
+        ev = Event(ev.type, json.loads(json.dumps(ev.object)), ev.resource_version)
+        hist = self._history.setdefault(kind, [])
+        hist.append(ev)
+        if len(hist) > REPLAY_WINDOW:
+            del hist[:len(hist) - REPLAY_WINDOW]
+        for q in self._watchers.get(kind, []):
+            q.put(ev)
+
+    def _drop_watcher(self, kind: str, q):
+        with self._lock:
+            ws = self._watchers.get(kind, [])
+            if q in ws:
+                ws.remove(q)
+
+    # ---- CRUD ------------------------------------------------------------
+
+    def create(self, kind: str, obj: dict) -> dict:
+        with self._lock:
+            k = obj_key(obj)
+            space = self._data.setdefault(kind, {})
+            if k in space:
+                raise AlreadyExists(f"{kind} {k}")
+            rv = self._bump_locked()
+            obj = json.loads(json.dumps(obj))  # defensive copy, wire-shaped
+            obj.setdefault("metadata", {})["resourceVersion"] = str(rv)
+            space[k] = obj
+            self._emit_locked(kind, Event(ADDED, obj, rv))
+            return json.loads(json.dumps(obj))
+
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        with self._lock:
+            try:
+                return json.loads(json.dumps(self._data[kind][(namespace or "", name)]))
+            except KeyError:
+                raise NotFound(f"{kind} {namespace}/{name}") from None
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             selector: Optional[Callable[[dict], bool]] = None
+             ) -> tuple[list[dict], int]:
+        """-> (items, listResourceVersion)."""
+        with self._lock:
+            items = []
+            for (ns, _), obj in sorted(self._data.get(kind, {}).items()):
+                if namespace is not None and ns != namespace:
+                    continue
+                if selector is not None and not selector(obj):
+                    continue
+                items.append(json.loads(json.dumps(obj)))
+            return items, self._rv
+
+    def update(self, kind: str, obj: dict, expect_rv: Optional[str] = None) -> dict:
+        with self._lock:
+            k = obj_key(obj)
+            space = self._data.setdefault(kind, {})
+            if k not in space:
+                raise NotFound(f"{kind} {k}")
+            current = space[k]
+            if expect_rv is not None and current["metadata"]["resourceVersion"] != expect_rv:
+                raise Conflict(f"{kind} {k}: rv {expect_rv} != "
+                               f"{current['metadata']['resourceVersion']}")
+            rv = self._bump_locked()
+            obj = json.loads(json.dumps(obj))
+            obj.setdefault("metadata", {})["resourceVersion"] = str(rv)
+            space[k] = obj
+            self._emit_locked(kind, Event(MODIFIED, obj, rv))
+            return json.loads(json.dumps(obj))
+
+    def delete(self, kind: str, namespace: str, name: str) -> dict:
+        with self._lock:
+            k = (namespace or "", name)
+            space = self._data.setdefault(kind, {})
+            if k not in space:
+                raise NotFound(f"{kind} {namespace}/{name}")
+            obj = json.loads(json.dumps(space.pop(k)))
+            rv = self._bump_locked()
+            obj["metadata"]["resourceVersion"] = str(rv)
+            self._emit_locked(kind, Event(DELETED, obj, rv))
+            return obj
+
+    # ---- watch -----------------------------------------------------------
+
+    def watch(self, kind: str, since_rv: int = 0) -> Watcher:
+        """Watch events with rv > since_rv. Raises TooOld if the replay window
+        no longer covers since_rv (caller must relist, Reflector-style)."""
+        with self._lock:
+            q: queue.Queue = queue.Queue()
+            hist = self._history.get(kind, [])
+            if hist and hist[0].resource_version > since_rv + 1 and \
+                    since_rv < self._rv - REPLAY_WINDOW:
+                raise TooOld(f"rv {since_rv} compacted")
+            for ev in hist:
+                if ev.resource_version > since_rv:
+                    q.put(ev)
+            self._watchers.setdefault(kind, []).append(q)
+            return Watcher(self, kind, q)
+
+    # ---- checkpoint ------------------------------------------------------
+
+    def save(self, path: str):
+        with self._lock:
+            blob = {kind: list(space.values()) for kind, space in self._data.items()}
+            data = {"rv": self._rv, "data": blob}
+        with open(path, "w") as f:
+            json.dump(data, f)
+
+    def load(self, path: str):
+        with open(path) as f:
+            data = json.load(f)
+        with self._lock:
+            self._rv = data["rv"]
+            self._data = {kind: {obj_key(o): o for o in objs}
+                          for kind, objs in data["data"].items()}
+            self._history.clear()
+
+    @property
+    def resource_version(self) -> int:
+        with self._lock:
+            return self._rv

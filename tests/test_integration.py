@@ -1,0 +1,94 @@
+"""Integration: apiserver + connected scheduler, no kubelet — pods get bound.
+
+Mirrors the reference's integration tier (test/integration/scheduler/): real
+API server + real scheduler, node readiness faked, success = spec.nodeName set
+by the watch-driven pipeline.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client.clientset import DirectClient, HTTPClient
+from kubernetes_tpu.config.types import SchedulerConfiguration
+from kubernetes_tpu.sched.runner import SchedulerRunner
+from kubernetes_tpu.store.apiserver import APIServer
+from kubernetes_tpu.store.store import ObjectStore
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def wait_for(pred, timeout=10.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def cluster():
+    server = APIServer().start()
+    client = HTTPClient(server.url)
+    runner = SchedulerRunner(client, SchedulerConfiguration(
+        backoff_initial_s=0.05, backoff_max_s=0.2))
+    runner.start()
+    yield server, client, runner
+    runner.stop()
+    server.stop()
+
+
+def test_pods_scheduled_through_apiserver(cluster):
+    server, client, runner = cluster
+    nodes = client.nodes()
+    for i in range(3):
+        nodes.create(make_node(f"n{i}")
+                     .capacity({"cpu": "4", "memory": "8Gi", "pods": "10"})
+                     .obj().to_dict())
+    pods = client.pods("default")
+    for i in range(6):
+        pods.create(make_pod(f"p{i}").req({"cpu": "500m"}).obj().to_dict())
+
+    def all_bound():
+        return all(p["spec"].get("nodeName") for p in pods.list())
+
+    assert wait_for(all_bound, 15), [
+        (p["metadata"]["name"], p["spec"].get("nodeName")) for p in pods.list()]
+    # spread across nodes by LeastAllocated
+    assigned = {p["spec"]["nodeName"] for p in pods.list()}
+    assert len(assigned) == 3
+
+
+def test_unschedulable_pod_schedules_after_node_add(cluster):
+    server, client, runner = cluster
+    pods = client.pods("default")
+    pods.create(make_pod("waiting").req({"cpu": "2"}).obj().to_dict())
+    time.sleep(0.4)
+    assert not pods.get("waiting")["spec"].get("nodeName")
+    client.nodes().create(make_node("late").capacity(
+        {"cpu": "4", "pods": "10"}).obj().to_dict())
+    assert wait_for(lambda: pods.get("waiting")["spec"].get("nodeName") == "late", 15)
+
+
+def test_preemption_through_api(cluster):
+    server, client, runner = cluster
+    client.nodes().create(make_node("only").capacity(
+        {"cpu": "2", "pods": "5"}).obj().to_dict())
+    pods = client.pods("default")
+    pods.create(make_pod("victim").req({"cpu": "2"}).priority(1).obj().to_dict())
+    assert wait_for(lambda: pods.get("victim")["spec"].get("nodeName"), 15)
+    pods.create(make_pod("vip").req({"cpu": "2"}).priority(100).obj().to_dict())
+    # victim evicted, vip bound
+    assert wait_for(
+        lambda: not any(p["metadata"]["name"] == "victim" for p in pods.list())
+        and (pods.get("vip")["spec"].get("nodeName") or None), 20), \
+        [(p["metadata"]["name"], p["spec"].get("nodeName")) for p in pods.list()]
+
+
+def test_foreign_scheduler_pods_left_alone(cluster):
+    server, client, runner = cluster
+    client.nodes().create(make_node("n").capacity({"cpu": "4"}).obj().to_dict())
+    pods = client.pods("default")
+    pods.create(make_pod("foreign").scheduler_name("their-scheduler").obj().to_dict())
+    time.sleep(0.6)
+    assert not pods.get("foreign")["spec"].get("nodeName")

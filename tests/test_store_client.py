@@ -1,0 +1,248 @@
+"""Store, apiserver, clientset, informers, workqueue, leader election."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.client.clientset import ApiError, DirectClient, HTTPClient
+from kubernetes_tpu.client.informer import InformerFactory, SharedInformer
+from kubernetes_tpu.client.leaderelection import LeaderElectionConfig, LeaderElector
+from kubernetes_tpu.client.workqueue import RateLimitingQueue, WorkQueue
+from kubernetes_tpu.store.apiserver import AdmissionError, APIServer
+from kubernetes_tpu.store.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+    TooOld,
+)
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+# ------------------------------------------------------------------- store
+
+def test_store_crud_and_rv():
+    s = ObjectStore()
+    pod = make_pod("p1", "ns1").obj().to_dict()
+    created = s.create("Pod", pod)
+    assert created["metadata"]["resourceVersion"] == "1"
+    with pytest.raises(AlreadyExists):
+        s.create("Pod", pod)
+    got = s.get("Pod", "ns1", "p1")
+    got["spec"]["priority"] = 5
+    updated = s.update("Pod", got, expect_rv="1")
+    assert updated["metadata"]["resourceVersion"] == "2"
+    with pytest.raises(Conflict):
+        s.update("Pod", got, expect_rv="1")
+    items, rv = s.list("Pod")
+    assert len(items) == 1 and rv == 2
+    s.delete("Pod", "ns1", "p1")
+    with pytest.raises(NotFound):
+        s.get("Pod", "ns1", "p1")
+
+
+def test_store_watch_replay_and_live():
+    s = ObjectStore()
+    s.create("Pod", make_pod("a").obj().to_dict())
+    w = s.watch("Pod", since_rv=0)
+    s.create("Pod", make_pod("b").obj().to_dict())
+    evs = [w.get(0.2), w.get(0.2)]
+    assert [e.type for e in evs] == [ADDED, ADDED]
+    assert [e.object["metadata"]["name"] for e in evs] == ["a", "b"]
+    s.delete("Pod", "default", "a")
+    ev = w.get(0.5)
+    assert ev.type == DELETED
+    w.stop()
+
+
+def test_store_checkpoint_roundtrip(tmp_path):
+    s = ObjectStore()
+    s.create("Node", make_node("n1").obj().to_dict())
+    s.create("Pod", make_pod("p1").obj().to_dict())
+    path = str(tmp_path / "ckpt.json")
+    s.save(path)
+    s2 = ObjectStore()
+    s2.load(path)
+    assert s2.get("Node", "", "n1")["metadata"]["name"] == "n1"
+    assert s2.resource_version == s.resource_version
+
+
+# ------------------------------------------------- apiserver + http client
+
+@pytest.fixture(scope="module")
+def api():
+    server = APIServer().start()
+    yield server
+    server.stop()
+
+
+def test_apiserver_pod_lifecycle(api):
+    c = HTTPClient(api.url)
+    pods = c.pods("prod")
+    created = pods.create(make_pod("web", "prod").req({"cpu": "1"}).obj().to_dict())
+    assert created["metadata"]["namespace"] == "prod"
+    assert pods.get("web")["spec"]["containers"]
+    # bind subresource
+    pods.bind("web", "node-1")
+    assert pods.get("web")["spec"]["nodeName"] == "node-1"
+    with pytest.raises(ApiError) as ei:
+        pods.bind("web", "node-2")
+    assert ei.value.code == 409
+    # status subresource
+    obj = pods.get("web")
+    obj["status"]["phase"] = "Running"
+    pods.update_status(obj)
+    assert pods.get("web")["status"]["phase"] == "Running"
+    # list with selectors
+    pods.create(make_pod("db", "prod").label("app", "db").obj().to_dict())
+    assert len(pods.list(label_selector="app=db")) == 1
+    assert len(pods.list(field_selector="spec.nodeName=node-1")) == 1
+    pods.delete("db")
+    with pytest.raises(ApiError):
+        pods.get("db")
+
+
+def test_apiserver_watch_stream(api):
+    c = HTTPClient(api.url)
+    nodes = c.nodes()
+    _, rv = nodes.list_rv()
+    w = nodes.watch(since_rv=rv)
+    nodes.create(make_node("w1").obj().to_dict())
+    ev = None
+    for _ in range(20):
+        ev = w.get(timeout=0.5)
+        if ev:
+            break
+    w.stop()
+    assert ev is not None and ev.type == ADDED
+    assert ev.object["metadata"]["name"] == "w1"
+
+
+def test_apiserver_admission(api):
+    def deny_privileged(verb, kind, obj):
+        if kind == "Pod" and (obj.get("metadata", {}).get("labels") or {}).get("privileged"):
+            raise AdmissionError("privileged pods denied")
+        return obj
+
+    api.admission.append(deny_privileged)
+    try:
+        c = HTTPClient(api.url)
+        with pytest.raises(ApiError) as ei:
+            c.pods().create(make_pod("bad").label("privileged", "true").obj().to_dict())
+        assert ei.value.code == 400
+    finally:
+        api.admission.clear()
+
+
+def test_apiserver_404_and_healthz(api):
+    import urllib.request
+    assert urllib.request.urlopen(api.url + "/healthz").read() == b"ok"
+    body = urllib.request.urlopen(api.url + "/metrics").read()
+    assert b"# TYPE" in body
+    c = HTTPClient(api.url)
+    with pytest.raises(ApiError) as ei:
+        c.pods().get("nope")
+    assert ei.value.code == 404
+
+
+# -------------------------------------------------------------- informers
+
+def test_informer_sync_and_events():
+    store = ObjectStore()
+    client = DirectClient(store)
+    store.create("Pod", make_pod("pre").obj().to_dict())
+    events = []
+    inf = SharedInformer(client.resource("pods", None),
+                         indexers={"node": lambda o: [o.get("spec", {}).get("nodeName", "")]})
+    inf.add_event_handler(lambda t, o, old: events.append((t, o["metadata"]["name"])))
+    inf.start()
+    assert inf.wait_for_cache_sync(5)
+    store.create("Pod", make_pod("live").node("n1").obj().to_dict())
+    for _ in range(50):
+        if ("ADDED", "live") in events:
+            break
+        time.sleep(0.02)
+    assert ("ADDED", "pre") in events and ("ADDED", "live") in events
+    assert [o["metadata"]["name"] for o in inf.store.by_index("node", "n1")] == ["live"]
+    store.delete("Pod", "default", "live")
+    for _ in range(50):
+        if any(t == "DELETED" for t, _ in events):
+            break
+        time.sleep(0.02)
+    assert any(t == "DELETED" for t, _ in events)
+    inf.stop()
+
+
+def test_informer_field_selector():
+    store = ObjectStore()
+    client = DirectClient(store)
+    inf = SharedInformer(client.resource("pods", None),
+                         field_selector="spec.nodeName=n1")
+    inf.start()
+    assert inf.wait_for_cache_sync(5)
+    store.create("Pod", make_pod("on-n1").node("n1").obj().to_dict())
+    store.create("Pod", make_pod("on-n2").node("n2").obj().to_dict())
+    time.sleep(0.3)
+    names = {o["metadata"]["name"] for o in inf.store.list()}
+    assert names == {"on-n1"}
+    inf.stop()
+
+
+# -------------------------------------------------------------- workqueue
+
+def test_workqueue_dedup_and_reprocess():
+    q = WorkQueue()
+    q.add("a")
+    q.add("a")
+    assert q.get(0.1) == "a"
+    q.add("a")            # re-added while processing
+    assert q.get(0.05) is None
+    q.done("a")
+    assert q.get(0.1) == "a"
+    q.done("a")
+    q.close()
+
+
+def test_rate_limited_backoff():
+    q = RateLimitingQueue(base_delay=0.02, max_delay=0.1)
+    q.add_rate_limited("x")
+    assert q.get(0.01) is None       # delayed
+    item = q.get(0.5)
+    assert item == "x"
+    q.done("x")
+    assert q.num_requeues("x") == 1
+    q.forget("x")
+    assert q.num_requeues("x") == 0
+    q.close()
+
+
+# -------------------------------------------------------- leader election
+
+def test_leader_election_failover():
+    store = ObjectStore()
+    client = DirectClient(store)
+    leases = client.leases()
+    a_started = threading.Event()
+    b_started = threading.Event()
+    cfg_a = LeaderElectionConfig("sched", "A", lease_duration=0.3,
+                                 renew_deadline=0.2, retry_period=0.05,
+                                 on_started_leading=a_started.set)
+    cfg_b = LeaderElectionConfig("sched", "B", lease_duration=0.3,
+                                 renew_deadline=0.2, retry_period=0.05,
+                                 on_started_leading=b_started.set)
+    ea, eb = LeaderElector(leases, cfg_a), LeaderElector(leases, cfg_b)
+    stop_a, stop_b = threading.Event(), threading.Event()
+    ta = threading.Thread(target=ea.run, args=(stop_a,), daemon=True)
+    tb = threading.Thread(target=eb.run, args=(stop_b,), daemon=True)
+    ta.start()
+    assert a_started.wait(2)
+    tb.start()
+    time.sleep(0.2)
+    assert not eb.is_leader          # A holds the lease
+    stop_a.set()                     # A dies; lease expires; B takes over
+    assert b_started.wait(3)
+    stop_b.set()
