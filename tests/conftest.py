@@ -1,27 +1,25 @@
 """Test harness config: 8-device virtual CPU mesh, axon TPU tunnel disabled.
 
-The image's sitecustomize (PYTHONPATH=/root/.axon_site) dials the single-chip
-TPU tunnel at EVERY interpreter start when PALLAS_AXON_POOL_IPS is set;
-concurrent clients contend for the chip claim and can hang for minutes. Tests
-never need the real chip, so if the axon env leaks in we re-exec pytest once
-with a scrubbed environment. Real-TPU benchmarking happens only in bench.py.
+The image's sitecustomize (PYTHONPATH=/root/.axon_site) registers an "axon"
+PJRT plugin for the single-chip TPU tunnel when PALLAS_AXON_POOL_IPS is set.
+Backend *initialization* (the dial) is lazy — it only happens when JAX first
+resolves a platform — so forcing JAX_PLATFORMS=cpu before any backend use is
+enough to keep tests off the chip. We previously re-exec'd pytest with a
+scrubbed env, but pytest's capture plugin has already swapped fd 1/2 to a
+temp file by the time conftest imports, so the exec'd run's output vanished.
+Real-TPU benchmarking happens only in bench.py.
 """
 
 import os
 import sys
 
-_SCRUBBED = "KUBERNETES_TPU_TEST_SCRUBBED"
-
-if os.environ.get("PALLAS_AXON_POOL_IPS") and not os.environ.get(_SCRUBBED):
-    env = dict(os.environ)
-    env["PALLAS_AXON_POOL_IPS"] = ""
-    env["JAX_PLATFORMS"] = "cpu"
-    env[_SCRUBBED] = "1"
-    os.execvpe(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
-
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (after env setup, before any backend init)
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
