@@ -81,3 +81,42 @@ def label_selector_matches(selector: Optional[LabelSelector], labels: dict[str, 
     if selector is None:
         return False
     return all(requirement_matches(r, labels) for r in selector.requirements())
+
+
+def compile_list_selector(label_selector: Optional[str] = None,
+                          field_selector: Optional[str] = None):
+    """Wire-string list/watch filtering: ``labelSelector=k=v,k2=v2`` equality
+    pairs and ``fieldSelector=spec.nodeName=x`` dotted-path equality.
+
+    Single source of truth shared by the apiserver's list handler, the
+    DirectClient, and the informer's watch-side rematching — the three must
+    agree or list-time and watch-time filtering diverge (an object matched at
+    list never deletes, or vice versa). Returns None when unfiltered.
+    """
+    if not label_selector and not field_selector:
+        return None
+
+    # Parse once here; the predicate runs per object per list/watch event.
+    label_pairs = [tuple(p.split("=", 1))
+                   for p in (label_selector or "").split(",") if "=" in p]
+    field_pairs = [(k.split("."), v) for k, v in
+                   (tuple(p.split("=", 1))
+                    for p in (field_selector or "").split(",") if "=" in p)]
+
+    def match(obj: dict) -> bool:
+        if label_pairs:
+            labels = (obj.get("metadata") or {}).get("labels") or {}
+            for k, v in label_pairs:
+                if labels.get(k) != v:
+                    return False
+        for path, v in field_pairs:
+            cur = obj
+            for part in path:
+                cur = (cur or {}).get(part)
+                if cur is None:
+                    break
+            if (cur or "") != v:
+                return False
+        return True
+
+    return match

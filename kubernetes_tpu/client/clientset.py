@@ -21,6 +21,7 @@ import urllib.error
 import urllib.request
 from typing import Callable, Iterator, Optional
 
+from kubernetes_tpu.api.selectors import compile_list_selector
 from kubernetes_tpu.store.apiserver import ALL_RESOURCES, APPS_RESOURCES
 from kubernetes_tpu.store.store import Event, NotFound, ObjectStore, TooOld
 
@@ -128,15 +129,7 @@ class DirectClient(_Handles):
         return self.store.get(kind, ns or "", name)
 
     def list(self, plural, kind, ns, label_selector, field_selector):
-        sel = None
-        if label_selector or field_selector:
-            from kubernetes_tpu.store.apiserver import _field_label_selector
-            qs = {}
-            if label_selector:
-                qs["labelSelector"] = [label_selector]
-            if field_selector:
-                qs["fieldSelector"] = [field_selector]
-            sel = _field_label_selector(qs)
+        sel = compile_list_selector(label_selector, field_selector)
         return self.store.list(kind, namespace=ns, selector=sel)
 
     def update(self, plural, kind, ns, obj, sub):
@@ -177,7 +170,12 @@ class _NamespaceFilteredWatch:
     def __init__(self, inner, ns):
         self._inner = inner
         self._ns = ns
-        self.closed = False
+
+    @property
+    def closed(self) -> bool:
+        # Delegate: the inner stream closes on store-side invalidation
+        # (checkpoint restore) and the informer checks THIS object's flag.
+        return self._inner.closed
 
     def get(self, timeout: float = 0.2):
         ev = self._inner.get(timeout)

@@ -13,6 +13,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from kubernetes_tpu.api.selectors import compile_list_selector
 from kubernetes_tpu.client.clientset import ResourceClient
 from kubernetes_tpu.store.store import ADDED, DELETED, MODIFIED, TooOld
 
@@ -94,6 +95,10 @@ class SharedInformer:
         self.store = ThreadSafeStore(indexers)
         self.label_selector = label_selector
         self.field_selector = field_selector
+        # Same predicate the apiserver/DirectClient use at list time — watch
+        # events must be re-matched with identical semantics (watch streams
+        # are unfiltered by selectors; see APIServer._watch).
+        self._selector = compile_list_selector(label_selector, field_selector)
         self._handlers: list[Callable] = []
         self._stop = threading.Event()
         self._synced = threading.Event()
@@ -171,26 +176,7 @@ class SharedInformer:
             w.stop()
 
     def _matches(self, obj: dict) -> bool:
-        if self.label_selector:
-            labels = (obj.get("metadata") or {}).get("labels") or {}
-            for pair in self.label_selector.split(","):
-                if "=" in pair:
-                    k, v = pair.split("=", 1)
-                    if labels.get(k) != v:
-                        return False
-        if self.field_selector:
-            for pair in self.field_selector.split(","):
-                if "=" not in pair:
-                    continue
-                k, v = pair.split("=", 1)
-                cur = obj
-                for part in k.split("."):
-                    cur = (cur or {}).get(part)
-                    if cur is None:
-                        break
-                if (cur or "") != v:
-                    return False
-        return True
+        return self._selector(obj) if self._selector is not None else True
 
     def _dispatch(self, type_: str, obj: dict, old: Optional[dict]):
         for fn in self._handlers:

@@ -67,11 +67,21 @@ class LeaderElector:
         except (Conflict, ApiError):
             return False
 
+    def _try(self) -> bool:
+        """try_acquire_or_renew, treating ANY transport failure as a missed
+        renewal. Only HTTPError becomes ApiError in the client; URLError /
+        socket timeouts would otherwise kill the run() thread and leave a
+        zombie leader (is_leader stuck True, renewals silently stopped)."""
+        try:
+            return self.try_acquire_or_renew()
+        except Exception:
+            return False
+
     def run(self, stop: Optional[threading.Event] = None):
         """Block: acquire, then renew until lost or stopped."""
         stop = stop or self._stop
         while not stop.is_set():
-            if self.try_acquire_or_renew():
+            if self._try():
                 if not self.is_leader:
                     self.is_leader = True
                     if self.cfg.on_started_leading:
@@ -79,7 +89,7 @@ class LeaderElector:
                 deadline = time.time() + self.cfg.renew_deadline
                 while not stop.is_set():
                     time.sleep(self.cfg.retry_period)
-                    if self.try_acquire_or_renew():
+                    if self._try():
                         deadline = time.time() + self.cfg.renew_deadline
                     elif time.time() > deadline:
                         break

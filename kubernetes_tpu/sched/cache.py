@@ -60,6 +60,11 @@ class SchedulerCache:
     def update_pod(self, pod: Pod):
         self.add_pod(pod)
 
+    def is_bound(self, pod_key: str) -> bool:
+        """True if the pod is recorded as bound (confirmed via watch)."""
+        with self._lock:
+            return pod_key in self._pods
+
     def remove_pod(self, pod_key: str):
         with self._lock:
             existed = self._pods.pop(pod_key, None) or self._assumed.pop(pod_key, None)

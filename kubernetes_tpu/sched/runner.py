@@ -43,6 +43,11 @@ class SchedulerRunner:
         self.identity = identity
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # Per-leadership-term scheduling loop: a lost lease stops the loop (no
+        # split-brain binding), a re-acquired one starts a fresh term instead
+        # of stacking a second concurrent loop.
+        self._loop_stop: Optional[threading.Event] = None
+        self._loop_thread: Optional[threading.Thread] = None
         self._scheduler_names = {p.scheduler_name for p in self.cfg.profiles}
 
     # ---- event handlers (pkg/scheduler/eventhandlers.go analog) ----------
@@ -58,8 +63,17 @@ class SchedulerRunner:
             self.queue.move_all_to_active_or_backoff(EVENT_POD_DELETE)
             return
         if pod.spec.node_name:
-            # bound (or assumed-confirmed) pod
+            # bound (or assumed-confirmed) pod — also drop it from the queue:
+            # a pod bound by another party while sitting in backoffQ would
+            # otherwise be double-counted (pending in the batch AND bound in
+            # the cache) and retried in a 409 loop forever. Mirrors the
+            # reference's addPodToCache -> SchedulingQueue.AssignedPodAdded.
+            # Order matters: cache BEFORE queue. The scheduler's failure
+            # paths requeue only if not cache.is_bound, then re-check; with
+            # this order, an is_bound=False re-check guarantees our
+            # queue.delete below still lies ahead and will clean up.
             self.cache.add_pod(pod)
+            self.queue.delete(pod)
             return
         if pod.spec.scheduler_name not in self._scheduler_names:
             return
@@ -108,7 +122,8 @@ class SchedulerRunner:
         if self.cfg.leader_elect:
             elector = LeaderElector(self.client.leases(), LeaderElectionConfig(
                 lock_name="kubernetes-tpu-scheduler", identity=self.identity,
-                on_started_leading=self._start_loop))
+                on_started_leading=self._start_loop,
+                on_stopped_leading=self._stop_loop))
             t = threading.Thread(target=elector.run, args=(self._stop,), daemon=True)
             t.start()
             self._threads.append(t)
@@ -117,12 +132,33 @@ class SchedulerRunner:
         return self
 
     def _start_loop(self):
-        t = threading.Thread(target=self.scheduler.run, args=(self._stop,),
-                             daemon=True)
-        t.start()
-        self._threads.append(t)
+        # Chain terms: if the previous term's loop is still draining (e.g.
+        # stuck in a long run_once/JIT compile when the lease bounced), the
+        # new term's thread waits for it rather than stacking a concurrent
+        # loop — and rather than silently not starting one, which would leave
+        # a leader that schedules nothing until the next transition.
+        prev_t, prev_s = self._loop_thread, self._loop_stop
+        stop = threading.Event()
+
+        def term():
+            if prev_t is not None and prev_t.is_alive():
+                if prev_s is not None:
+                    prev_s.set()
+                prev_t.join()
+            self.scheduler.run(stop)
+
+        self._loop_stop = stop
+        self._loop_thread = threading.Thread(target=term, daemon=True)
+        self._loop_thread.start()
+
+    def _stop_loop(self):
+        if self._loop_stop is not None:
+            self._loop_stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5.0)
 
     def stop(self):
         self._stop.set()
+        self._stop_loop()
         self.queue.close()
         self.factory.stop_all()

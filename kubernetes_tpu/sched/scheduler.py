@@ -117,6 +117,11 @@ class Scheduler:
 
     def _handle_failure(self, pod: Pod, attempts: int):
         SCHEDULE_ATTEMPTS.inc({"result": "unschedulable"})
+        if self.cache.is_bound(pod.key):
+            # Bound by another party while in-flight (its own bound copy may
+            # even be why the gang step couldn't place it). Requeueing would
+            # cycle it through backoffQ forever — no future event clears it.
+            return
         nominated = None
         if pod.spec.priority > 0 and self.features.enabled("PreemptionSimulation"):
             nominated = self.preemptor(pod)
@@ -127,6 +132,8 @@ class Scheduler:
             self.queue.add(pod)
         else:
             self.queue.add_unschedulable(pod, attempts + 1)
+            if self.cache.is_bound(pod.key):  # bound event raced the requeue
+                self.queue.delete(pod)
 
     def _default_preempt(self, pod: Pod) -> Optional[str]:
         nodes, _, _ = self.cache.snapshot()
@@ -160,7 +167,15 @@ class Scheduler:
             self.cache.finish_binding(pod.key)
         else:
             self.cache.forget(pod.key)
-            self.queue.add_unschedulable(pod, 1)
+            # 409 ordering: if another party bound this pod while it was
+            # in-flight, the informer's MODIFIED(nodeName) event (and its
+            # queue.delete) may have already fired — requeueing now would
+            # retry-409 forever with no further event to clear it. Mirrors
+            # the reference's handleSchedulingFailure assigned-pod check.
+            if not self.cache.is_bound(pod.key):
+                self.queue.add_unschedulable(pod, 1)
+                if self.cache.is_bound(pod.key):  # event raced the requeue
+                    self.queue.delete(pod)
             SCHEDULE_ATTEMPTS.inc({"result": "error"})
 
     def wait_for_bindings(self, timeout: float = 5.0):

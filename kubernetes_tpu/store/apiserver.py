@@ -27,6 +27,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
+from kubernetes_tpu.api.selectors import compile_list_selector
 from kubernetes_tpu.metrics.registry import REGISTRY
 from kubernetes_tpu.store.store import (
     AlreadyExists,
@@ -191,14 +192,18 @@ class APIServer:
                         return self._error(404, str(e), "NotFound")
                     return self._send_json(200, obj)
                 if qs.get("watch", ["false"])[0] in ("true", "1"):
-                    return self._watch(kind, qs)
+                    return self._watch(kind, ns, qs)
                 sel = _field_label_selector(qs)
                 items, rv = server.store.list(kind, namespace=ns, selector=sel)
                 return self._send_json(200, {
                     "kind": f"{kind}List", "apiVersion": "v1",
                     "metadata": {"resourceVersion": str(rv)}, "items": items})
 
-            def _watch(self, kind: str, qs):
+            def _watch(self, kind: str, ns, qs):
+                # Namespace filtering happens here (matching DirectClient's
+                # _NamespaceFilteredWatch); label/field selector filtering is
+                # deliberately left to the informer layer, which needs to see
+                # matched -> unmatched MODIFIEDs to synthesize DELETEDs.
                 since = int(qs.get("resourceVersion", ["0"])[0] or 0)
                 try:
                     w = server.store.watch(kind, since_rv=since)
@@ -212,6 +217,8 @@ class APIServer:
                     idle = 0
                     while True:
                         ev = w.get(timeout=0.5)
+                        if w.closed:
+                            break  # stream invalidated (restore): client relists
                         if ev is None:
                             idle += 1
                             if idle >= 2:  # ~1s heartbeat: empty payload line
@@ -220,6 +227,9 @@ class APIServer:
                                 idle = 0
                             continue
                         idle = 0
+                        if ns is not None and (ev.object.get("metadata") or {}
+                                               ).get("namespace", "") != ns:
+                            continue
                         line = json.dumps({"type": ev.type, "object": ev.object}
                                           ).encode() + b"\n"
                         self.wfile.write(hex(len(line))[2:].encode() + b"\r\n"
@@ -324,31 +334,5 @@ class APIServer:
 
 def _field_label_selector(qs) -> Optional[Callable[[dict], bool]]:
     """labelSelector=k=v,k2=v2 and fieldSelector=spec.nodeName=x supported."""
-    lsel = qs.get("labelSelector", [None])[0]
-    fsel = qs.get("fieldSelector", [None])[0]
-    if not lsel and not fsel:
-        return None
-
-    def match(obj: dict) -> bool:
-        if lsel:
-            labels = (obj.get("metadata") or {}).get("labels") or {}
-            for pair in lsel.split(","):
-                if "=" in pair:
-                    k, v = pair.split("=", 1)
-                    if labels.get(k) != v:
-                        return False
-        if fsel:
-            for pair in fsel.split(","):
-                if "=" not in pair:
-                    continue
-                k, v = pair.split("=", 1)
-                cur = obj
-                for part in k.split("."):
-                    cur = (cur or {}).get(part)
-                    if cur is None:
-                        break
-                if (cur or "") != v:
-                    return False
-        return True
-
-    return match
+    return compile_list_selector(qs.get("labelSelector", [None])[0],
+                                 qs.get("fieldSelector", [None])[0])

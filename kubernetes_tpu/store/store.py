@@ -65,18 +65,23 @@ class Watcher:
 
     def __next__(self) -> Event:
         while not self.closed:
-            try:
-                ev = self._q.get(timeout=0.2)
+            ev = self.get(timeout=0.2)
+            if ev is not None:
                 return ev
-            except queue.Empty:
-                continue
         raise StopIteration
 
     def get(self, timeout: float = 0.2) -> Optional[Event]:
+        if self.closed:
+            return None
         try:
-            return self._q.get(timeout=timeout)
+            ev = self._q.get(timeout=timeout)
         except queue.Empty:
             return None
+        if ev.type == ERROR:
+            # Stream invalidated (checkpoint restore); consumer must relist.
+            self.closed = True
+            return None
+        return ev
 
     def stop(self):
         self.closed = True
@@ -92,6 +97,14 @@ class ObjectStore:
         self._rv = 0
         self._data: dict[str, dict[tuple[str, str], dict]] = {}
         self._history: dict[str, list[Event]] = {}
+        # Highest rv trimmed out of each kind's replay history ("compaction
+        # point"). TooOld is per kind: a quiet kind's full history stays
+        # replayable no matter how fast the global rv advances. _floor_rv is
+        # the all-kinds compaction point set by a checkpoint restore, which
+        # discards every kind's history (including kinds absent from the
+        # checkpoint blob).
+        self._compacted: dict[str, int] = {}
+        self._floor_rv = 0
         self._watchers: dict[str, list[queue.Queue]] = {}
 
     # ---- internals -------------------------------------------------------
@@ -109,7 +122,9 @@ class ObjectStore:
         hist = self._history.setdefault(kind, [])
         hist.append(ev)
         if len(hist) > REPLAY_WINDOW:
-            del hist[:len(hist) - REPLAY_WINDOW]
+            cut = len(hist) - REPLAY_WINDOW
+            self._compacted[kind] = hist[cut - 1].resource_version
+            del hist[:cut]
         for q in self._watchers.get(kind, []):
             q.put(ev)
 
@@ -192,9 +207,8 @@ class ObjectStore:
         with self._lock:
             q: queue.Queue = queue.Queue()
             hist = self._history.get(kind, [])
-            if hist and hist[0].resource_version > since_rv + 1 and \
-                    since_rv < self._rv - REPLAY_WINDOW:
-                raise TooOld(f"rv {since_rv} compacted")
+            if since_rv < max(self._floor_rv, self._compacted.get(kind, 0)):
+                raise TooOld(f"{kind} rv {since_rv} compacted")
             for ev in hist:
                 if ev.resource_version > since_rv:
                     q.put(ev)
@@ -218,6 +232,20 @@ class ObjectStore:
             self._data = {kind: {obj_key(o): o for o in objs}
                           for kind, objs in data["data"].items()}
             self._history.clear()
+            # No replay history survives a checkpoint restore: every kind —
+            # including kinds absent from the blob — is compacted up to the
+            # restored rv, so stale watchers get TooOld and relist instead of
+            # silently missing pre-restore events.
+            self._compacted = {}
+            self._floor_rv = self._rv
+            # Live watch streams are invalidated too: they'd otherwise keep
+            # receiving post-restore events while missing the restore delta
+            # (e.g. an object absent from the blob never emits DELETED, so a
+            # connected informer would retain it as a phantom forever).
+            for qs in self._watchers.values():
+                for q in qs:
+                    q.put(Event(ERROR, {}, self._rv))
+            self._watchers = {}
 
     @property
     def resource_version(self) -> int:

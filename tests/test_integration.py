@@ -92,3 +92,37 @@ def test_foreign_scheduler_pods_left_alone(cluster):
     pods.create(make_pod("foreign").scheduler_name("their-scheduler").obj().to_dict())
     time.sleep(0.6)
     assert not pods.get("foreign")["spec"].get("nodeName")
+
+
+def test_externally_bound_pod_leaves_queue(cluster):
+    """A pod bound by another party while queued must be dropped from the
+    scheduling queue (regression: it was double-counted — pending in the
+    batch AND bound in the cache — and retried in a 409 loop forever)."""
+    _, client, runner = cluster
+    client.nodes().create(make_node("n-ext").capacity({"cpu": "4"}).obj().to_dict())
+    pod = make_pod("ext-bound").req({"cpu": "1"}).obj().to_dict()
+    client.pods("default").create(pod)
+    # bind it out from under the scheduler, as a split-brain peer would
+    try:
+        client.pods("default").bind("ext-bound", "n-ext")
+    except Exception:
+        pass  # scheduler may have bound it first — equally fine
+    assert wait_for(
+        lambda: client.pods("default").get("ext-bound")["spec"].get("nodeName"))
+    # the queue must drain: no perpetual 409 retry loop for this pod
+    assert wait_for(
+        lambda: "default/ext-bound" not in runner.queue._keys_queued)
+
+
+def test_start_loop_displaces_previous_term():
+    """A re-elected leader's new loop must displace the old term's loop, not
+    stack a concurrent one — and must actually start even if the old loop is
+    still draining (regression: silent skip left a leader scheduling nothing)."""
+    client = DirectClient(ObjectStore())
+    runner = SchedulerRunner(client, SchedulerConfiguration(batch_size=4))
+    runner._start_loop()
+    first_t, first_s = runner._loop_thread, runner._loop_stop
+    runner._start_loop()              # new term while the old loop still runs
+    assert wait_for(lambda: first_s.is_set() and not first_t.is_alive())
+    assert runner._loop_thread.is_alive()
+    runner.stop()

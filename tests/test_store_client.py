@@ -246,3 +246,94 @@ def test_leader_election_failover():
     stop_a.set()                     # A dies; lease expires; B takes over
     assert b_started.wait(3)
     stop_b.set()
+
+
+def test_store_tooold_is_per_kind():
+    """Global rv churn on one kind must not compact another kind's replay
+    window (regression: TooOld used the global rv, so any watcher >1024 total
+    writes behind got a spurious 410 even with its kind's history intact)."""
+    s = ObjectStore()
+    s.create("Pod", make_pod("p").obj().to_dict())
+    for i in range(1200):  # > REPLAY_WINDOW writes on an unrelated kind
+        s.create("Lease", {"metadata": {"name": f"l{i}", "namespace": "ns"}})
+    w = s.watch("Pod", since_rv=0)
+    ev = w.get(timeout=1.0)
+    w.stop()
+    assert ev is not None and ev.type == ADDED
+    with pytest.raises(TooOld):
+        s.watch("Lease", since_rv=0)
+
+
+def test_http_watch_namespace_scoped(api):
+    """A namespaced HTTP watch must not stream other namespaces' events
+    (regression: do_GET discarded the route's namespace for watches)."""
+    c = HTTPClient(api.url)
+    pods_a = c.pods("ns-a")
+    _, rv = pods_a.list_rv()
+    w = pods_a.watch(since_rv=rv)
+    c.pods("ns-b").create(make_pod("other", "ns-b").obj().to_dict())
+    pods_a.create(make_pod("mine", "ns-a").obj().to_dict())
+    seen = []
+    deadline = time.time() + 5
+    while time.time() < deadline and "mine" not in seen:
+        ev = w.get(timeout=0.5)
+        if ev is not None:
+            seen.append(ev.object["metadata"]["name"])
+    w.stop()
+    assert seen == ["mine"]
+
+
+def test_leader_elector_survives_transport_errors():
+    """Non-ApiError transport failures (URLError/OSError) must count as missed
+    renewals, not kill the elector thread (regression: zombie leader)."""
+    client = DirectClient(ObjectStore())
+    fail = {"on": False}
+
+    def maybe_fail(obj):
+        if fail["on"]:
+            raise OSError("connection refused")
+        return obj
+
+    client.prepend_reactor("*", "leases", maybe_fail)
+    started, stopped = [], threading.Event()
+    cfg = LeaderElectionConfig(
+        "sched-err", "A", lease_duration=0.3, renew_deadline=0.2,
+        retry_period=0.05, on_started_leading=lambda: started.append(1),
+        on_stopped_leading=stopped.set)
+    e = LeaderElector(client.leases(), cfg)
+    stop = threading.Event()
+    t = threading.Thread(target=e.run, args=(stop,), daemon=True)
+    t.start()
+    deadline = time.time() + 3
+    while time.time() < deadline and not started:
+        time.sleep(0.02)
+    assert started
+    fail["on"] = True                 # transport down: renewals now raise
+    assert stopped.wait(3)            # leadership lost, callback fired
+    assert t.is_alive()               # ...and the elector thread survived
+    assert not e.is_leader
+    fail["on"] = False                # transport back: re-acquire
+    deadline = time.time() + 3
+    while time.time() < deadline and len(started) < 2:
+        time.sleep(0.02)
+    stop.set()
+    assert len(started) == 2
+
+
+def test_checkpoint_restore_invalidates_live_watchers(tmp_path):
+    """load() must close live watch streams: a connected watcher would
+    otherwise miss the restore delta (objects absent from the blob never emit
+    DELETED) and retain phantoms forever."""
+    s = ObjectStore()
+    s.create("Pod", make_pod("keep").obj().to_dict())
+    path = str(tmp_path / "ckpt.json")
+    s.save(path)
+    s.create("Pod", make_pod("phantom").obj().to_dict())  # post-checkpoint
+    w = s.watch("Pod", since_rv=s.resource_version)
+    s.load(path)  # restore: 'phantom' no longer exists
+    deadline = time.time() + 2
+    while time.time() < deadline and not w.closed:
+        w.get(timeout=0.1)
+    assert w.closed  # stream invalidated -> consumer relists
+    with pytest.raises(TooOld):  # and pre-restore rvs force a relist
+        s.watch("Pod", since_rv=0)
