@@ -536,6 +536,8 @@ class PodStatus:
     nominated_node_name: str = ""
     conditions: list[dict[str, Any]] = field(default_factory=list)
     start_time: Optional[float] = None
+    pod_ip: str = ""
+    host_ip: str = ""
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "PodStatus":
@@ -545,6 +547,8 @@ class PodStatus:
             nominated_node_name=d.get("nominatedNodeName", ""),
             conditions=list(d.get("conditions") or []),
             start_time=d.get("startTime"),
+            pod_ip=d.get("podIP", ""),
+            host_ip=d.get("hostIP", ""),
         )
 
     def to_dict(self) -> dict:
@@ -555,7 +559,16 @@ class PodStatus:
             d["conditions"] = list(self.conditions)
         if self.start_time is not None:
             d["startTime"] = self.start_time
+        if self.pod_ip:
+            d["podIP"] = self.pod_ip
+        if self.host_ip:
+            d["hostIP"] = self.host_ip
         return d
+
+    def is_ready(self) -> bool:
+        """PodReady condition True (pkg/api/v1/pod/util.go IsPodReady)."""
+        return any(c.get("type") == "Ready" and c.get("status") == "True"
+                   for c in self.conditions)
 
 
 @dataclass

@@ -21,9 +21,18 @@ import urllib.error
 import urllib.request
 from typing import Callable, Iterator, Optional
 
+import functools
+
 from kubernetes_tpu.api.selectors import compile_list_selector
 from kubernetes_tpu.store.apiserver import ALL_RESOURCES, APPS_RESOURCES
-from kubernetes_tpu.store.store import Event, NotFound, ObjectStore, TooOld
+from kubernetes_tpu.store.store import (
+    AlreadyExists,
+    Conflict,
+    Event,
+    NotFound,
+    ObjectStore,
+    TooOld,
+)
 
 
 class ApiError(Exception):
@@ -31,6 +40,22 @@ class ApiError(Exception):
         super().__init__(f"{code}: {message}")
         self.code = code
         self.reason = reason
+
+
+def _api_errors(fn):
+    """Translate store exceptions to ApiError so DirectClient and HTTPClient
+    raise identically (the fake clientset returns apierrors upstream too)."""
+    @functools.wraps(fn)
+    def wrapped(*a, **kw):
+        try:
+            return fn(*a, **kw)
+        except NotFound as e:
+            raise ApiError(404, str(e), "NotFound") from None
+        except AlreadyExists as e:
+            raise ApiError(409, str(e), "AlreadyExists") from None
+        except Conflict as e:
+            raise ApiError(409, str(e), "Conflict") from None
+    return wrapped
 
 
 class ResourceClient:
@@ -117,6 +142,7 @@ class DirectClient(_Handles):
                 obj = fn(obj)
         return obj
 
+    @_api_errors
     def create(self, plural, kind, ns, obj):
         obj = self._react("create", plural, obj)
         obj.setdefault("metadata", {})
@@ -125,13 +151,16 @@ class DirectClient(_Handles):
         obj.setdefault("kind", kind)
         return self.store.create(kind, obj)
 
+    @_api_errors
     def get(self, plural, kind, ns, name):
         return self.store.get(kind, ns or "", name)
 
+    @_api_errors
     def list(self, plural, kind, ns, label_selector, field_selector):
         sel = compile_list_selector(label_selector, field_selector)
         return self.store.list(kind, namespace=ns, selector=sel)
 
+    @_api_errors
     def update(self, plural, kind, ns, obj, sub):
         obj = self._react("update", plural, obj)
         expect = (obj.get("metadata") or {}).get("resourceVersion") or None
@@ -143,6 +172,7 @@ class DirectClient(_Handles):
             expect = obj["metadata"].get("resourceVersion") or None
         return self.store.update(kind, obj, expect_rv=expect)
 
+    @_api_errors
     def delete(self, plural, kind, ns, name):
         self._react("delete", plural, {"metadata": {"name": name, "namespace": ns}})
         return self.store.delete(kind, ns or "", name)
@@ -153,6 +183,7 @@ class DirectClient(_Handles):
             return w
         return _NamespaceFilteredWatch(w, ns)
 
+    @_api_errors
     def bind(self, ns, name, node_name):
         pod = self.store.get("Pod", ns or "", name)
         if pod.get("spec", {}).get("nodeName"):
@@ -162,6 +193,7 @@ class DirectClient(_Handles):
         return self.store.update("Pod", pod,
                                  expect_rv=pod["metadata"]["resourceVersion"])
 
+    @_api_errors
     def evict(self, ns, name):
         return self.store.delete("Pod", ns or "", name)
 

@@ -1,0 +1,24 @@
+"""Controllers — reconcile loops over the API (SURVEY §2.3).
+
+Each controller is the informer + workqueue + ``sync(key)`` pattern from
+``pkg/controller/``; ``ControllerManager`` is the kube-controller-manager
+analog wiring them over one shared informer factory.
+"""
+
+from kubernetes_tpu.controllers.base import Controller, active_pods, controller_of
+from kubernetes_tpu.controllers.daemonset import DaemonSetController
+from kubernetes_tpu.controllers.deployment import DeploymentController
+from kubernetes_tpu.controllers.endpoints import EndpointsController
+from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
+from kubernetes_tpu.controllers.job import JobController
+from kubernetes_tpu.controllers.manager import ControllerManager
+from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
+from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+from kubernetes_tpu.controllers.statefulset import StatefulSetController
+
+__all__ = [
+    "Controller", "ControllerManager", "DaemonSetController",
+    "DeploymentController", "EndpointsController", "GarbageCollector",
+    "JobController", "NodeLifecycleController", "ReplicaSetController",
+    "StatefulSetController", "active_pods", "controller_of",
+]

@@ -1,0 +1,132 @@
+"""Controller base — the informer + workqueue + sync(key) reconcile pattern.
+
+Reference shape: every controller in ``pkg/controller/<name>/`` is informer
+event handlers enqueueing keys into a rate-limited workqueue, N workers
+popping keys and running ``syncX(key)``; errors requeue with backoff,
+successes forget. Wiring mirrors ``pkg/controller/controller_utils.go``
+(owner-reference helpers: ``GetControllerOf``, adoption semantics).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from kubernetes_tpu.client.informer import InformerFactory, meta_namespace_key
+from kubernetes_tpu.client.workqueue import RateLimitingQueue
+
+MAX_REQUEUES = 15  # maxRetries in most upstream controllers
+
+
+def controller_of(obj: dict) -> Optional[dict]:
+    """The ownerReference with controller=true (metav1.GetControllerOf)."""
+    for ref in (obj.get("metadata") or {}).get("ownerReferences") or []:
+        if ref.get("controller"):
+            return ref
+    return None
+
+
+def is_controlled_by(obj: dict, owner: dict) -> bool:
+    ref = controller_of(obj)
+    return ref is not None and ref.get("uid") == (owner.get("metadata") or {}).get("uid")
+
+
+def owner_reference(owner: dict, kind: str) -> dict:
+    md = owner.get("metadata") or {}
+    return {
+        "apiVersion": owner.get("apiVersion", "apps/v1"),
+        "kind": kind,
+        "name": md.get("name", ""),
+        "uid": md.get("uid", ""),
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+class Controller:
+    """Workqueue-driven reconcile loop.
+
+    Subclasses set ``name``, register informers in ``register(factory)`` and
+    implement ``sync(key)``. ``enqueue(obj)`` / ``enqueue_owner(obj, kind)``
+    are the standard event-handler bodies.
+    """
+
+    name = "controller"
+    workers = 2
+
+    def __init__(self, client):
+        self.client = client
+        self.queue = RateLimitingQueue()
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # ---- wiring ----------------------------------------------------------
+
+    def register(self, factory: InformerFactory) -> None:
+        raise NotImplementedError
+
+    def sync(self, key: str) -> None:
+        raise NotImplementedError
+
+    def enqueue(self, obj: dict) -> None:
+        self.queue.add(meta_namespace_key(obj))
+
+    def enqueue_owner(self, obj: dict, kind: str) -> None:
+        """Enqueue the controlling owner of ``obj`` if it has the given kind
+        (resolveControllerRef pattern: pod events wake the ReplicaSet, etc.)."""
+        ref = controller_of(obj)
+        if ref is not None and ref.get("kind") == kind:
+            ns = (obj.get("metadata") or {}).get("namespace", "")
+            self.queue.add(f"{ns}/{ref['name']}" if ns else ref["name"])
+
+    def handler(self, enqueue_fn: Optional[Callable] = None):
+        fn = enqueue_fn or self.enqueue
+
+        def on_event(type_, obj, old):
+            fn(obj)
+        return on_event
+
+    # ---- worker loop -----------------------------------------------------
+
+    def start(self):
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"{self.name}-{i}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.queue.close()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def _worker(self):
+        while not self._stop.is_set():
+            key = self.queue.get(timeout=0.2)
+            if key is None:
+                continue
+            try:
+                self.sync(key)
+            except Exception:
+                if self.queue.num_requeues(key) < MAX_REQUEUES:
+                    self.queue.add_rate_limited(key)
+                else:
+                    self.queue.forget(key)
+            else:
+                self.queue.forget(key)
+            finally:
+                self.queue.done(key)
+
+
+def split_key(key: str) -> tuple[str, str]:
+    ns, _, name = key.rpartition("/")
+    return ns, name
+
+
+def active_pods(pods: list[dict]) -> list[dict]:
+    """Pods not terminal and not being deleted (controller_utils FilterActivePods)."""
+    return [p for p in pods
+            if (p.get("status") or {}).get("phase") not in ("Succeeded", "Failed")
+            and not (p.get("metadata") or {}).get("deletionTimestamp")]

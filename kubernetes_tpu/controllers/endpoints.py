@@ -1,0 +1,96 @@
+"""Endpoints controller — Service selector -> ready pod addresses.
+
+Reference: ``pkg/controller/endpoint/endpoints_controller.go``
+(``syncService``: list pods matching .spec.selector, split into
+ready/notReady addresses, write the Endpoints object the proxy consumes).
+The EndpointSlice shape upstream adds is a sharded encoding of the same
+data; one Endpoints object per service carries it here.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.types import PodStatus
+from kubernetes_tpu.client.clientset import ApiError
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.controllers.base import Controller, split_key
+
+
+class EndpointsController(Controller):
+    name = "endpoints"
+
+    def register(self, factory: InformerFactory) -> None:
+        self.svc_informer = factory.informer("services", None)
+        self.svc_informer.add_event_handler(self.handler())
+        self.pod_informer = factory.informer("pods", None)
+        self.pod_informer.add_event_handler(self.handler(self._enqueue_services))
+
+    def _enqueue_services(self, pod: dict) -> None:
+        labels = (pod.get("metadata") or {}).get("labels") or {}
+        ns = (pod.get("metadata") or {}).get("namespace", "")
+        for svc in self.svc_informer.store.list():
+            smd = svc.get("metadata") or {}
+            if smd.get("namespace", "") != ns:
+                continue
+            sel = (svc.get("spec") or {}).get("selector") or {}
+            if sel and all(labels.get(k) == v for k, v in sel.items()):
+                self.enqueue(svc)
+
+    def sync(self, key: str) -> None:
+        ns, name = split_key(key)
+        svc = self.svc_informer.store.get(key)
+        if svc is None:
+            # service deleted -> delete its endpoints
+            try:
+                self.client.endpoints(ns).delete(name)
+            except ApiError as e:
+                if e.code != 404:
+                    raise
+            return
+        sel = (svc.get("spec") or {}).get("selector") or {}
+        if not sel:
+            return  # selectorless services manage endpoints manually
+        ready, not_ready = [], []
+        for p in self.pod_informer.store.list():
+            md = p.get("metadata") or {}
+            if md.get("namespace", "") != ns:
+                continue
+            labels = md.get("labels") or {}
+            if not all(labels.get(k) == v for k, v in sel.items()):
+                continue
+            st = PodStatus.from_dict(p.get("status"))
+            if st.phase in ("Succeeded", "Failed") or not st.pod_ip:
+                continue
+            addr = {"ip": st.pod_ip,
+                    "nodeName": (p.get("spec") or {}).get("nodeName", ""),
+                    "targetRef": {"kind": "Pod", "name": md.get("name", ""),
+                                  "namespace": ns, "uid": md.get("uid", "")}}
+            (ready if st.is_ready() else not_ready).append(addr)
+        ports = [{"name": sp.get("name", ""), "port": int(sp.get("targetPort",
+                                                                 sp.get("port", 0))),
+                  "protocol": sp.get("protocol", "TCP")}
+                 for sp in (svc.get("spec") or {}).get("ports") or []]
+        subsets = []
+        if ready or not_ready:
+            subset: dict = {"ports": ports}
+            if ready:
+                subset["addresses"] = sorted(ready, key=lambda a: a["ip"])
+            if not_ready:
+                subset["notReadyAddresses"] = sorted(not_ready, key=lambda a: a["ip"])
+            subsets = [subset]
+        ep_api = self.client.endpoints(ns)
+        desired = {"apiVersion": "v1", "kind": "Endpoints",
+                   "metadata": {"name": name, "namespace": ns,
+                                "labels": dict((svc.get("metadata") or {})
+                                               .get("labels") or {})},
+                   "subsets": subsets}
+        try:
+            current = ep_api.get(name)
+        except ApiError as e:
+            if e.code != 404:
+                raise
+            ep_api.create(desired)
+            return
+        if current.get("subsets") != subsets:
+            desired["metadata"]["resourceVersion"] = \
+                (current.get("metadata") or {}).get("resourceVersion", "")
+            ep_api.update(desired)  # 409 -> requeue with backoff

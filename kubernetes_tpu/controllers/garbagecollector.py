@@ -1,0 +1,61 @@
+"""Garbage collector — cascade-delete orphans via ownerReferences.
+
+Reference: ``pkg/controller/garbagecollector/garbagecollector.go`` (uid →
+object dependency graph from informers; ``attemptToDeleteItem`` removes
+objects whose owners are all gone; blockOwnerDeletion/foreground handled via
+finalizers — here only the background-cascade core).
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.client.clientset import ApiError
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.store.apiserver import ALL_RESOURCES
+
+# kinds tracked in the ownership graph (plural -> kind, namespaced)
+GC_RESOURCES = ("pods", "replicasets", "deployments", "statefulsets",
+                "daemonsets", "jobs", "endpoints")
+
+
+class GarbageCollector:
+    """Periodic mark-and-sweep over informer caches: any object with
+    ownerReferences whose referenced uids all no longer exist is deleted.
+    Runs from the manager's resync tick rather than a workqueue — the graph
+    is global, not per-key."""
+
+    name = "garbagecollector"
+
+    def __init__(self, client):
+        self.client = client
+        self._informers = {}
+
+    def register(self, factory: InformerFactory) -> None:
+        for plural in GC_RESOURCES:
+            self._informers[plural] = factory.informer(plural, None)
+
+    def sweep(self) -> int:
+        """One mark-and-sweep pass; returns number of deletions issued."""
+        live_uids = set()
+        for inf in self._informers.values():
+            for obj in inf.store.list():
+                uid = (obj.get("metadata") or {}).get("uid")
+                if uid:
+                    live_uids.add(uid)
+        deleted = 0
+        for plural, inf in self._informers.items():
+            kind, namespaced = ALL_RESOURCES[plural]
+            for obj in inf.store.list():
+                md = obj.get("metadata") or {}
+                refs = md.get("ownerReferences") or []
+                if not refs:
+                    continue
+                if any(r.get("uid") in live_uids for r in refs):
+                    continue
+                try:
+                    ns = md.get("namespace") if namespaced else None
+                    self.client.resource(plural, ns).delete(md["name"])
+                    deleted += 1
+                except ApiError as e:
+                    if e.code != 404:
+                        raise
+        return deleted

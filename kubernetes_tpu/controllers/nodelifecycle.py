@@ -1,0 +1,129 @@
+"""Node lifecycle controller — taint unhealthy nodes, evict their pods.
+
+Reference: ``pkg/controller/nodelifecycle/node_lifecycle_controller.go``
+(monitorNodeHealth: Ready condition staleness -> NoExecute ``not-ready`` /
+``unreachable`` taints) and the NoExecute taint-manager eviction path
+(``tainteviction/``: pods without a matching toleration are evicted after
+tolerationSeconds).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from kubernetes_tpu.api.types import Pod, Taint, Toleration
+from kubernetes_tpu.client.clientset import ApiError
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.controllers.base import Controller, split_key
+
+TAINT_NOT_READY = "node.kubernetes.io/not-ready"
+TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
+
+DEFAULT_GRACE = 40.0  # nodeMonitorGracePeriod default 40s
+
+
+def _ready_condition(node: dict):
+    for c in (node.get("status") or {}).get("conditions") or []:
+        if c.get("type") == "Ready":
+            return c
+    return None
+
+
+class NodeLifecycleController(Controller):
+    """Sync per node: reconcile health taints; evict intolerant pods on
+    NoExecute-tainted nodes. A monitor thread re-enqueues all nodes every
+    ``monitor_period`` so staleness is noticed without events."""
+
+    name = "nodelifecycle"
+
+    def __init__(self, client, grace_period: float = DEFAULT_GRACE,
+                 monitor_period: float = 5.0):
+        super().__init__(client)
+        self.grace_period = grace_period
+        self.monitor_period = monitor_period
+        self._monitor: threading.Thread | None = None
+
+    def register(self, factory: InformerFactory) -> None:
+        self.node_informer = factory.informer("nodes", None)
+        self.node_informer.add_event_handler(self.handler())
+        self.pod_informer = factory.informer("pods", None)
+
+    def start(self):
+        super().start()
+        self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
+        self._monitor.start()
+        return self
+
+    def _monitor_loop(self):
+        while not self._stop.wait(self.monitor_period):
+            for key in self.node_informer.store.keys():
+                self.queue.add(key)
+
+    # ---- monitorNodeHealth ----------------------------------------------
+
+    def _wanted_taint(self, node: dict) -> str | None:
+        cond = _ready_condition(node)
+        if cond is None:
+            return None  # no kubelet heartbeat model yet — leave untouched
+        if cond.get("status") == "False":
+            return TAINT_NOT_READY
+        hb = cond.get("lastHeartbeatTime")
+        if hb is not None and time.time() - float(hb) > self.grace_period:
+            return TAINT_UNREACHABLE
+        if cond.get("status") == "Unknown":
+            return TAINT_UNREACHABLE
+        return None
+
+    def sync(self, key: str) -> None:
+        _, name = split_key(key)
+        node = self.node_informer.store.get(key) or self.node_informer.store.get(name)
+        if node is None:
+            return
+        wanted = self._wanted_taint(node)
+        taints = [t for t in (node.get("spec") or {}).get("taints") or []]
+        ours = [t for t in taints
+                if t.get("key") in (TAINT_NOT_READY, TAINT_UNREACHABLE)
+                and t.get("effect") == "NoExecute"]
+        rest = [t for t in taints if t not in ours]
+        new_taints = rest + ([{"key": wanted, "effect": "NoExecute",
+                               "timeAdded": ours[0].get("timeAdded", time.time())
+                               if ours and ours[0].get("key") == wanted
+                               else time.time()}]
+                             if wanted else [])
+        if new_taints != taints:
+            obj = {**node, "spec": {**(node.get("spec") or {}), "taints": new_taints}}
+            try:
+                self.client.nodes().update(obj)
+            except ApiError as e:
+                if e.code not in (404, 409):
+                    raise
+        if wanted:
+            self._evict_intolerant(node, wanted)
+
+    # ---- NoExecute taint eviction ---------------------------------------
+
+    def _evict_intolerant(self, node: dict, taint_key: str) -> None:
+        node_name = (node.get("metadata") or {}).get("name", "")
+        taint_obj = Taint(key=taint_key, effect="NoExecute")
+        added = next((float(t.get("timeAdded", 0)) for t in
+                      (node.get("spec") or {}).get("taints") or []
+                      if t.get("key") == taint_key), 0.0)
+        for p in self.pod_informer.store.list():
+            if (p.get("spec") or {}).get("nodeName") != node_name:
+                continue
+            if (p.get("status") or {}).get("phase") in ("Succeeded", "Failed"):
+                continue
+            pod = Pod.from_dict(p)
+            matching = [t for t in pod.spec.tolerations if t.tolerates(taint_obj)]
+            if matching:
+                secs = [t.toleration_seconds for t in matching]
+                if any(s is None for s in secs):
+                    continue  # tolerates forever
+                if time.time() - added < min(s for s in secs if s is not None):
+                    continue  # still within tolerationSeconds
+            try:
+                self.client.pods(pod.metadata.namespace).evict(pod.metadata.name)
+            except ApiError as e:
+                if e.code != 404:
+                    raise

@@ -218,7 +218,13 @@ class APIServer:
                     while True:
                         ev = w.get(timeout=0.5)
                         if w.closed:
-                            break  # stream invalidated (restore): client relists
+                            # stream invalidated (restore): terminate the
+                            # chunked response so the client sees EOF at once
+                            # instead of waiting out its heartbeat grace
+                            self.wfile.write(b"0\r\n\r\n")
+                            self.wfile.flush()
+                            self.close_connection = True
+                            break
                         if ev is None:
                             idle += 1
                             if idle >= 2:  # ~1s heartbeat: empty payload line

@@ -138,13 +138,27 @@ class ObjectStore:
 
     def create(self, kind: str, obj: dict) -> dict:
         with self._lock:
+            md = obj.get("metadata") or {}
+            if not md.get("name") and md.get("generateName"):
+                # names.SimpleNameGenerator analog: generateName + unique
+                # suffix. The rv counter is the suffix source — monotone AND
+                # checkpoint-persisted, so restored stores can never re-issue
+                # a name that an existing object carries.
+                obj = dict(obj)
+                obj["metadata"] = {**md, "name": f"{md['generateName']}{self._rv + 1:05x}"}
             k = obj_key(obj)
             space = self._data.setdefault(kind, {})
             if k in space:
                 raise AlreadyExists(f"{kind} {k}")
             rv = self._bump_locked()
             obj = json.loads(json.dumps(obj))  # defensive copy, wire-shaped
-            obj.setdefault("metadata", {})["resourceVersion"] = str(rv)
+            md = obj.setdefault("metadata", {})
+            md["resourceVersion"] = str(rv)
+            # registry.Store.Create stamps identity server-side
+            md.setdefault("uid", f"uid-s{rv}")
+            if "creationTimestamp" not in md:
+                import time as _time
+                md["creationTimestamp"] = _time.time()
             space[k] = obj
             self._emit_locked(kind, Event(ADDED, obj, rv))
             return json.loads(json.dumps(obj))

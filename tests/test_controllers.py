@@ -1,0 +1,500 @@
+"""Controllers: replicaset, deployment, job, daemonset, statefulset,
+endpoints, nodelifecycle, garbage collector, controller manager wiring."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client.clientset import DirectClient
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.controllers import (
+    ControllerManager,
+    DaemonSetController,
+    DeploymentController,
+    GarbageCollector,
+    JobController,
+    NodeLifecycleController,
+    ReplicaSetController,
+    StatefulSetController,
+)
+from kubernetes_tpu.controllers.deployment import HASH_LABEL, template_hash
+from kubernetes_tpu.controllers.endpoints import EndpointsController
+from kubernetes_tpu.controllers.nodelifecycle import TAINT_NOT_READY, TAINT_UNREACHABLE
+from kubernetes_tpu.store.store import ObjectStore
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def wait_until(fn, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+@pytest.fixture
+def client():
+    return DirectClient(ObjectStore())
+
+
+def start_controller(client, ctrl):
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    yield_obj = (ctrl, factory)
+    return yield_obj
+
+
+def rs_manifest(name="web", replicas=3, labels=None, ns="default"):
+    labels = labels or {"app": name}
+    return {
+        "apiVersion": "apps/v1", "kind": "ReplicaSet",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": dict(labels)},
+            "template": {
+                "metadata": {"labels": dict(labels)},
+                "spec": {"containers": [{"name": "c", "image": "img",
+                                         "resources": {"requests": {"cpu": "100m"}}}]},
+            },
+        },
+        "status": {},
+    }
+
+
+def deployment_manifest(name="dep", replicas=3, image="img:v1", ns="default"):
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": name}},
+            "strategy": {"type": "RollingUpdate",
+                         "rollingUpdate": {"maxSurge": 1, "maxUnavailable": 1}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {"containers": [{"name": "c", "image": image}]},
+            },
+        },
+        "status": {},
+    }
+
+
+def mark_pods_running(client, selector_fn=None, ns="default"):
+    """Simulate kubelet: phase=Running + Ready condition on scheduled pods."""
+    n = 0
+    for p in client.pods(ns).list():
+        if selector_fn is not None and not selector_fn(p):
+            continue
+        p["status"] = {"phase": "Running", "podIP": f"10.0.0.{n + 1}",
+                       "conditions": [{"type": "Ready", "status": "True"}]}
+        client.pods(ns).update_status(p)
+        n += 1
+    return n
+
+
+# --------------------------------------------------------------- replicaset
+
+def test_replicaset_scales_up_and_down(client):
+    ctrl = ReplicaSetController(client)
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    try:
+        client.resource("replicasets").create(rs_manifest(replicas=3))
+        assert wait_until(lambda: len(client.pods().list()) == 3)
+        pods = client.pods().list()
+        assert all(p["metadata"]["ownerReferences"][0]["kind"] == "ReplicaSet"
+                   for p in pods)
+        # scale down to 1
+        rs = client.resource("replicasets").get("web")
+        rs["spec"]["replicas"] = 1
+        client.resource("replicasets").update(rs)
+        assert wait_until(lambda: len(client.pods().list()) == 1)
+        # kill the survivor -> replaced
+        client.pods().delete(client.pods().list()[0]["metadata"]["name"])
+        assert wait_until(lambda: len(client.pods().list()) == 1)
+        # status reflects
+        assert wait_until(lambda: client.resource("replicasets").get("web")
+                          .get("status", {}).get("replicas") == 1)
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+def test_replicaset_ignores_unowned_pods(client):
+    ctrl = ReplicaSetController(client)
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    try:
+        # a pod with matching labels but no owner ref is NOT counted
+        loose = make_pod("loose").label("app", "web").obj().to_dict()
+        client.pods().create(loose)
+        client.resource("replicasets").create(rs_manifest(replicas=2))
+        assert wait_until(
+            lambda: len([p for p in client.pods().list()
+                         if p["metadata"].get("ownerReferences")]) == 2)
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+# --------------------------------------------------------------- deployment
+
+def test_deployment_creates_rs_and_rolls(client):
+    dep_ctrl = DeploymentController(client)
+    rs_ctrl = ReplicaSetController(client)
+    factory = InformerFactory(client)
+    dep_ctrl.register(factory)
+    rs_ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    dep_ctrl.start()
+    rs_ctrl.start()
+    try:
+        dep = deployment_manifest(replicas=2, image="img:v1")
+        client.resource("deployments").create(dep)
+        h1 = template_hash(dep)
+        assert wait_until(lambda: len(client.pods().list()) == 2)
+        rses = client.resource("replicasets").list()
+        assert len(rses) == 1 and rses[0]["metadata"]["name"] == f"dep-{h1}"
+        assert rses[0]["spec"]["template"]["metadata"]["labels"][HASH_LABEL] == h1
+        mark_pods_running(client)
+
+        # rollout: new template hash -> second RS, old drains as new readies
+        cur = client.resource("deployments").get("dep")
+        cur["spec"]["template"]["spec"]["containers"][0]["image"] = "img:v2"
+        client.resource("deployments").update(cur)
+        h2 = template_hash(cur)
+        assert h2 != h1
+        assert wait_until(
+            lambda: any(rs["metadata"]["name"] == f"dep-{h2}"
+                        for rs in client.resource("replicasets").list()))
+
+        # keep marking pods ready as they appear (kubelet stand-in) until
+        # the old RS is fully scaled down
+        def rolled():
+            mark_pods_running(client)
+            rss = {rs["metadata"]["name"]: rs
+                   for rs in client.resource("replicasets").list()}
+            old = rss.get(f"dep-{h1}", {})
+            new = rss.get(f"dep-{h2}", {})
+            return (old.get("spec", {}).get("replicas") == 0
+                    and new.get("spec", {}).get("replicas") == 2)
+        assert wait_until(rolled, timeout=10.0)
+    finally:
+        dep_ctrl.stop()
+        rs_ctrl.stop()
+        factory.stop_all()
+
+
+# ---------------------------------------------------------------------- job
+
+def test_job_runs_to_completion(client):
+    ctrl = JobController(client)
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    try:
+        job = {
+            "apiVersion": "batch/v1", "kind": "Job",
+            "metadata": {"name": "work", "namespace": "default"},
+            "spec": {"parallelism": 2, "completions": 4, "backoffLimit": 6,
+                     "template": {"metadata": {"labels": {"job": "work"}},
+                                  "spec": {"containers": [{"name": "c"}],
+                                           "restartPolicy": "Never"}}},
+            "status": {},
+        }
+        client.resource("jobs").create(job)
+        assert wait_until(lambda: len(client.pods().list()) == 2)
+
+        def finish_active():
+            for p in client.pods().list():
+                if p.get("status", {}).get("phase") not in ("Succeeded", "Failed"):
+                    p["status"] = {"phase": "Succeeded"}
+                    client.pods().update_status(p)
+
+        def complete():
+            finish_active()
+            j = client.resource("jobs").get("work")
+            return any(c.get("type") == "Complete" and c.get("status") == "True"
+                       for c in j.get("status", {}).get("conditions", []))
+        assert wait_until(complete, timeout=10.0)
+        j = client.resource("jobs").get("work")
+        assert j["status"]["succeeded"] == 4
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+def test_job_backoff_limit_fails_job(client):
+    ctrl = JobController(client)
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    try:
+        job = {
+            "apiVersion": "batch/v1", "kind": "Job",
+            "metadata": {"name": "bad", "namespace": "default"},
+            "spec": {"parallelism": 1, "completions": 1, "backoffLimit": 1,
+                     "template": {"spec": {"containers": [{"name": "c"}],
+                                           "restartPolicy": "Never"}}},
+            "status": {},
+        }
+        client.resource("jobs").create(job)
+
+        def fail_active():
+            for p in client.pods().list():
+                if p.get("status", {}).get("phase") not in ("Succeeded", "Failed"):
+                    p["status"] = {"phase": "Failed"}
+                    client.pods().update_status(p)
+
+        def failed():
+            fail_active()
+            j = client.resource("jobs").get("bad")
+            return any(c.get("type") == "Failed" and c.get("status") == "True"
+                       for c in j.get("status", {}).get("conditions", []))
+        assert wait_until(failed, timeout=10.0)
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+# ---------------------------------------------------------------- daemonset
+
+def test_daemonset_one_pod_per_eligible_node(client):
+    for i in range(3):
+        client.nodes().create(make_node(f"n{i}").allocatable(
+            {"cpu": "4", "memory": "8Gi", "pods": "110"}).obj().to_dict())
+    # tainted node: not eligible without toleration
+    client.nodes().create(make_node("tainted").taint("gpu", "true", "NoSchedule")
+                          .allocatable({"cpu": "4", "pods": "110"}).obj().to_dict())
+    ctrl = DaemonSetController(client)
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    try:
+        ds = {
+            "apiVersion": "apps/v1", "kind": "DaemonSet",
+            "metadata": {"name": "agent", "namespace": "default"},
+            "spec": {"selector": {"matchLabels": {"app": "agent"}},
+                     "template": {"metadata": {"labels": {"app": "agent"}},
+                                  "spec": {"containers": [{"name": "c"}]}}},
+            "status": {},
+        }
+        client.resource("daemonsets").create(ds)
+        assert wait_until(lambda: len(client.pods().list()) == 3)
+        pins = set()
+        for p in client.pods().list():
+            terms = (p["spec"]["affinity"]["nodeAffinity"]
+                     ["requiredDuringSchedulingIgnoredDuringExecution"]
+                     ["nodeSelectorTerms"])
+            pins.add(terms[0]["matchFields"][0]["values"][0])
+        assert pins == {"n0", "n1", "n2"}
+        # new node joins -> gets a daemon pod
+        client.nodes().create(make_node("n3").allocatable(
+            {"cpu": "4", "pods": "110"}).obj().to_dict())
+        assert wait_until(lambda: len(client.pods().list()) == 4)
+        # node drained -> its pod removed
+        client.nodes().delete("n3")
+        assert wait_until(lambda: len(client.pods().list()) == 3)
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+# -------------------------------------------------------------- statefulset
+
+def test_statefulset_ordered_bringup_and_scaledown(client):
+    ctrl = StatefulSetController(client)
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    try:
+        ss = {
+            "apiVersion": "apps/v1", "kind": "StatefulSet",
+            "metadata": {"name": "db", "namespace": "default"},
+            "spec": {"replicas": 3,
+                     "selector": {"matchLabels": {"app": "db"}},
+                     "template": {"metadata": {"labels": {"app": "db"}},
+                                  "spec": {"containers": [{"name": "c"}]}}},
+            "status": {},
+        }
+        client.resource("statefulsets").create(ss)
+        # only db-0 exists until it is Running+Ready
+        assert wait_until(lambda: [p["metadata"]["name"]
+                                   for p in client.pods().list()] == ["db-0"])
+        time.sleep(0.2)
+        assert len(client.pods().list()) == 1
+
+        def advance():
+            mark_pods_running(client)
+            return len(client.pods().list()) == 3
+        assert wait_until(advance, timeout=10.0)
+        names = sorted(p["metadata"]["name"] for p in client.pods().list())
+        assert names == ["db-0", "db-1", "db-2"]
+        mark_pods_running(client)
+        # scale down to 1: highest ordinals go first
+        cur = client.resource("statefulsets").get("db")
+        cur["spec"]["replicas"] = 1
+        client.resource("statefulsets").update(cur)
+        assert wait_until(lambda: sorted(p["metadata"]["name"] for p in
+                                         client.pods().list()) == ["db-0"],
+                          timeout=10.0)
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+# ---------------------------------------------------------------- endpoints
+
+def test_endpoints_track_ready_pods(client):
+    ctrl = EndpointsController(client)
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    try:
+        client.services().create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"selector": {"app": "web"},
+                     "ports": [{"port": 80, "targetPort": 8080}]},
+        })
+        p = make_pod("w1").label("app", "web").obj().to_dict()
+        p["status"] = {"phase": "Running", "podIP": "10.1.0.5",
+                       "conditions": [{"type": "Ready", "status": "True"}]}
+        client.pods().create(p)
+        p2 = make_pod("w2").label("app", "web").obj().to_dict()
+        p2["status"] = {"phase": "Running", "podIP": "10.1.0.6"}  # not ready
+        client.pods().create(p2)
+
+        def has_eps():
+            try:
+                ep = client.endpoints().get("web")
+            except Exception:
+                return False
+            subs = ep.get("subsets") or []
+            if not subs:
+                return False
+            ready = [a["ip"] for a in subs[0].get("addresses", [])]
+            notready = [a["ip"] for a in subs[0].get("notReadyAddresses", [])]
+            return ready == ["10.1.0.5"] and notready == ["10.1.0.6"]
+        assert wait_until(has_eps)
+        # pod deleted -> endpoints shrink
+        client.pods().delete("w1")
+        assert wait_until(lambda: not (client.endpoints().get("web")
+                                       .get("subsets") or [{}])[0].get("addresses"))
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+# ------------------------------------------------------------ nodelifecycle
+
+def test_nodelifecycle_taints_and_evicts(client):
+    node = make_node("sick").allocatable({"cpu": "4", "pods": "10"}).obj().to_dict()
+    node["status"]["conditions"] = [{"type": "Ready", "status": "True",
+                                     "lastHeartbeatTime": time.time()}]
+    client.nodes().create(node)
+    pod = make_pod("victim").node("sick").obj().to_dict()
+    pod["status"] = {"phase": "Running"}
+    client.pods().create(pod)
+    tolerant = make_pod("survivor").node("sick") \
+        .toleration(key=TAINT_NOT_READY, operator="Exists", effect="NoExecute") \
+        .obj().to_dict()
+    tolerant["status"] = {"phase": "Running"}
+    client.pods().create(tolerant)
+
+    ctrl = NodeLifecycleController(client, grace_period=0.5, monitor_period=0.1)
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    try:
+        # healthy -> no taint
+        time.sleep(0.3)
+        assert not (client.nodes().get("sick")["spec"].get("taints") or [])
+        # report NotReady -> not-ready taint + eviction of intolerant pod
+        n = client.nodes().get("sick")
+        n["status"]["conditions"] = [{"type": "Ready", "status": "False",
+                                      "lastHeartbeatTime": time.time()}]
+        client.nodes().update_status(n)
+        assert wait_until(lambda: any(
+            t["key"] == TAINT_NOT_READY
+            for t in client.nodes().get("sick")["spec"].get("taints") or []))
+        assert wait_until(lambda: [p["metadata"]["name"]
+                                   for p in client.pods().list()] == ["survivor"])
+        # recovery -> taint removed
+        n = client.nodes().get("sick")
+        n["status"]["conditions"] = [{"type": "Ready", "status": "True",
+                                      "lastHeartbeatTime": time.time()}]
+        client.nodes().update_status(n)
+        assert wait_until(lambda: not any(
+            t["key"] in (TAINT_NOT_READY, TAINT_UNREACHABLE)
+            for t in client.nodes().get("sick")["spec"].get("taints") or []))
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+# --------------------------------------------------------- garbagecollector
+
+def test_gc_deletes_orphans(client):
+    factory = InformerFactory(client)
+    gc = GarbageCollector(client)
+    gc.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    rs = client.resource("replicasets").create(rs_manifest(replicas=0))
+    pod = make_pod("child").obj().to_dict()
+    pod["metadata"]["ownerReferences"] = [{
+        "apiVersion": "apps/v1", "kind": "ReplicaSet", "name": "web",
+        "uid": rs["metadata"]["uid"], "controller": True}]
+    client.pods().create(pod)
+    time.sleep(0.2)
+    assert gc.sweep() == 0  # owner alive -> nothing deleted
+    client.resource("replicasets").delete("web")
+    assert wait_until(lambda: gc.sweep() >= 1)
+    assert wait_until(lambda: len(client.pods().list()) == 0)
+    factory.stop_all()
+
+
+# ----------------------------------------------------------------- manager
+
+def test_controller_manager_end_to_end(client):
+    mgr = ControllerManager(client, resync_period=0.5)
+    mgr.start()
+    try:
+        client.resource("deployments").create(deployment_manifest(replicas=2))
+        assert wait_until(lambda: len(client.pods().list()) == 2, timeout=10.0)
+        mark_pods_running(client)
+        assert wait_until(
+            lambda: client.resource("deployments").get("dep")
+            .get("status", {}).get("readyReplicas") == 2, timeout=10.0)
+        # deployment delete -> GC cascades RS + pods
+        client.resource("deployments").delete("dep")
+        assert wait_until(lambda: len(client.pods().list()) == 0, timeout=10.0)
+        assert wait_until(
+            lambda: len(client.resource("replicasets").list()) == 0, timeout=10.0)
+    finally:
+        mgr.stop()
