@@ -1,0 +1,10 @@
+"""Kubelet — node agent (SURVEY §2.4): sync loop, pod workers, PLEG,
+status manager, heartbeat, hollow-node (kubemark) mode."""
+
+from kubernetes_tpu.kubelet.kubelet import HollowNode, Kubelet
+from kubernetes_tpu.kubelet.pleg import GenericPLEG, PodLifecycleEvent
+from kubernetes_tpu.kubelet.pod_workers import PodWorkers
+from kubernetes_tpu.kubelet.runtime import ContainerRuntime, FakeRuntime
+
+__all__ = ["ContainerRuntime", "FakeRuntime", "GenericPLEG", "HollowNode",
+           "Kubelet", "PodLifecycleEvent", "PodWorkers"]

@@ -1,0 +1,256 @@
+"""Kubelet — the node agent: sync loop, pod workers, PLEG, status, heartbeat.
+
+Reference: ``pkg/kubelet/kubelet.go`` (``Kubelet.Run`` -> ``syncLoop`` ->
+``syncLoopIteration`` selecting over config/PLEG channels; ``SyncPod``
+computing container actions via ``kuberuntime_manager.go``), node status in
+``pkg/kubelet/kubelet_node_status.go`` (register + heartbeat Ready
+condition), status manager in ``pkg/kubelet/status/status_manager.go``
+(PATCH pod status on change).
+
+``HollowNode`` (bottom) is the kubemark analog: a full kubelet over
+``FakeRuntime``, cheap enough to run hundreds per process.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Optional
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.client.clientset import ApiError
+from kubernetes_tpu.client.informer import SharedInformer
+from kubernetes_tpu.kubelet.pleg import GenericPLEG
+from kubernetes_tpu.kubelet.pod_workers import PodWorkers
+from kubernetes_tpu.kubelet.runtime import (
+    EXITED,
+    RUNNING,
+    ContainerRuntime,
+    FakeRuntime,
+)
+
+_node_ip_counter = itertools.count(1)
+
+
+class Kubelet:
+    def __init__(self, client, node_name: str,
+                 runtime: Optional[ContainerRuntime] = None,
+                 allocatable: Optional[dict] = None,
+                 labels: Optional[dict] = None,
+                 heartbeat_period: float = 2.0,
+                 register_node: bool = True):
+        self.client = client
+        self.node_name = node_name
+        self.node_idx = next(_node_ip_counter)
+        self._pod_ip_seq = itertools.count(2)
+        self.runtime = runtime if runtime is not None else FakeRuntime(
+            ip_alloc=self._next_pod_ip)
+        self.allocatable = allocatable or {"cpu": "8", "memory": "16Gi",
+                                           "pods": "110"}
+        self.labels = labels or {}
+        self.heartbeat_period = heartbeat_period
+        self.register_node = register_node
+        self.pleg = GenericPLEG(self.runtime)
+        self.workers = PodWorkers(self._sync_pod)
+        self._informer: Optional[SharedInformer] = None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._pods_lock = threading.Lock()
+        self._pods: dict[str, dict] = {}  # uid -> latest pod object
+
+    def _next_pod_ip(self) -> str:
+        n = next(self._pod_ip_seq)
+        return f"10.{self.node_idx % 200 + 10}.{n // 250}.{n % 250}"
+
+    # ---- node registration + heartbeat ----------------------------------
+
+    def _node_object(self) -> dict:
+        return {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": self.node_name, "labels": dict(self.labels)},
+            "spec": {},
+            "status": {
+                "allocatable": dict(self.allocatable),
+                "capacity": dict(self.allocatable),
+                "conditions": [self._ready_condition()],
+            },
+        }
+
+    def _ready_condition(self) -> dict:
+        return {"type": "Ready", "status": "True",
+                "reason": "KubeletReady",
+                "lastHeartbeatTime": time.time()}
+
+    def _register(self):
+        try:
+            self.client.nodes().create(self._node_object())
+        except ApiError as e:
+            if e.code != 409:
+                raise  # exists: adopt + heartbeat
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.heartbeat_period):
+            try:
+                node = self.client.nodes().get(self.node_name)
+                conds = [c for c in (node.get("status") or {}).get("conditions") or []
+                         if c.get("type") != "Ready"]
+                node.setdefault("status", {})["conditions"] = \
+                    conds + [self._ready_condition()]
+                self.client.nodes().update_status(node)
+            except ApiError:
+                if self.register_node:
+                    try:
+                        self._register()
+                    except ApiError:
+                        pass
+
+    # ---- syncLoop --------------------------------------------------------
+
+    def start(self, wait_sync: float = 10.0):
+        if self.register_node:
+            self._register()
+        self._informer = SharedInformer(
+            self.client.resource("pods", None),
+            field_selector=f"spec.nodeName={self.node_name}")
+        self._informer.add_event_handler(self._on_pod_event)
+        self._informer.start()
+        self._informer.wait_for_cache_sync(wait_sync)
+        self.pleg.start()
+        for target in (self._heartbeat_loop, self._pleg_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.pleg.stop()
+        self.workers.stop()
+        if self._informer is not None:
+            self._informer.stop()
+
+    def _on_pod_event(self, type_, obj, old):
+        uid = (obj.get("metadata") or {}).get("uid", "")
+        if not uid:
+            return
+        if type_ == "DELETED":
+            with self._pods_lock:
+                self._pods.pop(uid, None)
+            self.workers.update_pod(uid, None)
+        else:
+            with self._pods_lock:
+                self._pods[uid] = obj
+            self.workers.update_pod(uid, obj)
+
+    def _pleg_loop(self):
+        """syncLoopIteration's plegCh arm: container events re-sync the pod."""
+        while not self._stop.is_set():
+            try:
+                ev = self.pleg.events.get(timeout=0.2)
+            except Exception:
+                continue
+            with self._pods_lock:
+                pod = self._pods.get(ev.pod_uid)
+            if pod is not None:
+                self.workers.update_pod(ev.pod_uid, pod)
+
+    # ---- SyncPod (computePodActions analog) ------------------------------
+
+    def _sync_pod(self, uid: str, pod: Optional[dict]) -> None:
+        if pod is None:
+            self.runtime.stop_pod_sandbox(uid)
+            return
+        md = pod.get("metadata") or {}
+        spec = pod.get("spec") or {}
+        phase = (pod.get("status") or {}).get("phase", "Pending")
+        if phase in ("Succeeded", "Failed"):
+            self.runtime.stop_pod_sandbox(uid)
+            return
+        sb = self.runtime.get_sandbox(uid)
+        if sb is None:
+            sb = self.runtime.run_pod_sandbox(uid, md.get("name", ""),
+                                              md.get("namespace", "default"))
+        restart_policy = spec.get("restartPolicy", "Always")
+        for c in spec.get("containers") or [{"name": "c"}]:
+            name = c.get("name", "c")
+            cs = sb.containers.get(name)
+            if cs is None:
+                self.runtime.create_container(uid, name, c.get("image", ""))
+                self.runtime.start_container(uid, name)
+            elif cs.state == EXITED:
+                restart = (restart_policy == "Always"
+                           or (restart_policy == "OnFailure" and cs.exit_code != 0))
+                if restart:
+                    self.runtime.create_container(uid, name, c.get("image", ""))
+                    self.runtime.start_container(uid, name)
+        self._update_status(pod, self.runtime.get_sandbox(uid))
+
+    # ---- status manager --------------------------------------------------
+
+    def _compute_phase(self, pod: dict, sb) -> str:
+        """getPhase (pkg/kubelet/kubelet_pods.go): all-succeeded -> Succeeded,
+        any-failed-and-no-restart -> Failed, any running -> Running."""
+        spec = pod.get("spec") or {}
+        restart_policy = spec.get("restartPolicy", "Always")
+        want = [c.get("name", "c") for c in spec.get("containers") or [{"name": "c"}]]
+        states = [sb.containers.get(n) for n in want] if sb else []
+        if not states or any(s is None for s in states):
+            return "Pending"
+        if all(s.state == EXITED for s in states):
+            if all(s.exit_code == 0 for s in states):
+                if restart_policy != "Always":
+                    return "Succeeded"
+            elif restart_policy == "Never":
+                return "Failed"
+        if any(s.state == RUNNING for s in states):
+            return "Running"
+        return "Pending"
+
+    def _update_status(self, pod: dict, sb) -> None:
+        phase = self._compute_phase(pod, sb)
+        running = phase == "Running"
+        status = {
+            "phase": phase,
+            "hostIP": f"192.168.0.{self.node_idx % 250}",
+            "podIP": sb.ip if sb else "",
+            "startTime": sb.created_at if sb else None,
+            "conditions": [
+                {"type": "PodScheduled", "status": "True"},
+                {"type": "Ready", "status": "True" if running else "False"},
+                {"type": "ContainersReady", "status": "True" if running else "False"},
+            ],
+        }
+        cur = pod.get("status") or {}
+        if (cur.get("phase") == status["phase"]
+                and cur.get("podIP") == status["podIP"]
+                and Pod.from_dict(pod).status.is_ready() == running):
+            return  # no material change; skip the write (status manager dedup)
+        md = pod["metadata"]
+        try:
+            self.client.pods(md.get("namespace", "default")).update_status(
+                {**pod, "status": status})
+        except ApiError:
+            pass  # next sync retries
+
+
+class HollowNode:
+    """kubemark analog: Kubelet over FakeRuntime with configurable container
+    behavior. ``exit_after`` makes workloads finish (Job testing)."""
+
+    def __init__(self, client, node_name: str,
+                 exit_after: Optional[float] = None,
+                 start_latency: float = 0.0, **kubelet_kw):
+        self.kubelet = Kubelet(client, node_name, **kubelet_kw)
+        # swap in a runtime wired to this kubelet's IP allocator
+        self.kubelet.runtime = FakeRuntime(exit_after=exit_after,
+                                           start_latency=start_latency,
+                                           ip_alloc=self.kubelet._next_pod_ip)
+        self.kubelet.pleg = GenericPLEG(self.kubelet.runtime)
+
+    def start(self, **kw):
+        self.kubelet.start(**kw)
+        return self
+
+    def stop(self):
+        self.kubelet.stop()

@@ -1,0 +1,47 @@
+"""Pod workers — per-pod serialized sync state machines.
+
+Reference: ``pkg/kubelet/pod_workers.go`` (``podWorkers.UpdatePod``: one
+goroutine per pod draining a 1-deep "latest update wins" slot, so syncs for
+one pod never run concurrently while distinct pods sync in parallel).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+
+class PodWorkers:
+    def __init__(self, sync_fn: Callable[[str, Optional[dict]], None]):
+        self._sync = sync_fn  # sync_fn(uid, pod_or_None_for_terminate)
+        self._lock = threading.Lock()
+        self._pending: dict[str, Optional[dict]] = {}  # latest update wins
+        self._busy: set[str] = set()
+        self._stopped = False
+
+    def update_pod(self, uid: str, pod: Optional[dict]) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            self._pending[uid] = pod
+            if uid in self._busy:
+                return  # running worker picks the new update up when done
+            self._busy.add(uid)
+        threading.Thread(target=self._drain, args=(uid,), daemon=True).start()
+
+    def _drain(self, uid: str) -> None:
+        while True:
+            with self._lock:
+                if uid not in self._pending or self._stopped:
+                    self._busy.discard(uid)
+                    return
+                pod = self._pending.pop(uid)
+            try:
+                self._sync(uid, pod)
+            except Exception:
+                pass  # next update retries; kubelet-level sync is idempotent
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+            self._pending.clear()

@@ -1,0 +1,137 @@
+"""Container runtime interface + hollow implementation.
+
+Reference: the CRI gRPC surface in
+``staging/src/k8s.io/cri-api/pkg/apis/runtime/v1/api.proto`` (RunPodSandbox /
+CreateContainer / StartContainer / StopPodSandbox / ListPodSandbox ...) as
+consumed by ``pkg/kubelet/kuberuntime/kuberuntime_manager.go``. The hollow
+runtime is the kubemark stand-in (``pkg/kubemark/hollow_kubelet.go``): real
+kubelet logic over mocked containers, so thousands of nodes fit in one
+process.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+# container states (runtimeapi.ContainerState)
+CREATED, RUNNING, EXITED = "CREATED", "RUNNING", "EXITED"
+
+
+@dataclass
+class ContainerStatus:
+    name: str
+    state: str = CREATED
+    exit_code: int = 0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    restart_count: int = 0
+
+
+@dataclass
+class PodSandboxStatus:
+    pod_uid: str
+    name: str
+    namespace: str
+    ip: str = ""
+    created_at: float = field(default_factory=time.time)
+    containers: dict[str, ContainerStatus] = field(default_factory=dict)
+
+
+class ContainerRuntime:
+    """The kubelet-facing runtime surface (CRI analog)."""
+
+    def run_pod_sandbox(self, pod_uid: str, name: str, namespace: str) -> PodSandboxStatus:
+        raise NotImplementedError
+
+    def stop_pod_sandbox(self, pod_uid: str) -> None:
+        raise NotImplementedError
+
+    def create_container(self, pod_uid: str, name: str, image: str) -> None:
+        raise NotImplementedError
+
+    def start_container(self, pod_uid: str, name: str) -> None:
+        raise NotImplementedError
+
+    def list_sandboxes(self) -> list[PodSandboxStatus]:
+        raise NotImplementedError
+
+    def get_sandbox(self, pod_uid: str) -> Optional[PodSandboxStatus]:
+        raise NotImplementedError
+
+
+class FakeRuntime(ContainerRuntime):
+    """Hollow runtime: containers are dicts; ``exit_after`` seconds (if set)
+    flips RUNNING containers to EXITED(code 0) to simulate workloads
+    completing — the knob batch/Job end-to-end tests turn.
+
+    ``start_latency`` models image pull + container start cost; sandbox IPs
+    come from the injected allocator (kubelet hands one in per node).
+    """
+
+    def __init__(self, exit_after: Optional[float] = None,
+                 start_latency: float = 0.0,
+                 ip_alloc=None):
+        self.exit_after = exit_after
+        self.start_latency = start_latency
+        self._ip_alloc = ip_alloc or (lambda: "10.88.0.1")
+        self._lock = threading.Lock()
+        self._sandboxes: dict[str, PodSandboxStatus] = {}
+
+    def run_pod_sandbox(self, pod_uid, name, namespace):
+        if self.start_latency:
+            time.sleep(self.start_latency)
+        with self._lock:
+            sb = self._sandboxes.get(pod_uid)
+            if sb is None:
+                sb = PodSandboxStatus(pod_uid, name, namespace, ip=self._ip_alloc())
+                self._sandboxes[pod_uid] = sb
+            return sb
+
+    def stop_pod_sandbox(self, pod_uid):
+        with self._lock:
+            sb = self._sandboxes.pop(pod_uid, None)
+            if sb is not None:
+                for c in sb.containers.values():
+                    if c.state == RUNNING:
+                        c.state = EXITED
+                        c.exit_code = 137  # SIGKILL
+                        c.finished_at = time.time()
+
+    def create_container(self, pod_uid, name, image):
+        if self.start_latency:
+            time.sleep(self.start_latency)
+        with self._lock:
+            sb = self._sandboxes[pod_uid]
+            cur = sb.containers.get(name)
+            restart = cur.restart_count + 1 if cur is not None else 0
+            sb.containers[name] = ContainerStatus(name, restart_count=restart)
+
+    def start_container(self, pod_uid, name):
+        with self._lock:
+            c = self._sandboxes[pod_uid].containers[name]
+            c.state = RUNNING
+            c.started_at = time.time()
+
+    def _tick_locked(self):
+        if self.exit_after is None:
+            return
+        now = time.time()
+        for sb in self._sandboxes.values():
+            for c in sb.containers.values():
+                if c.state == RUNNING and now - c.started_at >= self.exit_after:
+                    c.state = EXITED
+                    c.exit_code = 0
+                    c.finished_at = now
+
+    def list_sandboxes(self):
+        with self._lock:
+            self._tick_locked()
+            return list(self._sandboxes.values())
+
+    def get_sandbox(self, pod_uid):
+        with self._lock:
+            self._tick_locked()
+            return self._sandboxes.get(pod_uid)
