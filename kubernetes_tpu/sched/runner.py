@@ -57,7 +57,10 @@ class SchedulerRunner:
             pod = Pod.from_dict(obj)
         except Exception:
             return
-        if type_ == DELETED:
+        if type_ == DELETED or pod.status.phase in ("Succeeded", "Failed"):
+            # Terminal pods release their node's resources immediately; the
+            # reference filters them out of the scheduler's informer entirely
+            # (eventhandlers.go assignedNonTerminatedPod FilterFunc).
             self.queue.delete(pod)
             self.cache.remove_pod(pod.key)
             self.queue.move_all_to_active_or_backoff(EVENT_POD_DELETE)

@@ -126,3 +126,28 @@ def test_start_loop_displaces_previous_term():
     assert wait_for(lambda: first_s.is_set() and not first_t.is_alive())
     assert runner._loop_thread.is_alive()
     runner.stop()
+
+
+def test_terminal_pods_release_capacity(cluster):
+    """A node full of Succeeded pods must accept new ones (the reference's
+    scheduler informer filters terminated pods; eventhandlers.go)."""
+    server, client, runner = cluster
+    client.nodes().create(make_node("solo")
+                          .capacity({"cpu": "2", "pods": "10"})
+                          .obj().to_dict())
+    pods = client.pods("default")
+    # two pods saturate the 2-cpu node
+    for i in range(2):
+        pods.create(make_pod(f"fill{i}").req({"cpu": "1"}).obj().to_dict())
+    assert wait_for(lambda: all(p["spec"].get("nodeName")
+                                for p in pods.list()), 15)
+    # a third can't fit...
+    pods.create(make_pod("next").req({"cpu": "1"}).obj().to_dict())
+    time.sleep(0.5)
+    assert not pods.get("next")["spec"].get("nodeName")
+    # ...until the fillers terminate
+    for i in range(2):
+        p = pods.get(f"fill{i}")
+        p["status"] = {"phase": "Succeeded"}
+        pods.update_status(p)
+    assert wait_for(lambda: pods.get("next")["spec"].get("nodeName"), 15)
