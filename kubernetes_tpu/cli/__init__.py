@@ -1,0 +1,5 @@
+"""CLI — ktpu, the kubectl analog (SURVEY §2.5)."""
+
+from kubernetes_tpu.cli.ktpu import main
+
+__all__ = ["main"]

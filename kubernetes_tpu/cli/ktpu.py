@@ -1,0 +1,374 @@
+"""ktpu — the kubectl analog.
+
+Reference shape: ``staging/src/k8s.io/kubectl/pkg/cmd/`` (cobra command tree;
+``get`` printers in ``pkg/cmd/get``, ``apply`` in ``cmd/apply/apply.go`` via
+resource.Builder over multi-doc YAML, ``scale``, ``cordon``/``drain`` in
+``cmd/drain``). argparse stands in for cobra; the server is any running
+``kubernetes_tpu.store.apiserver.APIServer``.
+
+Usage:
+  ktpu --server http://127.0.0.1:8001 get pods [-n NS] [-o json|yaml|wide]
+  ktpu apply -f manifest.yaml            # create-or-update, multi-doc
+  ktpu delete pod NAME | ktpu delete -f manifest.yaml
+  ktpu describe pod NAME
+  ktpu scale deployment NAME --replicas N
+  ktpu cordon NODE / ktpu uncordon NODE
+  ktpu drain NODE
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Optional
+
+from kubernetes_tpu.client.clientset import ApiError, HTTPClient
+from kubernetes_tpu.store.apiserver import ALL_RESOURCES, KIND_TO_PLURAL
+
+# singular/short aliases -> plural (kubectl's RESTMapper shortcuts)
+ALIASES = {
+    "po": "pods", "pod": "pods",
+    "no": "nodes", "node": "nodes",
+    "svc": "services", "service": "services",
+    "ep": "endpoints",
+    "deploy": "deployments", "deployment": "deployments",
+    "rs": "replicasets", "replicaset": "replicasets",
+    "sts": "statefulsets", "statefulset": "statefulsets",
+    "ds": "daemonsets", "daemonset": "daemonsets",
+    "job": "jobs",
+    "cm": "configmaps", "configmap": "configmaps",
+    "ns": "namespaces", "namespace": "namespaces",
+    "lease": "leases",
+}
+
+
+def resolve_plural(res: str) -> str:
+    res = res.lower()
+    plural = ALIASES.get(res, res)
+    if plural not in ALL_RESOURCES:
+        raise SystemExit(f"error: unknown resource type {res!r}")
+    return plural
+
+
+def obj_age(obj: dict) -> str:
+    ts = (obj.get("metadata") or {}).get("creationTimestamp")
+    if not ts:
+        return "<unknown>"
+    secs = max(0, int(time.time() - float(ts)))
+    for unit, div in (("d", 86400), ("h", 3600), ("m", 60)):
+        if secs >= div:
+            return f"{secs // div}{unit}"
+    return f"{secs}s"
+
+
+# ---------------------------------------------------------------- printers
+
+def _pod_row(o: dict, wide: bool) -> list[str]:
+    st = o.get("status") or {}
+    ready = sum(1 for c in st.get("conditions") or []
+                if c.get("type") == "Ready" and c.get("status") == "True")
+    total = len((o.get("spec") or {}).get("containers") or []) or 1
+    row = [o["metadata"]["name"], f"{ready}/{1 if total == 0 else total}",
+           st.get("phase", "Unknown"), obj_age(o)]
+    if wide:
+        row += [st.get("podIP", "<none>"),
+                (o.get("spec") or {}).get("nodeName", "<none>")]
+    return row
+
+
+def _node_row(o: dict, wide: bool) -> list[str]:
+    conds = (o.get("status") or {}).get("conditions") or []
+    ready = any(c.get("type") == "Ready" and c.get("status") == "True"
+                for c in conds)
+    status = "Ready" if ready else "NotReady"
+    if (o.get("spec") or {}).get("unschedulable"):
+        status += ",SchedulingDisabled"
+    return [o["metadata"]["name"], status, obj_age(o)]
+
+
+def _workload_row(o: dict, wide: bool) -> list[str]:
+    spec_n = (o.get("spec") or {}).get("replicas", 1)
+    st = o.get("status") or {}
+    return [o["metadata"]["name"],
+            f"{st.get('readyReplicas', 0)}/{spec_n}",
+            str(st.get("updatedReplicas", st.get("replicas", 0))),
+            obj_age(o)]
+
+
+def _svc_row(o: dict, wide: bool) -> list[str]:
+    spec = o.get("spec") or {}
+    ports = ",".join(f"{p.get('port')}/{p.get('protocol', 'TCP')}"
+                     for p in spec.get("ports") or [])
+    return [o["metadata"]["name"], spec.get("type", "ClusterIP"),
+            spec.get("clusterIP", "<none>"), ports or "<none>", obj_age(o)]
+
+
+def _default_row(o: dict, wide: bool) -> list[str]:
+    return [o["metadata"]["name"], obj_age(o)]
+
+
+PRINTERS = {
+    "pods": (["NAME", "READY", "STATUS", "AGE"],
+             ["NAME", "READY", "STATUS", "AGE", "IP", "NODE"], _pod_row),
+    "nodes": (["NAME", "STATUS", "AGE"], ["NAME", "STATUS", "AGE"], _node_row),
+    "services": (["NAME", "TYPE", "CLUSTER-IP", "PORT(S)", "AGE"],
+                 ["NAME", "TYPE", "CLUSTER-IP", "PORT(S)", "AGE"], _svc_row),
+    "deployments": (["NAME", "READY", "UP-TO-DATE", "AGE"],
+                    ["NAME", "READY", "UP-TO-DATE", "AGE"], _workload_row),
+    "replicasets": (["NAME", "READY", "CURRENT", "AGE"],
+                    ["NAME", "READY", "CURRENT", "AGE"], _workload_row),
+    "statefulsets": (["NAME", "READY", "CURRENT", "AGE"],
+                     ["NAME", "READY", "CURRENT", "AGE"], _workload_row),
+}
+
+
+def print_table(plural: str, items: list[dict], out, wide: bool = False):
+    headers, wide_headers, row_fn = PRINTERS.get(
+        plural, (["NAME", "AGE"], ["NAME", "AGE"], _default_row))
+    headers = wide_headers if wide else headers
+    rows = [row_fn(o, wide) for o in items]
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    out.write("  ".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip() + "\n")
+    for r in rows:
+        out.write("  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip() + "\n")
+
+
+# --------------------------------------------------------------- commands
+
+def load_manifests(path: str) -> list[dict]:
+    import yaml
+    text = sys.stdin.read() if path == "-" else open(path).read()
+    return [d for d in yaml.safe_load_all(text) if d]
+
+
+def cmd_get(client: HTTPClient, args, out) -> int:
+    plural = resolve_plural(args.resource)
+    _, namespaced = ALL_RESOURCES[plural]
+    ns = None if args.all_namespaces else (args.namespace if namespaced else None)
+    res = client.resource(plural, ns)
+    if args.name:
+        items = [res.get(args.name)]
+    else:
+        items = res.list(label_selector=args.selector)
+    if args.output == "json":
+        out.write(json.dumps(items[0] if args.name else
+                             {"kind": "List", "items": items}, indent=2) + "\n")
+    elif args.output == "yaml":
+        import yaml
+        yaml.safe_dump(items[0] if args.name else {"kind": "List", "items": items},
+                       out, sort_keys=False)
+    else:
+        print_table(plural, items, out, wide=args.output == "wide")
+    return 0
+
+
+def cmd_apply(client: HTTPClient, args, out) -> int:
+    rc = 0
+    for doc in load_manifests(args.filename):
+        kind = doc.get("kind", "")
+        plural = KIND_TO_PLURAL.get(kind)
+        if plural is None:
+            out.write(f"error: unknown kind {kind!r}\n")
+            rc = 1
+            continue
+        _, namespaced = ALL_RESOURCES[plural]
+        md = doc.setdefault("metadata", {})
+        ns = md.get("namespace", args.namespace) if namespaced else None
+        if namespaced:
+            md.setdefault("namespace", ns)
+        res = client.resource(plural, ns)
+        name = md.get("name", "")
+        try:
+            current = res.get(name)
+        except ApiError as e:
+            if e.code != 404:
+                raise
+            res.create(doc)
+            out.write(f"{plural[:-1]}/{name} created\n")
+            continue
+        # apply = server-side merge of desired onto live (fieldmanager
+        # analog: desired spec/labels/annotations win; status/identity kept)
+        merged = dict(current)
+        for k, v in doc.items():
+            if k in ("status",):
+                continue
+            if k == "metadata":
+                m = dict(current.get("metadata") or {})
+                for mk in ("labels", "annotations"):
+                    if mk in v:
+                        m[mk] = v[mk]
+                merged["metadata"] = m
+            else:
+                merged[k] = v
+        res.update(merged)
+        out.write(f"{plural[:-1]}/{name} configured\n")
+    return rc
+
+
+def cmd_delete(client: HTTPClient, args, out) -> int:
+    targets: list[tuple[str, Optional[str], str]] = []
+    if args.filename:
+        for doc in load_manifests(args.filename):
+            plural = KIND_TO_PLURAL.get(doc.get("kind", ""), None)
+            if plural is None:
+                continue
+            _, namespaced = ALL_RESOURCES[plural]
+            md = doc.get("metadata") or {}
+            targets.append((plural,
+                            md.get("namespace", args.namespace) if namespaced else None,
+                            md.get("name", "")))
+    else:
+        plural = resolve_plural(args.resource)
+        _, namespaced = ALL_RESOURCES[plural]
+        targets.append((plural, args.namespace if namespaced else None, args.name))
+    for plural, ns, name in targets:
+        try:
+            client.resource(plural, ns).delete(name)
+            out.write(f"{plural[:-1]}/{name} deleted\n")
+        except ApiError as e:
+            if e.code != 404:
+                raise
+            out.write(f"{plural[:-1]}/{name} not found\n")
+    return 0
+
+
+def cmd_describe(client: HTTPClient, args, out) -> int:
+    plural = resolve_plural(args.resource)
+    _, namespaced = ALL_RESOURCES[plural]
+    obj = client.resource(plural, args.namespace if namespaced else None).get(args.name)
+    md = obj.get("metadata") or {}
+    out.write(f"Name:         {md.get('name')}\n")
+    if namespaced:
+        out.write(f"Namespace:    {md.get('namespace')}\n")
+    out.write(f"UID:          {md.get('uid')}\n")
+    if md.get("labels"):
+        out.write("Labels:       " + ",".join(f"{k}={v}" for k, v in
+                                              sorted(md["labels"].items())) + "\n")
+    if plural == "pods":
+        spec, st = obj.get("spec") or {}, obj.get("status") or {}
+        out.write(f"Node:         {spec.get('nodeName', '<none>')}\n")
+        out.write(f"Status:       {st.get('phase', 'Unknown')}\n")
+        out.write(f"IP:           {st.get('podIP', '<none>')}\n")
+        out.write("Containers:\n")
+        for c in spec.get("containers") or []:
+            out.write(f"  {c.get('name')}:\n    Image: {c.get('image', '<none>')}\n")
+            reqs = (c.get("resources") or {}).get("requests") or {}
+            if reqs:
+                out.write("    Requests: " + ", ".join(
+                    f"{k}={v}" for k, v in sorted(reqs.items())) + "\n")
+        if st.get("conditions"):
+            out.write("Conditions:\n")
+            for c in st["conditions"]:
+                out.write(f"  {c.get('type')}: {c.get('status')}\n")
+    else:
+        import yaml
+        out.write("Spec:\n")
+        yaml.safe_dump(obj.get("spec") or {}, out, sort_keys=False, indent=2)
+        out.write("Status:\n")
+        yaml.safe_dump(obj.get("status") or {}, out, sort_keys=False, indent=2)
+    return 0
+
+
+def cmd_scale(client: HTTPClient, args, out) -> int:
+    plural = resolve_plural(args.resource)
+    res = client.resource(plural, args.namespace)
+    obj = res.get(args.name)
+    obj.setdefault("spec", {})["replicas"] = args.replicas
+    res.update(obj)
+    out.write(f"{plural[:-1]}/{args.name} scaled\n")
+    return 0
+
+
+def _set_unschedulable(client: HTTPClient, name: str, flag: bool, out) -> int:
+    node = client.nodes().get(name)
+    node.setdefault("spec", {})["unschedulable"] = flag
+    client.nodes().update(node)
+    out.write(f"node/{name} {'cordoned' if flag else 'uncordoned'}\n")
+    return 0
+
+
+def cmd_drain(client: HTTPClient, args, out) -> int:
+    _set_unschedulable(client, args.name, True, out)
+    for p in client.resource("pods", None).list(
+            field_selector=f"spec.nodeName={args.name}"):
+        md = p["metadata"]
+        # daemon pods are not drained (kubectl drain --ignore-daemonsets)
+        refs = md.get("ownerReferences") or []
+        if any(r.get("kind") == "DaemonSet" for r in refs):
+            continue
+        client.pods(md.get("namespace", "default")).evict(md["name"])
+        out.write(f"pod/{md['name']} evicted\n")
+    return 0
+
+
+# ------------------------------------------------------------------- main
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="ktpu", description=__doc__.split("\n")[0])
+    ap.add_argument("--server", "-s", default="http://127.0.0.1:8001")
+    ap.add_argument("--namespace", "-n", default="default")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("get")
+    g.add_argument("resource")
+    g.add_argument("name", nargs="?", default="")
+    g.add_argument("-o", "--output", choices=["table", "wide", "json", "yaml"],
+                   default="table")
+    g.add_argument("-l", "--selector", default=None)
+    g.add_argument("-A", "--all-namespaces", action="store_true")
+
+    a = sub.add_parser("apply")
+    a.add_argument("-f", "--filename", required=True)
+
+    d = sub.add_parser("delete")
+    d.add_argument("resource", nargs="?", default="")
+    d.add_argument("name", nargs="?", default="")
+    d.add_argument("-f", "--filename", default=None)
+
+    de = sub.add_parser("describe")
+    de.add_argument("resource")
+    de.add_argument("name")
+
+    sc = sub.add_parser("scale")
+    sc.add_argument("resource")
+    sc.add_argument("name")
+    sc.add_argument("--replicas", type=int, required=True)
+
+    for nm in ("cordon", "uncordon", "drain"):
+        c = sub.add_parser(nm)
+        c.add_argument("name")
+    return ap
+
+
+def main(argv=None, out=None) -> int:
+    out = out or sys.stdout
+    args = build_parser().parse_args(argv)
+    client = HTTPClient(args.server)
+    try:
+        if args.cmd == "get":
+            return cmd_get(client, args, out)
+        if args.cmd == "apply":
+            return cmd_apply(client, args, out)
+        if args.cmd == "delete":
+            return cmd_delete(client, args, out)
+        if args.cmd == "describe":
+            return cmd_describe(client, args, out)
+        if args.cmd == "scale":
+            return cmd_scale(client, args, out)
+        if args.cmd == "cordon":
+            return _set_unschedulable(client, args.name, True, out)
+        if args.cmd == "uncordon":
+            return _set_unschedulable(client, args.name, False, out)
+        if args.cmd == "drain":
+            return cmd_drain(client, args, out)
+    except ApiError as e:
+        out.write(f"Error from server ({e.reason or e.code}): {e}\n")
+        return 1
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,5 @@
+"""kube-proxy — Service VIP dataplane (SURVEY §2.4)."""
+
+from kubernetes_tpu.proxy.proxier import Proxier, ServicePortInfo
+
+__all__ = ["Proxier", "ServicePortInfo"]

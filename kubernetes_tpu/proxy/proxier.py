@@ -1,0 +1,163 @@
+"""kube-proxy — Service VIPs programmed from Service + Endpoints watches.
+
+Reference: ``pkg/proxy/iptables/proxier.go`` (``Proxier.syncProxyRules``: a
+full-state rule rebuild debounced behind change trackers), with
+``pkg/proxy/servicechangetracker.go`` / ``endpointschangetracker.go``
+feeding it. The kernel-rule surface here is a data-plane table: chains
+KUBE-SERVICES -> KUBE-SVC-<id> -> KUBE-SEP-<id> represented as dicts, plus a
+``resolve()`` that performs the DNAT a packet would take — tests and the CLI
+exercise the same table the kernel would.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kubernetes_tpu.client.informer import InformerFactory
+
+
+@dataclass
+class ServicePortInfo:
+    namespace: str
+    name: str
+    port_name: str
+    cluster_ip: str
+    port: int
+    protocol: str = "TCP"
+    node_port: int = 0
+    session_affinity: bool = False
+    endpoints: list[str] = field(default_factory=list)  # "ip:port"
+
+
+class Proxier:
+    """Full-state sync on any Service/Endpoints change (rate-limited by the
+    informer thread itself; upstream debounces with a bounded-frequency
+    runner — sync here is cheap enough to run per event)."""
+
+    def __init__(self, client, node_name: str = ""):
+        self.client = client
+        self.node_name = node_name
+        self.factory = InformerFactory(client)
+        self._lock = threading.Lock()
+        # (ns, svc_name, port_name) -> ServicePortInfo
+        self._services: dict[tuple, ServicePortInfo] = {}
+        self._affinity: dict[tuple, str] = {}  # (client_ip, vip, port) -> endpoint
+        self.sync_count = 0
+
+    # ---- wiring ----------------------------------------------------------
+
+    def start(self, wait_sync: float = 10.0):
+        self.svc_informer = self.factory.informer("services", None)
+        self.ep_informer = self.factory.informer("endpoints", None)
+        self.svc_informer.add_event_handler(lambda *a: self.sync())
+        self.ep_informer.add_event_handler(lambda *a: self.sync())
+        self.factory.start_all()
+        self.factory.wait_for_cache_sync(wait_sync)
+        self.sync()
+        return self
+
+    def stop(self):
+        self.factory.stop_all()
+
+    # ---- syncProxyRules --------------------------------------------------
+
+    def sync(self) -> None:
+        eps_by_svc: dict[tuple, dict] = {}
+        for ep in self.ep_informer.store.list():
+            md = ep.get("metadata") or {}
+            eps_by_svc[(md.get("namespace", ""), md.get("name", ""))] = ep
+        table: dict[tuple, ServicePortInfo] = {}
+        for svc in self.svc_informer.store.list():
+            md = svc.get("metadata") or {}
+            spec = svc.get("spec") or {}
+            cluster_ip = spec.get("clusterIP", "")
+            if not cluster_ip or cluster_ip == "None":
+                continue  # headless: no VIP rules
+            ns, name = md.get("namespace", ""), md.get("name", "")
+            ep = eps_by_svc.get((ns, name), {})
+            affinity = (spec.get("sessionAffinity") == "ClientIP")
+            for sp in spec.get("ports") or []:
+                pname = sp.get("name", "")
+                backends: list[str] = []
+                for subset in ep.get("subsets") or []:
+                    # match the endpoints port to this service port by name
+                    # (unnamed single-port services match everything)
+                    target = None
+                    for epp in subset.get("ports") or []:
+                        if not pname or epp.get("name", "") == pname:
+                            target = int(epp.get("port", 0))
+                            break
+                    if target is None:
+                        continue
+                    for a in subset.get("addresses") or []:
+                        backends.append(f"{a['ip']}:{target}")
+                table[(ns, name, pname)] = ServicePortInfo(
+                    namespace=ns, name=name, port_name=pname,
+                    cluster_ip=cluster_ip, port=int(sp.get("port", 0)),
+                    protocol=sp.get("protocol", "TCP"),
+                    node_port=int(sp.get("nodePort", 0) or 0),
+                    session_affinity=affinity,
+                    endpoints=sorted(backends))
+        with self._lock:
+            self._services = table
+            live = {(spi.cluster_ip, spi.port) for spi in table.values()}
+            self._affinity = {k: v for k, v in self._affinity.items()
+                              if (k[1], k[2]) in live}
+            self.sync_count += 1
+
+    # ---- the data plane --------------------------------------------------
+
+    def resolve(self, vip: str, port: int,
+                client_ip: str = "",
+                rng: Optional[random.Random] = None) -> Optional[str]:
+        """DNAT decision for a packet to vip:port -> "backend_ip:port" or
+        None (REJECT, the no-endpoints rule). Random pick mirrors the
+        iptables statistic-mode-random chain; ClientIP affinity mirrors
+        recent-module pinning."""
+        rng = rng or random
+        with self._lock:
+            spi = next((s for s in self._services.values()
+                        if (s.cluster_ip == vip or (s.node_port and s.node_port == port))
+                        and (s.port == port or s.node_port == port)), None)
+            if spi is None or not spi.endpoints:
+                return None
+            key = (client_ip, vip, port)
+            if spi.session_affinity and client_ip:
+                pinned = self._affinity.get(key)
+                if pinned in spi.endpoints:
+                    return pinned
+            choice = spi.endpoints[rng.randrange(len(spi.endpoints))]
+            if spi.session_affinity and client_ip:
+                self._affinity[key] = choice
+            return choice
+
+    def rules(self) -> list[str]:
+        """Render the table as iptables-ish chains (what ``iptables-save``
+        of the reference's KUBE-* chains encodes)."""
+        out = ["-N KUBE-SERVICES"]
+        with self._lock:
+            for (ns, name, pname), spi in sorted(self._services.items()):
+                svc_chain = f"KUBE-SVC-{ns}/{name}:{pname or spi.port}"
+                if not spi.endpoints:
+                    out.append(f"-A KUBE-SERVICES -d {spi.cluster_ip}/32 "
+                               f"-p {spi.protocol.lower()} --dport {spi.port} "
+                               f"-j REJECT")
+                    continue
+                out.append(f"-A KUBE-SERVICES -d {spi.cluster_ip}/32 "
+                           f"-p {spi.protocol.lower()} --dport {spi.port} "
+                           f"-j {svc_chain}")
+                n = len(spi.endpoints)
+                for i, ep in enumerate(spi.endpoints):
+                    sep = f"KUBE-SEP-{ep}"
+                    prob = f" -m statistic --mode random --probability {1/(n-i):.5f}" \
+                        if i < n - 1 else ""
+                    out.append(f"-A {svc_chain}{prob} -j {sep}")
+                    out.append(f"-A {sep} -j DNAT --to-destination {ep}")
+        return out
+
+    def service_table(self) -> dict[tuple, ServicePortInfo]:
+        with self._lock:
+            return dict(self._services)

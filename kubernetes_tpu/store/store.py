@@ -150,6 +150,16 @@ class ObjectStore:
             space = self._data.setdefault(kind, {})
             if k in space:
                 raise AlreadyExists(f"{kind} {k}")
+            if kind == "Service":
+                # service registry PrepareForCreate: ClusterIP allocation
+                # (pkg/registry/core/service/ipallocator) from 10.96.0.0/12
+                spec = obj.get("spec") or {}
+                if not spec.get("clusterIP") and spec.get("type") != "ExternalName":
+                    self._svc_ip_seq = getattr(self, "_svc_ip_seq", 0) + 1
+                    n = self._svc_ip_seq
+                    obj = dict(obj)
+                    obj["spec"] = {**spec,
+                                   "clusterIP": f"10.96.{n // 250}.{n % 250 + 1}"}
             rv = self._bump_locked()
             obj = json.loads(json.dumps(obj))  # defensive copy, wire-shaped
             md = obj.setdefault("metadata", {})

@@ -1,0 +1,120 @@
+"""ktpu CLI against a live apiserver: get/apply/delete/describe/scale/cordon."""
+
+import io
+import json
+import time
+
+import pytest
+
+from kubernetes_tpu.cli.ktpu import main
+from kubernetes_tpu.client.clientset import HTTPClient
+from kubernetes_tpu.store.apiserver import APIServer
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+@pytest.fixture()
+def server():
+    s = APIServer().start()
+    yield s
+    s.stop()
+
+
+def run(server, *argv):
+    out = io.StringIO()
+    rc = main(["--server", server.url, *argv], out=out)
+    return rc, out.getvalue()
+
+
+def test_apply_get_describe_delete(server, tmp_path):
+    manifest = tmp_path / "app.yaml"
+    manifest.write_text("""
+apiVersion: v1
+kind: Pod
+metadata: {name: cli-pod, namespace: default, labels: {app: cli}}
+spec:
+  containers:
+  - name: c
+    image: img:v1
+    resources: {requests: {cpu: 250m}}
+---
+apiVersion: v1
+kind: Service
+metadata: {name: cli-svc, namespace: default}
+spec:
+  selector: {app: cli}
+  ports: [{port: 80}]
+""")
+    rc, out = run(server, "apply", "-f", str(manifest))
+    assert rc == 0 and "pod/cli-pod created" in out and "service/cli-svc created" in out
+
+    rc, out = run(server, "get", "pods")
+    assert rc == 0 and "cli-pod" in out and "NAME" in out
+
+    rc, out = run(server, "get", "pod", "cli-pod", "-o", "json")
+    assert rc == 0
+    assert json.loads(out)["metadata"]["name"] == "cli-pod"
+
+    rc, out = run(server, "get", "svc")
+    assert "10.96." in out  # allocated clusterIP rendered
+
+    rc, out = run(server, "describe", "pod", "cli-pod")
+    assert "Name:         cli-pod" in out and "Image: img:v1" in out
+
+    # re-apply with a change -> configured
+    manifest.write_text(manifest.read_text().replace("img:v1", "img:v2"))
+    rc, out = run(server, "apply", "-f", str(manifest))
+    assert rc == 0 and "pod/cli-pod configured" in out
+    rc, out = run(server, "get", "pod", "cli-pod", "-o", "json")
+    assert json.loads(out)["spec"]["containers"][0]["image"] == "img:v2"
+
+    rc, out = run(server, "delete", "pod", "cli-pod")
+    assert rc == 0 and "deleted" in out
+    rc, out = run(server, "get", "pods")
+    assert "cli-pod" not in out
+
+
+def test_scale_and_selector(server):
+    client = HTTPClient(server.url)
+    client.resource("deployments").create({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"replicas": 2, "selector": {"matchLabels": {"app": "web"}},
+                 "template": {"metadata": {"labels": {"app": "web"}},
+                              "spec": {"containers": [{"name": "c"}]}}},
+    })
+    rc, out = run(server, "scale", "deployment", "web", "--replicas", "5")
+    assert rc == 0
+    assert client.resource("deployments").get("web")["spec"]["replicas"] == 5
+
+    client.pods().create(make_pod("a").label("app", "web").obj().to_dict())
+    client.pods().create(make_pod("b").label("app", "other").obj().to_dict())
+    rc, out = run(server, "get", "pods", "-l", "app=web")
+    assert "a" in out.split() and "b" not in out.split()
+
+
+def test_cordon_drain_uncordon(server):
+    client = HTTPClient(server.url)
+    client.nodes().create(make_node("n1").obj().to_dict())
+    pod = make_pod("on-n1").node("n1").obj().to_dict()
+    client.pods().create(pod)
+    ds_pod = make_pod("daemon-on-n1").node("n1").obj().to_dict()
+    ds_pod["metadata"]["ownerReferences"] = [{"kind": "DaemonSet", "name": "ds",
+                                              "uid": "u-ds", "controller": True}]
+    client.pods().create(ds_pod)
+
+    rc, out = run(server, "drain", "n1")
+    assert rc == 0 and "pod/on-n1 evicted" in out
+    assert client.nodes().get("n1")["spec"]["unschedulable"] is True
+    names = [p["metadata"]["name"] for p in client.pods().list()]
+    assert names == ["daemon-on-n1"]  # daemon pod survives the drain
+
+    rc, out = run(server, "uncordon", "n1")
+    assert rc == 0
+    assert not client.nodes().get("n1")["spec"].get("unschedulable")
+
+
+def test_error_surface(server):
+    rc, out = run(server, "get", "pod", "nope")
+    assert rc == 1 and "Error from server" in out
+    with pytest.raises(SystemExit):
+        run(server, "get", "flurble")

@@ -1,0 +1,121 @@
+"""kube-proxy: rule table from Service+Endpoints, DNAT resolution, affinity."""
+
+import random
+import time
+
+import pytest
+
+from kubernetes_tpu.client.clientset import DirectClient
+from kubernetes_tpu.proxy import Proxier
+from kubernetes_tpu.store.store import ObjectStore
+
+
+def wait_until(fn, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+@pytest.fixture
+def client():
+    return DirectClient(ObjectStore())
+
+
+def make_service(client, name="web", port=80, target=8080, affinity=False):
+    spec = {"selector": {"app": name},
+            "ports": [{"port": port, "targetPort": target}]}
+    if affinity:
+        spec["sessionAffinity"] = "ClientIP"
+    return client.services().create({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": name, "namespace": "default"}, "spec": spec})
+
+
+def put_endpoints(client, name, ips, port=8080, update=False):
+    ep = {"apiVersion": "v1", "kind": "Endpoints",
+          "metadata": {"name": name, "namespace": "default"},
+          "subsets": [{"ports": [{"name": "", "port": port}],
+                       "addresses": [{"ip": ip} for ip in ips]}] if ips else []}
+    if update:
+        cur = client.endpoints().get(name)
+        ep["metadata"]["resourceVersion"] = cur["metadata"]["resourceVersion"]
+        return client.endpoints().update(ep)
+    return client.endpoints().create(ep)
+
+
+def test_cluster_ip_allocated_on_create(client):
+    svc = make_service(client)
+    assert svc["spec"]["clusterIP"].startswith("10.96.")
+    svc2 = make_service(client, name="other")
+    assert svc2["spec"]["clusterIP"] != svc["spec"]["clusterIP"]
+
+
+def test_proxier_resolves_vip_to_backends(client):
+    svc = make_service(client)
+    put_endpoints(client, "web", ["10.1.0.1", "10.1.0.2"])
+    p = Proxier(client).start()
+    try:
+        vip = svc["spec"]["clusterIP"]
+        rng = random.Random(0)
+        picks = {p.resolve(vip, 80, rng=rng) for _ in range(50)}
+        assert picks == {"10.1.0.1:8080", "10.1.0.2:8080"}
+        # no endpoints -> REJECT (None)
+        assert p.resolve("10.96.99.99", 80) is None
+        # endpoints change propagates
+        put_endpoints(client, "web", ["10.1.0.9"], update=True)
+        assert wait_until(lambda: p.resolve(vip, 80) == "10.1.0.9:8080")
+    finally:
+        p.stop()
+
+
+def test_proxier_session_affinity(client):
+    svc = make_service(client, name="sticky", affinity=True)
+    put_endpoints(client, "sticky", ["10.2.0.1", "10.2.0.2", "10.2.0.3"])
+    p = Proxier(client).start()
+    try:
+        vip = svc["spec"]["clusterIP"]
+        rng = random.Random(7)
+        first = p.resolve(vip, 80, client_ip="172.16.0.5", rng=rng)
+        assert all(p.resolve(vip, 80, client_ip="172.16.0.5", rng=rng) == first
+                   for _ in range(20))
+        # a different client may get a different backend (and keeps it)
+        other = p.resolve(vip, 80, client_ip="172.16.0.6", rng=rng)
+        assert all(p.resolve(vip, 80, client_ip="172.16.0.6", rng=rng) == other
+                   for _ in range(5))
+    finally:
+        p.stop()
+
+
+def test_proxier_rules_render(client):
+    svc = make_service(client)
+    put_endpoints(client, "web", ["10.1.0.1", "10.1.0.2"])
+    empty = make_service(client, name="void")
+    p = Proxier(client).start()
+    try:
+        rules = p.rules()
+        vip = svc["spec"]["clusterIP"]
+        assert any(f"-d {vip}/32" in r and "KUBE-SVC-default/web" in r for r in rules)
+        assert any("DNAT --to-destination 10.1.0.1:8080" in r for r in rules)
+        # probability ladder on the first of two endpoints
+        assert any("--probability 0.50000" in r for r in rules)
+        # service without endpoints renders a REJECT
+        assert any(f"-d {empty['spec']['clusterIP']}/32" in r and "REJECT" in r
+                   for r in rules)
+    finally:
+        p.stop()
+
+
+def test_headless_service_has_no_rules(client):
+    client.services().create({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "hl", "namespace": "default"},
+        "spec": {"clusterIP": "None", "selector": {"app": "hl"},
+                 "ports": [{"port": 80}]}})
+    p = Proxier(client).start()
+    try:
+        assert all("hl" not in r for r in p.rules())
+    finally:
+        p.stop()
