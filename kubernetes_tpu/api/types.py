@@ -482,6 +482,7 @@ class PodSpec:
     scheduling_gates: list[str] = field(default_factory=list)
     overhead: dict[str, Any] = field(default_factory=dict)
     restart_policy: str = "Always"
+    volumes: list[dict[str, Any]] = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: dict) -> "PodSpec":
@@ -501,6 +502,7 @@ class PodSpec:
                               for g in d.get("schedulingGates") or []],
             overhead=dict(d.get("overhead") or {}),
             restart_policy=d.get("restartPolicy", "Always"),
+            volumes=list(d.get("volumes") or []),
         )
 
     def to_dict(self) -> dict:
@@ -527,6 +529,8 @@ class PodSpec:
             d["schedulingGates"] = [{"name": g} for g in self.scheduling_gates]
         if self.overhead:
             d["overhead"] = dict(self.overhead)
+        if self.volumes:
+            d["volumes"] = list(self.volumes)
         return d
 
 
@@ -619,6 +623,13 @@ class Pod:
 
     def containers_all(self, init: bool = True) -> list[Container]:
         return (self.spec.init_containers if init else []) + self.spec.containers
+
+    def pvc_names(self) -> list[str]:
+        """claimNames of persistentVolumeClaim volumes, in spec order."""
+        return [v["persistentVolumeClaim"]["claimName"]
+                for v in self.spec.volumes
+                if isinstance(v.get("persistentVolumeClaim"), dict)
+                and v["persistentVolumeClaim"].get("claimName")]
 
     def host_ports(self) -> list[tuple[str, str, int]]:
         """(hostIP, protocol, hostPort) triples with hostPort != 0."""

@@ -18,11 +18,12 @@ from kubernetes_tpu.controllers.endpoints import EndpointsController
 from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
 from kubernetes_tpu.controllers.job import JobController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
+from kubernetes_tpu.controllers.pvbinder import PersistentVolumeController
 from kubernetes_tpu.controllers.replicaset import ReplicaSetController
 from kubernetes_tpu.controllers.statefulset import StatefulSetController
 
 DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "daemonset",
-                       "statefulset", "endpoints", "nodelifecycle")
+                       "statefulset", "endpoints", "nodelifecycle", "pvbinder")
 
 
 class ControllerManager:
@@ -42,6 +43,7 @@ class ControllerManager:
             "statefulset": StatefulSetController,
             "endpoints": EndpointsController,
             "nodelifecycle": NodeLifecycleController,
+            "pvbinder": PersistentVolumeController,
         }
         self.controllers = [ctors[n](client) for n in controllers]
         self.gc = GarbageCollector(client) if gc_enabled else None
@@ -112,4 +114,5 @@ def _informer_attr(c) -> str:
         "statefulset": "ss_informer",
         "endpoints": "svc_informer",
         "nodelifecycle": "node_informer",
+        "pvbinder": "pvc_informer",
     }.get(c.name, "")

@@ -147,6 +147,11 @@ class ClusterTensors(struct.PyTreeNode):
     ea_sel: "SelectorSet"  # [E,ET,...]
     ea_topo: Any           # [E,ET] int32
     ea_valid: Any          # [E,ET] bool
+    # volumes (VolumeRestrictions / NodeVolumeLimits node side)
+    used_rwo: Any          # [N,VN] int32 pv-name id of node-exclusive PVs in use
+    used_rwo_valid: Any    # [N,VN] bool
+    attach_used: Any       # [N] int32 attachable volumes currently on node
+    attach_limit: Any      # [N] int32 (UNLIMITED if node reports no limit)
 
 
 class PodBatch(struct.PyTreeNode):
@@ -188,6 +193,15 @@ class PodBatch(struct.PyTreeNode):
     sc_maxskew: Any         # [P,SC] int32
     sc_hard: Any            # [P,SC] bool (DoNotSchedule)
     sc_valid: Any           # [P,SC] bool
+    # volumes (VolumeBinding/VolumeZone as grouped node-selector terms:
+    # OR within a group = any candidate PV; AND across groups = every PVC)
+    vol_terms: TermSet      # [P,VT,...]
+    vol_group: Any          # [P,VT] int32 group id of each term (-1 pad)
+    vol_group_valid: Any    # [P,VG] bool real groups (a group with no terms
+    #                         is unsatisfiable: valid here, no matching term)
+    rwo_pv: Any             # [P,VB] int32 node-exclusive pv ids the pod mounts
+    rwo_valid: Any          # [P,VB] bool
+    attach_req: Any         # [P] int32 attachable volumes the pod adds
 
 
 @dataclass
@@ -233,9 +247,17 @@ class SnapshotEncoder:
         self.namespaces = StringTable(["default"])
         self.ips = StringTable([WILDCARD_IP])
         self.images = StringTable()
+        self.pv_names = StringTable()
         self._image_sizes: list[float] = []
         self._cluster_topo_keys: set[int] = set()
+        self._volumes = None  # VolumeCatalog | None
+        self._rwop_in_use: set = set()
         self.generation = 0
+
+    def set_volumes(self, catalog) -> None:
+        """Attach the PVC/PV/StorageClass catalog consulted by the next
+        encode_cluster/encode_pods pair (sched/volumebinding.VolumeCatalog)."""
+        self._volumes = catalog
 
     # -- small helpers ------------------------------------------------------
 
@@ -370,6 +392,27 @@ class SnapshotEncoder:
                 ea_valid[e, t_idx] = True
                 _selset_fill(ea_arrs, (e, t_idx), valid, exprs)
 
+        # volumes: node-side VolumeRestrictions / NodeVolumeLimits state
+        from kubernetes_tpu.sched.volumebinding import (
+            cluster_volume_state,
+            node_attach_limit,
+        )
+        per_node_rwo, per_node_attach, self._rwop_in_use = \
+            cluster_volume_state(epods, self._volumes)
+        VN = next_bucket(max((len(v) for v in per_node_rwo.values()), default=0))
+        used_rwo = np.full((N, VN), -1, np.int32)
+        used_rwo_valid = np.zeros((N, VN), bool)
+        attach_used = np.zeros(N, np.int32)
+        attach_limit = np.full(N, UNLIMITED, np.int32)
+        for i, n in enumerate(nodes):
+            lim = node_attach_limit(n.status.allocatable)
+            if lim >= 0:
+                attach_limit[i] = lim
+            attach_used[i] = per_node_attach.get(n.metadata.name, 0)
+            for v_idx, pv in enumerate(per_node_rwo.get(n.metadata.name, [])):
+                used_rwo[i, v_idx] = self.pv_names.intern(pv)
+                used_rwo_valid[i, v_idx] = True
+
         V = next_bucket(len(self.values), minimum=1)
         label_value_num = np.full(V, np.nan, np.float32)
         nums = self.values.numeric_values()
@@ -398,6 +441,8 @@ class SnapshotEncoder:
             epod_node=epod_node, epod_ns=epod_ns, epod_labels=epod_labels,
             epod_valid=epod_valid,
             ea_sel=SelectorSet(**ea_arrs), ea_topo=ea_topo, ea_valid=ea_valid,
+            used_rwo=used_rwo, used_rwo_valid=used_rwo_valid,
+            attach_used=attach_used, attach_limit=attach_limit,
         )
         return ct, meta
 
@@ -499,10 +544,21 @@ class SnapshotEncoder:
                                 int(sc.max_skew),
                                 sc.when_unsatisfiable == "DoNotSchedule"))
             labels = self._label_ids(p.metadata.labels)
+            # volumes: PVC groups -> (group_id, compiled term) pairs
+            from kubernetes_tpu.sched.volumebinding import compile_pod_volumes
+            vinfo = compile_pod_volumes(p, self._volumes, self._rwop_in_use)
+            vol_terms = []
+            for g_idx, group in enumerate(vinfo.groups):
+                for _w, exprs in self._compile_terms([(t, 1.0) for t in group],
+                                                     (0, 0, 0)):
+                    vol_terms.append((g_idx, exprs))
+            vol_rwo = [self.pv_names.intern(n) for n in vinfo.rwo_pv_names]
             compiled.append(dict(
                 pod=p, req_terms=req_terms, pref_terms=pref_terms, sel=sel,
                 tols=tols, ports=ports, images=images, labels=labels, ns=own_ns,
                 aff_req=aff_req, anti_req=anti_req, paff=paff, spreads=spreads,
+                vol_terms=vol_terms, vol_groups=len(vinfo.groups),
+                vol_rwo=vol_rwo, attach_req=vinfo.attach_count,
             ))
 
         K = next_bucket(len(self.keys), minimum=1)
@@ -512,9 +568,13 @@ class SnapshotEncoder:
 
         TREQ = _bucket(lambda c: len(c["req_terms"]))
         TPREF = _bucket(lambda c: len(c["pref_terms"]))
-        X = _bucket(lambda c: max((len(e) for _, e in c["req_terms"] + c["pref_terms"]),
-                                  default=0))
+        VT = _bucket(lambda c: len(c["vol_terms"]))
+        VG = _bucket(lambda c: c["vol_groups"])
+        VB = _bucket(lambda c: len(c["vol_rwo"]))
+        X = _bucket(lambda c: max((len(e) for _, e in c["req_terms"] + c["pref_terms"]
+                                   + c["vol_terms"]), default=0))
         VV = _bucket(lambda c: max((len(v) for _, ex in c["req_terms"] + c["pref_terms"]
+                                    + c["vol_terms"]
                                     for (_, _, v, _) in ex), default=0))
         S = _bucket(lambda c: len(c["sel"]))
         TOL = _bucket(lambda c: len(c["tols"]))
@@ -548,6 +608,12 @@ class SnapshotEncoder:
 
         req_a = _new_termset(TREQ)
         pref_a = _new_termset(TPREF)
+        vol_a = _new_termset(VT)
+        vol_group = np.full((P, VT), -1, np.int32)
+        vol_group_valid = np.zeros((P, VG), bool)
+        rwo_pv = np.full((P, VB), -1, np.int32)
+        rwo_valid = np.zeros((P, VB), bool)
+        attach_req = np.zeros(P, np.int32)
 
         def _fill_terms(arrs, p_idx, terms):
             arrs["has_any"][p_idx] = len(terms) > 0
@@ -628,6 +694,16 @@ class SnapshotEncoder:
                 sel_valid[i, s_idx] = True
             _fill_terms(req_a, i, c["req_terms"])
             _fill_terms(pref_a, i, c["pref_terms"])
+            # vol terms reuse the TermSet fill with group id in place of
+            # weight, then split the group id out into vol_group
+            _fill_terms(vol_a, i, [(float(g), e) for g, e in c["vol_terms"]])
+            for t_idx, (g, _e) in enumerate(c["vol_terms"]):
+                vol_group[i, t_idx] = g
+            vol_group_valid[i, :c["vol_groups"]] = True
+            for b_idx, pvid in enumerate(c["vol_rwo"]):
+                rwo_pv[i, b_idx] = pvid
+                rwo_valid[i, b_idx] = True
+            attach_req[i] = c["attach_req"]
             for pt_idx, (proto, port, ip) in enumerate(c["ports"]):
                 pport_proto[i, pt_idx] = proto
                 pport_port[i, pt_idx] = port
@@ -677,4 +753,7 @@ class SnapshotEncoder:
             paff_weight=paff_weight, paff_valid=paff_valid,
             sc_sel=SelectorSet(**sc_sel), sc_topo=sc_topo, sc_maxskew=sc_maxskew,
             sc_hard=sc_hard, sc_valid=sc_valid,
+            vol_terms=TermSet(**vol_a), vol_group=vol_group,
+            vol_group_valid=vol_group_valid,
+            rwo_pv=rwo_pv, rwo_valid=rwo_valid, attach_req=attach_req,
         )

@@ -112,6 +112,36 @@ def node_ports_mask(ct: ClusterTensors, pb: PodBatch):
     return ~conflict
 
 
+def volume_mask(ct: ClusterTensors, pb: PodBatch):
+    """VolumeBinding + VolumeZone + VolumeRestrictions + NodeVolumeLimits.
+
+    Reference: framework/plugins/{volumebinding,volumezone,volumerestrictions,
+    nodevolumelimits}. Constraints arrive pre-compiled as grouped
+    node-selector terms (sched/volumebinding.compile_pod_volumes): a node
+    passes when every PVC group has >=1 matching term (bound PV's affinity /
+    any candidate PV / provisionable match-all), no node-exclusive PV the pod
+    mounts is already attached, and the attach-count limit holds.
+    """
+    term = eval_term_set(pb.vol_terms, ct.node_labels, ct.label_value_num)  # [N,P,T]
+    G = pb.vol_group_valid.shape[1]
+    if G == 0:
+        vol_ok = jnp.ones(pb.pod_valid.shape + ct.node_valid.shape, bool)
+    else:
+        grp = (pb.vol_group[None, :, :, None]
+               == jnp.arange(G)[None, None, None, :])            # [1,P,T,G]
+        sat = jnp.any(term[..., None] & grp, axis=2)             # [N,P,G]
+        vol_ok = jnp.all(sat | ~pb.vol_group_valid[None], axis=-1).T  # [P,N]
+    # VolumeRestrictions: node-exclusive PV already in use on that node
+    clash = jnp.any(
+        (pb.rwo_pv[:, None, :, None] == ct.used_rwo[None, :, None, :])
+        & pb.rwo_valid[:, None, :, None] & ct.used_rwo_valid[None, :, None, :],
+        axis=(2, 3))                                             # [P,N]
+    # NodeVolumeLimits
+    fits = (ct.attach_used[None, :] + pb.attach_req[:, None]
+            <= ct.attach_limit[None, :])                         # [P,N]
+    return vol_ok & ~clash & fits
+
+
 # Ordered registry: name -> mask fn. Relational filters (PodTopologySpread,
 # InterPodAffinity) live in ops/topology.py and join in models/schedule_step.
 FILTERS = {
@@ -121,6 +151,7 @@ FILTERS = {
     "NodeAffinity": node_affinity_mask,
     "TaintToleration": taint_toleration_mask,
     "NodePorts": node_ports_mask,
+    "VolumeBinding": volume_mask,
 }
 
 

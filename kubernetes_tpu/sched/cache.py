@@ -30,6 +30,37 @@ class SchedulerCache:
         self._encoder = SnapshotEncoder()
         self._cached: Optional[tuple[int, ClusterTensors, SnapshotMeta]] = None
         self.assume_ttl = assume_ttl
+        self._volumes = None  # VolumeCatalog once any PVC/PV/SC appears
+
+    # ---- volume catalog (PVC/PV/StorageClass informers feed this) --------
+
+    def update_volume_object(self, kind: str, obj: dict, deleted: bool = False):
+        """Track PVC/PV/StorageClass state for the VolumeBinding tensors."""
+        from kubernetes_tpu.sched.volumebinding import VolumeCatalog
+        with self._lock:
+            if self._volumes is None:
+                self._volumes = VolumeCatalog()
+            md = obj.get("metadata") or {}
+            if kind == "PersistentVolumeClaim":
+                key = (md.get("namespace", "default"), md.get("name", ""))
+                space = self._volumes.pvcs
+            elif kind == "PersistentVolume":
+                key = md.get("name", "")
+                space = self._volumes.pvs
+            else:
+                key = md.get("name", "")
+                space = self._volumes.storage_classes
+            if deleted:
+                space.pop(key, None)
+            else:
+                space[key] = obj
+            self._encoder.set_volumes(self._volumes)
+            self._generation += 1
+
+    @property
+    def volume_catalog(self):
+        with self._lock:
+            return self._volumes
 
     # ---- node events -----------------------------------------------------
 

@@ -116,6 +116,7 @@ class FailReason:
     SPREAD = "node(s) didn't satisfy topology spread constraints"
     POD_AFFINITY = "node(s) didn't match pod affinity rules"
     POD_ANTI_AFFINITY = "node(s) didn't satisfy existing pods anti-affinity rules"
+    VOLUME = "node(s) had volume node affinity conflict"
 
 
 class OracleScheduler:
@@ -123,15 +124,39 @@ class OracleScheduler:
     assignments in, mirroring Cache.AssumePod optimism."""
 
     def __init__(self, nodes: list[Node], bound_pods: Optional[list[Pod]] = None,
-                 weights: Optional[dict[str, float]] = None, seed: int = 0):
+                 weights: Optional[dict[str, float]] = None, seed: int = 0,
+                 volumes=None):
         self.states = [NodeState.build(n) for n in nodes]
         self.node_index = {n.metadata.name: i for i, n in enumerate(nodes)}
         self.weights = dict(weights or DEFAULT_WEIGHTS)
         self.seed = seed
+        self.volumes = volumes  # VolumeCatalog | None
         for p in bound_pods or []:
             i = self.node_index.get(p.spec.node_name)
             if i is not None:
                 self.states[i].add_pod(p)
+        from kubernetes_tpu.sched.volumebinding import cluster_volume_state
+        self._vol_rwo, self._vol_attach, self._vol_rwop = cluster_volume_state(
+            [p for st in self.states for p in st.pods], volumes)
+
+    def _volume_ok(self, pod: Pod, node: Node, vinfo) -> bool:
+        """VolumeBinding/Zone/Restrictions/Limits, serial reference form."""
+        from kubernetes_tpu.api.selectors import node_fields, node_selector_matches
+        from kubernetes_tpu.sched.volumebinding import node_attach_limit
+        name = node.metadata.name
+        for group in vinfo.groups:
+            if not group:
+                return False  # unsatisfiable PVC
+            if not node_selector_matches(group, node.metadata.labels,
+                                         node_fields(name)):
+                return False
+        in_use = set(self._vol_rwo.get(name, []))
+        if any(pv in in_use for pv in vinfo.rwo_pv_names):
+            return False
+        limit = node_attach_limit(node.status.allocatable)
+        if limit >= 0 and self._vol_attach.get(name, 0) + vinfo.attach_count > limit:
+            return False
+        return True
 
     # ---- filters ---------------------------------------------------------
 
@@ -153,6 +178,8 @@ class OracleScheduler:
             return FailReason.TAINT
         if self._ports_conflict(pod, st):
             return FailReason.PORTS
+        if ctx.get("vol") is not None and not self._volume_ok(pod, node, ctx["vol"]):
+            return FailReason.VOLUME
         if not self._spread_ok(st, ctx):
             return FailReason.SPREAD
         r = self._interpod_ok(st, ctx)
@@ -216,8 +243,11 @@ class OracleScheduler:
                     dv = other_st.labels.get(term.topology_key)
                     if dv is not None:
                         sym_veto.add((term.topology_key, dv))
+        from kubernetes_tpu.sched.volumebinding import compile_pod_volumes
+        vol = (compile_pod_volumes(pod, self.volumes, self._vol_rwop)
+               if self.volumes is not None else None)
         return dict(spread=spread, aff=aff_counts, bootstrap=bootstrap,
-                    anti=anti_counts, sym=sym_veto)
+                    anti=anti_counts, sym=sym_veto, vol=vol)
 
     def _node_affinity_ok(self, pod: Pod, node: Node) -> bool:
         labels, fields = node.metadata.labels, node_fields(node.metadata.name)

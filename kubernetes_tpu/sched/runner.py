@@ -96,9 +96,29 @@ class SchedulerRunner:
             self.queue.move_all_to_active_or_backoff(
                 EVENT_NODE_ADD if type_ == ADDED else EVENT_NODE_UPDATE)
 
+    # ---- event handler: volume objects -----------------------------------
+
+    def _on_volume(self, kind: str):
+        def handler(type_, obj, old):
+            self.cache.update_volume_object(kind, obj, deleted=type_ == DELETED)
+            # a new/changed PV or PVC can unblock pending pods
+            self.queue.move_all_to_active_or_backoff(EVENT_NODE_UPDATE)
+        return handler
+
     # ---- binding via API (DefaultBinder analog) --------------------------
 
     def _bind(self, pod: Pod, node_name: str) -> bool:
+        # PreBind: volumes first (volumebinding.go BindPodVolumes), then the
+        # pod binding itself.
+        catalog = self.cache.volume_catalog
+        if catalog is not None and pod.pvc_names():
+            from kubernetes_tpu.sched.volumebinding import VolumeBinder
+            node = next((n for n in self.cache.snapshot()[0]
+                         if n.metadata.name == node_name), None)
+            labels = node.metadata.labels if node is not None else {}
+            if not VolumeBinder(self.client).bind_pod_volumes(
+                    pod, node, catalog, labels, node_name):
+                return False
         try:
             self.client.pods(pod.metadata.namespace).bind(pod.metadata.name, node_name)
             return True
@@ -119,6 +139,11 @@ class SchedulerRunner:
         pods.add_event_handler(self._on_pod)
         nodes = self.factory.informer("nodes", None)
         nodes.add_event_handler(self._on_node)
+        for plural, kind in (("persistentvolumeclaims", "PersistentVolumeClaim"),
+                             ("persistentvolumes", "PersistentVolume"),
+                             ("storageclasses", "StorageClass")):
+            inf = self.factory.informer(plural, None)
+            inf.add_event_handler(self._on_volume(kind))
         self.factory.start_all()
         self.factory.wait_for_cache_sync(wait_sync)
 

@@ -46,7 +46,10 @@ CORE_RESOURCES = {
     "events": ("Event", True),
     "configmaps": ("ConfigMap", True),
     "namespaces": ("Namespace", False),
+    "persistentvolumes": ("PersistentVolume", False),
+    "persistentvolumeclaims": ("PersistentVolumeClaim", True),
 }
+STORAGE_RESOURCES = {"storageclasses": ("StorageClass", False)}
 APPS_RESOURCES = {
     "deployments": ("Deployment", True),
     "replicasets": ("ReplicaSet", True),
@@ -56,7 +59,8 @@ APPS_RESOURCES = {
 }
 COORD_RESOURCES = {"leases": ("Lease", True)}
 
-ALL_RESOURCES = {**CORE_RESOURCES, **APPS_RESOURCES, **COORD_RESOURCES}
+ALL_RESOURCES = {**CORE_RESOURCES, **APPS_RESOURCES, **COORD_RESOURCES,
+                 **STORAGE_RESOURCES}
 KIND_TO_PLURAL = {k: p for p, (k, _) in ALL_RESOURCES.items()}
 
 

@@ -1,0 +1,351 @@
+"""Volume scheduling: VolumeBinding/VolumeZone/VolumeRestrictions/
+NodeVolumeLimits — tensor path vs oracle parity, plus the VolumeBinder."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.client.clientset import DirectClient
+from kubernetes_tpu.encode.snapshot import SnapshotEncoder
+from kubernetes_tpu.ops.filters import run_filters
+from kubernetes_tpu.sched.oracle import OracleScheduler
+from kubernetes_tpu.sched.volumebinding import (
+    SELECTED_NODE_ANNOTATION,
+    VolumeBinder,
+    VolumeCatalog,
+    compile_pod_volumes,
+)
+from kubernetes_tpu.store.store import ObjectStore
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def pv(name, capacity="10Gi", zone=None, sc="", modes=("ReadWriteOnce",),
+       node_affinity_values=None, claim=None):
+    obj = {"apiVersion": "v1", "kind": "PersistentVolume",
+           "metadata": {"name": name, "labels": {}},
+           "spec": {"capacity": {"storage": capacity},
+                    "accessModes": list(modes),
+                    "storageClassName": sc},
+           "status": {"phase": "Available"}}
+    if zone:
+        obj["metadata"]["labels"]["topology.kubernetes.io/zone"] = zone
+    if node_affinity_values:
+        obj["spec"]["nodeAffinity"] = {"required": {"nodeSelectorTerms": [
+            {"matchExpressions": [{"key": "topology.kubernetes.io/zone",
+                                   "operator": "In",
+                                   "values": list(node_affinity_values)}]}]}}
+    if claim:
+        ns, nm = claim
+        obj["spec"]["claimRef"] = {"namespace": ns, "name": nm}
+    return obj
+
+
+def pvc(name, request="5Gi", sc="", modes=("ReadWriteOnce",), volume_name="",
+        ns="default"):
+    obj = {"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+           "metadata": {"name": name, "namespace": ns},
+           "spec": {"accessModes": list(modes),
+                    "resources": {"requests": {"storage": request}},
+                    "storageClassName": sc},
+           "status": {}}
+    if volume_name:
+        obj["spec"]["volumeName"] = volume_name
+    return obj
+
+
+def storage_class(name, provisioner="csi.example.com"):
+    return {"apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+            "metadata": {"name": name}, "provisioner": provisioner}
+
+
+def pod_with_pvc(name, *claims):
+    p = make_pod(name).req({"cpu": "100m"}).obj()
+    p.spec.volumes = [{"name": f"v{i}", "persistentVolumeClaim":
+                       {"claimName": c}} for i, c in enumerate(claims)]
+    return p
+
+
+def zone_nodes():
+    return [make_node(f"n-{z}").label("topology.kubernetes.io/zone", z)
+            .allocatable({"cpu": "8", "memory": "16Gi", "pods": "110"}).obj()
+            for z in ("a", "b", "c")]
+
+
+def tensor_feasible(nodes, pods, bound=(), catalog=None):
+    enc = SnapshotEncoder()
+    if catalog is not None:
+        enc.set_volumes(catalog)
+    ct, meta = enc.encode_cluster(nodes, list(bound), pending_pods=pods)
+    pb = enc.encode_pods(pods, meta)
+    mask = np.asarray(run_filters(ct, pb))
+    return mask[:len(pods), :len(nodes)]
+
+
+def oracle_feasible(nodes, pods, bound=(), catalog=None):
+    orc = OracleScheduler(nodes, list(bound), volumes=catalog)
+    return np.array([[r is None for r in orc.feasible(p)[1]] for p in pods]) \
+        if hasattr(orc, "feasible") else None
+
+
+def assert_parity(nodes, pods, bound=(), catalog=None):
+    got = tensor_feasible(nodes, pods, bound, catalog)
+    orc = OracleScheduler(nodes, list(bound), volumes=catalog)
+    for i, p in enumerate(pods):
+        mask, _ = orc.feasible(p)
+        assert list(got[i]) == list(mask), (
+            p.metadata.name, list(got[i]), list(mask))
+    return got
+
+
+def test_bound_pv_node_affinity_constrains_pod():
+    nodes = zone_nodes()
+    catalog = VolumeCatalog.from_lists(
+        pvcs=[pvc("data", volume_name="pv-a")],
+        pvs=[pv("pv-a", node_affinity_values=["a"], claim=("default", "data"))])
+    got = assert_parity(nodes, [pod_with_pvc("p", "data")], catalog=catalog)
+    assert list(got[0]) == [True, False, False]
+
+
+def test_pv_zone_label_is_volumezone():
+    nodes = zone_nodes()
+    catalog = VolumeCatalog.from_lists(
+        pvcs=[pvc("data", volume_name="pv-z")],
+        pvs=[pv("pv-z", zone="b", claim=("default", "data"))])
+    got = assert_parity(nodes, [pod_with_pvc("p", "data")], catalog=catalog)
+    assert list(got[0]) == [False, True, False]
+
+
+def test_unbound_pvc_candidates_or_semantics():
+    nodes = zone_nodes()
+    # two candidate PVs in zones a and c -> pod can go to either
+    catalog = VolumeCatalog.from_lists(
+        pvcs=[pvc("data")],
+        pvs=[pv("pv1", node_affinity_values=["a"]),
+             pv("pv2", node_affinity_values=["c"])])
+    got = assert_parity(nodes, [pod_with_pvc("p", "data")], catalog=catalog)
+    assert list(got[0]) == [True, False, True]
+
+
+def test_two_pvcs_and_semantics():
+    nodes = zone_nodes()
+    catalog = VolumeCatalog.from_lists(
+        pvcs=[pvc("d1"), pvc("d2", volume_name="pv2")],
+        pvs=[pv("pv1", node_affinity_values=["a", "b"]),
+             pv("pv2", node_affinity_values=["b", "c"], claim=("default", "d2"))])
+    got = assert_parity(nodes, [pod_with_pvc("p", "d1", "d2")], catalog=catalog)
+    assert list(got[0]) == [False, True, False]
+
+
+def test_missing_pvc_blocks_everywhere():
+    nodes = zone_nodes()
+    catalog = VolumeCatalog.from_lists(pvcs=[], pvs=[])
+    got = assert_parity(nodes, [pod_with_pvc("p", "ghost")], catalog=catalog)
+    assert not got[0].any()
+
+
+def test_no_match_no_provisioner_blocks_but_storageclass_unblocks():
+    nodes = zone_nodes()
+    c1 = VolumeCatalog.from_lists(pvcs=[pvc("data", sc="fast")], pvs=[])
+    got = assert_parity(nodes, [pod_with_pvc("p", "data")], catalog=c1)
+    assert not got[0].any()
+    c2 = VolumeCatalog.from_lists(pvcs=[pvc("data", sc="fast")], pvs=[],
+                                  storage_classes=[storage_class("fast")])
+    got = assert_parity(nodes, [pod_with_pvc("p", "data")], catalog=c2)
+    assert got[0].all()  # provisionable anywhere (WaitForFirstConsumer shape)
+
+
+def test_capacity_and_class_matching():
+    nodes = zone_nodes()
+    # pv too small + pv wrong class -> only pv-ok matches (zone b)
+    catalog = VolumeCatalog.from_lists(
+        pvcs=[pvc("data", request="8Gi")],
+        pvs=[pv("pv-small", capacity="1Gi"),
+             pv("pv-class", sc="other"),
+             pv("pv-ok", node_affinity_values=["b"])])
+    got = assert_parity(nodes, [pod_with_pvc("p", "data")], catalog=catalog)
+    assert list(got[0]) == [False, True, False]
+
+
+def test_rwo_conflict_with_existing_pod():
+    nodes = zone_nodes()
+    bound = pod_with_pvc("existing", "data")
+    bound.spec.node_name = "n-a"
+    catalog = VolumeCatalog.from_lists(
+        pvcs=[pvc("data", volume_name="pv-x"), pvc("other", volume_name="pv-x2")],
+        pvs=[pv("pv-x", claim=("default", "data")),
+             pv("pv-x2", claim=("default", "other"))])
+    # incoming pod mounts the SAME rwo pv -> n-a blocked; different pv -> free
+    got = assert_parity(nodes, [pod_with_pvc("p1", "data"),
+                                pod_with_pvc("p2", "other")],
+                        bound=[bound], catalog=catalog)
+    assert list(got[0]) == [False, True, True]
+    assert list(got[1]) == [True, True, True]
+
+
+def test_attach_limits():
+    nodes = zone_nodes()
+    for n in nodes:
+        n.status.allocatable["attachable-volumes-csi-x"] = "1"
+    bound = pod_with_pvc("existing", "d0")
+    bound.spec.node_name = "n-a"
+    catalog = VolumeCatalog.from_lists(
+        pvcs=[pvc("d0", volume_name="pv0"), pvc("d1", volume_name="pv1")],
+        pvs=[pv("pv0", claim=("default", "d0")),
+             pv("pv1", claim=("default", "d1"))],
+    )
+    got = assert_parity(nodes, [pod_with_pvc("p", "d1")],
+                        bound=[bound], catalog=catalog)
+    assert list(got[0]) == [False, True, True]  # n-a at its 1-volume limit
+
+
+def test_rwop_in_use_blocks_everywhere():
+    nodes = zone_nodes()
+    bound = pod_with_pvc("holder", "excl")
+    bound.spec.node_name = "n-b"
+    catalog = VolumeCatalog.from_lists(
+        pvcs=[pvc("excl", volume_name="pv-e", modes=("ReadWriteOncePod",))],
+        pvs=[pv("pv-e", modes=("ReadWriteOncePod",), claim=("default", "excl"))])
+    got = assert_parity(nodes, [pod_with_pvc("p", "excl")],
+                        bound=[bound], catalog=catalog)
+    assert not got[0].any()
+
+
+# ---------------------------------------------------------------- binder
+
+def test_volume_binder_static_bind():
+    client = DirectClient(ObjectStore())
+    client.resource("persistentvolumeclaims").create(pvc("data"))
+    client.resource("persistentvolumes", None).create(
+        pv("pv-b", node_affinity_values=["b"]))
+    catalog = VolumeCatalog.from_lists(
+        pvcs=[client.resource("persistentvolumeclaims").get("data")],
+        pvs=[client.resource("persistentvolumes", None).get("pv-b")])
+    binder = VolumeBinder(client)
+    p = pod_with_pvc("p", "data")
+    ok = binder.bind_pod_volumes(
+        p, None, catalog,
+        node_labels={"topology.kubernetes.io/zone": "b"}, node_name="n-b")
+    assert ok
+    got_pvc = client.resource("persistentvolumeclaims").get("data")
+    got_pv = client.resource("persistentvolumes", None).get("pv-b")
+    assert got_pvc["spec"]["volumeName"] == "pv-b"
+    assert got_pv["spec"]["claimRef"]["name"] == "data"
+    assert got_pv["status"]["phase"] == "Bound"
+
+
+def test_volume_binder_annotates_for_provisioner():
+    client = DirectClient(ObjectStore())
+    client.resource("persistentvolumeclaims").create(pvc("dyn", sc="fast"))
+    catalog = VolumeCatalog.from_lists(
+        pvcs=[client.resource("persistentvolumeclaims").get("dyn")],
+        storage_classes=[storage_class("fast")])
+    binder = VolumeBinder(client)
+    ok = binder.bind_pod_volumes(pod_with_pvc("p", "dyn"), None, catalog,
+                                 node_labels={}, node_name="n-a")
+    assert ok
+    got = client.resource("persistentvolumeclaims").get("dyn")
+    assert got["metadata"]["annotations"][SELECTED_NODE_ANNOTATION] == "n-a"
+
+
+def test_compile_groups_shape():
+    catalog = VolumeCatalog.from_lists(
+        pvcs=[pvc("a", volume_name="pv1"), pvc("b")],
+        pvs=[pv("pv1", claim=("default", "a")), pv("pv2"), pv("pv3")])
+    info = compile_pod_volumes(pod_with_pvc("p", "a", "b"), catalog)
+    assert len(info.groups) == 2
+    assert len(info.groups[0]) == 1      # bound: the PV's terms
+    assert len(info.groups[1]) == 2      # candidates pv2, pv3
+    assert info.claims_to_bind == ["b"]
+    assert info.attach_count == 2
+
+
+# ------------------------------------------------- connected end-to-end
+
+def test_e2e_pod_with_waitforfirstconsumer_volume():
+    """pod + unbound PVC (dynamic class) -> scheduler picks a node, annotates/
+    binds via VolumeBinder, pvbinder provisions a node-pinned PV, pod binds."""
+    import time as _t
+
+    from kubernetes_tpu.controllers import ControllerManager
+    from kubernetes_tpu.sched.runner import SchedulerRunner
+
+    def wait_until(fn, timeout=20.0):
+        dl = _t.time() + timeout
+        while _t.time() < dl:
+            if fn():
+                return True
+            _t.sleep(0.05)
+        return fn()
+
+    client = DirectClient(ObjectStore())
+    for z in ("a", "b"):
+        client.nodes().create(
+            make_node(f"vn-{z}").label("topology.kubernetes.io/zone", z)
+            .allocatable({"cpu": "4", "memory": "8Gi", "pods": "20"})
+            .obj().to_dict())
+    client.resource("storageclasses", None).create(
+        {**storage_class("fast"), "volumeBindingMode": "WaitForFirstConsumer"})
+    client.resource("persistentvolumeclaims").create(pvc("data", sc="fast"))
+
+    mgr = ControllerManager(client, controllers=("pvbinder",),
+                            resync_period=0.3, gc_enabled=False).start()
+    sched = SchedulerRunner(client).start()
+    try:
+        p = pod_with_pvc("stateful", "data")
+        client.pods().create(p.to_dict())
+        assert wait_until(
+            lambda: client.pods().get("stateful")["spec"].get("nodeName"))
+        node = client.pods().get("stateful")["spec"]["nodeName"]
+        # provisioner created a PV pinned to the chosen node and bound it
+        assert wait_until(
+            lambda: client.resource("persistentvolumeclaims").get("data")
+            .get("spec", {}).get("volumeName"))
+        pv_name = client.resource("persistentvolumeclaims").get("data") \
+            ["spec"]["volumeName"]
+        got_pv = client.resource("persistentvolumes", None).get(pv_name)
+        pins = (got_pv["spec"]["nodeAffinity"]["required"]
+                ["nodeSelectorTerms"][0]["matchFields"][0]["values"])
+        assert pins == [node]
+    finally:
+        sched.stop()
+        mgr.stop()
+
+
+def test_e2e_static_pv_constrains_scheduling():
+    """Immediate-mode PVC binds to a zone-a PV via pvbinder; the scheduler
+    must then place the pod in zone a."""
+    import time as _t
+
+    from kubernetes_tpu.controllers import ControllerManager
+    from kubernetes_tpu.sched.runner import SchedulerRunner
+
+    def wait_until(fn, timeout=20.0):
+        dl = _t.time() + timeout
+        while _t.time() < dl:
+            if fn():
+                return True
+            _t.sleep(0.05)
+        return fn()
+
+    client = DirectClient(ObjectStore())
+    for z in ("a", "b"):
+        client.nodes().create(
+            make_node(f"sn-{z}").label("topology.kubernetes.io/zone", z)
+            .allocatable({"cpu": "4", "memory": "8Gi", "pods": "20"})
+            .obj().to_dict())
+    client.resource("persistentvolumes", None).create(
+        pv("static-a", node_affinity_values=["a"]))
+    client.resource("persistentvolumeclaims").create(pvc("disk"))
+
+    mgr = ControllerManager(client, controllers=("pvbinder",),
+                            resync_period=0.3, gc_enabled=False).start()
+    sched = SchedulerRunner(client).start()
+    try:
+        assert wait_until(
+            lambda: client.resource("persistentvolumeclaims").get("disk")
+            .get("spec", {}).get("volumeName") == "static-a")
+        client.pods().create(pod_with_pvc("user", "disk").to_dict())
+        assert wait_until(
+            lambda: client.pods().get("user")["spec"].get("nodeName") == "sn-a")
+    finally:
+        sched.stop()
+        mgr.stop()
