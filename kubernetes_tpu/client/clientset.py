@@ -240,7 +240,9 @@ class HTTPClient(_Handles):
     def _path(self, plural, ns, name=None, sub=None, query=""):
         group = "/apis/apps/v1" if plural in APPS_RESOURCES else (
             "/apis/coordination.k8s.io/v1" if plural == "leases" else
-            "/apis/storage.k8s.io/v1" if plural == "storageclasses" else "/api/v1")
+            "/apis/storage.k8s.io/v1" if plural == "storageclasses" else
+            "/apis/scheduling.k8s.io/v1" if plural == "priorityclasses" else
+            "/api/v1")
         p = group
         if ns:
             p += f"/namespaces/{ns}"

@@ -1,0 +1,239 @@
+"""Admission control — mutating/validating plugin chain + policy rules.
+
+Reference: ``staging/src/k8s.io/apiserver/pkg/admission/`` (two-phase chain:
+all mutating plugins, then all validating), built-ins from
+``plugin/pkg/admission/``:
+  DefaultTolerationSeconds  defaulttolerationseconds/admission.go — add 300s
+                            not-ready/unreachable NoExecute tolerations
+  PodPriority               priority/admission.go — resolve priorityClassName
+                            to spec.priority via PriorityClass objects
+  ResourceQuota             resourcequota/admission.go — enforce per-namespace
+                            hard limits against live usage
+  LimitRanger               limitranger/admission.go — default container
+                            requests from LimitRange objects
+and ``ValidatingAdmissionPolicy`` (CEL upstream) as a small field-path
+expression engine with the same match-conditions shape.
+
+Every plugin is ``fn(verb, kind, obj) -> obj`` raising AdmissionError to
+reject — the signature APIServer.admission already dispatches.
+"""
+
+from __future__ import annotations
+
+import operator
+import threading
+from typing import Any, Callable, Optional
+
+from kubernetes_tpu.api.resource import canonical
+from kubernetes_tpu.store.apiserver import AdmissionError
+from kubernetes_tpu.store.store import ObjectStore
+
+DEFAULT_TOLERATION_SECONDS = 300
+_AUTO_TOLERATIONS = ("node.kubernetes.io/not-ready",
+                     "node.kubernetes.io/unreachable")
+
+
+class AdmissionChain:
+    """Ordered mutating plugins then validating plugins, as one callable."""
+
+    def __init__(self):
+        self.mutating: list[Callable] = []
+        self.validating: list[Callable] = []
+
+    def __call__(self, verb: str, kind: str, obj: dict) -> dict:
+        for fn in self.mutating:
+            obj = fn(verb, kind, obj) or obj
+        for fn in self.validating:
+            out = fn(verb, kind, obj)
+            if out is not None and out is not obj:
+                raise AdmissionError(
+                    f"validating plugin {getattr(fn, '__name__', fn)!r} mutated")
+        return obj
+
+    def install(self, server) -> "AdmissionChain":
+        server.admission.append(self)
+        return self
+
+
+# ---------------------------------------------------------------- mutating
+
+def default_toleration_seconds(verb: str, kind: str, obj: dict):
+    """Every pod tolerates not-ready/unreachable for 300s unless it already
+    addresses those taints (defaulttolerationseconds/admission.go)."""
+    if kind != "Pod" or verb not in ("CREATE",):
+        return obj
+    spec = obj.setdefault("spec", {})
+    tols = list(spec.get("tolerations") or [])
+    for key in _AUTO_TOLERATIONS:
+        if any(t.get("key") == key or (not t.get("key") and
+                                       t.get("operator") == "Exists")
+               for t in tols):
+            continue
+        tols.append({"key": key, "operator": "Exists", "effect": "NoExecute",
+                     "tolerationSeconds": DEFAULT_TOLERATION_SECONDS})
+    spec["tolerations"] = tols
+    return obj
+
+
+def pod_priority_resolver(store: ObjectStore):
+    """priorityClassName -> spec.priority (priority/admission.go)."""
+    def resolve(verb: str, kind: str, obj: dict):
+        if kind != "Pod" or verb != "CREATE":
+            return obj
+        spec = obj.setdefault("spec", {})
+        name = spec.get("priorityClassName", "")
+        if not name:
+            return obj
+        try:
+            pc = store.get("PriorityClass", "", name)
+        except Exception:
+            raise AdmissionError(f"no PriorityClass with name {name} found") \
+                from None
+        spec["priority"] = int(pc.get("value", 0))
+        return obj
+    return resolve
+
+
+def limit_ranger(store: ObjectStore):
+    """Default container requests from the namespace LimitRange
+    (limitranger/admission.go, type Container defaultRequest)."""
+    def default_requests(verb: str, kind: str, obj: dict):
+        if kind != "Pod" or verb != "CREATE":
+            return obj
+        ns = (obj.get("metadata") or {}).get("namespace", "default")
+        items, _ = store.list("LimitRange", namespace=ns)
+        defaults: dict[str, Any] = {}
+        for lr in items:
+            for lim in (lr.get("spec") or {}).get("limits") or []:
+                if lim.get("type", "Container") == "Container":
+                    defaults.update(lim.get("defaultRequest") or {})
+        if not defaults:
+            return obj
+        for c in (obj.get("spec") or {}).get("containers") or []:
+            res = c.setdefault("resources", {})
+            req = res.setdefault("requests", {})
+            for r, q in defaults.items():
+                req.setdefault(r, q)
+        return obj
+    return default_requests
+
+
+# --------------------------------------------------------------- validating
+
+QUOTA_TRACKED = ("cpu", "memory", "pods")
+
+
+def _pod_usage(obj: dict) -> dict[str, int]:
+    use = {"pods": 1}
+    for c in (obj.get("spec") or {}).get("containers") or []:
+        for r, q in ((c.get("resources") or {}).get("requests") or {}).items():
+            if r in QUOTA_TRACKED:
+                use[r] = use.get(r, 0) + canonical(r, q)
+    return use
+
+
+def resource_quota(store: ObjectStore):
+    """Enforce ResourceQuota.spec.hard against live namespace usage
+    (resourcequota/admission.go; usage recomputed per decision — the
+    controller-cached usage status is an optimization we skip)."""
+    lock = threading.Lock()
+
+    def enforce(verb: str, kind: str, obj: dict):
+        if kind != "Pod" or verb != "CREATE":
+            return None
+        ns = (obj.get("metadata") or {}).get("namespace", "default")
+        quotas, _ = store.list("ResourceQuota", namespace=ns)
+        if not quotas:
+            return None
+        with lock:  # serialize check-then-admit so racing creates can't slip past
+            pods, _ = store.list("Pod", namespace=ns)
+            used: dict[str, int] = {}
+            for p in pods:
+                if (p.get("status") or {}).get("phase") in ("Succeeded", "Failed"):
+                    continue
+                for r, v in _pod_usage(p).items():
+                    used[r] = used.get(r, 0) + v
+            want = _pod_usage(obj)
+            for q in quotas:
+                hard = (q.get("spec") or {}).get("hard") or {}
+                for r, lim in hard.items():
+                    key = r.split("requests.", 1)[-1]
+                    if key not in want:
+                        continue
+                    if used.get(key, 0) + want[key] > canonical(key, lim):
+                        raise AdmissionError(
+                            f"exceeded quota: {q['metadata']['name']}, "
+                            f"requested: {key}={want[key]}, "
+                            f"used: {key}={used.get(key, 0)}, "
+                            f"limited: {key}={canonical(key, lim)}")
+        return None
+    return enforce
+
+
+# ----------------------------------------------------- policy engine (CEL-ish)
+
+_OPS = {"==": operator.eq, "!=": operator.ne, ">": operator.gt,
+        "<": operator.lt, ">=": operator.ge, "<=": operator.le,
+        "in": lambda a, b: a in b, "exists": lambda a, b: a is not None}
+
+
+def _field(obj: dict, path: str):
+    cur: Any = obj
+    for part in path.split("."):
+        if isinstance(cur, list):
+            try:
+                cur = cur[int(part)]
+                continue
+            except (ValueError, IndexError):
+                return None
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+class ValidatingPolicy:
+    """ValidatingAdmissionPolicy analog: match kinds + rule list.
+
+    Rules: {"field": "spec.replicas", "op": "<=", "value": 10,
+            "message": "..."}. The reference expresses these in CEL; the
+    field-path/op/value triple covers the same match shape without an
+    expression VM.
+    """
+
+    def __init__(self, name: str, kinds: tuple[str, ...],
+                 rules: list[dict], verbs: tuple[str, ...] = ("CREATE", "UPDATE")):
+        self.name = name
+        self.kinds = kinds
+        self.rules = rules
+        self.verbs = verbs
+        self.__name__ = f"policy/{name}"
+
+    def __call__(self, verb: str, kind: str, obj: dict):
+        if kind not in self.kinds or verb not in self.verbs:
+            return None
+        for rule in self.rules:
+            got = _field(obj, rule["field"])
+            op = _OPS[rule.get("op", "==")]
+            try:
+                ok = op(got, rule.get("value"))
+            except TypeError:
+                ok = False
+            if not ok:
+                raise AdmissionError(
+                    rule.get("message",
+                             f"policy {self.name}: {rule['field']} "
+                             f"{rule.get('op')} {rule.get('value')} violated"))
+        return None
+
+
+def default_chain(store: ObjectStore) -> AdmissionChain:
+    """The default plugin set, in upstream enablement order."""
+    chain = AdmissionChain()
+    chain.mutating += [
+        pod_priority_resolver(store),
+        default_toleration_seconds,
+        limit_ranger(store),
+    ]
+    chain.validating += [resource_quota(store)]
+    return chain

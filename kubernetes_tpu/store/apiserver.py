@@ -29,6 +29,7 @@ from urllib.parse import parse_qs, urlparse
 
 from kubernetes_tpu.api.selectors import compile_list_selector
 from kubernetes_tpu.metrics.registry import REGISTRY
+from kubernetes_tpu.store.flowcontrol import RejectedError
 from kubernetes_tpu.store.store import (
     AlreadyExists,
     Conflict,
@@ -48,8 +49,11 @@ CORE_RESOURCES = {
     "namespaces": ("Namespace", False),
     "persistentvolumes": ("PersistentVolume", False),
     "persistentvolumeclaims": ("PersistentVolumeClaim", True),
+    "resourcequotas": ("ResourceQuota", True),
+    "limitranges": ("LimitRange", True),
 }
 STORAGE_RESOURCES = {"storageclasses": ("StorageClass", False)}
+SCHEDULING_RESOURCES = {"priorityclasses": ("PriorityClass", False)}
 APPS_RESOURCES = {
     "deployments": ("Deployment", True),
     "replicasets": ("ReplicaSet", True),
@@ -60,7 +64,7 @@ APPS_RESOURCES = {
 COORD_RESOURCES = {"leases": ("Lease", True)}
 
 ALL_RESOURCES = {**CORE_RESOURCES, **APPS_RESOURCES, **COORD_RESOURCES,
-                 **STORAGE_RESOURCES}
+                 **STORAGE_RESOURCES, **SCHEDULING_RESOURCES}
 KIND_TO_PLURAL = {k: p for p, (k, _) in ALL_RESOURCES.items()}
 
 
@@ -77,6 +81,7 @@ class APIServer:
                  host: str = "127.0.0.1", port: int = 0):
         self.store = store or ObjectStore()
         self.admission: list[Callable] = []
+        self.flow = None  # FlowController when APF is enabled
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
@@ -98,6 +103,18 @@ class APIServer:
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
 
+    def enable_flow_control(self, controller=None):
+        """Turn on API Priority and Fairness (store/flowcontrol.py)."""
+        from kubernetes_tpu.store.flowcontrol import FlowController
+        self.flow = controller or FlowController()
+        return self
+
+    def enable_admission(self, chain=None):
+        """Install the default admission plugin set (store/admission.py)."""
+        from kubernetes_tpu.store.admission import default_chain
+        (chain or default_chain(self.store)).install(self)
+        return self
+
     # ---- request handling ------------------------------------------------
 
     def _admit(self, verb: str, kind: str, obj: dict) -> dict:
@@ -113,6 +130,35 @@ class APIServer:
 
             def log_message(self, *a):
                 pass
+
+            def _shaped(self, verb: str, fn):
+                """APF: classify -> acquire a seat -> run -> release.
+                Watches are long-running and exempt from seat accounting
+                (upstream excludes them from the queueset after initial
+                admission)."""
+                if server.flow is None or "watch=true" in self.path:
+                    return fn()
+                level = server.flow.classify(
+                    verb, urlparse(self.path).path,
+                    self.headers.get("User-Agent", ""))
+                try:
+                    server.flow.acquire(level)
+                except RejectedError as e:
+                    body = json.dumps({"kind": "Status", "status": "Failure",
+                                       "message": "too many requests",
+                                       "reason": "TooManyRequests",
+                                       "code": 429}).encode()
+                    self.send_response(429)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After", str(int(e.retry_after)))
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return None
+                try:
+                    return fn()
+                finally:
+                    server.flow.release(level)
 
             def _send_json(self, code: int, obj):
                 body = json.dumps(obj).encode()
@@ -168,6 +214,9 @@ class APIServer:
             # ---- verbs ---------------------------------------------------
 
             def do_GET(self):
+                return self._shaped("get", self._do_GET)
+
+            def _do_GET(self):
                 path = urlparse(self.path).path
                 if path in ("/healthz", "/readyz", "/livez"):
                     body = b"ok"
@@ -251,6 +300,9 @@ class APIServer:
                     w.stop()
 
             def do_POST(self):
+                return self._shaped("post", self._do_POST)
+
+            def _do_POST(self):
                 r = self._route()
                 if r is None:
                     return self._error(404, "unknown path")
@@ -298,6 +350,9 @@ class APIServer:
                 return self._send_json(201, out)
 
             def do_PUT(self):
+                return self._shaped("put", self._do_PUT)
+
+            def _do_PUT(self):
                 r = self._route()
                 if r is None:
                     return self._error(404, "unknown path")
@@ -327,6 +382,9 @@ class APIServer:
                 return self._send_json(200, out)
 
             def do_DELETE(self):
+                return self._shaped("delete", self._do_DELETE)
+
+            def _do_DELETE(self):
                 r = self._route()
                 if r is None:
                     return self._error(404, "unknown path")

@@ -1,0 +1,131 @@
+"""API Priority and Fairness — request classification + concurrency shaping.
+
+Reference: ``staging/src/k8s.io/apiserver/pkg/util/flowcontrol/`` (flow
+schemas match requests to priority levels; each level runs a queueset with a
+concurrency share; excess waits in bounded queues, overflow is rejected 429
+with Retry-After). The queueset's fair-queuing-across-flows refinement is
+collapsed to per-level FIFO — the shaping contract (isolation between
+priority levels, bounded queueing, 429 overflow) is what clients observe.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class PriorityLevel:
+    name: str
+    concurrency: int          # assured concurrency shares (seats)
+    queue_length: int = 50    # waiting requests before 429
+    exempt: bool = False
+
+    _active: int = field(default=0, repr=False)
+    _waiting: int = field(default=0, repr=False)
+
+
+@dataclass
+class FlowSchema:
+    """Match rules -> priority level. Rules match on verb group and/or a
+    user-agent substring (upstream matches full RequestInfo + user)."""
+
+    name: str
+    level: str
+    verbs: tuple[str, ...] = ()       # () = all ("get", "list", "watch", ...)
+    agent_substr: str = ""            # "" = all agents
+    paths: tuple[str, ...] = ()       # path prefixes; () = all
+
+
+class RejectedError(Exception):
+    def __init__(self, retry_after: float = 1.0):
+        super().__init__("too many requests")
+        self.retry_after = retry_after
+
+
+class FlowController:
+    """classify() -> acquire/release around request execution."""
+
+    def __init__(self, levels: Optional[list[PriorityLevel]] = None,
+                 schemas: Optional[list[FlowSchema]] = None):
+        self._cv = threading.Condition()
+        self.levels = {pl.name: pl for pl in levels or default_levels()}
+        self.schemas = schemas if schemas is not None else default_schemas()
+        self.rejected_total = 0
+
+    def classify(self, verb: str, path: str, agent: str = "") -> PriorityLevel:
+        for fs in self.schemas:
+            if fs.verbs and verb.lower() not in fs.verbs:
+                continue
+            if fs.agent_substr and fs.agent_substr not in agent:
+                continue
+            if fs.paths and not any(path.startswith(p) for p in fs.paths):
+                continue
+            if fs.level in self.levels:
+                return self.levels[fs.level]
+        return self.levels["global-default"]
+
+    def acquire(self, level: PriorityLevel, timeout: float = 15.0) -> None:
+        """Block until a seat frees (bounded queue) or raise RejectedError."""
+        if level.exempt:
+            return
+        with self._cv:
+            if level._active < level.concurrency:
+                level._active += 1
+                return
+            if level._waiting >= level.queue_length:
+                self.rejected_total += 1
+                raise RejectedError()
+            level._waiting += 1
+            try:
+                deadline = threading.TIMEOUT_MAX if timeout is None else timeout
+                import time
+                end = time.time() + deadline
+                while level._active >= level.concurrency:
+                    remaining = end - time.time()
+                    if remaining <= 0 or not self._cv.wait(min(remaining, 0.5)):
+                        if end - time.time() <= 0:
+                            self.rejected_total += 1
+                            raise RejectedError()
+                level._active += 1
+            finally:
+                level._waiting -= 1
+
+    def release(self, level: PriorityLevel) -> None:
+        if level.exempt:
+            return
+        with self._cv:
+            level._active -= 1
+            self._cv.notify()
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {pl.name: {"active": pl._active, "waiting": pl._waiting}
+                    for pl in self.levels.values()}
+
+
+def default_levels() -> list[PriorityLevel]:
+    """The upstream suggested configuration's shape (bootstrap policy)."""
+    return [
+        PriorityLevel("exempt", concurrency=0, exempt=True),
+        PriorityLevel("system", concurrency=30),
+        PriorityLevel("leader-election", concurrency=10),
+        PriorityLevel("workload-high", concurrency=40),
+        PriorityLevel("global-default", concurrency=20),
+        PriorityLevel("catch-all", concurrency=5),
+    ]
+
+
+def default_schemas() -> list[FlowSchema]:
+    return [
+        FlowSchema("health", "exempt", paths=("/healthz", "/readyz", "/livez",
+                                              "/metrics")),
+        FlowSchema("system-leader-election", "leader-election",
+                   paths=("/apis/coordination.k8s.io",)),
+        FlowSchema("system-nodes", "system", agent_substr="kubelet"),
+        FlowSchema("kube-scheduler", "system", agent_substr="scheduler"),
+        FlowSchema("kube-controller-manager", "workload-high",
+                   agent_substr="controller"),
+        FlowSchema("service-accounts", "global-default"),
+    ]
