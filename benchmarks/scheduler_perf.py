@@ -1,0 +1,180 @@
+"""scheduler_perf — YAML-driven scheduling benchmark harness.
+
+Reference: ``test/integration/scheduler_perf/scheduler_perf.go``
+(``BenchmarkPerfScheduling``: each test case is an op list — createNodes,
+createPods[, churn] — bound to named workloads via ``$param`` substitution;
+the SchedulingThroughput collector measures pods/s over the
+``collectMetrics: true`` pods; per-workload thresholds gate pass/fail;
+``labels`` select subsets like the upstream ``performance``/``short`` tags).
+
+The execution engine here is the TPU gang scheduler driven in-process (the
+measured cycle is filter->score->select, exactly what the reference's
+collector measures — binding is async in both).
+
+Usage:
+  python benchmarks/scheduler_perf.py [--labels short] [--case SchedulingBasic]
+                                      [--scale 0.1] [--serial-oracle]
+Emits one JSON line per workload:
+  {"case": ..., "workload": ..., "SchedulingThroughput": ..., "passed": ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+CONFIG_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "config")
+
+
+def _sub(value, params):
+    """$param substitution (scheduler_perf's countParam convention)."""
+    if isinstance(value, str) and value.startswith("$"):
+        return params[value[1:]]
+    return value
+
+
+def load_config(path=None):
+    import yaml
+    path = path or os.path.join(CONFIG_DIR, "performance-config.yaml")
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def _load_template(rel_path):
+    import yaml
+    with open(os.path.join(CONFIG_DIR, rel_path)) as f:
+        return yaml.safe_load(f)
+
+
+def materialize(case: dict, params: dict):
+    """Run the op list host-side -> (nodes, measured_pods, warm_pods)."""
+    from kubernetes_tpu.api.types import Node, Pod
+
+    nodes: list = []
+    measured: list = []
+    warm: list = []
+    for op in case["workloadTemplate"]:
+        code = op["opcode"]
+        if code == "createNodes":
+            count = int(_sub(op.get("countParam", op.get("count", 0)), params))
+            tpl = _load_template(op["nodeTemplatePath"])
+            strat = op.get("labelStrategy")
+            for i in range(count):
+                d = json.loads(json.dumps(tpl))
+                md = d.setdefault("metadata", {})
+                md["name"] = f"{md.pop('generateName', 'node-')}{i}"
+                if strat:
+                    md.setdefault("labels", {})[strat["key"]] = \
+                        strat["values"][i % len(strat["values"])]
+                md.setdefault("labels", {})["kubernetes.io/hostname"] = md["name"]
+                nodes.append(Node.from_dict(d))
+        elif code == "createPods":
+            count = int(_sub(op.get("countParam", op.get("count", 0)), params))
+            tpl = _load_template(op["podTemplatePath"])
+            out = measured if op.get("collectMetrics") else warm
+            for i in range(count):
+                d = json.loads(json.dumps(tpl))
+                md = d.setdefault("metadata", {})
+                md["name"] = f"{md.pop('generateName', 'pod-')}{len(out)}-{i}"
+                out.append(Pod.from_dict(d))
+        elif code == "generateWorkload":
+            from benchmarks.workloads import WORKLOADS
+            gen = WORKLOADS[op["generator"]]
+            n_nodes = int(_sub(op["nodesParam"], params))
+            n_pods = int(_sub(op["podsParam"], params))
+            g_nodes, g_pods = gen(pods=n_pods, nodes=n_nodes)
+            nodes.extend(g_nodes)
+            (measured if op.get("collectMetrics") else warm).extend(g_pods)
+        else:
+            raise ValueError(f"unknown opcode {code!r}")
+    return nodes, measured, warm
+
+
+def run_workload(case: dict, workload: dict, scale: float = 1.0,
+                 batch: int = 1024, log=lambda *a: None):
+    """-> result dict with SchedulingThroughput + threshold verdicts."""
+    from kubernetes_tpu.encode.snapshot import SnapshotEncoder
+    from kubernetes_tpu.models.gang import gang_schedule
+
+    params = {k: max(1, int(v * scale)) for k, v in workload["params"].items()}
+    nodes, measured, warm = materialize(case, params)
+    log(f"  materialized {len(nodes)} nodes, {len(measured)} measured pods")
+
+    enc = SnapshotEncoder()
+    t0 = time.time()
+    ct, meta = enc.encode_cluster(nodes, warm, pending_pods=measured)
+    batches = [measured[i:i + batch] for i in range(0, len(measured), batch)]
+    pbs = [enc.encode_pods(b, meta) for b in batches]
+    encode_s = time.time() - t0
+    topo_keys = meta.topo_keys
+
+    # warmup compile on first batch shape (excluded, as upstream excludes
+    # informer warmup)
+    t0 = time.time()
+    gang_schedule(ct, pbs[0], topo_keys=topo_keys, max_rounds=2)
+    compile_s = time.time() - t0
+
+    t0 = time.time()
+    scheduled = 0
+    requested = np.asarray(ct.requested)
+    for pb, chunk in zip(pbs, batches):
+        ct_run = ct.replace(requested=requested)
+        assignment, _ = gang_schedule(ct_run, pb, topo_keys=topo_keys)
+        a = assignment[:len(chunk)]
+        scheduled += int((a >= 0).sum())
+        reqs = np.asarray(pb.requests)[:len(chunk)]
+        valid = a >= 0
+        np.add.at(requested, a[valid], reqs[valid])
+    dt = time.time() - t0
+    throughput = scheduled / dt if dt > 0 else 0.0
+
+    thresholds = workload.get("thresholds") or {}
+    passed = all(throughput >= t * scale if k == "SchedulingThroughput" else True
+                 for k, t in thresholds.items())
+    return {
+        "case": case["name"], "workload": workload["name"],
+        "SchedulingThroughput": round(throughput, 1),
+        "scheduled": scheduled, "pods": len(measured), "nodes": len(nodes),
+        "encode_s": round(encode_s, 2), "compile_s": round(compile_s, 2),
+        "measure_s": round(dt, 2),
+        "thresholds": thresholds, "passed": passed,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--labels", default=None,
+                    help="only workloads carrying this label (e.g. short)")
+    ap.add_argument("--case", default=None, help="only this test case")
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="scale all counts (0.1 = 10%% size)")
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--config", default=None)
+    args = ap.parse_args(argv)
+
+    cases = load_config(args.config)
+    failed = 0
+    for case in cases:
+        if args.case and case["name"] != args.case:
+            continue
+        for workload in case["workloads"]:
+            if args.labels and args.labels not in (workload.get("labels") or []):
+                continue
+            res = run_workload(case, workload, scale=args.scale,
+                               batch=args.batch,
+                               log=lambda *a: print(*a, file=sys.stderr))
+            print(json.dumps(res))
+            if not res["passed"]:
+                failed += 1
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

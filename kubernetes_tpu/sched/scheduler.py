@@ -79,17 +79,21 @@ class Scheduler:
         return n_bound
 
     def _schedule_group(self, profile, items) -> int:
+        from kubernetes_tpu.utils.tracing import TRACER
         t0 = time.time()
         pods = [p for p, _ in items]
-        nodes, ct, meta = self.cache.snapshot(pending_pods=pods)
+        with TRACER.span("scheduler/snapshot", pods=len(pods)):
+            nodes, ct, meta = self.cache.snapshot(pending_pods=pods)
         if not nodes:
             for pod, attempts in items:
                 self.queue.add_unschedulable(pod, attempts + 1)
                 SCHEDULE_ATTEMPTS.inc({"result": "unschedulable"})
             return 0
-        pb = self.cache.encode_pods(pods, meta)
+        with TRACER.span("scheduler/encode_pods", pods=len(pods)):
+            pb = self.cache.encode_pods(pods, meta)
         serial = not self.features.enabled("TPUBatchScheduling")
-        with BATCH_DURATION.time():
+        with BATCH_DURATION.time(), TRACER.span(
+                "scheduler/gang_schedule", pods=len(pods), nodes=len(nodes)):
             assignment, rounds = gang_schedule(
                 ct, pb, seed=self.cfg.seed, fit_strategy=profile.fit_strategy,
                 topo_keys=meta.topo_keys, serial=serial,

@@ -1,0 +1,79 @@
+"""Tracing — span instrumentation with an in-memory exporter.
+
+Reference: ``staging/src/k8s.io/component-base/tracing/`` (OpenTelemetry
+spans behind a TracerProvider; apiserver/kubelet attach spans around request
+handling and CRI calls). The scheduler upstream is metrics-only (SURVEY §5);
+here spans cover the batched cycle too since one span per *batch* is cheap
+where one per pod would not be.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    end: float = 0.0
+    parent: Optional[str] = None
+    attributes: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end - self.start) * 1000.0
+
+
+class Tracer:
+    """Minimal tracer: nested spans via a thread-local stack, finished spans
+    collected by the in-memory exporter (sampling via ``ratio``)."""
+
+    def __init__(self, ratio: float = 1.0, max_spans: int = 4096):
+        self.ratio = ratio
+        self.max_spans = max_spans
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+        self._tls = threading.local()
+        self._counter = 0
+
+    @contextmanager
+    def span(self, name: str, **attributes):
+        with self._lock:
+            self._counter += 1
+            sampled = self.ratio >= 1.0 or (self._counter * self.ratio) % 1.0 < self.ratio
+        if not sampled:
+            yield None
+            return
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        sp = Span(name=name, start=time.time(),
+                  parent=stack[-1].name if stack else None,
+                  attributes=dict(attributes))
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            sp.end = time.time()
+            stack.pop()
+            with self._lock:
+                self._spans.append(sp)
+                if len(self._spans) > self.max_spans:
+                    del self._spans[:len(self._spans) - self.max_spans]
+
+    def spans(self, name: Optional[str] = None) -> list[Span]:
+        with self._lock:
+            return [s for s in self._spans if name is None or s.name == name]
+
+    def reset(self):
+        with self._lock:
+            self._spans.clear()
+
+
+# process-global default tracer (TracerProvider analog)
+TRACER = Tracer()
