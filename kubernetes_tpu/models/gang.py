@@ -179,16 +179,14 @@ def _relational_veto(ct: ClusterTensors, pb: PodBatch, choice, accept, rank,
     return accept & ~veto
 
 
-@partial(jax.jit, static_argnames=("seed", "fit_strategy", "topo_keys", "serial",
-                                   "weights", "enabled_filters"))
-def gang_round(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
-               seed: int = 0, fit_strategy: str = "LeastAllocated",
-               topo_keys: tuple[int, ...] = (), serial: bool = False,
-               weights: tuple = (), enabled_filters: tuple = (),
-               cap_scale=1):
-    """One propose/accept/fold round. Returns (new_state, progress) where
-    progress counts acceptances (plus serial-mode attempts) — the driver stops
-    at 0."""
+def _gang_round_impl(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
+                     seed: int = 0, fit_strategy: str = "LeastAllocated",
+                     topo_keys: tuple[int, ...] = (), serial: bool = False,
+                     weights: tuple = (), enabled_filters: tuple = (),
+                     cap_scale=1):
+    """Traceable body of one propose/accept/fold round. Returns
+    (new_state, progress) where progress counts acceptances (plus serial-mode
+    attempts)."""
     E = ct_ext.epod_valid.shape[0] - state.committed.shape[0]
     P = state.committed.shape[0]
     N = ct_ext.node_valid.shape[0]
@@ -246,15 +244,61 @@ def gang_round(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
     return new_state, jnp.sum(accept) + n_attempted
 
 
+gang_round = partial(jax.jit, static_argnames=(
+    "seed", "fit_strategy", "topo_keys", "serial", "weights",
+    "enabled_filters"))(_gang_round_impl)
+
+
+@partial(jax.jit, static_argnames=("seed", "fit_strategy", "topo_keys",
+                                   "serial", "weights", "enabled_filters"))
+def gang_converge(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
+                  seed: int = 0, fit_strategy: str = "LeastAllocated",
+                  topo_keys: tuple[int, ...] = (), serial: bool = False,
+                  weights: tuple = (), enabled_filters: tuple = (),
+                  max_rounds=64) -> GangState:
+    """On-device convergence: the whole propose/accept/fold round sequence is
+    one ``lax.while_loop`` — no device→host sync per round (the reference's
+    per-pod loop is host-side; our analog keeps the batch's entire conflict
+    resolution inside one XLA program and transfers once per batch).
+    ``max_rounds`` is a traced operand so warmup at a small bound compiles the
+    same program as the real run."""
+    def cond(carry):
+        _, n, _, left = carry
+        return (n > 0) & (left > 0)
+
+    def body(carry):
+        st, _, cap_scale, left = carry
+        st, n = _gang_round_impl(ct_ext, pb, st, seed=seed,
+                                 fit_strategy=fit_strategy,
+                                 topo_keys=topo_keys, serial=serial,
+                                 weights=weights,
+                                 enabled_filters=enabled_filters,
+                                 cap_scale=cap_scale)
+        return (st, n, jnp.minimum(cap_scale * 2, jnp.int32(1 << 20)), left - 1)
+
+    carry = (state, jnp.int32(1), jnp.int32(1),
+             jnp.asarray(max_rounds, jnp.int32))
+    state, _, _, _ = jax.lax.while_loop(cond, body, carry)
+    return state
+
+
 def gang_schedule(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
                   fit_strategy: str = "LeastAllocated",
                   topo_keys: tuple[int, ...] = (), serial: bool = False,
-                  max_rounds: int = 64, weights=None, enabled_filters=None):
+                  max_rounds: int = 64, weights=None, enabled_filters=None,
+                  mesh=None):
     """Drive rounds until convergence. Returns (assignment [P] np.int32 with -1
     for unschedulable, rounds_used). ``weights`` (plugin->weight) and
     ``enabled_filters`` (set of filter names) carry the active profile's
-    plugin configuration; they are static for jit purposes."""
+    plugin configuration; they are static for jit purposes. ``mesh``: optional
+    ("pods","nodes") Mesh — tensors are sharded over it and the converge
+    program runs with GSPMD collectives over the node/pod axes."""
     P = int(pb.pod_valid.shape[0])
+    ct_ext = extend_cluster(ct, pb)
+    if mesh is not None:
+        from kubernetes_tpu.parallel.mesh import shard_batch, shard_cluster
+        ct_ext = shard_cluster(mesh, ct_ext)
+        pb = shard_batch(mesh, pb)
     state = GangState(
         requested=jnp.asarray(ct.requested),
         committed=jnp.zeros(P, bool),
@@ -262,18 +306,11 @@ def gang_schedule(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
         tried=jnp.zeros(P, bool),
         rounds=jnp.zeros((), jnp.int32),
     )
-    ct_ext = extend_cluster(ct, pb)
     weights_t = tuple(sorted(weights.items())) if weights else ()
     filters_t = tuple(sorted(enabled_filters)) if enabled_filters else ()
-    limit = P if serial else max_rounds
-    cap_scale = 1
-    for _ in range(max(limit, 1)):
-        state, n = gang_round(ct_ext, pb, state, seed=seed,
-                              fit_strategy=fit_strategy, topo_keys=topo_keys,
-                              serial=serial, weights=weights_t,
-                              enabled_filters=filters_t,
-                              cap_scale=jnp.int32(cap_scale))
-        if int(n) == 0:
-            break
-        cap_scale = min(cap_scale * 2, 1 << 20)
+    limit = max(P if serial else max_rounds, 1)
+    state = gang_converge(ct_ext, pb, state, seed=seed,
+                          fit_strategy=fit_strategy, topo_keys=topo_keys,
+                          serial=serial, weights=weights_t,
+                          enabled_filters=filters_t, max_rounds=limit)
     return np.asarray(state.assignment), int(state.rounds)
