@@ -1,19 +1,23 @@
 """Benchmark: scheduling throughput (pods/sec) on the real TPU chip.
 
-Headline config (BASELINE.json #5): 10k heterogeneous pods (spread + affinity
-+ taints + selectors) onto 5k nodes, gang-batched. The metric mirrors
-scheduler_perf's SchedulingThroughput: scheduling *decisions* per second —
-the filter/score/select cycle — which is the part the reference measures and
-the part lifted onto the TPU. Host-side snapshot encoding happens once per
-cluster and is reported separately on stderr (it amortizes across cycles in
-the live scheduler via incremental updates).
+Runs ALL FIVE BASELINE.json configs through the scheduler_perf harness
+(benchmarks/scheduler_perf.py — the reference's
+test/integration/scheduler_perf YAML workloads), then the CONNECTED path
+(benchmarks/connected.py — informers + queue + incremental cache + gang step
++ async binding against the in-process apiserver).
+
+Headline metric mirrors scheduler_perf's SchedulingThroughput on the
+MixedHeterogeneous 10k pods x 5k nodes workload: scheduling *decisions* per
+second through the filter/score/select cycle. p99 per-pod schedule latency
+is reported per workload (north-star target: p99 < 1s).
 
 vs_baseline: ratio against 300 pods/s — the mid-range of upstream
 scheduler_perf thresholds for comparable workloads (BASELINE.md; the
 reference publishes no in-repo numbers, "published": {}).
 
-Env knobs: BENCH_WORKLOAD (default MixedHeterogeneous), BENCH_PODS,
-BENCH_NODES, BENCH_BATCH (default 1024).
+Env knobs: BENCH_CASE (only this case), BENCH_SCALE (default 1.0),
+BENCH_BATCH (default 1024), BENCH_CONNECTED=0 to skip the connected run,
+BENCH_CONNECTED_PODS/NODES (default 2000/1000).
 """
 
 from __future__ import annotations
@@ -26,65 +30,64 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_PODS_PER_SEC = 300.0
+HEADLINE = ("MixedHeterogeneous", "10000Pods5000Nodes")
 
 
 def main():
-    import numpy as np
+    from benchmarks.connected import run_connected
+    from benchmarks.scheduler_perf import load_config, run_workload
 
-    from benchmarks.workloads import WORKLOADS
-    from kubernetes_tpu.encode.snapshot import SnapshotEncoder
-    from kubernetes_tpu.models.gang import gang_schedule
-
-    name = os.environ.get("BENCH_WORKLOAD", "MixedHeterogeneous")
-    n_pods = int(os.environ.get("BENCH_PODS", "10000"))
-    n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
+    only_case = os.environ.get("BENCH_CASE")
+    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
     batch = int(os.environ.get("BENCH_BATCH", "1024"))
 
-    t0 = time.time()
-    nodes, pods = WORKLOADS[name](pods=n_pods, nodes=n_nodes)
-    print(f"[bench] workload {name}: {len(pods)} pods x {len(nodes)} nodes "
-          f"(gen {time.time()-t0:.1f}s)", file=sys.stderr)
+    log = lambda *a: print(*a, file=sys.stderr)  # noqa: E731
+    results = []
+    for case in load_config():
+        if only_case and case["name"] != only_case:
+            continue
+        for wl in case["workloads"]:
+            if "performance" not in (wl.get("labels") or []):
+                continue
+            t0 = time.time()
+            log(f"[bench] {case['name']}/{wl['name']} ...")
+            res = run_workload(case, wl, scale=scale, batch=batch, log=log)
+            res["total_s"] = round(time.time() - t0, 1)
+            results.append(res)
+            log("[bench] " + json.dumps(res))
 
-    t0 = time.time()
-    enc = SnapshotEncoder()
-    ct, meta = enc.encode_cluster(nodes, [], pending_pods=pods)
-    batches = [pods[i:i + batch] for i in range(0, len(pods), batch)]
-    pbs = [enc.encode_pods(b, meta) for b in batches]
-    topo_keys = meta.topo_keys
-    print(f"[bench] encode: {time.time()-t0:.1f}s "
-          f"({len(batches)} batches of {batch})", file=sys.stderr)
+    connected = None
+    if os.environ.get("BENCH_CONNECTED", "1") != "0" and not only_case:
+        log("[bench] connected-path run ...")
+        connected = run_connected(
+            n_pods=int(os.environ.get("BENCH_CONNECTED_PODS", "2000")),
+            n_nodes=int(os.environ.get("BENCH_CONNECTED_NODES", "1000")),
+            log=log)
+        log("[bench] " + json.dumps(connected))
 
-    # Warmup: compile the gang round on the first batch shape.
-    t0 = time.time()
-    gang_schedule(ct, pbs[0], topo_keys=topo_keys, max_rounds=2)
-    print(f"[bench] warmup/compile: {time.time()-t0:.1f}s", file=sys.stderr)
-
-    # Timed: schedule every batch, carrying committed capacity forward.
-    t0 = time.time()
-    scheduled = 0
-    requested = np.asarray(ct.requested)
-    total_rounds = 0
-    for pb, chunk in zip(pbs, batches):
-        ct_run = ct.replace(requested=requested)
-        assignment, rounds = gang_schedule(ct_run, pb, topo_keys=topo_keys)
-        total_rounds += rounds
-        a = assignment[:len(chunk)]
-        scheduled += int((a >= 0).sum())
-        # fold accepted requests into the carried cluster state
-        reqs = np.asarray(pb.requests)[:len(chunk)]
-        valid = a >= 0
-        np.add.at(requested, a[valid], reqs[valid])
-    dt = time.time() - t0
-    throughput = scheduled / dt if dt > 0 else 0.0
-    print(f"[bench] scheduled {scheduled}/{len(pods)} pods in {dt:.2f}s "
-          f"({total_rounds} gang rounds)", file=sys.stderr)
-
-    print(json.dumps({
-        "metric": f"scheduling throughput ({name} {len(pods)}x{len(nodes)})",
+    head = next((r for r in results
+                 if (r["case"], r["workload"]) == HEADLINE), None)
+    if head is None:
+        head = results[-1] if results else {"SchedulingThroughput": 0.0,
+                                            "pods": 0, "nodes": 0,
+                                            "case": "none", "workload": ""}
+    throughput = head["SchedulingThroughput"]
+    out = {
+        "metric": (f"scheduling throughput ({head['case']} "
+                   f"{head.get('pods', 0)}x{head.get('nodes', 0)})"),
         "value": round(throughput, 1),
         "unit": "pods/sec",
         "vs_baseline": round(throughput / BASELINE_PODS_PER_SEC, 2),
-    }))
+        "p99_schedule_latency_s": head.get("p99_schedule_latency_s"),
+        "all_passed": all(r["passed"] for r in results) if results else False,
+        "workloads": [
+            {"case": r["case"], "workload": r["workload"],
+             "pods_per_sec": r["SchedulingThroughput"],
+             "p99_s": r.get("p99_schedule_latency_s"),
+             "passed": r["passed"]} for r in results],
+        "connected": connected,
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,103 @@
+"""Connected-path benchmark: SchedulerRunner against the in-process apiserver.
+
+The raw gang numbers (scheduler_perf.py) measure the device program alone;
+this measures the PRODUCT — informers watching the apiserver, the scheduling
+queue, the cache's incremental snapshot encode, the gang step, and async
+binding POSTs — the same window the reference's scheduler_perf measures
+against a real apiserver with hollow nodes (SURVEY §4: integration tier +
+kubemark).
+
+Pods are created first (queue fills via the watch), then the scheduler loop
+starts; throughput = pods bound / time from loop start to last binding
+visible in the store.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
+                  batch_size: int = 512, timeout: float = 300.0,
+                  log=lambda *a: None) -> dict:
+    from kubernetes_tpu.client.clientset import DirectClient, HTTPClient
+    from kubernetes_tpu.config.types import SchedulerConfiguration
+    from kubernetes_tpu.metrics.registry import ATTEMPT_DURATION
+    from kubernetes_tpu.sched.runner import SchedulerRunner
+    from kubernetes_tpu.store.apiserver import APIServer
+    from benchmarks.workloads import mixed_heterogeneous
+
+    server = APIServer().start()
+    try:
+        seed_client = DirectClient(server.store)  # fast seeding path
+        nodes, pods = mixed_heterogeneous(pods=n_pods, nodes=n_nodes)
+        t0 = time.time()
+        for n in nodes:
+            seed_client.nodes().create(n.to_dict())
+        for p in pods:
+            seed_client.pods(p.metadata.namespace).create(p.to_dict())
+        log(f"  seeded {n_nodes} nodes + {n_pods} pods in {time.time()-t0:.1f}s")
+
+        runner = SchedulerRunner(
+            HTTPClient(server.url),
+            SchedulerConfiguration(batch_size=batch_size))
+        _warm_jit(runner, nodes, pods, batch_size, log)
+        t_start = time.time()
+        runner.start()
+        pods_api = seed_client.pods("default")
+        deadline = t_start + timeout
+        bound = 0
+        while time.time() < deadline:
+            bound = sum(1 for p in pods_api.list() if p["spec"].get("nodeName"))
+            if bound >= n_pods:
+                break
+            time.sleep(0.25)
+        dt = time.time() - t_start
+        runner.stop()
+        # p99 attempt latency (scheduled results) from the live histogram —
+        # bucket upper bound, like Prometheus histogram_quantile
+        p99 = ATTEMPT_DURATION.percentile(0.99, {"result": "scheduled"})
+        return {
+            "case": "ConnectedScheduler", "workload": f"{n_pods}x{n_nodes}",
+            "SchedulingThroughput": round(bound / dt, 1) if dt > 0 else 0.0,
+            "bound": bound, "pods": n_pods, "nodes": n_nodes,
+            "measure_s": round(dt, 2),
+            "p99_attempt_latency_s": p99,
+        }
+    finally:
+        server.stop()
+
+
+def _warm_jit(runner, nodes, pods, batch_size, log):
+    """Compile the gang program at the exact shapes/static-args the runner's
+    first batch will use (a long-lived scheduler amortizes this once per shape
+    bucket; the measured window is steady-state, as in scheduler_perf)."""
+    from kubernetes_tpu.models.gang import gang_schedule
+    from kubernetes_tpu.sched.cache import SchedulerCache
+
+    t0 = time.time()
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    profile = runner.cfg.profile_for(pods[0].spec.scheduler_name)
+    batch = pods[:batch_size]
+    _, ct, meta = cache.snapshot(pending_pods=batch, slot_headroom=len(pods))
+    pb = cache.encode_pods(batch, meta)
+    gang_schedule(ct, pb, seed=runner.cfg.seed,
+                  fit_strategy=profile.fit_strategy,
+                  topo_keys=meta.topo_keys, max_rounds=2,
+                  weights=profile.weights(),
+                  enabled_filters=profile.enabled_filters)
+    log(f"  jit warmup {time.time()-t0:.1f}s")
+
+
+if __name__ == "__main__":
+    import json
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    res = run_connected(
+        n_pods=int(os.environ.get("BENCH_CONNECTED_PODS", "2000")),
+        n_nodes=int(os.environ.get("BENCH_CONNECTED_NODES", "1000")),
+        log=lambda *a: print(*a, file=sys.stderr))
+    print(json.dumps(res))

@@ -135,10 +135,15 @@ class DeploymentController(Controller):
         def ready_n(rs): return int((rs.get("status") or {}).get("readyReplicas", 0))
 
         total = spec_n(new_rs) + sum(spec_n(rs) for rs in old_rses)
-        # reconcileNewReplicaSet: grow new up to replicas + surge - total
-        grow = min(replicas - spec_n(new_rs), replicas + max_surge - total)
-        if grow > 0:
-            new_rs = self._scale_rs(new_rs, spec_n(new_rs) + grow)
+        # reconcileNewReplicaSet (rolling.go): above spec -> scale straight
+        # down to spec (covers `ktpu scale` lowering replicas mid/post
+        # rollout); below -> grow up to replicas + surge - total.
+        if spec_n(new_rs) > replicas:
+            new_rs = self._scale_rs(new_rs, replicas)
+        else:
+            grow = min(replicas - spec_n(new_rs), replicas + max_surge - total)
+            if grow > 0:
+                new_rs = self._scale_rs(new_rs, spec_n(new_rs) + grow)
         # reconcileOldReplicaSets: shrink old while staying above min-available
         ready_total = ready_n(new_rs) + sum(ready_n(rs) for rs in old_rses)
         can_remove = ready_total - (replicas - max_unavail)

@@ -15,6 +15,23 @@ from kubernetes_tpu.client.informer import InformerFactory
 from kubernetes_tpu.controllers.base import Controller, split_key
 
 
+def _resolve_target_port(sp: dict, matched_pods: list[dict]) -> int:
+    """targetPort may be a name — resolve it against the matched pods'
+    container ports (endpoints_controller FindPort); fall back to the
+    service port rather than failing the whole sync."""
+    tp = sp.get("targetPort", sp.get("port", 0))
+    if isinstance(tp, int):
+        return tp
+    if isinstance(tp, str) and tp.isdigit():
+        return int(tp)
+    for p in matched_pods:
+        for c in (p.get("spec") or {}).get("containers") or []:
+            for port in c.get("ports") or []:
+                if port.get("name") == tp and port.get("containerPort"):
+                    return int(port["containerPort"])
+    return int(sp.get("port", 0))
+
+
 class EndpointsController(Controller):
     name = "endpoints"
 
@@ -49,7 +66,7 @@ class EndpointsController(Controller):
         sel = (svc.get("spec") or {}).get("selector") or {}
         if not sel:
             return  # selectorless services manage endpoints manually
-        ready, not_ready = [], []
+        ready, not_ready, matched = [], [], []
         for p in self.pod_informer.store.list():
             md = p.get("metadata") or {}
             if md.get("namespace", "") != ns:
@@ -60,13 +77,14 @@ class EndpointsController(Controller):
             st = PodStatus.from_dict(p.get("status"))
             if st.phase in ("Succeeded", "Failed") or not st.pod_ip:
                 continue
+            matched.append(p)
             addr = {"ip": st.pod_ip,
                     "nodeName": (p.get("spec") or {}).get("nodeName", ""),
                     "targetRef": {"kind": "Pod", "name": md.get("name", ""),
                                   "namespace": ns, "uid": md.get("uid", "")}}
             (ready if st.is_ready() else not_ready).append(addr)
-        ports = [{"name": sp.get("name", ""), "port": int(sp.get("targetPort",
-                                                                 sp.get("port", 0))),
+        ports = [{"name": sp.get("name", ""),
+                  "port": _resolve_target_port(sp, matched),
                   "protocol": sp.get("protocol", "TCP")}
                  for sp in (svc.get("spec") or {}).get("ports") or []]
         subsets = []

@@ -42,12 +42,19 @@ class GarbageCollector:
                 if uid:
                     live_uids.add(uid)
         deleted = 0
+        tracked_kinds = {ALL_RESOURCES[p][0] for p in GC_RESOURCES}
         for plural, inf in self._informers.items():
             kind, namespaced = ALL_RESOURCES[plural]
             for obj in inf.store.list():
                 md = obj.get("metadata") or {}
                 refs = md.get("ownerReferences") or []
                 if not refs:
+                    continue
+                # Owners of kinds outside the graph (Node, Service, ...) have
+                # unknowable liveness here — never treat their dependents as
+                # orphaned (upstream deletes only when ALL owners are
+                # confirmed gone).
+                if any(r.get("kind") not in tracked_kinds for r in refs):
                     continue
                 if any(r.get("uid") in live_uids for r in refs):
                     continue

@@ -86,11 +86,17 @@ class NodeLifecycleController(Controller):
                 if t.get("key") in (TAINT_NOT_READY, TAINT_UNREACHABLE)
                 and t.get("effect") == "NoExecute"]
         rest = [t for t in taints if t not in ours]
+        added_ts = None
+        if wanted:
+            # Carry the existing timestamp if the same taint is already
+            # present; otherwise this sync IS the add — the informer copy is
+            # stale on this very sync, so the eviction check below must use
+            # this value, not whatever the node object says.
+            added_ts = (float(ours[0].get("timeAdded", time.time()))
+                        if ours and ours[0].get("key") == wanted
+                        else time.time())
         new_taints = rest + ([{"key": wanted, "effect": "NoExecute",
-                               "timeAdded": ours[0].get("timeAdded", time.time())
-                               if ours and ours[0].get("key") == wanted
-                               else time.time()}]
-                             if wanted else [])
+                               "timeAdded": added_ts}] if wanted else [])
         if new_taints != taints:
             obj = {**node, "spec": {**(node.get("spec") or {}), "taints": new_taints}}
             try:
@@ -99,16 +105,14 @@ class NodeLifecycleController(Controller):
                 if e.code not in (404, 409):
                     raise
         if wanted:
-            self._evict_intolerant(node, wanted)
+            self._evict_intolerant(node, wanted, added_ts)
 
     # ---- NoExecute taint eviction ---------------------------------------
 
-    def _evict_intolerant(self, node: dict, taint_key: str) -> None:
+    def _evict_intolerant(self, node: dict, taint_key: str,
+                          added: float) -> None:
         node_name = (node.get("metadata") or {}).get("name", "")
         taint_obj = Taint(key=taint_key, effect="NoExecute")
-        added = next((float(t.get("timeAdded", 0)) for t in
-                      (node.get("spec") or {}).get("taints") or []
-                      if t.get("key") == taint_key), 0.0)
         for p in self.pod_informer.store.list():
             if (p.get("spec") or {}).get("nodeName") != node_name:
                 continue

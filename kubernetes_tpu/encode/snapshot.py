@@ -205,6 +205,32 @@ class PodBatch(struct.PyTreeNode):
 
 
 @dataclass
+class _PatchState:
+    """Book-keeping from the last full encode enabling in-place pod deltas
+    (the analog of ``Cache.UpdateSnapshot``'s generation-counter incremental
+    path — pkg/scheduler/internal/cache/cache.go): which existing-pod slot
+    each bound pod occupies, free slots, and the bucket sizes that bound what
+    a patch may grow."""
+
+    generation: int
+    resources: list[str]
+    res_index: dict[str, int]
+    node_index: dict[str, int]
+    K: int
+    ET: int
+    EAX: int
+    EAV: int
+    V: int
+    slot_of: dict[str, int] = dc_field(default_factory=dict)
+    free: list[int] = dc_field(default_factory=list)
+    slot_node: dict[str, int] = dc_field(default_factory=dict)
+    slot_req: dict[str, Any] = dc_field(default_factory=dict)
+    # pods whose encode contributed node port/volume state — removing or
+    # replacing one requires a full re-encode
+    unpatchable: set = dc_field(default_factory=set)
+
+
+@dataclass
 class SnapshotMeta:
     """Host-side static metadata accompanying the tensors (NOT a pytree)."""
 
@@ -252,6 +278,7 @@ class SnapshotEncoder:
         self._cluster_topo_keys: set[int] = set()
         self._volumes = None  # VolumeCatalog | None
         self._rwop_in_use: set = set()
+        self._patch: Optional[_PatchState] = None
         self.generation = 0
 
     def set_volumes(self, catalog) -> None:
@@ -279,11 +306,16 @@ class SnapshotEncoder:
 
     def encode_cluster(self, nodes: list[Node], bound_pods: list[Pod],
                        pending_pods: Optional[list[Pod]] = None,
+                       slot_headroom: int = 0,
                        ) -> tuple[ClusterTensors, SnapshotMeta]:
         """Encode node-side state. ``bound_pods`` are pods already assigned
         (their requests fold into ``requested`` and they populate the
         existing-pods tensors). ``pending_pods`` only widen the resource axis so
-        cluster and batch tensors agree on R."""
+        cluster and batch tensors agree on R. ``slot_headroom``: reserve at
+        least this many free existing-pod slots (typically the scheduler's
+        total queue depth) so subsequent binds patch incrementally without
+        growing the E bucket — keeping tensor shapes, and therefore the
+        compiled XLA program, stable across the whole drain."""
         self.generation += 1
         resources = _resource_union(nodes, bound_pods + list(pending_pods or []))
         R = len(resources)
@@ -295,18 +327,28 @@ class SnapshotEncoder:
                           for n in nodes]
         epods = [p for p in bound_pods if p.spec.node_name in node_index]
         epod_label_ids = [self._label_ids(p.metadata.labels) for p in epods]
+
         # existing pods' required anti-affinity terms (symmetry veto) — compile
         # before fixing K so their keys are covered by the bucket.
-        ea_terms: list[list] = []
-        for p in epods:
+        def _anti_terms(p: Pod) -> list:
             aff = p.spec.affinity
             pan = aff.pod_anti_affinity if aff else None
             terms = []
             for t in (pan.required if pan else []):
                 valid, exprs = self._compile_selector(t.label_selector)
                 terms.append((self.keys.intern(t.topology_key), valid, exprs))
-            ea_terms.append(terms)
+            return terms
+
+        ea_terms = [_anti_terms(p) for p in epods]
         self._cluster_topo_keys = {k for ts in ea_terms for (k, _, _) in ts}
+        # Pre-intern pending pods' labels + anti terms and leave slot headroom
+        # so that when they bind, the incremental patch path (apply_pod_deltas)
+        # fits them without a full re-encode.
+        pend = list(pending_pods or [])
+        pend_terms = []
+        for p in pend:
+            self._label_ids(p.metadata.labels)
+            pend_terms.append(_anti_terms(p))
         K = next_bucket(len(self.keys), minimum=1)
 
         allocatable = np.zeros((N, R), np.int32)
@@ -361,13 +403,10 @@ class SnapshotEncoder:
 
         # Fold bound pods into requested[N,R].
         for p in epods:
-            ni = node_index[p.spec.node_name]
-            reqs = p.resource_requests()
-            for r_idx, r in enumerate(resources):
-                if r in reqs:
-                    requested[ni, r_idx] += scale_request(r, reqs[r])
+            requested[node_index[p.spec.node_name]] += \
+                self._request_vector(p, resources)
 
-        E = next_bucket(len(epods))
+        E = next_bucket(len(epods) + max(len(pend), slot_headroom))
         epod_node = np.full(E, -1, np.int32)
         epod_ns = np.full(E, -1, np.int32)
         epod_labels = np.full((E, K), -1, np.int32)
@@ -379,9 +418,10 @@ class SnapshotEncoder:
                 epod_labels[e, kid] = vid
             epod_valid[e] = True
 
-        ET = next_bucket(max((len(t) for t in ea_terms), default=0))
-        EAX = next_bucket(max((len(ex) for ts in ea_terms for (_, _, ex) in ts), default=0))
-        EAV = next_bucket(max((len(v) for ts in ea_terms for (_, _, ex) in ts
+        all_terms = ea_terms + pend_terms
+        ET = next_bucket(max((len(t) for t in all_terms), default=0))
+        EAX = next_bucket(max((len(ex) for ts in all_terms for (_, _, ex) in ts), default=0))
+        EAV = next_bucket(max((len(v) for ts in all_terms for (_, _, ex) in ts
                                for (_, _, v, _) in ex), default=0))
         ea_arrs = _selset_arrays((E, ET), EAX, EAV)
         ea_topo = np.full((E, ET), -1, np.int32)
@@ -429,6 +469,17 @@ class SnapshotEncoder:
             topo_keys=tuple(sorted(self._cluster_topo_keys)),
             generation=self.generation,
         )
+        self._patch = _PatchState(
+            generation=self.generation, resources=resources,
+            res_index={r: i for i, r in enumerate(resources)},
+            node_index=node_index, K=K, ET=ET, EAX=EAX, EAV=EAV, V=V,
+            slot_of={p.key: e for e, p in enumerate(epods)},
+            free=list(range(len(epods), E))[::-1],
+            slot_node={p.key: node_index[p.spec.node_name] for p in epods},
+            slot_req={p.key: self._request_vector(p, resources) for p in epods},
+            unpatchable={p.key for p in epods
+                         if p.spec.volumes or p.host_ports()},
+        )
         ct = ClusterTensors(
             allocatable=allocatable, requested=requested, node_valid=node_valid,
             unschedulable=unschedulable, node_labels=node_labels,
@@ -445,6 +496,131 @@ class SnapshotEncoder:
             attach_used=attach_used, attach_limit=attach_limit,
         )
         return ct, meta
+
+    # -- incremental pod deltas --------------------------------------------
+
+    @staticmethod
+    def _request_vector(p: Pod, resources: list[str]) -> np.ndarray:
+        reqs = p.resource_requests()
+        vec = np.zeros(len(resources), np.int32)
+        for r_idx, r in enumerate(resources):
+            if r in reqs:
+                vec[r_idx] = scale_request(r, reqs[r])
+        return vec
+
+    def apply_pod_deltas(self, ct: ClusterTensors, meta: SnapshotMeta,
+                         upserts: list[Pod], deletes: list[str],
+                         ) -> Optional[ClusterTensors]:
+        """Patch bound-pod deltas into an existing encoding without a full
+        re-encode (the reference's incremental ``Cache.UpdateSnapshot``).
+
+        Returns the patched ClusterTensors (copy-on-write on touched arrays),
+        or None when a delta doesn't fit the encoded buckets (new label key,
+        more anti-affinity terms than reserved, pod with host ports/volumes,
+        unknown node, no free slot) — the caller then falls back to a full
+        encode_cluster.
+        """
+        st = self._patch
+        if st is None or st.generation != meta.generation:
+            return None
+        if any(k in st.unpatchable for k in deletes) or \
+                any(p.key in st.unpatchable for p in upserts):
+            return None
+
+        # ---- validate + compile everything before mutating anything ------
+        compiled = []
+        for p in upserts:
+            if p.spec.volumes or p.host_ports():
+                return None          # port/volume node state isn't patchable
+            ni = st.node_index.get(p.spec.node_name, -1)
+            if ni < 0:
+                return None
+            reqs = p.resource_requests()
+            if any(r not in st.res_index for r in reqs):
+                return None          # new resource kind widens R
+            label_ids = self._label_ids(p.metadata.labels)
+            if any(kid >= st.K for kid in label_ids):
+                return None          # label key beyond the K bucket
+            aff = p.spec.affinity
+            pan = aff.pod_anti_affinity if aff else None
+            terms = []
+            for t in (pan.required if pan else []):
+                valid, exprs = self._compile_selector(t.label_selector)
+                terms.append((self.keys.intern(t.topology_key), valid, exprs))
+            if (len(terms) > st.ET
+                    or any(len(ex) > st.EAX for (_, _, ex) in terms)
+                    or any(len(v) > st.EAV for (_, _, ex) in terms
+                           for (_, _, v, _) in ex)):
+                return None
+            compiled.append((p, ni, label_ids, terms,
+                             self._request_vector(p, st.resources)))
+
+        freed = sum(1 for k in set(deletes) if k in st.slot_of)
+        needed = sum(1 for (p, *_rest) in compiled if p.key not in st.slot_of)
+        if needed > len(st.free) + freed:
+            return None
+
+        # ---- copy-on-write the arrays a pod delta touches ----------------
+        requested = np.array(ct.requested)
+        epod_node = np.array(ct.epod_node)
+        epod_ns = np.array(ct.epod_ns)
+        epod_labels = np.array(ct.epod_labels)
+        epod_valid = np.array(ct.epod_valid)
+        ea = {f: np.array(getattr(ct.ea_sel, f))
+              for f in ("key", "op", "vals", "expr_valid", "valid")}
+        ea_topo = np.array(ct.ea_topo)
+        ea_valid = np.array(ct.ea_valid)
+
+        def _clear(slot: int):
+            epod_valid[slot] = False
+            epod_labels[slot, :] = -1
+            ea_topo[slot, :] = -1
+            ea_valid[slot, :] = False
+            ea["valid"][slot, :] = False
+            ea["expr_valid"][slot, :, :] = False
+            ea["key"][slot, :, :] = -1
+            ea["vals"][slot, :, :, :] = -1
+
+        for k in set(deletes):
+            slot = st.slot_of.pop(k, None)
+            if slot is None:
+                continue
+            requested[st.slot_node.pop(k)] -= st.slot_req.pop(k)
+            _clear(slot)
+            st.free.append(slot)
+
+        new_topo: set[int] = set()
+        for p, ni, label_ids, terms, req_vec in compiled:
+            key = p.key
+            slot = st.slot_of.get(key)
+            if slot is not None:
+                requested[st.slot_node[key]] -= st.slot_req[key]
+                _clear(slot)
+            else:
+                slot = st.free.pop()
+                st.slot_of[key] = slot
+            epod_node[slot] = ni
+            epod_ns[slot] = self.namespaces.intern(p.metadata.namespace)
+            for kid, vid in label_ids.items():
+                epod_labels[slot, kid] = vid
+            epod_valid[slot] = True
+            for t_idx, (topo, valid, exprs) in enumerate(terms):
+                ea_topo[slot, t_idx] = topo
+                ea_valid[slot, t_idx] = True
+                _selset_fill(ea, (slot, t_idx), valid, exprs)
+                new_topo.add(topo)
+            requested[ni] += req_vec
+            st.slot_node[key] = ni
+            st.slot_req[key] = req_vec
+
+        if new_topo - set(meta.topo_keys):
+            self._cluster_topo_keys |= new_topo
+            meta.topo_keys = tuple(sorted(set(meta.topo_keys) | new_topo))
+        return ct.replace(
+            requested=requested, epod_node=epod_node, epod_ns=epod_ns,
+            epod_labels=epod_labels, epod_valid=epod_valid,
+            ea_sel=SelectorSet(**ea), ea_topo=ea_topo, ea_valid=ea_valid,
+        )
 
     # -- selector compilation ----------------------------------------------
 

@@ -182,6 +182,9 @@ E2E_DURATION = REGISTRY.histogram(
     "Pod queue-add to bound latency")
 QUEUE_DEPTH = REGISTRY.gauge(
     "scheduler_pending_pods", "Pending pods by queue (active|backoff|unschedulable)")
+BIND_RESULTS = REGISTRY.counter(
+    "scheduler_bind_failures_total",
+    "Bind RPC failures by class (conflict|error|connection)")
 GANG_ROUNDS = REGISTRY.histogram(
     "scheduler_gang_rounds", "Conflict-resolution rounds per gang batch",
     buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64))

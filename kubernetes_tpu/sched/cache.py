@@ -31,6 +31,15 @@ class SchedulerCache:
         self._cached: Optional[tuple[int, ClusterTensors, SnapshotMeta]] = None
         self.assume_ttl = assume_ttl
         self._volumes = None  # VolumeCatalog once any PVC/PV/SC appears
+        # incremental-snapshot delta tracking (Cache.UpdateSnapshot analog):
+        # pod churn accumulates here and patches the cached encoding in place;
+        # anything structural (node add/remove, volumes) forces a full encode.
+        self._delta_upserts: dict[str, Pod] = {}
+        self._delta_deletes: set[str] = set()
+        self._needs_full = True
+        # encode-relevant node fingerprints: heartbeats that only touch
+        # status/conditions must not invalidate the encoding at all
+        self._node_fps: dict[str, tuple] = {}
 
     # ---- volume catalog (PVC/PV/StorageClass informers feed this) --------
 
@@ -56,6 +65,7 @@ class SchedulerCache:
                 space[key] = obj
             self._encoder.set_volumes(self._volumes)
             self._generation += 1
+            self._needs_full = True
 
     @property
     def volume_catalog(self):
@@ -64,18 +74,39 @@ class SchedulerCache:
 
     # ---- node events -----------------------------------------------------
 
+    @staticmethod
+    def _node_fp(node: Node) -> tuple:
+        """Fingerprint of the encode-relevant node fields; status-only churn
+        (heartbeat conditions) leaves it unchanged."""
+        return (
+            tuple(sorted(node.status.allocatable.items())),
+            tuple(sorted(node.metadata.labels.items())),
+            tuple((t.key, t.value, t.effect) for t in node.spec.taints),
+            node.spec.unschedulable,
+            tuple((tuple(i.names[:1]), i.size_bytes)
+                  for i in node.status.images),
+        )
+
     def add_node(self, node: Node):
         with self._lock:
+            fp = self._node_fp(node)
+            prev = self._node_fps.get(node.metadata.name)
             self._nodes[node.metadata.name] = node
+            if prev == fp:
+                return  # heartbeat-only update: encoding unaffected
+            self._node_fps[node.metadata.name] = fp
             self._generation += 1
+            self._needs_full = True
 
     def update_node(self, node: Node):
         self.add_node(node)
 
     def remove_node(self, name: str):
         with self._lock:
-            self._nodes.pop(name, None)
-            self._generation += 1
+            if self._nodes.pop(name, None) is not None:
+                self._node_fps.pop(name, None)
+                self._generation += 1
+                self._needs_full = True
 
     # ---- pod events ------------------------------------------------------
 
@@ -87,6 +118,8 @@ class SchedulerCache:
             self._assumed.pop(pod.key, None)
             self._pods[pod.key] = pod
             self._generation += 1
+            self._delta_upserts[pod.key] = pod
+            self._delta_deletes.discard(pod.key)
 
     def update_pod(self, pod: Pod):
         self.add_pod(pod)
@@ -101,6 +134,8 @@ class SchedulerCache:
             existed = self._pods.pop(pod_key, None) or self._assumed.pop(pod_key, None)
             if existed:
                 self._generation += 1
+                self._delta_upserts.pop(pod_key, None)
+                self._delta_deletes.add(pod_key)
 
     # ---- optimistic binding ---------------------------------------------
 
@@ -114,6 +149,8 @@ class SchedulerCache:
             p.spec.node_name = node_name
             self._assumed[p.key] = (p, time.time() + self.assume_ttl)
             self._generation += 1
+            self._delta_upserts[p.key] = p
+            self._delta_deletes.discard(p.key)
 
     def finish_binding(self, pod_key: str):
         """Binding RPC done; keep assumed until the watch confirms (TTL holds)."""
@@ -123,36 +160,61 @@ class SchedulerCache:
         with self._lock:
             if self._assumed.pop(pod_key, None):
                 self._generation += 1
+                self._delta_upserts.pop(pod_key, None)
+                self._delta_deletes.add(pod_key)
 
     def _expire_assumed_locked(self):
         now = time.time()
         expired = [k for k, (_, dl) in self._assumed.items() if dl < now]
         for k in expired:
             del self._assumed[k]
+            self._delta_upserts.pop(k, None)
+            self._delta_deletes.add(k)
         if expired:
             self._generation += 1
 
     # ---- snapshot --------------------------------------------------------
 
-    def snapshot(self, pending_pods: Optional[list[Pod]] = None):
-        """-> (nodes list, ClusterTensors, SnapshotMeta). Cached by generation.
+    def snapshot(self, pending_pods: Optional[list[Pod]] = None,
+                 slot_headroom: int = 0):
+        """-> (nodes list, ClusterTensors, SnapshotMeta).
+
+        Three paths, mirroring ``Cache.UpdateSnapshot``:
+          clean     — nothing changed: return the cached encoding.
+          pod delta — only pod binds/unbinds since the last snapshot: patch
+                      the cached tensors in place (apply_pod_deltas).
+          full      — structural change (node add/remove/relabel, volumes,
+                      bucket overflow, new resource kind): re-encode.
 
         ``pending_pods`` widen the resource axis; passing a batch with a new
-        extended resource invalidates the cached encoding (rare).
+        extended resource forces the full path (rare).
         """
         with self._lock:
             self._expire_assumed_locked()
             nodes = list(self._nodes.values())
-            bound = list(self._pods.values()) + [p for p, _ in self._assumed.values()]
             gen = self._generation
-            if self._cached is not None and self._cached[0] == gen:
+            if self._cached is not None and not self._needs_full:
                 _, ct, meta = self._cached
                 known = set(meta.resources)
                 if not any(r not in known for p in (pending_pods or [])
                            for r in p.resource_requests()):
-                    return nodes, ct, meta
+                    if not self._delta_upserts and not self._delta_deletes:
+                        return nodes, ct, meta
+                    patched = self._encoder.apply_pod_deltas(
+                        ct, meta, list(self._delta_upserts.values()),
+                        list(self._delta_deletes))
+                    if patched is not None:
+                        self._delta_upserts.clear()
+                        self._delta_deletes.clear()
+                        self._cached = (gen, patched, meta)
+                        return nodes, patched, meta
+            bound = list(self._pods.values()) + [p for p, _ in self._assumed.values()]
             ct, meta = self._encoder.encode_cluster(nodes, bound,
-                                                    pending_pods=pending_pods)
+                                                    pending_pods=pending_pods,
+                                                    slot_headroom=slot_headroom)
+            self._delta_upserts.clear()
+            self._delta_deletes.clear()
+            self._needs_full = False
             self._cached = (gen, ct, meta)
             return nodes, ct, meta
 

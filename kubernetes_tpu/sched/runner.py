@@ -8,14 +8,18 @@ in leader election (active-passive HA, SURVEY §5).
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Optional
+
+_LOG = logging.getLogger("kubernetes_tpu.sched.runner")
 
 from kubernetes_tpu.api.types import Node, Pod
 from kubernetes_tpu.client.clientset import ApiError
 from kubernetes_tpu.client.informer import InformerFactory, meta_namespace_key
 from kubernetes_tpu.client.leaderelection import LeaderElectionConfig, LeaderElector
 from kubernetes_tpu.config.types import SchedulerConfiguration
+from kubernetes_tpu.metrics.registry import BIND_RESULTS
 from kubernetes_tpu.sched.cache import SchedulerCache
 from kubernetes_tpu.sched.queue import (
     EVENT_NODE_ADD,
@@ -122,14 +126,27 @@ class SchedulerRunner:
         try:
             self.client.pods(pod.metadata.namespace).bind(pod.metadata.name, node_name)
             return True
-        except (ApiError, Exception):
+        except ApiError as e:
+            # 409 = another party bound it first (expected race); anything
+            # else is a systemic failure worth surfacing, not swallowing.
+            label = "conflict" if e.code == 409 else "error"
+            BIND_RESULTS.inc({"result": label})
+            if e.code != 409:
+                _LOG.warning("bind %s -> %s failed: %s", pod.key, node_name, e)
+            return False
+        except Exception as e:
+            BIND_RESULTS.inc({"result": "connection"})
+            _LOG.warning("bind %s -> %s: API unreachable: %s", pod.key, node_name, e)
             return False
 
     def _evict(self, victim: Pod):
         try:
             self.client.pods(victim.metadata.namespace).evict(victim.metadata.name)
-        except Exception:
-            pass
+        except ApiError as e:
+            if e.code != 404:  # already gone is fine
+                _LOG.warning("evict %s failed: %s", victim.key, e)
+        except Exception as e:
+            _LOG.warning("evict %s: API unreachable: %s", victim.key, e)
         self.cache.remove_pod(victim.key)
 
     # ---- lifecycle -------------------------------------------------------

@@ -61,6 +61,10 @@ class Scheduler:
         stats = self.queue.stats()
         for q, v in stats.items():
             QUEUE_DEPTH.set(v, {"queue": q})
+        # Slot headroom = everything still pending (this batch + queued):
+        # the snapshot reserves that many existing-pod slots so the whole
+        # drain binds via incremental patches with stable tensor shapes.
+        headroom = len(batch) + sum(stats.values())
 
         by_profile: dict[str, list[tuple[Pod, int]]] = {}
         for pod, attempts in batch:
@@ -75,15 +79,16 @@ class Scheduler:
                 for pod, attempts in items:
                     self.queue.park_unschedulable(pod, attempts)
                 continue
-            n_bound += self._schedule_group(profile, items)
+            n_bound += self._schedule_group(profile, items, headroom)
         return n_bound
 
-    def _schedule_group(self, profile, items) -> int:
+    def _schedule_group(self, profile, items, slot_headroom: int = 0) -> int:
         from kubernetes_tpu.utils.tracing import TRACER
         t0 = time.time()
         pods = [p for p, _ in items]
         with TRACER.span("scheduler/snapshot", pods=len(pods)):
-            nodes, ct, meta = self.cache.snapshot(pending_pods=pods)
+            nodes, ct, meta = self.cache.snapshot(pending_pods=pods,
+                                                  slot_headroom=slot_headroom)
         if not nodes:
             for pod, attempts in items:
                 self.queue.add_unschedulable(pod, attempts + 1)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import operator
 import threading
+import time
 from typing import Any, Callable, Optional
 
 from kubernetes_tpu.api.resource import canonical
@@ -135,8 +136,14 @@ def _pod_usage(obj: dict) -> dict[str, int]:
 def resource_quota(store: ObjectStore):
     """Enforce ResourceQuota.spec.hard against live namespace usage
     (resourcequota/admission.go; usage recomputed per decision — the
-    controller-cached usage status is an optimization we skip)."""
+    controller-cached usage status is an optimization we skip).
+
+    Admission returns before the pod is persisted, so an admitted-but-not-
+    yet-visible pod reserves its usage in ``inflight`` until it appears in
+    the store listing (or 30s pass — the create failed); racing creates see
+    each other's reservations and cannot jointly exceed the quota."""
     lock = threading.Lock()
+    inflight: dict[tuple, tuple[dict, float]] = {}  # (ns,name) -> (usage, ts)
 
     def enforce(verb: str, kind: str, obj: dict):
         if kind != "Pod" or verb != "CREATE":
@@ -147,12 +154,22 @@ def resource_quota(store: ObjectStore):
             return None
         with lock:  # serialize check-then-admit so racing creates can't slip past
             pods, _ = store.list("Pod", namespace=ns)
+            now = time.time()
+            visible = {(ns, (p.get("metadata") or {}).get("name"))
+                       for p in pods}
+            for k in list(inflight):
+                if k in visible or now - inflight[k][1] > 30.0:
+                    del inflight[k]
             used: dict[str, int] = {}
             for p in pods:
                 if (p.get("status") or {}).get("phase") in ("Succeeded", "Failed"):
                     continue
                 for r, v in _pod_usage(p).items():
                     used[r] = used.get(r, 0) + v
+            for (res_ns, _name), (u, _ts) in inflight.items():
+                if res_ns == ns:
+                    for r, v in u.items():
+                        used[r] = used.get(r, 0) + v
             want = _pod_usage(obj)
             for q in quotas:
                 hard = (q.get("spec") or {}).get("hard") or {}
@@ -166,6 +183,8 @@ def resource_quota(store: ObjectStore):
                             f"requested: {key}={want[key]}, "
                             f"used: {key}={used.get(key, 0)}, "
                             f"limited: {key}={canonical(key, lim)}")
+            inflight[(ns, (obj.get("metadata") or {}).get("name", ""))] = \
+                (want, now)
         return None
     return enforce
 

@@ -1,0 +1,152 @@
+"""Incremental snapshot encoding (Cache.UpdateSnapshot analog).
+
+Pod binds/unbinds between snapshots must patch the cached tensors in place
+(no full re-encode — encoder.generation stays put); heartbeat-only node
+updates must not invalidate the cache at all; structural changes fall back
+to a full encode. Patched tensors must be semantically identical to a fresh
+full encode (same requested state, same existing-pod set, same scheduling
+decisions).
+"""
+
+import numpy as np
+
+from kubernetes_tpu.encode.snapshot import SnapshotEncoder
+from kubernetes_tpu.models.schedule_step import schedule_step
+from kubernetes_tpu.sched.cache import SchedulerCache
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _nodes(n=8):
+    return [make_node(f"n{i}")
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": "20"})
+            .label("topology.kubernetes.io/zone", f"z{i % 3}")
+            .label("kubernetes.io/hostname", f"n{i}")
+            .obj() for i in range(n)]
+
+
+def _pod(i, labels=None, anti=False):
+    b = make_pod(f"p{i}").req({"cpu": "500m", "memory": "256Mi"})
+    for k, v in (labels or {"app": "a"}).items():
+        b = b.label(k, v)
+    if anti:
+        b = b.pod_anti_affinity("kubernetes.io/hostname", {"app": "a"})
+    return b.obj()
+
+
+def test_pod_binds_patch_without_reencode():
+    cache = SchedulerCache()
+    for n in _nodes():
+        cache.add_node(n)
+    pending = [_pod(i, anti=(i % 2 == 0)) for i in range(6)]
+    nodes, ct0, meta = cache.snapshot(pending_pods=pending)
+    gen_after_full = cache._encoder.generation
+
+    # bind three pods (assume path, like the scheduler does)
+    for i, p in enumerate(pending[:3]):
+        cache.assume(p, f"n{i}")
+    nodes, ct1, meta1 = cache.snapshot(pending_pods=pending[3:])
+    assert cache._encoder.generation == gen_after_full, "should have patched"
+    assert meta1 is meta
+
+    # semantics match a fresh full encode of the same state
+    bound = cache.bound_pods()
+    fresh_enc = SnapshotEncoder()
+    ct_ref, meta_ref = fresh_enc.encode_cluster(
+        [cache._nodes[n] for n in sorted(cache._nodes)], bound,
+        pending_pods=pending[3:])
+    # same node ordering? cache.snapshot uses dict order == insertion order
+    assert meta.node_names == meta_ref.node_names
+    np.testing.assert_array_equal(np.asarray(ct1.requested)[:8],
+                                  np.asarray(ct_ref.requested)[:8])
+    # valid epods occupy same (node, ns) multiset
+    def epod_set(ct):
+        v = np.asarray(ct.epod_valid)
+        return sorted(np.asarray(ct.epod_node)[v].tolist())
+    assert epod_set(ct1) == epod_set(ct_ref)
+
+    # scheduling parity on the remaining pods
+    pb1 = cache.encode_pods(pending[3:], meta1)
+    r1 = schedule_step(ct1, pb1, topo_keys=meta1.topo_keys)
+    pb_ref = fresh_enc.encode_pods(pending[3:], meta_ref)
+    r_ref = schedule_step(ct_ref, pb_ref, topo_keys=meta_ref.topo_keys)
+    np.testing.assert_array_equal(np.asarray(r1.choice)[:3],
+                                  np.asarray(r_ref.choice)[:3])
+    np.testing.assert_array_equal(np.asarray(r1.feasible)[:3, :8],
+                                  np.asarray(r_ref.feasible)[:3, :8])
+
+
+def test_unbind_and_rebind_patch():
+    cache = SchedulerCache()
+    for n in _nodes(4):
+        cache.add_node(n)
+    pods = [_pod(i) for i in range(4)]
+    cache.snapshot(pending_pods=pods)
+    for i, p in enumerate(pods):
+        cache.assume(p, f"n{i}")
+    _, ct, _ = cache.snapshot()
+    gen = cache._encoder.generation
+    before = np.asarray(ct.requested).copy()
+
+    cache.remove_pod(pods[0].key)   # unbind
+    _, ct2, _ = cache.snapshot()
+    assert cache._encoder.generation == gen
+    after = np.asarray(ct2.requested)
+    assert (after[0] <= before[0]).all() and (after[0] < before[0]).any()
+    assert int(np.asarray(ct2.epod_valid).sum()) == 3
+
+    cache.assume(pods[0], "n3")      # rebind elsewhere
+    _, ct3, _ = cache.snapshot()
+    assert cache._encoder.generation == gen
+    np.testing.assert_array_equal(np.asarray(ct3.requested)[3],
+                                  before[3] + (before[0] - after[0]))
+
+
+def test_heartbeat_does_not_invalidate():
+    cache = SchedulerCache()
+    for n in _nodes(4):
+        cache.add_node(n)
+    _, ct, _ = cache.snapshot()
+    n0 = cache._nodes["n0"]
+    import copy
+    hb = copy.deepcopy(n0)
+    hb.status.conditions = [{"type": "Ready", "status": "True",
+                             "lastHeartbeatTime": "2026-07-29T00:00:00Z"}]
+    cache.update_node(hb)
+    _, ct2, _ = cache.snapshot()
+    assert ct2 is ct  # same cached object — not even a patch
+
+
+def test_structural_changes_force_full_encode():
+    cache = SchedulerCache()
+    for n in _nodes(4):
+        cache.add_node(n)
+    cache.snapshot()
+    gen = cache._encoder.generation
+
+    # node relabel → full
+    import copy
+    n0 = copy.deepcopy(cache._nodes["n0"])
+    n0.metadata.labels["disk"] = "ssd"
+    cache.update_node(n0)
+    cache.snapshot()
+    assert cache._encoder.generation == gen + 1
+
+    # pod with an unseen label key → patch bails, full encode
+    gen = cache._encoder.generation
+    cache.assume(_pod(9, labels={"brand-new-key": "x"}), "n1")
+    _, ct, meta = cache.snapshot()
+    assert cache._encoder.generation == gen + 1
+    assert int(np.asarray(ct.epod_valid).sum()) == 1
+
+
+def test_delta_pod_with_volumes_falls_back():
+    cache = SchedulerCache()
+    for n in _nodes(2):
+        cache.add_node(n)
+    cache.snapshot()
+    gen = cache._encoder.generation
+    p = _pod(0)
+    p.spec.volumes.append({"persistentVolumeClaim": {"claimName": "c1"}})
+    cache.assume(p, "n0")
+    cache.snapshot()
+    assert cache._encoder.generation == gen + 1
