@@ -85,7 +85,8 @@ def _warm_jit(runner, nodes, pods, batch_size, log):
     pb = cache.encode_pods(batch, meta)
     gang_schedule(ct, pb, seed=runner.cfg.seed,
                   fit_strategy=profile.fit_strategy,
-                  topo_keys=meta.topo_keys, max_rounds=2,
+                  topo_keys=meta.topo_keys,
+                  max_rounds=runner.cfg.max_gang_rounds,
                   weights=profile.weights(),
                   enabled_filters=profile.enabled_filters)
     log(f"  jit warmup {time.time()-t0:.1f}s")

@@ -15,21 +15,22 @@ from kubernetes_tpu.client.informer import InformerFactory
 from kubernetes_tpu.controllers.base import Controller, split_key
 
 
-def _resolve_target_port(sp: dict, matched_pods: list[dict]) -> int:
-    """targetPort may be a name — resolve it against the matched pods'
-    container ports (endpoints_controller FindPort); fall back to the
-    service port rather than failing the whole sync."""
+def _resolve_target_port(sp: dict, pod: dict):
+    """targetPort may be a name — resolve it against THIS pod's container
+    ports (endpoints_controller FindPort is per-pod: during a rolling update
+    the same port name can map to different containerPorts on old and new
+    pods, and each address must advertise its own). None = the pod does not
+    expose the named port, so it is skipped for this service port."""
     tp = sp.get("targetPort", sp.get("port", 0))
     if isinstance(tp, int):
         return tp
     if isinstance(tp, str) and tp.isdigit():
         return int(tp)
-    for p in matched_pods:
-        for c in (p.get("spec") or {}).get("containers") or []:
-            for port in c.get("ports") or []:
-                if port.get("name") == tp and port.get("containerPort"):
-                    return int(port["containerPort"])
-    return int(sp.get("port", 0))
+    for c in (pod.get("spec") or {}).get("containers") or []:
+        for port in c.get("ports") or []:
+            if port.get("name") == tp and port.get("containerPort"):
+                return int(port["containerPort"])
+    return None
 
 
 class EndpointsController(Controller):
@@ -66,7 +67,11 @@ class EndpointsController(Controller):
         sel = (svc.get("spec") or {}).get("selector") or {}
         if not sel:
             return  # selectorless services manage endpoints manually
-        ready, not_ready, matched = [], [], []
+        svc_ports = (svc.get("spec") or {}).get("ports") or []
+        # Group addresses by their RESOLVED port set (RepackSubsets): pods
+        # whose named targetPorts resolve differently land in separate
+        # subsets, each advertising its own containerPort.
+        groups: dict[tuple, dict] = {}
         for p in self.pod_informer.store.list():
             md = p.get("metadata") or {}
             if md.get("namespace", "") != ns:
@@ -77,24 +82,33 @@ class EndpointsController(Controller):
             st = PodStatus.from_dict(p.get("status"))
             if st.phase in ("Succeeded", "Failed") or not st.pod_ip:
                 continue
-            matched.append(p)
+            ports = []
+            for sp in svc_ports:
+                port = _resolve_target_port(sp, p)
+                if port is not None:
+                    ports.append({"name": sp.get("name", ""), "port": port,
+                                  "protocol": sp.get("protocol", "TCP")})
+            if svc_ports and not ports:
+                continue  # pod exposes none of the service's named ports
+            gkey = tuple(sorted((pp["name"], pp["port"], pp["protocol"])
+                                for pp in ports))
+            g = groups.setdefault(gkey, {"ports": ports, "ready": [],
+                                         "not_ready": []})
             addr = {"ip": st.pod_ip,
                     "nodeName": (p.get("spec") or {}).get("nodeName", ""),
                     "targetRef": {"kind": "Pod", "name": md.get("name", ""),
                                   "namespace": ns, "uid": md.get("uid", "")}}
-            (ready if st.is_ready() else not_ready).append(addr)
-        ports = [{"name": sp.get("name", ""),
-                  "port": _resolve_target_port(sp, matched),
-                  "protocol": sp.get("protocol", "TCP")}
-                 for sp in (svc.get("spec") or {}).get("ports") or []]
+            g["ready" if st.is_ready() else "not_ready"].append(addr)
         subsets = []
-        if ready or not_ready:
-            subset: dict = {"ports": ports}
-            if ready:
-                subset["addresses"] = sorted(ready, key=lambda a: a["ip"])
-            if not_ready:
-                subset["notReadyAddresses"] = sorted(not_ready, key=lambda a: a["ip"])
-            subsets = [subset]
+        for gkey in sorted(groups):
+            g = groups[gkey]
+            subset: dict = {"ports": g["ports"]}
+            if g["ready"]:
+                subset["addresses"] = sorted(g["ready"], key=lambda a: a["ip"])
+            if g["not_ready"]:
+                subset["notReadyAddresses"] = sorted(g["not_ready"],
+                                                     key=lambda a: a["ip"])
+            subsets.append(subset)
         ep_api = self.client.endpoints(ns)
         desired = {"apiVersion": "v1", "kind": "Endpoints",
                    "metadata": {"name": name, "namespace": ns,

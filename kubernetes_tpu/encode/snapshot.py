@@ -216,11 +216,14 @@ class _PatchState:
     resources: list[str]
     res_index: dict[str, int]
     node_index: dict[str, int]
+    # bucket sizes bounding what a patch may add. Value ids have no bucket
+    # here on purpose: patched pods' label VALUES are only ever compared by
+    # interned id (label_value_num[V] is indexed by node labels alone, and
+    # node changes force a full re-encode).
     K: int
     ET: int
     EAX: int
     EAV: int
-    V: int
     slot_of: dict[str, int] = dc_field(default_factory=dict)
     free: list[int] = dc_field(default_factory=list)
     slot_node: dict[str, int] = dc_field(default_factory=dict)
@@ -307,6 +310,7 @@ class SnapshotEncoder:
     def encode_cluster(self, nodes: list[Node], bound_pods: list[Pod],
                        pending_pods: Optional[list[Pod]] = None,
                        slot_headroom: int = 0,
+                       pending_slots: bool = True,
                        ) -> tuple[ClusterTensors, SnapshotMeta]:
         """Encode node-side state. ``bound_pods`` are pods already assigned
         (their requests fold into ``requested`` and they populate the
@@ -315,7 +319,10 @@ class SnapshotEncoder:
         least this many free existing-pod slots (typically the scheduler's
         total queue depth) so subsequent binds patch incrementally without
         growing the E bucket — keeping tensor shapes, and therefore the
-        compiled XLA program, stable across the whole drain."""
+        compiled XLA program, stable across the whole drain.
+        ``pending_slots=False`` skips reserving epod slots for pending pods
+        (gang_drain appends its own per-batch extension slots; double-
+        reserving would widen every relational contraction for nothing)."""
         self.generation += 1
         resources = _resource_union(nodes, bound_pods + list(pending_pods or []))
         R = len(resources)
@@ -406,7 +413,8 @@ class SnapshotEncoder:
             requested[node_index[p.spec.node_name]] += \
                 self._request_vector(p, resources)
 
-        E = next_bucket(len(epods) + max(len(pend), slot_headroom))
+        E = next_bucket(len(epods) + (max(len(pend), slot_headroom)
+                                      if pending_slots else slot_headroom))
         epod_node = np.full(E, -1, np.int32)
         epod_ns = np.full(E, -1, np.int32)
         epod_labels = np.full((E, K), -1, np.int32)
@@ -472,7 +480,7 @@ class SnapshotEncoder:
         self._patch = _PatchState(
             generation=self.generation, resources=resources,
             res_index={r: i for i, r in enumerate(resources)},
-            node_index=node_index, K=K, ET=ET, EAX=EAX, EAV=EAV, V=V,
+            node_index=node_index, K=K, ET=ET, EAX=EAX, EAV=EAV,
             slot_of={p.key: e for e, p in enumerate(epods)},
             free=list(range(len(epods), E))[::-1],
             slot_node={p.key: node_index[p.spec.node_name] for p in epods},

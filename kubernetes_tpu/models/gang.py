@@ -183,18 +183,22 @@ def _gang_round_impl(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
                      seed: int = 0, fit_strategy: str = "LeastAllocated",
                      topo_keys: tuple[int, ...] = (), serial: bool = False,
                      weights: tuple = (), enabled_filters: tuple = (),
-                     cap_scale=1):
+                     cap_scale=1, slot_start=None):
     """Traceable body of one propose/accept/fold round. Returns
     (new_state, progress) where progress counts acceptances (plus serial-mode
-    attempts)."""
-    E = ct_ext.epod_valid.shape[0] - state.committed.shape[0]
+    attempts). ``slot_start``: index (may be traced) of this batch's extension
+    slots in the epod tensors; defaults to the trailing P slots."""
     P = state.committed.shape[0]
     N = ct_ext.node_valid.shape[0]
-    # wire committed members into extension slots
+    if slot_start is None:
+        slot_start = ct_ext.epod_valid.shape[0] - P
+    # wire committed members into this batch's extension slots
     ct_round = ct_ext.replace(
         requested=state.requested,
-        epod_node=ct_ext.epod_node.at[E:].set(state.assignment),
-        epod_valid=ct_ext.epod_valid.at[E:].set(state.committed),
+        epod_node=jax.lax.dynamic_update_slice(
+            ct_ext.epod_node, state.assignment, (slot_start,)),
+        epod_valid=jax.lax.dynamic_update_slice(
+            ct_ext.epod_valid, state.committed, (slot_start,)),
     )
     pb_round = pb.replace(pod_valid=pb.pod_valid & ~state.committed)
     res = evaluate(ct_round, pb_round, seed=seed,
@@ -250,35 +254,52 @@ gang_round = partial(jax.jit, static_argnames=(
 
 
 @partial(jax.jit, static_argnames=("seed", "fit_strategy", "topo_keys",
-                                   "serial", "weights", "enabled_filters"))
+                                   "serial", "weights", "enabled_filters",
+                                   "max_rounds"))
 def gang_converge(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
                   seed: int = 0, fit_strategy: str = "LeastAllocated",
                   topo_keys: tuple[int, ...] = (), serial: bool = False,
                   weights: tuple = (), enabled_filters: tuple = (),
-                  max_rounds=64) -> GangState:
+                  max_rounds: int = 64) -> GangState:
     """On-device convergence: the whole propose/accept/fold round sequence is
-    one ``lax.while_loop`` — no device→host sync per round (the reference's
-    per-pod loop is host-side; our analog keeps the batch's entire conflict
-    resolution inside one XLA program and transfers once per batch).
-    ``max_rounds`` is a traced operand so warmup at a small bound compiles the
-    same program as the real run."""
-    def cond(carry):
-        _, n, _, left = carry
-        return (n > 0) & (left > 0)
+    one XLA program — no device→host sync per round (the reference's per-pod
+    loop is host-side; our analog keeps the batch's entire conflict resolution
+    on device and transfers once per batch).
 
-    def body(carry):
-        st, _, cap_scale, left = carry
-        st, n = _gang_round_impl(ct_ext, pb, st, seed=seed,
-                                 fit_strategy=fit_strategy,
-                                 topo_keys=topo_keys, serial=serial,
-                                 weights=weights,
-                                 enabled_filters=enabled_filters,
-                                 cap_scale=cap_scale)
-        return (st, n, jnp.minimum(cap_scale * 2, jnp.int32(1 << 20)), left - 1)
+    Shape: a STATIC-trip ``fori_loop`` whose body is a ``lax.cond`` that
+    becomes a no-op once a round makes no progress. A data-dependent
+    ``while_loop`` would be semantically cleaner, but on remote-attached TPU
+    runtimes each dynamic condition evaluation stalls the dispatch pipeline
+    for a host round-trip (~100ms/iteration measured); a constant-trip loop
+    with a conditional body runs entirely ahead of the host, and the dead
+    branch costs nothing after convergence."""
+    return _converge(ct_ext, pb, state, seed=seed, fit_strategy=fit_strategy,
+                     topo_keys=topo_keys, serial=serial, weights=weights,
+                     enabled_filters=enabled_filters, max_rounds=max_rounds)
 
-    carry = (state, jnp.int32(1), jnp.int32(1),
-             jnp.asarray(max_rounds, jnp.int32))
-    state, _, _, _ = jax.lax.while_loop(cond, body, carry)
+
+def _converge(ct_ext, pb, state, *, seed, fit_strategy, topo_keys,
+              weights, enabled_filters, max_rounds, serial=False,
+              slot_start=None) -> GangState:
+    """Shared traceable convergence loop (gang_converge + the drain's
+    per-batch step): fori(max_rounds) of cond-guarded rounds."""
+    def body(i, carry):
+        def live(c):
+            st, _ = c
+            # cap_scale doubles every live round (see _gang_round_impl);
+            # no progress => cond is dead forever, so i counts live rounds.
+            cap = jnp.left_shift(jnp.int32(1), jnp.minimum(i, 20))
+            return _gang_round_impl(ct_ext, pb, st, seed=seed,
+                                    fit_strategy=fit_strategy,
+                                    topo_keys=topo_keys, serial=serial,
+                                    weights=weights,
+                                    enabled_filters=enabled_filters,
+                                    cap_scale=cap, slot_start=slot_start)
+        _, n = carry
+        return jax.lax.cond(n > 0, live, lambda c: c, carry)
+
+    state, _ = jax.lax.fori_loop(0, max(int(max_rounds), 1), body,
+                                 (state, jnp.int32(1)))
     return state
 
 
@@ -313,4 +334,142 @@ def gang_schedule(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
                           fit_strategy=fit_strategy, topo_keys=topo_keys,
                           serial=serial, weights=weights_t,
                           enabled_filters=filters_t, max_rounds=limit)
-    return np.asarray(state.assignment), int(state.rounds)
+    # one batched readback: sequential per-array fetches each pay a full
+    # host<->device round trip (~100ms on remote-attached TPUs)
+    assignment, rounds = jax.device_get((state.assignment, state.rounds))
+    return assignment, int(rounds)
+
+
+# -- multi-batch drain: the whole queue as ONE device program ----------------
+
+def _pad_to(a: np.ndarray, shape: tuple[int, ...], fill):
+    pads = [(0, t - s) for s, t in zip(a.shape, shape)]
+    if not any(hi for _, hi in pads):
+        return a
+    return np.pad(a, pads, constant_values=fill)
+
+
+def unify_batches(pbs: list[PodBatch]) -> list[PodBatch]:
+    """Host-side: pad every leaf of each PodBatch to the max shape across
+    batches. Bucket dims (selector terms, toleration slots, ...) can differ
+    batch to batch; every padded region is guarded by its validity flag, so
+    dtype-driven fills (-1 ids / False / 0.0) are semantically inert."""
+    leaves = [jax.tree_util.tree_leaves(pb) for pb in pbs]
+    treedef = jax.tree_util.tree_structure(pbs[0])
+    unified: list[list[np.ndarray]] = []
+    for i in range(len(leaves[0])):
+        arrs = [np.asarray(ls[i]) for ls in leaves]
+        shape = tuple(max(a.shape[d] for a in arrs)
+                      for d in range(arrs[0].ndim))
+        if arrs[0].dtype == bool:
+            fill = False
+        elif np.issubdtype(arrs[0].dtype, np.floating):
+            fill = 0.0
+        else:
+            fill = -1
+        unified.append([_pad_to(a, shape, fill) for a in arrs])
+    return [jax.tree_util.tree_unflatten(
+                treedef, [unified[i][b] for i in range(len(unified))])
+            for b in range(len(pbs))]
+
+
+def extend_cluster_drain(ct: ClusterTensors, pbs: list[PodBatch]
+                         ) -> tuple[ClusterTensors, int]:
+    """Chain P extension slots for EVERY batch onto the cluster: batch b's
+    pods live at epod slots [e0 + b*P, e0 + (b+1)*P). Committed members of
+    earlier batches therefore stay relationally visible (spread counts,
+    affinity, anti-affinity symmetry) to later batches — the sequential
+    semantics the reference's one-pod-at-a-time loop gets for free."""
+    e0 = int(ct.epod_valid.shape[0])
+    for pb in pbs:
+        ct = extend_cluster(ct, pb)
+    return ct, e0
+
+
+@partial(jax.jit, static_argnames=("e0", "seed", "fit_strategy", "topo_keys",
+                                   "weights", "enabled_filters", "max_rounds"))
+def _gang_drain_compiled(ct_all: ClusterTensors, pb_stack: PodBatch, e0: int,
+                         seed: int, fit_strategy: str,
+                         topo_keys: tuple[int, ...], weights: tuple,
+                         enabled_filters: tuple, max_rounds: int):
+    B, P = pb_stack.pod_valid.shape
+
+    def batch_body(carry, xs):
+        requested, epod_node, epod_valid = carry
+        pb, b = xs
+        start = e0 + b * P
+        ct_b = ct_all.replace(epod_node=epod_node, epod_valid=epod_valid)
+        st0 = GangState(requested=requested,
+                        committed=jnp.zeros(P, bool),
+                        assignment=jnp.full(P, -1, jnp.int32),
+                        tried=jnp.zeros(P, bool),
+                        rounds=jnp.zeros((), jnp.int32))
+        st = _converge(ct_b, pb, st0, seed=seed, fit_strategy=fit_strategy,
+                       topo_keys=topo_keys, weights=weights,
+                       enabled_filters=enabled_filters,
+                       max_rounds=max_rounds, slot_start=start)
+        epod_node = jax.lax.dynamic_update_slice(
+            epod_node, st.assignment, (start,))
+        epod_valid = jax.lax.dynamic_update_slice(
+            epod_valid, st.committed, (start,))
+        return ((st.requested, epod_node, epod_valid),
+                (st.assignment, st.rounds))
+
+    carry0 = (jnp.asarray(ct_all.requested),
+              jnp.asarray(ct_all.epod_node),
+              jnp.asarray(ct_all.epod_valid))
+    (requested, _, _), (assignments, rounds) = jax.lax.scan(
+        batch_body, carry0, (pb_stack, jnp.arange(B)))
+    return assignments, rounds, requested
+
+
+_stage = jax.jit(lambda tree: tree)
+
+
+def prepare_drain(ct: ClusterTensors, pbs: list[PodBatch], stage: bool = True):
+    """Host-side drain prep: unify batch buckets, chain extension slots,
+    stack batches, and (by default) stage everything onto the device via a
+    jitted identity — so repeated drains over the same cluster state pay zero
+    re-transfer (a long-lived scheduler keeps cluster tensors resident in
+    HBM; see sched/cache.py's incremental patches for the connected path).
+    Returns an opaque (ct_all, pb_stack, e0) tuple for gang_drain."""
+    pbs_u = unify_batches(pbs)
+    ct_all, e0 = extend_cluster_drain(ct, pbs_u)
+    pb_stack = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *pbs_u)
+    if stage:
+        ct_all, pb_stack = _stage((ct_all, pb_stack))
+    return ct_all, pb_stack, e0
+
+
+def gang_drain(ct: ClusterTensors = None, pbs: list[PodBatch] = None,
+               seed: int = 0,
+               fit_strategy: str = "LeastAllocated",
+               topo_keys: tuple[int, ...] = (), weights=None,
+               enabled_filters=None, max_rounds: int = 64, prepared=None):
+    """Schedule a whole queue of batches as ONE device program.
+
+    ``lax.scan`` over the batch axis, each step a full gang convergence,
+    carrying (requested[N,R], epod slot state) batch to batch — so capacity
+    AND relational effects of earlier batches are visible to later ones, and
+    the host pays exactly one dispatch + one readback for the entire drain
+    (the per-batch dispatch/sync round-trips the previous design paid are the
+    dominant cost on remote-attached TPUs, ~115ms each measured).
+
+    Returns (assignments [B,P] np.int32 with -1 unschedulable,
+    rounds [B] np.int32, requested_final [N,R] np.int32).
+
+    ``prepared``: the result of prepare_drain() — pass it to amortize host
+    prep + device staging across repeated drains of the same queue shape.
+    """
+    if prepared is None:
+        prepared = prepare_drain(ct, pbs, stage=False)
+    ct_all, pb_stack, e0 = prepared
+    weights_t = tuple(sorted(weights.items())) if weights else ()
+    filters_t = tuple(sorted(enabled_filters)) if enabled_filters else ()
+    out = _gang_drain_compiled(
+        ct_all, pb_stack, e0=e0, seed=seed, fit_strategy=fit_strategy,
+        topo_keys=topo_keys, weights=weights_t, enabled_filters=filters_t,
+        max_rounds=max_rounds)
+    # one batched readback (sequential np.asarray fetches pay a full
+    # host<->device round trip each on remote-attached TPUs)
+    return jax.device_get(out)

@@ -20,6 +20,7 @@ reject — the signature APIServer.admission already dispatches.
 
 from __future__ import annotations
 
+import itertools
 import operator
 import threading
 import time
@@ -42,13 +43,32 @@ class AdmissionChain:
         self.validating: list[Callable] = []
 
     def __call__(self, verb: str, kind: str, obj: dict) -> dict:
-        for fn in self.mutating:
-            obj = fn(verb, kind, obj) or obj
-        for fn in self.validating:
-            out = fn(verb, kind, obj)
-            if out is not None and out is not obj:
-                raise AdmissionError(
-                    f"validating plugin {getattr(fn, '__name__', fn)!r} mutated")
+        hooks = []
+        try:
+            for fn in self.mutating:
+                r = fn(verb, kind, obj)
+                if callable(r):
+                    hooks.append(r)
+                elif r:
+                    obj = r
+            for fn in self.validating:
+                out = fn(verb, kind, obj)
+                if callable(out):  # two-phase plugin: commit hook (see _admit)
+                    hooks.append(out)
+                elif out is not None and out is not obj:
+                    raise AdmissionError(
+                        f"validating plugin {getattr(fn, '__name__', fn)!r} mutated")
+        except Exception:
+            # a later plugin denied: earlier plugins' reservations must not
+            # linger until their TTL (a quota hold would phantom-count 30s)
+            for h in hooks:
+                try:
+                    h(False)
+                except Exception:
+                    pass
+            raise
+        if hooks:
+            obj.setdefault("\x00admission_commits", []).extend(hooks)
         return obj
 
     def install(self, server) -> "AdmissionChain":
@@ -139,11 +159,15 @@ def resource_quota(store: ObjectStore):
     controller-cached usage status is an optimization we skip).
 
     Admission returns before the pod is persisted, so an admitted-but-not-
-    yet-visible pod reserves its usage in ``inflight`` until it appears in
-    the store listing (or 30s pass — the create failed); racing creates see
-    each other's reservations and cannot jointly exceed the quota."""
+    yet-visible pod reserves its usage in ``inflight`` under a UNIQUE token
+    (names are useless here: generateName pods have none yet) and returns a
+    commit hook the apiserver invokes once the create commits or fails —
+    releasing the reservation exactly when the pod becomes countable in the
+    store listing. Racing creates see each other's reservations and cannot
+    jointly exceed the quota; a 30s TTL backstops crashed request paths."""
     lock = threading.Lock()
-    inflight: dict[tuple, tuple[dict, float]] = {}  # (ns,name) -> (usage, ts)
+    seq = itertools.count()
+    inflight: dict[tuple, tuple[dict, float]] = {}  # (ns,tok) -> (usage, ts)
 
     def enforce(verb: str, kind: str, obj: dict):
         if kind != "Pod" or verb != "CREATE":
@@ -155,10 +179,8 @@ def resource_quota(store: ObjectStore):
         with lock:  # serialize check-then-admit so racing creates can't slip past
             pods, _ = store.list("Pod", namespace=ns)
             now = time.time()
-            visible = {(ns, (p.get("metadata") or {}).get("name"))
-                       for p in pods}
             for k in list(inflight):
-                if k in visible or now - inflight[k][1] > 30.0:
+                if now - inflight[k][1] > 30.0:
                     del inflight[k]
             used: dict[str, int] = {}
             for p in pods:
@@ -166,7 +188,7 @@ def resource_quota(store: ObjectStore):
                     continue
                 for r, v in _pod_usage(p).items():
                     used[r] = used.get(r, 0) + v
-            for (res_ns, _name), (u, _ts) in inflight.items():
+            for (res_ns, _tok), (u, _ts) in inflight.items():
                 if res_ns == ns:
                     for r, v in u.items():
                         used[r] = used.get(r, 0) + v
@@ -183,9 +205,13 @@ def resource_quota(store: ObjectStore):
                             f"requested: {key}={want[key]}, "
                             f"used: {key}={used.get(key, 0)}, "
                             f"limited: {key}={canonical(key, lim)}")
-            inflight[(ns, (obj.get("metadata") or {}).get("name", ""))] = \
-                (want, now)
-        return None
+            token = (ns, next(seq))
+            inflight[token] = (want, now)
+
+        def release(ok: bool):
+            with lock:
+                inflight.pop(token, None)
+        return release
     return enforce
 
 

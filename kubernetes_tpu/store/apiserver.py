@@ -118,9 +118,41 @@ class APIServer:
     # ---- request handling ------------------------------------------------
 
     def _admit(self, verb: str, kind: str, obj: dict) -> dict:
-        for fn in self.admission:
-            obj = fn(verb, kind, obj) or obj
+        """Run the admission chain. A plugin may return a mutated object, or a
+        callable commit hook ``hook(ok: bool)`` invoked after the storage
+        operation completes (two-phase: lets e.g. quota release its in-flight
+        reservation exactly when the object becomes visible, instead of
+        guessing by name — generateName objects have none at admission time).
+        Collected hooks are stashed on the returned object under a private
+        key the storage path pops before persisting."""
+        hooks = []
+        try:
+            for fn in self.admission:
+                r = fn(verb, kind, obj)
+                if callable(r):
+                    hooks.append(r)
+                elif r:
+                    obj = r
+                if isinstance(obj, dict):
+                    hooks.extend(obj.pop("\x00admission_commits", []))
+        except Exception:
+            self._commit(hooks, False)  # release earlier plugins' holds
+            raise
+        if hooks:
+            obj["\x00admission_commits"] = hooks
         return obj
+
+    @staticmethod
+    def _pop_commits(obj: dict) -> list:
+        return obj.pop("\x00admission_commits", [])
+
+    @staticmethod
+    def _commit(hooks: list, ok: bool):
+        for h in hooks:
+            try:
+                h(ok)
+            except Exception:
+                pass
 
     def _make_handler(self):
         server = self
@@ -340,13 +372,19 @@ class APIServer:
                     body = server._admit("CREATE", kind, body)
                 except AdmissionError as e:
                     return self._error(400, str(e), "AdmissionDenied")
+                commits = server._pop_commits(body)
                 md = body.setdefault("metadata", {})
                 if ns:
                     md["namespace"] = ns
                 try:
                     out = server.store.create(kind, body)
                 except AlreadyExists as e:
+                    server._commit(commits, False)
                     return self._error(409, str(e), "AlreadyExists")
+                except Exception:
+                    server._commit(commits, False)
+                    raise
+                server._commit(commits, True)
                 return self._send_json(201, out)
 
             def do_PUT(self):
@@ -365,6 +403,7 @@ class APIServer:
                     body = server._admit("UPDATE", kind, body)
                 except AdmissionError as e:
                     return self._error(400, str(e), "AdmissionDenied")
+                commits = server._pop_commits(body)
                 if sub == "status":
                     try:
                         cur = server.store.get(kind, ns or "", name)
@@ -376,9 +415,12 @@ class APIServer:
                 try:
                     out = server.store.update(kind, body, expect_rv=expect)
                 except NotFound as e:
+                    server._commit(commits, False)
                     return self._error(404, str(e), "NotFound")
                 except Conflict as e:
+                    server._commit(commits, False)
                     return self._error(409, str(e), "Conflict")
+                server._commit(commits, True)
                 return self._send_json(200, out)
 
             def do_DELETE(self):

@@ -185,3 +185,59 @@ def test_apf_429_over_http():
         assert server.flow.rejected_total >= 1
     finally:
         server.stop()
+
+
+def test_resource_quota_generate_name_reservations(server):
+    """generateName pods have no name at admission time; reservations must
+    still be unique per request (no overwrite between racing creates) and
+    released once the create commits (no 30s phantom double-count)."""
+    client = HTTPClient(server.url)
+    client.resource("resourcequotas").create({
+        "apiVersion": "v1", "kind": "ResourceQuota",
+        "metadata": {"name": "team", "namespace": "default"},
+        "spec": {"hard": {"requests.cpu": "1"}}})
+
+    def gen_pod(cpu):
+        d = make_pod("x").req({"cpu": cpu}).obj().to_dict()
+        d["metadata"].pop("name", None)
+        d["metadata"]["generateName"] = "burst-"
+        return d
+
+    # two sequential generateName creates filling the quota exactly: the
+    # second must NOT be blocked by a lingering reservation for the first
+    # (released at commit), and a third must be denied.
+    client.pods().create(gen_pod("500m"))
+    client.pods().create(gen_pod("500m"))
+    with pytest.raises(ApiError) as ei:
+        client.pods().create(gen_pod("50m"))
+    assert "quota" in str(ei.value).lower()
+
+    # racing generateName creates cannot jointly exceed the quota
+    client.resource("resourcequotas").update({
+        "apiVersion": "v1", "kind": "ResourceQuota",
+        "metadata": {"name": "team", "namespace": "default"},
+        "spec": {"hard": {"requests.cpu": "4"}}})
+    errs, oks = [], []
+
+    def create_one():
+        try:
+            client.pods().create(gen_pod("1"))
+            oks.append(1)
+        except ApiError:
+            errs.append(1)
+
+    threads = [threading.Thread(target=create_one) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    pods = client.pods().list()
+    def millicpu(q):
+        return int(q[:-1]) if q.endswith("m") else int(q) * 1000
+
+    total_cpu = sum(
+        millicpu(c["resources"]["requests"]["cpu"])
+        for p in pods for c in p["spec"]["containers"]
+        if (p["metadata"].get("generateName") or "").startswith("burst-")
+        or p["metadata"]["name"].startswith("burst-"))
+    assert total_cpu <= 4000, f"quota jointly exceeded: {total_cpu}m"

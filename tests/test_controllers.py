@@ -498,3 +498,45 @@ def test_controller_manager_end_to_end(client):
             lambda: len(client.resource("replicasets").list()) == 0, timeout=10.0)
     finally:
         mgr.stop()
+
+
+def test_endpoints_named_targetport_resolved_per_pod(client):
+    """A named targetPort must resolve against EACH pod's containers
+    (FindPort): old and new pods mapping the name to different
+    containerPorts land in separate subsets with their own ports."""
+    ctrl = EndpointsController(client)
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    try:
+        client.services().create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "api", "namespace": "default"},
+            "spec": {"selector": {"app": "api"},
+                     "ports": [{"port": 80, "targetPort": "http"}]},
+        })
+        for name, ip, cport in (("old", "10.2.0.1", 8080),
+                                ("new", "10.2.0.2", 9090)):
+            p = make_pod(name).label("app", "api").obj().to_dict()
+            p["spec"]["containers"][0]["ports"] = [
+                {"name": "http", "containerPort": cport}]
+            p["status"] = {"phase": "Running", "podIP": ip,
+                           "conditions": [{"type": "Ready", "status": "True"}]}
+            client.pods().create(p)
+
+        def split_by_port():
+            try:
+                ep = client.endpoints().get("api")
+            except Exception:
+                return False
+            subs = ep.get("subsets") or []
+            got = {(s["ports"][0]["port"],
+                    tuple(a["ip"] for a in s.get("addresses", [])))
+                   for s in subs}
+            return got == {(8080, ("10.2.0.1",)), (9090, ("10.2.0.2",))}
+        assert wait_until(split_by_port)
+    finally:
+        ctrl.stop()
+        factory.stop_all()
