@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Callable, Iterator, Optional
@@ -24,7 +25,8 @@ from typing import Callable, Iterator, Optional
 import functools
 
 from kubernetes_tpu.api.selectors import compile_list_selector
-from kubernetes_tpu.store.apiserver import ALL_RESOURCES, APPS_RESOURCES
+from kubernetes_tpu.store.apiserver import (ALL_RESOURCES, APPS_RESOURCES,
+                                            RBAC_RESOURCES)
 from kubernetes_tpu.store.store import (
     AlreadyExists,
     Conflict,
@@ -231,18 +233,35 @@ class _NamespaceFilteredWatch:
 
 
 class HTTPClient(_Handles):
-    """urllib transport against an APIServer URL."""
+    """urllib transport against an APIServer URL. ``token``: bearer token
+    presented on every request (the service-identity credential —
+    rest.Config.BearerToken); ``impersonate``: acts-as user name sent via
+    Impersonate-User (requires the real user to hold ``impersonate``)."""
 
-    def __init__(self, base_url: str, timeout: float = 10.0):
+    def __init__(self, base_url: str, timeout: float = 10.0,
+                 token: Optional[str] = None,
+                 impersonate: Optional[str] = None):
         self.base = base_url.rstrip("/")
         self.timeout = timeout
+        self.token = token
+        self.impersonate = impersonate
+
+    def _auth_headers(self) -> dict:
+        h = {}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        if self.impersonate:
+            h["Impersonate-User"] = self.impersonate
+        return h
 
     def _path(self, plural, ns, name=None, sub=None, query=""):
         group = "/apis/apps/v1" if plural in APPS_RESOURCES else (
             "/apis/coordination.k8s.io/v1" if plural == "leases" else
             "/apis/storage.k8s.io/v1" if plural == "storageclasses" else
             "/apis/scheduling.k8s.io/v1" if plural == "priorityclasses" else
-            "/api/v1")
+            "/apis/policy/v1" if plural == "poddisruptionbudgets" else
+            "/apis/rbac.authorization.k8s.io/v1" if plural in RBAC_RESOURCES
+            else "/api/v1")
         p = group
         if ns:
             p += f"/namespaces/{ns}"
@@ -259,17 +278,32 @@ class HTTPClient(_Handles):
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method,
                                      headers={"Content-Type": "application/json",
+                                              **self._auth_headers(),
                                               **(headers or {})})
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:
+        # One retry on transport-level failures (reset/refused under load
+        # bursts). A retried NAMED write that actually committed surfaces as
+        # 409/AlreadyExists — the expected optimistic-concurrency outcome.
+        # generateName creates are NOT idempotent (the server mints a fresh
+        # name each time, so a lost-response retry would duplicate the
+        # object); those fail fast and rely on the controller's resync.
+        retriable = not (method == "POST" and isinstance(body, dict)
+                         and (body.get("metadata") or {}).get("generateName")
+                         and not (body.get("metadata") or {}).get("name"))
+        for attempt in (0, 1):
             try:
-                status = json.loads(e.read())
-            except Exception:
-                status = {}
-            raise ApiError(e.code, status.get("message", str(e)),
-                           status.get("reason", "")) from None
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                try:
+                    status = json.loads(e.read())
+                except Exception:
+                    status = {}
+                raise ApiError(e.code, status.get("message", str(e)),
+                               status.get("reason", "")) from None
+            except (ConnectionError, urllib.error.URLError, TimeoutError):
+                if attempt or not retriable:
+                    raise
+                time.sleep(0.05)
 
     def create(self, plural, kind, ns, obj):
         return self._req("POST", self._path(plural, ns), obj)
@@ -321,7 +355,8 @@ class _HTTPWatch:
         # read timeout doubles as the liveness window: the server heartbeats
         # every ~1s, so a blocking readline that times out means a dead peer.
         self._resp = urllib.request.urlopen(
-            urllib.request.Request(self._url), timeout=self.HEARTBEAT_GRACE)
+            urllib.request.Request(self._url, headers=client._auth_headers()),
+            timeout=self.HEARTBEAT_GRACE)
         self._lock = threading.Lock()
 
     def get(self, timeout: float = 0.2) -> Optional[Event]:

@@ -14,6 +14,7 @@ from kubernetes_tpu.client.informer import InformerFactory
 from kubernetes_tpu.client.leaderelection import LeaderElectionConfig, LeaderElector
 from kubernetes_tpu.controllers.daemonset import DaemonSetController
 from kubernetes_tpu.controllers.deployment import DeploymentController
+from kubernetes_tpu.controllers.disruption import DisruptionController
 from kubernetes_tpu.controllers.endpoints import EndpointsController
 from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
 from kubernetes_tpu.controllers.job import JobController
@@ -23,7 +24,8 @@ from kubernetes_tpu.controllers.replicaset import ReplicaSetController
 from kubernetes_tpu.controllers.statefulset import StatefulSetController
 
 DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "daemonset",
-                       "statefulset", "endpoints", "nodelifecycle", "pvbinder")
+                       "statefulset", "endpoints", "nodelifecycle", "pvbinder",
+                       "disruption")
 
 
 class ControllerManager:
@@ -44,6 +46,7 @@ class ControllerManager:
             "endpoints": EndpointsController,
             "nodelifecycle": NodeLifecycleController,
             "pvbinder": PersistentVolumeController,
+            "disruption": DisruptionController,
         }
         self.controllers = [ctors[n](client) for n in controllers]
         self.gc = GarbageCollector(client) if gc_enabled else None

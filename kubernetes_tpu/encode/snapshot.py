@@ -152,6 +152,13 @@ class ClusterTensors(struct.PyTreeNode):
     used_rwo_valid: Any    # [N,VN] bool
     attach_used: Any       # [N] int32 attachable volumes currently on node
     attach_limit: Any      # [N] int32 (UNLIMITED if node reports no limit)
+    # nominated-but-unbound pods (preemption nominees): their requests are
+    # reserved on nom_node against pods of LOWER priority
+    # (RunFilterPluginsWithNominatedPods — schedule_one.go)
+    nom_node: Any          # [M] int32 node index
+    nom_prio: Any          # [M] int32
+    nom_req: Any           # [M,R] int32
+    nom_valid: Any         # [M] bool
 
 
 class PodBatch(struct.PyTreeNode):
@@ -502,8 +509,33 @@ class SnapshotEncoder:
             ea_sel=SelectorSet(**ea_arrs), ea_topo=ea_topo, ea_valid=ea_valid,
             used_rwo=used_rwo, used_rwo_valid=used_rwo_valid,
             attach_used=attach_used, attach_limit=attach_limit,
+            nom_node=np.zeros(0, np.int32), nom_prio=np.zeros(0, np.int32),
+            nom_req=np.zeros((0, R), np.int32), nom_valid=np.zeros(0, bool),
         )
         return ct, meta
+
+    def with_nominated(self, ct: ClusterTensors, meta: "SnapshotMeta",
+                       nominated: list) -> ClusterTensors:
+        """Overlay nominated-pod reservations onto an encoded snapshot.
+        ``nominated``: [(node_name, priority, Pod)]. Cheap (tiny M-bucketed
+        arrays), so it applies on every scheduling cycle without touching the
+        incremental-patch bookkeeping."""
+        R = ct.nom_req.shape[1]
+        entries = [(meta.node_index[n], prio,
+                    self._request_vector(p, meta.resources))
+                   for (n, prio, p) in nominated if n in meta.node_index]
+        M = next_bucket(len(entries), minimum=1) if entries else 0
+        nom_node = np.full(M, -1, np.int32)
+        nom_prio = np.zeros(M, np.int32)
+        nom_req = np.zeros((M, R), np.int32)
+        nom_valid = np.zeros(M, bool)
+        for m, (ni, prio, vec) in enumerate(entries):
+            nom_node[m] = ni
+            nom_prio[m] = prio
+            nom_req[m] = vec
+            nom_valid[m] = True
+        return ct.replace(nom_node=nom_node, nom_prio=nom_prio,
+                          nom_req=nom_req, nom_valid=nom_valid)
 
     # -- incremental pod deltas --------------------------------------------
 

@@ -30,9 +30,24 @@ from kubernetes_tpu.ops.exprs import eval_term_set, gather_values
 
 
 def fit_mask(ct: ClusterTensors, pb: PodBatch):
-    """NodeResourcesFit: requests fit into allocatable - requested, per resource."""
+    """NodeResourcesFit: requests fit into allocatable - requested, per
+    resource. Nominated-but-unbound pods (preemption nominees) reserve their
+    requests on their nominated node against LOWER-priority pods — the
+    RunFilterPluginsWithNominatedPods pass of schedule_one.go, where
+    higher-or-equal-priority nominees are added to the node before filtering."""
     free = ct.allocatable - ct.requested              # [N,R]
-    return jnp.all(pb.requests[:, None, :] <= free[None, :, :], axis=-1)
+    fits = jnp.all(pb.requests[:, None, :] <= free[None, :, :], axis=-1)
+    M = ct.nom_valid.shape[0]
+    if M == 0:
+        return fits
+    N = ct.node_valid.shape[0]
+    applies = ((ct.nom_prio[None, :] >= pb.priority[:, None])
+               & ct.nom_valid[None, :])                       # [P,M]
+    onehot = (ct.nom_node[:, None] == jnp.arange(N)[None, :]) # [M,N]
+    extra = jnp.einsum("pm,mn,mr->pnr", applies.astype(jnp.int32),
+                       onehot.astype(jnp.int32), ct.nom_req)  # [P,N,R]
+    fits_nom = jnp.all(pb.requests[:, None, :] + extra <= free[None], axis=-1)
+    return fits & fits_nom
 
 
 def node_name_mask(ct: ClusterTensors, pb: PodBatch):

@@ -222,6 +222,12 @@ class SchedulerCache:
         with self._lock:
             return self._encoder.encode_pods(pods, meta)
 
+    def overlay_nominated(self, ct, meta, entries):
+        """ct with nominated-pod reservations applied (encoder.with_nominated);
+        entries: [(node_name, priority, Pod)]."""
+        with self._lock:
+            return self._encoder.with_nominated(ct, meta, entries)
+
     def bound_pods(self, include_assumed: bool = True) -> list[Pod]:
         with self._lock:
             out = list(self._pods.values())

@@ -13,9 +13,10 @@ optimization.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
 
+from kubernetes_tpu.api.policy import _matches, compute_pdb_status
 from kubernetes_tpu.api.types import Node, Pod
 from kubernetes_tpu.sched.oracle import OracleScheduler
 
@@ -24,37 +25,87 @@ from kubernetes_tpu.sched.oracle import OracleScheduler
 class PreemptionResult:
     node_name: str
     victims: list[Pod]  # sorted by priority asc (evict lowest first)
+    num_pdb_violations: int = 0
+
+
+def _pdb_budgets(pdbs: list[dict], bound_pods: list[Pod]) -> list[tuple]:
+    """-> [(pdb_ns, selector, disruptionsAllowed)] computed live."""
+    out = []
+    pod_dicts = [p.to_dict() for p in bound_pods]
+    for pdb in pdbs or []:
+        ns = (pdb.get("metadata") or {}).get("namespace", "")
+        sel = (pdb.get("spec") or {}).get("selector")
+        allowed = compute_pdb_status(
+            pdb, [d for d in pod_dicts
+                  if (d.get("metadata") or {}).get("namespace", "") == ns]
+        )["disruptionsAllowed"]
+        out.append((ns, sel, allowed))
+    return out
+
+
+def _violates(pod: Pod, budgets_used: list) -> bool:
+    """True if evicting ``pod`` would exceed some covering PDB's remaining
+    budget; charges the budget either way (filterPodsWithPDBViolation)."""
+    violating = False
+    for entry in budgets_used:
+        ns, sel, allowed, used = entry
+        if pod.metadata.namespace != ns:
+            continue
+        if not _matches(sel, pod.metadata.labels):
+            continue
+        if used >= allowed:
+            violating = True
+        entry[3] += 1
+    return violating
 
 
 def find_candidate(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
+                   pdbs: Optional[list[dict]] = None,
                    ) -> Optional[PreemptionResult]:
     """Find the best node + minimal victim set enabling ``pod`` to schedule.
 
-    Per node: remove lower-priority pods lowest-first until feasible, then
-    reprieve (re-add highest-first while staying feasible) — mirrors
-    SelectVictimsOnNode. Candidate selection mirrors pickOneNodeForPreemption:
-    min highest-victim-priority, then min victim count, then node order.
+    Per node: remove lower-priority pods — PDB-unprotected ones first — until
+    feasible, then reprieve (re-add highest-first while staying feasible),
+    mirroring SelectVictimsOnNode's split into violating/non-violating
+    victims. A budget MAY be violated as a last resort, exactly as upstream.
+    Candidate selection mirrors pickOneNodeForPreemption: fewest PDB
+    violations, then min highest-victim-priority, then min victim count,
+    then node order.
     """
+    budgets = _pdb_budgets(pdbs or [], bound_pods)
     best: Optional[tuple] = None
     for i, node in enumerate(nodes):
-        victims = _victims_on_node(nodes, bound_pods, pod, node)
-        if victims is None:
+        found = _victims_on_node(nodes, bound_pods, pod, node, budgets)
+        if found is None:
             continue
-        key = (max((v.spec.priority for v in victims), default=-1), len(victims), i)
+        victims, violations = found
+        key = (violations,
+               max((v.spec.priority for v in victims), default=-1),
+               len(victims), i)
         if best is None or key < best[0]:
-            best = (key, node.metadata.name, victims)
+            best = (key, node.metadata.name, victims, violations)
     if best is None:
         return None
-    return PreemptionResult(node_name=best[1],
-                            victims=sorted(best[2], key=lambda p: p.spec.priority))
+    return PreemptionResult(
+        node_name=best[1],
+        victims=sorted(best[2], key=lambda p: p.spec.priority),
+        num_pdb_violations=best[3])
 
 
-def _victims_on_node(nodes, bound_pods, pod, node) -> Optional[list[Pod]]:
+def _victims_on_node(nodes, bound_pods, pod, node, budgets
+                     ) -> Optional[tuple[list[Pod], int]]:
     on_node = [p for p in bound_pods if p.spec.node_name == node.metadata.name]
-    lower = sorted([p for p in on_node if p.spec.priority < pod.spec.priority],
-                   key=lambda p: p.spec.priority)
+    lower = [p for p in on_node if p.spec.priority < pod.spec.priority]
     if not lower:
         return None
+    # classify against fresh per-node budget accounting, then try
+    # non-violating victims (priority asc) before violating ones
+    used = [[ns, sel, allowed, 0] for (ns, sel, allowed) in budgets]
+    flagged = [(p, _violates(p, used))
+               for p in sorted(lower, key=lambda p: p.spec.priority)]
+    ordered = ([p for p, v in flagged if not v]
+               + [p for p, v in flagged if v])
+    violating_uids = {p.metadata.uid for p, v in flagged if v}
     ni = next(i for i, n in enumerate(nodes) if n.metadata.name == node.metadata.name)
 
     def feasible_without(removed: set[str]) -> bool:
@@ -66,7 +117,7 @@ def _victims_on_node(nodes, bound_pods, pod, node) -> Optional[list[Pod]]:
     removed: set[str] = set()
     victims: list[Pod] = []
     ok = False
-    for v in lower:
+    for v in ordered:
         removed.add(v.metadata.uid)
         victims.append(v)
         if feasible_without(removed):
@@ -74,10 +125,15 @@ def _victims_on_node(nodes, bound_pods, pod, node) -> Optional[list[Pod]]:
             break
     if not ok:
         return None
-    # Reprieve: re-add highest-priority victims that aren't actually needed.
-    for v in sorted(victims, key=lambda p: -p.spec.priority):
+    # Reprieve: re-add victims that aren't actually needed — PDB-violating
+    # candidates first (so budgets are preserved whenever possible), then by
+    # priority desc, mirroring SelectVictimsOnNode's two reprieve passes.
+    for v in sorted(victims,
+                    key=lambda p: (p.metadata.uid not in violating_uids,
+                                   -p.spec.priority)):
         trial = removed - {v.metadata.uid}
         if feasible_without(trial):
             removed = trial
             victims = [p for p in victims if p.metadata.uid != v.metadata.uid]
-    return victims
+    violations = sum(1 for v in victims if v.metadata.uid in violating_uids)
+    return victims, violations

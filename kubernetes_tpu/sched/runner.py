@@ -140,8 +140,14 @@ class SchedulerRunner:
             return False
 
     def _evict(self, victim: Pod):
+        # Preemption DELETEs the victim directly (schedule_one.go preempts
+        # via clientset Pods().Delete, not the Eviction API): victim
+        # selection already preferred PDB-safe victims, and upstream allows
+        # violating a budget as a last resort. The Eviction subresource —
+        # which 429s on exhausted budgets — is for voluntary disruption
+        # (drain), not preemption.
         try:
-            self.client.pods(victim.metadata.namespace).evict(victim.metadata.name)
+            self.client.pods(victim.metadata.namespace).delete(victim.metadata.name)
         except ApiError as e:
             if e.code != 404:  # already gone is fine
                 _LOG.warning("evict %s failed: %s", victim.key, e)
@@ -161,6 +167,10 @@ class SchedulerRunner:
                              ("storageclasses", "StorageClass")):
             inf = self.factory.informer(plural, None)
             inf.add_event_handler(self._on_volume(kind))
+        # PDBs feed preemption's victim selection (default_preemption.go
+        # checks budgets when picking victims)
+        pdb_inf = self.factory.informer("poddisruptionbudgets", None)
+        self.scheduler.pdb_lister = lambda: list(pdb_inf.store.list())
         self.factory.start_all()
         self.factory.wait_for_cache_sync(wait_sync)
 

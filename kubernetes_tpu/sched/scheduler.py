@@ -50,6 +50,15 @@ class Scheduler:
         self.features = feature_gate
         self.preemptor = preemptor if preemptor is not None else self._default_preempt
         self._bind_threads: list[threading.Thread] = []
+        # preemption nominees awaiting re-schedule: key -> (node, prio, pod, ts).
+        # Their freed capacity is reserved against lower-priority pods until
+        # they bind (schedule_one.go nominatedNodeName handling). The TTL
+        # backstops pods deleted while nominated.
+        self._nominated: dict[str, tuple] = {}
+        self._nominated_ttl = 300.0
+        # PDBs for preemption victim selection; the runner wires this to its
+        # poddisruptionbudgets informer
+        self.pdb_lister: Callable[[], list] = lambda: []
 
     # ---- one batch iteration --------------------------------------------
 
@@ -94,6 +103,17 @@ class Scheduler:
                 self.queue.add_unschedulable(pod, attempts + 1)
                 SCHEDULE_ATTEMPTS.inc({"result": "unschedulable"})
             return 0
+        batch_keys = {p.key for p in pods}
+        now = time.time()
+        self._nominated = {
+            k: e for k, e in self._nominated.items()
+            if now - e[3] < self._nominated_ttl and not self.cache.is_bound(k)}
+        entries = [(n, prio, p) for k, (n, prio, p, _ts)
+                   in self._nominated.items() if k not in batch_keys]
+        if entries:
+            # nominees OUTSIDE this batch hold their reservation tensor-side;
+            # nominees inside it are protected by the gang rank order instead
+            ct = self.cache.overlay_nominated(ct, meta, entries)
         with TRACER.span("scheduler/encode_pods", pods=len(pods)):
             pb = self.cache.encode_pods(pods, meta)
         serial = not self.features.enabled("TPUBatchScheduling")
@@ -112,6 +132,7 @@ class Scheduler:
         for (pod, attempts), a in zip(items, assignment[:len(items)]):
             if a >= 0:
                 node_name = meta.node_names[int(a)]
+                self._nominated.pop(pod.key, None)
                 self.cache.assume(pod, node_name)
                 self._bind_async(pod, node_name)
                 SCHEDULE_ATTEMPTS.inc({"result": "scheduled"})
@@ -136,8 +157,12 @@ class Scheduler:
             nominated = self.preemptor(pod)
         if nominated:
             # Victims were evicted: retry immediately (no backoff) so the
-            # freed capacity isn't stolen by lower-priority arrivals.
+            # freed capacity isn't stolen by lower-priority arrivals; until
+            # the pod binds, the reservation also shields the capacity from
+            # lower-priority pods in other batches (fit_mask nominated terms).
             pod.status.nominated_node_name = nominated
+            self._nominated[pod.key] = (nominated, pod.spec.priority, pod,
+                                        time.time())
             self.queue.add(pod)
         else:
             self.queue.add_unschedulable(pod, attempts + 1)
@@ -147,7 +172,8 @@ class Scheduler:
     def _default_preempt(self, pod: Pod) -> Optional[str]:
         nodes, _, _ = self.cache.snapshot()
         bound = self.cache.bound_pods(include_assumed=True)
-        res = preemption_mod.find_candidate(nodes, bound, pod)
+        res = preemption_mod.find_candidate(nodes, bound, pod,
+                                            pdbs=self.pdb_lister())
         if res is None:
             return None
         for v in res.victims:

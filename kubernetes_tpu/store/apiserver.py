@@ -62,9 +62,17 @@ APPS_RESOURCES = {
     "jobs": ("Job", True),
 }
 COORD_RESOURCES = {"leases": ("Lease", True)}
+POLICY_RESOURCES = {"poddisruptionbudgets": ("PodDisruptionBudget", True)}
+RBAC_RESOURCES = {
+    "roles": ("Role", True),
+    "rolebindings": ("RoleBinding", True),
+    "clusterroles": ("ClusterRole", False),
+    "clusterrolebindings": ("ClusterRoleBinding", False),
+}
 
 ALL_RESOURCES = {**CORE_RESOURCES, **APPS_RESOURCES, **COORD_RESOURCES,
-                 **STORAGE_RESOURCES, **SCHEDULING_RESOURCES}
+                 **STORAGE_RESOURCES, **SCHEDULING_RESOURCES,
+                 **RBAC_RESOURCES, **POLICY_RESOURCES}
 KIND_TO_PLURAL = {k: p for p, (k, _) in ALL_RESOURCES.items()}
 
 
@@ -76,13 +84,27 @@ class _BadRequest(Exception):
     pass
 
 
+class _HTTPServer(ThreadingHTTPServer):
+    # http.server's default listen backlog of 5 drops (RSTs) connections
+    # under controller/binder bursts — every client request is a fresh TCP
+    # connection (urllib does not keep-alive), so bursts of a few dozen
+    # concurrent binds overflow it instantly.
+    request_queue_size = 128
+
+
 class APIServer:
     def __init__(self, store: Optional[ObjectStore] = None,
-                 host: str = "127.0.0.1", port: int = 0):
-        self.store = store or ObjectStore()
+                 host: str = "127.0.0.1", port: int = 0,
+                 data_dir: Optional[str] = None):
+        """``data_dir``: durable mode — the store journals every write and
+        restores state on construction (store.py WAL + snapshot)."""
+        self.store = store or ObjectStore(data_dir=data_dir)
         self.admission: list[Callable] = []
         self.flow = None  # FlowController when APF is enabled
-        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self.authenticator = None  # set by enable_auth
+        self.authorizer = None
+        self.audit = None
+        self._httpd = _HTTPServer((host, port), self._make_handler())
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
@@ -98,6 +120,7 @@ class APIServer:
     def stop(self):
         self._httpd.shutdown()
         self._httpd.server_close()
+        self.store.close()
 
     @property
     def url(self) -> str:
@@ -107,6 +130,24 @@ class APIServer:
         """Turn on API Priority and Fairness (store/flowcontrol.py)."""
         from kubernetes_tpu.store.flowcontrol import FlowController
         self.flow = controller or FlowController()
+        return self
+
+    def enable_auth(self, authenticator=None, authorizer=None, audit=None,
+                    bootstrap: bool = True):
+        """Install the authn -> audit -> impersonation -> (APF) -> authz
+        filter chain (DefaultBuildHandlerChain order — store/auth.py).
+        ``bootstrap`` seeds the default system: roles/bindings."""
+        from kubernetes_tpu.store.auth import (
+            AuditLog, RBACAuthorizer, TokenAuthenticator, bootstrap_policy)
+        self.authenticator = authenticator or TokenAuthenticator()
+        self.authorizer = authorizer or RBACAuthorizer(self.store)
+        self.audit = audit if audit is not None else AuditLog()
+        if bootstrap:
+            for obj in bootstrap_policy():
+                try:
+                    self.store.create(obj["kind"], obj)
+                except AlreadyExists:
+                    pass
         return self
 
     def enable_admission(self, chain=None):
@@ -164,12 +205,36 @@ class APIServer:
                 pass
 
             def _shaped(self, verb: str, fn):
-                """APF: classify -> acquire a seat -> run -> release.
-                Watches are long-running and exempt from seat accounting
-                (upstream excludes them from the queueset after initial
-                admission)."""
+                """The filter chain, in DefaultBuildHandlerChain order:
+                authn (401) -> audit -> impersonation (403) -> APF (429) ->
+                authz (403) -> handler. Watches are long-running and exempt
+                from APF seat accounting (upstream excludes them from the
+                queueset after initial admission)."""
+                self._user = None
+                self._impersonated = None
+                if server.authenticator is not None:
+                    from kubernetes_tpu.store.auth import AuthError
+                    try:
+                        self._user = server.authenticator.authenticate(
+                            self.headers.get("Authorization", ""))
+                    except AuthError as e:
+                        return self._audited(401, lambda: self._error(
+                            401, str(e), "Unauthorized"))
+                    imp = self.headers.get("Impersonate-User")
+                    if imp:
+                        groups = tuple(
+                            g for g in self.headers.get(
+                                "Impersonate-Group", "").split(",") if g)
+                        if not server.authorizer.can_impersonate(
+                                self._user, groups):
+                            return self._audited(403, lambda: self._error(
+                                403, f"user {self._user.name!r} cannot "
+                                     "impersonate", "Forbidden"))
+                        from kubernetes_tpu.store.auth import UserInfo
+                        self._impersonated = self._user.name
+                        self._user = UserInfo(imp, groups)
                 if server.flow is None or "watch=true" in self.path:
-                    return fn()
+                    return self._run_authorized(verb, fn)
                 level = server.flow.classify(
                     verb, urlparse(self.path).path,
                     self.headers.get("User-Agent", ""))
@@ -186,13 +251,56 @@ class APIServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                    self._audit(429)
                     return None
                 try:
-                    return fn()
+                    return self._run_authorized(verb, fn)
                 finally:
                     server.flow.release(level)
 
+            def _run_authorized(self, http_verb: str, fn):
+                """Authorize against the parsed route, then run + audit."""
+                if server.authorizer is None or self._user is None:
+                    return fn()
+                from kubernetes_tpu.store.auth import (
+                    request_verb, resource_for)
+                r = self._route()
+                if r is not None:
+                    plural, _kind, ns, name, sub = r
+                    verb = request_verb(self.command, name,
+                                        sub, urlparse(self.path).query)
+                    resource = resource_for(plural, sub)
+                    if not server.authorizer.authorize(
+                            self._user, verb, resource, ns or "", name or ""):
+                        return self._audited(403, lambda: self._error(
+                            403, f"user {self._user.name!r} cannot {verb} "
+                                 f"{resource}"
+                                 + (f" in namespace {ns!r}" if ns else ""),
+                            "Forbidden"))
+                # non-resource paths (/metrics, /healthz, ...): any
+                # authenticated (or anonymous-allowed) user may read
+                return self._audited(None, fn)
+
+            def _audit(self, code: int):
+                if server.audit is None:
+                    return
+                user = self._user
+                if user is None:  # failed authn is audited too
+                    from kubernetes_tpu.store.auth import ANONYMOUS, UserInfo
+                    user = UserInfo(ANONYMOUS)
+                server.audit.log(user=user, verb=self.command,
+                                 path=self.path, code=code,
+                                 impersonated=self._impersonated)
+
+            def _audited(self, code, fn):
+                try:
+                    return fn()
+                finally:
+                    self._audit(code if code is not None
+                                else getattr(self, "_last_code", 200))
+
             def _send_json(self, code: int, obj):
+                self._last_code = code
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -363,6 +471,29 @@ class APIServer:
                         return self._error(409, str(e), "Conflict")
                     return self._send_json(201, out)
                 if sub == "eviction" and kind == "Pod":
+                    # Eviction API honors PodDisruptionBudgets
+                    # (registry/core/pod/storage/eviction.go): 429 when the
+                    # governing budget has no disruptions left. Preemption
+                    # deletes pods directly and is allowed to violate PDBs as
+                    # a last resort, exactly as upstream.
+                    from kubernetes_tpu.api.policy import disruptions_allowed_for
+                    try:
+                        pod_obj = server.store.get("Pod", ns or "", name)
+                    except NotFound as e:
+                        return self._error(404, str(e), "NotFound")
+                    pdbs, _ = server.store.list("PodDisruptionBudget",
+                                                namespace=ns or "")
+                    if pdbs:
+                        pods_ns, _ = server.store.list("Pod", namespace=ns or "")
+                        allowed, governing = disruptions_allowed_for(
+                            pod_obj, pdbs, pods_ns)
+                        if allowed <= 0:
+                            g = (governing or {}).get("metadata", {}).get(
+                                "name", "")
+                            return self._error(
+                                429, f"Cannot evict pod as it would violate "
+                                     f"the pod's disruption budget {g!r}",
+                                "TooManyRequests")
                     try:
                         out = server.store.delete("Pod", ns or "", name)
                     except NotFound as e:

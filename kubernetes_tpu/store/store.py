@@ -10,11 +10,20 @@ etcd compaction).
 Checkpoint/resume: the cluster state IS the checkpoint (SURVEY §5) —
 ``save``/``load`` serialize the whole keyspace; components rebuild everything
 else from watches.
+
+Durability (etcd's WAL + snapshot analog): pass ``data_dir`` and every
+mutation is journaled to ``wal.jsonl`` inside the store lock before the call
+returns; the journal is folded into ``snapshot.json`` (atomic tmp+rename)
+every ``wal_compact_every`` entries. A store opened on an existing data_dir
+restores snapshot + replays the journal tail — an apiserver restart keeps
+all pods/bindings, and watchers relist exactly as clients of a compacted
+etcd would (TooOld on pre-restart resourceVersions).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import queue
 import threading
 from dataclasses import dataclass, field
@@ -92,7 +101,8 @@ class ObjectStore:
     """Thread-safe multi-kind object store. Objects are plain dicts in the k8s
     wire shape; metadata.resourceVersion is stamped on every write."""
 
-    def __init__(self):
+    def __init__(self, data_dir: Optional[str] = None,
+                 wal_compact_every: int = 4096, fsync: bool = False):
         self._lock = threading.Lock()
         self._rv = 0
         self._data: dict[str, dict[tuple[str, str], dict]] = {}
@@ -106,6 +116,23 @@ class ObjectStore:
         self._compacted: dict[str, int] = {}
         self._floor_rv = 0
         self._watchers: dict[str, list[queue.Queue]] = {}
+        self._data_dir = data_dir
+        self._wal_compact_every = wal_compact_every
+        self._fsync = fsync
+        self._wal = None
+        self._wal_count = 0
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._restore_locked()
+            self._wal = open(self._wal_path, "a", buffering=1)
+
+    @property
+    def _snap_path(self):
+        return os.path.join(self._data_dir, "snapshot.json")
+
+    @property
+    def _wal_path(self):
+        return os.path.join(self._data_dir, "wal.jsonl")
 
     # ---- internals -------------------------------------------------------
 
@@ -133,6 +160,74 @@ class ObjectStore:
             ws = self._watchers.get(kind, [])
             if q in ws:
                 ws.remove(q)
+
+    # ---- durability ------------------------------------------------------
+
+    def _journal_locked(self, entry: dict):
+        if self._wal is None:
+            return
+        self._wal.write(json.dumps(entry) + "\n")
+        if self._fsync:
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+        self._wal_count += 1
+        if self._wal_count >= self._wal_compact_every:
+            self._compact_wal_locked()
+
+    def _compact_wal_locked(self):
+        """Fold the journal into the snapshot: write snapshot.tmp, fsync,
+        rename (atomic on POSIX), truncate the WAL."""
+        blob = {kind: list(space.values())
+                for kind, space in self._data.items()}
+        tmp = self._snap_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"rv": self._rv, "data": blob}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path)
+        if self._wal is not None:
+            self._wal.close()
+        self._wal = open(self._wal_path, "w", buffering=1)
+        self._wal_count = 0
+
+    def _restore_locked(self):
+        """Snapshot + WAL tail -> memory. Called once from __init__ (no
+        watchers exist yet); a torn trailing WAL line (crash mid-write) is
+        discarded, matching a write that never committed."""
+        if os.path.exists(self._snap_path):
+            with open(self._snap_path) as f:
+                data = json.load(f)
+            self._rv = data["rv"]
+            self._data = {kind: {tuple(obj_key(o)): o for o in objs}
+                          for kind, objs in data["data"].items()}
+        if os.path.exists(self._wal_path):
+            with open(self._wal_path) as f:
+                for line in f:
+                    try:
+                        e = json.loads(line)
+                    except json.JSONDecodeError:
+                        break  # torn tail: uncommitted write
+                    rv = int(e["rv"])
+                    if rv <= self._rv:
+                        # already folded into the snapshot (crash between
+                        # snapshot rename and WAL truncate)
+                        continue
+                    space = self._data.setdefault(e["kind"], {})
+                    if e["op"] == "set":
+                        space[(e["ns"], e["name"])] = e["obj"]
+                    elif e["op"] == "del":
+                        space.pop((e["ns"], e["name"]), None)
+                    self._rv = max(self._rv, rv)
+        self._floor_rv = self._rv
+        # re-seed the ClusterIP allocator past every restored Service
+        seq = 0
+        for (_ns, _n), svc in self._data.get("Service", {}).items():
+            ip = (svc.get("spec") or {}).get("clusterIP") or ""
+            parts = ip.split(".")
+            if len(parts) == 4 and ip.startswith("10.96."):
+                seq = max(seq, int(parts[2]) * 250 + int(parts[3]) - 1)
+        if seq:
+            self._svc_ip_seq = seq
 
     # ---- CRUD ------------------------------------------------------------
 
@@ -170,6 +265,8 @@ class ObjectStore:
                 import time as _time
                 md["creationTimestamp"] = _time.time()
             space[k] = obj
+            self._journal_locked({"op": "set", "kind": kind, "ns": k[0],
+                                  "name": k[1], "rv": rv, "obj": obj})
             self._emit_locked(kind, Event(ADDED, obj, rv))
             return json.loads(json.dumps(obj))
 
@@ -208,6 +305,8 @@ class ObjectStore:
             obj = json.loads(json.dumps(obj))
             obj.setdefault("metadata", {})["resourceVersion"] = str(rv)
             space[k] = obj
+            self._journal_locked({"op": "set", "kind": kind, "ns": k[0],
+                                  "name": k[1], "rv": rv, "obj": obj})
             self._emit_locked(kind, Event(MODIFIED, obj, rv))
             return json.loads(json.dumps(obj))
 
@@ -220,6 +319,8 @@ class ObjectStore:
             obj = json.loads(json.dumps(space.pop(k)))
             rv = self._bump_locked()
             obj["metadata"]["resourceVersion"] = str(rv)
+            self._journal_locked({"op": "del", "kind": kind, "ns": k[0],
+                                  "name": k[1], "rv": rv})
             self._emit_locked(kind, Event(DELETED, obj, rv))
             return obj
 
@@ -270,6 +371,15 @@ class ObjectStore:
                 for q in qs:
                     q.put(Event(ERROR, {}, self._rv))
             self._watchers = {}
+            if self._wal is not None:
+                # re-sync durable state with the explicitly loaded blob
+                self._compact_wal_locked()
+
+    def close(self):
+        with self._lock:
+            if self._wal is not None:
+                self._wal.close()
+                self._wal = None
 
     @property
     def resource_version(self) -> int:

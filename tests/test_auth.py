@@ -1,0 +1,169 @@
+"""Authn/authz/audit chain (store/auth.py + apiserver filter order).
+
+Reference: DefaultBuildHandlerChain (apiserver/pkg/server/config.go) —
+authenticate (401) -> audit -> impersonation -> APF -> authorize (403);
+RBAC semantics from plugin/pkg/auth/authorizer/rbac.
+"""
+
+import pytest
+
+from kubernetes_tpu.client.clientset import ApiError, HTTPClient
+from kubernetes_tpu.store.apiserver import APIServer
+from kubernetes_tpu.store.auth import (
+    AuditLog,
+    RBACAuthorizer,
+    TokenAuthenticator,
+    UserInfo,
+)
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+@pytest.fixture()
+def server():
+    auth = (TokenAuthenticator(allow_anonymous=False)
+            .add("admin-token", ("admin", ("system:masters",)))
+            .add("sched-token", ("system:kube-scheduler", ()))
+            .add("dev-token", ("dev", ("app-team",))))
+    s = APIServer().enable_auth(authenticator=auth).start()
+    yield s
+    s.stop()
+
+
+def admin(server):
+    return HTTPClient(server.url, token="admin-token")
+
+
+def test_unauthenticated_401(server):
+    c = HTTPClient(server.url)
+    with pytest.raises(ApiError) as ei:
+        c.pods().list()
+    assert ei.value.code == 401
+
+
+def test_bad_token_401(server):
+    c = HTTPClient(server.url, token="nope")
+    with pytest.raises(ApiError) as ei:
+        c.pods().list()
+    assert ei.value.code == 401
+
+
+def test_masters_group_bypasses_authz(server):
+    c = admin(server)
+    c.nodes().create(make_node("n1").capacity({"cpu": "4"}).obj().to_dict())
+    assert [n["metadata"]["name"] for n in c.nodes().list()] == ["n1"]
+
+
+def test_unbound_user_403(server):
+    c = HTTPClient(server.url, token="dev-token")
+    with pytest.raises(ApiError) as ei:
+        c.pods().list()
+    assert ei.value.code == 403
+
+
+def test_role_binding_scopes_to_namespace(server):
+    a = admin(server)
+    a.resource("roles", "team-a").create({
+        "apiVersion": "rbac/v1", "kind": "Role",
+        "metadata": {"name": "pod-editor", "namespace": "team-a"},
+        "rules": [{"verbs": ["get", "list", "create", "delete"],
+                   "resources": ["pods"]}]})
+    a.resource("rolebindings", "team-a").create({
+        "apiVersion": "rbac/v1", "kind": "RoleBinding",
+        "metadata": {"name": "dev-pods", "namespace": "team-a"},
+        "subjects": [{"kind": "Group", "name": "app-team"}],
+        "roleRef": {"kind": "Role", "name": "pod-editor"}})
+    dev = HTTPClient(server.url, token="dev-token")
+    pod = make_pod("p1").obj().to_dict()
+    pod["metadata"]["namespace"] = "team-a"
+    dev.pods("team-a").create(pod)
+    assert dev.pods("team-a").list()
+    # same verb in another namespace: denied
+    with pytest.raises(ApiError) as ei:
+        dev.pods("team-b").list()
+    assert ei.value.code == 403
+    # unlisted resource: denied
+    with pytest.raises(ApiError) as ei:
+        dev.nodes().list()
+    assert ei.value.code == 403
+    # unlisted verb (update): denied
+    with pytest.raises(ApiError) as ei:
+        dev.pods("team-a").update(pod)
+    assert ei.value.code == 403
+
+
+def test_scheduler_bootstrap_identity(server):
+    """The seeded system:kube-scheduler ClusterRole admits exactly the
+    scheduler's API surface: read pods/nodes, create bindings, not much
+    else."""
+    sched = HTTPClient(server.url, token="sched-token")
+    a = admin(server)
+    a.nodes().create(make_node("n1").capacity(
+        {"cpu": "4", "pods": "10"}).obj().to_dict())
+    a.pods().create(make_pod("w").req({"cpu": "1"}).obj().to_dict())
+    assert sched.pods().list()
+    assert sched.nodes().list()
+    sched.pods().bind("w", "n1")  # pods/binding create allowed
+    assert a.pods().get("w")["spec"]["nodeName"] == "n1"
+    with pytest.raises(ApiError) as ei:  # cannot create plain pods
+        sched.pods().create(make_pod("x").obj().to_dict())
+    assert ei.value.code == 403
+    with pytest.raises(ApiError) as ei:  # cannot delete nodes
+        sched.nodes().delete("n1")
+    assert ei.value.code == 403
+
+
+def test_impersonation(server):
+    a = HTTPClient(server.url, token="admin-token", impersonate="dev")
+    # admin impersonating unbound dev -> dev's (empty) permissions apply
+    with pytest.raises(ApiError) as ei:
+        a.pods().list()
+    assert ei.value.code == 403
+    # non-privileged user may not impersonate
+    d = HTTPClient(server.url, token="dev-token", impersonate="admin")
+    with pytest.raises(ApiError) as ei:
+        d.pods().list()
+    assert ei.value.code == 403
+
+
+def test_audit_log_records(server):
+    c = admin(server)
+    c.nodes().create(make_node("n1").obj().to_dict())
+    with pytest.raises(ApiError):
+        HTTPClient(server.url).pods().list()
+    evs = server.audit.events
+    assert any(e["user"] == "admin" and e["verb"] == "POST"
+               and e["code"] == 201 for e in evs)
+    assert any(e["code"] == 401 for e in evs)
+
+
+def test_anonymous_enabled_still_authorized():
+    s = APIServer().enable_auth(
+        authenticator=TokenAuthenticator(allow_anonymous=True)).start()
+    try:
+        c = HTTPClient(s.url)
+        with pytest.raises(ApiError) as ei:  # anonymous has no bindings
+            c.pods().list()
+        assert ei.value.code == 403
+    finally:
+        s.stop()
+
+
+def test_rbac_authorizer_unit():
+    from kubernetes_tpu.store.store import ObjectStore
+    st = ObjectStore()
+    st.create("ClusterRole", {
+        "kind": "ClusterRole", "metadata": {"name": "reader"},
+        "rules": [{"verbs": ["get", "list", "watch"],
+                   "resources": ["pods", "nodes"]}]})
+    st.create("ClusterRoleBinding", {
+        "kind": "ClusterRoleBinding", "metadata": {"name": "readers"},
+        "subjects": [{"kind": "Group", "name": "view"}],
+        "roleRef": {"kind": "ClusterRole", "name": "reader"}})
+    az = RBACAuthorizer(st)
+    viewer = UserInfo("alice", ("view",))
+    assert az.authorize(viewer, "list", "pods", "any-ns", "")
+    assert az.authorize(viewer, "get", "nodes", "", "n1")
+    assert not az.authorize(viewer, "create", "pods", "ns", "")
+    assert not az.authorize(viewer, "list", "secrets", "ns", "")
+    nobody = UserInfo("bob", ())
+    assert not az.authorize(nobody, "list", "pods", "ns", "")
