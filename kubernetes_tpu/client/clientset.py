@@ -260,6 +260,9 @@ class HTTPClient(_Handles):
             "/apis/storage.k8s.io/v1" if plural == "storageclasses" else
             "/apis/scheduling.k8s.io/v1" if plural == "priorityclasses" else
             "/apis/policy/v1" if plural == "poddisruptionbudgets" else
+            "/apis/batch/v1" if plural == "cronjobs" else
+            "/apis/autoscaling/v2" if plural == "horizontalpodautoscalers" else
+            "/apis/discovery.k8s.io/v1" if plural == "endpointslices" else
             "/apis/rbac.authorization.k8s.io/v1" if plural in RBAC_RESOURCES
             else "/api/v1")
         p = group

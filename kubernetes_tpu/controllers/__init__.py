@@ -6,19 +6,28 @@ analog wiring them over one shared informer factory.
 """
 
 from kubernetes_tpu.controllers.base import Controller, active_pods, controller_of
+from kubernetes_tpu.controllers.cronjob import CronJobController
 from kubernetes_tpu.controllers.daemonset import DaemonSetController
 from kubernetes_tpu.controllers.deployment import DeploymentController
+from kubernetes_tpu.controllers.disruption import DisruptionController
 from kubernetes_tpu.controllers.endpoints import EndpointsController
+from kubernetes_tpu.controllers.endpointslice import EndpointSliceController
 from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
+from kubernetes_tpu.controllers.hpa import HorizontalPodAutoscalerController
 from kubernetes_tpu.controllers.job import JobController
 from kubernetes_tpu.controllers.manager import ControllerManager
+from kubernetes_tpu.controllers.namespace import NamespaceController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.replicaset import ReplicaSetController
 from kubernetes_tpu.controllers.statefulset import StatefulSetController
+from kubernetes_tpu.controllers.ttlafterfinished import TTLAfterFinishedController
 
 __all__ = [
-    "Controller", "ControllerManager", "DaemonSetController",
-    "DeploymentController", "EndpointsController", "GarbageCollector",
-    "JobController", "NodeLifecycleController", "ReplicaSetController",
-    "StatefulSetController", "active_pods", "controller_of",
+    "Controller", "ControllerManager", "CronJobController",
+    "DaemonSetController", "DeploymentController", "DisruptionController",
+    "EndpointsController", "EndpointSliceController", "GarbageCollector",
+    "HorizontalPodAutoscalerController", "JobController",
+    "NamespaceController", "NodeLifecycleController", "ReplicaSetController",
+    "StatefulSetController", "TTLAfterFinishedController", "active_pods",
+    "controller_of",
 ]

@@ -53,6 +53,11 @@ class Controller:
 
     name = "controller"
     workers = 2
+    # time-driven controllers (cronjob schedule ticks, TTL expiry, HPA
+    # evaluation) set tick_interval and implement tick(); the base runs it
+    # on a timer alongside the workers (the upstream analog is the informer
+    # resync period re-delivering every object)
+    tick_interval: Optional[float] = None
 
     def __init__(self, client):
         self.client = client
@@ -94,7 +99,22 @@ class Controller:
                                  name=f"{self.name}-{i}")
             t.start()
             self._threads.append(t)
+        if self.tick_interval:
+            t = threading.Thread(target=self._tick_loop, daemon=True,
+                                 name=f"{self.name}-tick")
+            t.start()
+            self._threads.append(t)
         return self
+
+    def tick(self) -> None:
+        """Periodic work for time-driven controllers (see tick_interval)."""
+
+    def _tick_loop(self):
+        while not self._stop.wait(self.tick_interval):
+            try:
+                self.tick()
+            except Exception:
+                pass
 
     def stop(self):
         self._stop.set()

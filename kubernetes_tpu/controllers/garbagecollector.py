@@ -14,7 +14,8 @@ from kubernetes_tpu.store.apiserver import ALL_RESOURCES
 
 # kinds tracked in the ownership graph (plural -> kind, namespaced)
 GC_RESOURCES = ("pods", "replicasets", "deployments", "statefulsets",
-                "daemonsets", "jobs", "endpoints")
+                "daemonsets", "jobs", "cronjobs", "endpoints",
+                "endpointslices")
 
 
 class GarbageCollector:

@@ -12,20 +12,27 @@ from typing import Optional
 
 from kubernetes_tpu.client.informer import InformerFactory
 from kubernetes_tpu.client.leaderelection import LeaderElectionConfig, LeaderElector
+from kubernetes_tpu.controllers.cronjob import CronJobController
 from kubernetes_tpu.controllers.daemonset import DaemonSetController
 from kubernetes_tpu.controllers.deployment import DeploymentController
 from kubernetes_tpu.controllers.disruption import DisruptionController
 from kubernetes_tpu.controllers.endpoints import EndpointsController
+from kubernetes_tpu.controllers.endpointslice import EndpointSliceController
 from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
+from kubernetes_tpu.controllers.hpa import HorizontalPodAutoscalerController
 from kubernetes_tpu.controllers.job import JobController
+from kubernetes_tpu.controllers.namespace import NamespaceController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.pvbinder import PersistentVolumeController
 from kubernetes_tpu.controllers.replicaset import ReplicaSetController
 from kubernetes_tpu.controllers.statefulset import StatefulSetController
+from kubernetes_tpu.controllers.ttlafterfinished import TTLAfterFinishedController
 
 DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "daemonset",
-                       "statefulset", "endpoints", "nodelifecycle", "pvbinder",
-                       "disruption")
+                       "statefulset", "endpoints", "endpointslice",
+                       "nodelifecycle", "pvbinder", "disruption", "cronjob",
+                       "ttlafterfinished", "horizontalpodautoscaler",
+                       "namespace")
 
 
 class ControllerManager:
@@ -47,6 +54,11 @@ class ControllerManager:
             "nodelifecycle": NodeLifecycleController,
             "pvbinder": PersistentVolumeController,
             "disruption": DisruptionController,
+            "cronjob": CronJobController,
+            "ttlafterfinished": TTLAfterFinishedController,
+            "horizontalpodautoscaler": HorizontalPodAutoscalerController,
+            "namespace": NamespaceController,
+            "endpointslice": EndpointSliceController,
         }
         self.controllers = [ctors[n](client) for n in controllers]
         self.gc = GarbageCollector(client) if gc_enabled else None
@@ -116,6 +128,11 @@ def _informer_attr(c) -> str:
         "daemonset": "ds_informer",
         "statefulset": "ss_informer",
         "endpoints": "svc_informer",
+        "endpointslice": "svc_informer",
         "nodelifecycle": "node_informer",
         "pvbinder": "pvc_informer",
+        "cronjob": "cj_informer",
+        "ttlafterfinished": "job_informer",
+        "horizontalpodautoscaler": "hpa_informer",
+        "disruption": "pdb_informer",
     }.get(c.name, "")

@@ -61,6 +61,10 @@ APPS_RESOURCES = {
     "daemonsets": ("DaemonSet", True),
     "jobs": ("Job", True),
 }
+BATCH_RESOURCES = {"cronjobs": ("CronJob", True)}
+AUTOSCALING_RESOURCES = {
+    "horizontalpodautoscalers": ("HorizontalPodAutoscaler", True)}
+DISCOVERY_RESOURCES = {"endpointslices": ("EndpointSlice", True)}
 COORD_RESOURCES = {"leases": ("Lease", True)}
 POLICY_RESOURCES = {"poddisruptionbudgets": ("PodDisruptionBudget", True)}
 RBAC_RESOURCES = {
@@ -72,7 +76,8 @@ RBAC_RESOURCES = {
 
 ALL_RESOURCES = {**CORE_RESOURCES, **APPS_RESOURCES, **COORD_RESOURCES,
                  **STORAGE_RESOURCES, **SCHEDULING_RESOURCES,
-                 **RBAC_RESOURCES, **POLICY_RESOURCES}
+                 **RBAC_RESOURCES, **POLICY_RESOURCES, **BATCH_RESOURCES,
+                 **AUTOSCALING_RESOURCES, **DISCOVERY_RESOURCES}
 KIND_TO_PLURAL = {k: p for p, (k, _) in ALL_RESOURCES.items()}
 
 

@@ -1,0 +1,304 @@
+"""Breadth controllers: CronJob, TTL-after-finished, HPA, Namespace purge,
+EndpointSlice.
+
+Reference: pkg/controller/{cronjob,ttlafterfinished,podautoscaler,namespace,
+endpointslice}/.
+"""
+
+import calendar
+import time
+
+import pytest
+
+from kubernetes_tpu.client.clientset import ApiError, DirectClient
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.controllers import (
+    CronJobController,
+    EndpointSliceController,
+    HorizontalPodAutoscalerController,
+    NamespaceController,
+    TTLAfterFinishedController,
+)
+from kubernetes_tpu.controllers.cronjob import cron_matches, most_recent_schedule
+from kubernetes_tpu.controllers.hpa import USAGE_ANNOTATION
+from kubernetes_tpu.store.store import ObjectStore
+from kubernetes_tpu.testing.wrappers import make_pod
+
+
+def wait_until(fn, timeout=8.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+@pytest.fixture
+def client():
+    return DirectClient(ObjectStore())
+
+
+def run_controller(client, ctrl):
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    return ctrl, factory
+
+
+def stop(ctrl, factory):
+    ctrl.stop()
+    factory.stop_all()
+
+
+# ------------------------------------------------------------------ cronjob
+
+def _utc(y, mo, d, h, mi):
+    return float(calendar.timegm((y, mo, d, h, mi, 0, 0, 0, 0)))
+
+
+def test_cron_expression_parsing():
+    ts = _utc(2026, 7, 30, 12, 30)  # Thursday 12:30 UTC
+    assert cron_matches("30 12 * * *", ts)
+    assert cron_matches("*/15 * * * *", ts)
+    assert cron_matches("* * * * 4", ts)          # Thursday
+    assert not cron_matches("* * * * 0", ts)      # not Sunday
+    assert cron_matches("30 12 30 7 *", ts)
+    assert not cron_matches("31 12 * * *", ts)
+    assert cron_matches("0-45/5 12 * * *", ts)
+    # dow 7 == Sunday, including inside ranges and steps
+    sun = _utc(2026, 8, 2, 12, 30)  # Sunday
+    assert cron_matches("30 12 * * 7", sun)
+    assert cron_matches("30 12 * * 5-7", sun)       # Fri-Sun range
+    assert not cron_matches("30 12 * * 5-7", ts)    # Thursday outside it
+    assert cron_matches("30 12 * * */7", sun)       # {0,7} -> Sunday
+    # vixie OR-semantics when both dom and dow are restricted
+    assert cron_matches("30 12 2 * 4", sun)         # dom matches, dow doesn't
+
+
+def test_most_recent_schedule():
+    now = _utc(2026, 7, 30, 12, 34)
+    got = most_recent_schedule("30 12 * * *", now - 3600, now)
+    assert got == _utc(2026, 7, 30, 12, 30)
+    assert most_recent_schedule("0 1 * * *", now - 60, now) is None
+
+
+def test_cronjob_creates_and_forbids(client):
+    ctrl, factory = run_controller(client, CronJobController(client))
+    try:
+        client.resource("cronjobs").create({
+            "apiVersion": "batch/v1", "kind": "CronJob",
+            "metadata": {"name": "tick", "namespace": "default"},
+            "spec": {"schedule": "* * * * *",   # every minute
+                     "concurrencyPolicy": "Forbid",
+                     "jobTemplate": {"spec": {"parallelism": 1,
+                                              "template": {"spec": {
+                                                  "containers": [{"name": "c"}]}}}}}})
+        assert wait_until(lambda: client.resource("jobs").list())
+        jobs = client.resource("jobs").list()
+        assert len(jobs) == 1
+        ref = jobs[0]["metadata"]["ownerReferences"][0]
+        assert ref["kind"] == "CronJob" and ref["name"] == "tick"
+        # Forbid: while the job is active, no second job appears
+        time.sleep(1.5)
+        assert len(client.resource("jobs").list()) == 1
+        st = client.resource("cronjobs").get("tick").get("status") or {}
+        assert st.get("lastScheduleTime") and st.get("active")
+    finally:
+        stop(ctrl, factory)
+
+
+def test_cronjob_invalid_schedule_sets_condition(client):
+    ctrl, factory = run_controller(client, CronJobController(client))
+    try:
+        client.resource("cronjobs").create({
+            "apiVersion": "batch/v1", "kind": "CronJob",
+            "metadata": {"name": "bad", "namespace": "default"},
+            "spec": {"schedule": "@hourly",  # macros unsupported: 5 fields only
+                     "jobTemplate": {"spec": {}}}})
+        assert wait_until(lambda: (client.resource("cronjobs").get("bad")
+                                   .get("status") or {}).get("conditions"))
+        cond = client.resource("cronjobs").get("bad")["status"]["conditions"][0]
+        assert cond["type"] == "InvalidSchedule"
+        assert not client.resource("jobs").list()
+    finally:
+        stop(ctrl, factory)
+
+
+def test_cronjob_suspend(client):
+    ctrl, factory = run_controller(client, CronJobController(client))
+    try:
+        client.resource("cronjobs").create({
+            "apiVersion": "batch/v1", "kind": "CronJob",
+            "metadata": {"name": "paused", "namespace": "default"},
+            "spec": {"schedule": "* * * * *", "suspend": True,
+                     "jobTemplate": {"spec": {}}}})
+        time.sleep(1.5)
+        assert not client.resource("jobs").list()
+    finally:
+        stop(ctrl, factory)
+
+
+# --------------------------------------------------------------------- ttl
+
+def test_ttl_after_finished_deletes_job(client):
+    ctrl, factory = run_controller(client, TTLAfterFinishedController(client))
+    try:
+        client.resource("jobs").create({
+            "apiVersion": "apps/v1", "kind": "Job",
+            "metadata": {"name": "done", "namespace": "default"},
+            "spec": {"ttlSecondsAfterFinished": 0},
+            "status": {"conditions": [{"type": "Complete", "status": "True",
+                                       "lastTransitionTime": time.time() - 5}]}})
+        client.resource("jobs").create({
+            "apiVersion": "apps/v1", "kind": "Job",
+            "metadata": {"name": "running", "namespace": "default"},
+            "spec": {"ttlSecondsAfterFinished": 0}, "status": {}})
+        client.resource("jobs").create({
+            "apiVersion": "apps/v1", "kind": "Job",
+            "metadata": {"name": "no-ttl", "namespace": "default"},
+            "spec": {},
+            "status": {"conditions": [{"type": "Complete", "status": "True"}]}})
+        assert wait_until(lambda: {j["metadata"]["name"]
+                                   for j in client.resource("jobs").list()}
+                          == {"running", "no-ttl"})
+    finally:
+        stop(ctrl, factory)
+
+
+# --------------------------------------------------------------------- hpa
+
+def _deploy(replicas):
+    return {"apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"replicas": replicas,
+                     "selector": {"matchLabels": {"app": "web"}},
+                     "template": {"metadata": {"labels": {"app": "web"}},
+                                  "spec": {"containers": [{"name": "c"}]}}}}
+
+
+def _usage_pod(name, request, used):
+    p = make_pod(name).label("app", "web").req({"cpu": request}).obj().to_dict()
+    p["metadata"].setdefault("annotations", {})[USAGE_ANNOTATION] = used
+    p["spec"]["nodeName"] = "n1"
+    p["status"] = {"phase": "Running"}
+    return p
+
+
+def test_hpa_scales_up_and_respects_max(client):
+    ctrl, factory = run_controller(
+        client, HorizontalPodAutoscalerController(client))
+    try:
+        client.resource("deployments").create(_deploy(2))
+        for i in range(2):
+            client.pods().create(_usage_pod(f"w{i}", "1", "900m"))  # 90% used
+        client.resource("horizontalpodautoscalers").create({
+            "apiVersion": "autoscaling/v2", "kind": "HorizontalPodAutoscaler",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"scaleTargetRef": {"kind": "Deployment", "name": "web"},
+                     "minReplicas": 1, "maxReplicas": 3,
+                     "metrics": [{"type": "Resource", "resource": {
+                         "name": "cpu", "target": {
+                             "type": "Utilization",
+                             "averageUtilization": 50}}}]}})
+        # 90% actual vs 50% target -> ceil(2*1.8)=4, clamped to max 3
+        assert wait_until(lambda: client.resource("deployments")
+                          .get("web")["spec"]["replicas"] == 3)
+        st = client.resource("horizontalpodautoscalers").get("web")["status"]
+        assert st["desiredReplicas"] == 3
+        assert st["currentCPUUtilizationPercentage"] == 90.0
+    finally:
+        stop(ctrl, factory)
+
+
+def test_hpa_scale_down_stabilized(client):
+    ctrl, factory = run_controller(
+        client, HorizontalPodAutoscalerController(
+            client, downscale_stabilization_s=9999.0))
+    try:
+        client.resource("deployments").create(_deploy(3))
+        for i in range(3):
+            client.pods().create(_usage_pod(f"w{i}", "1", "100m"))  # 10% used
+        client.resource("horizontalpodautoscalers").create({
+            "apiVersion": "autoscaling/v2", "kind": "HorizontalPodAutoscaler",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"scaleTargetRef": {"kind": "Deployment", "name": "web"},
+                     "minReplicas": 1, "maxReplicas": 5,
+                     "metrics": [{"type": "Resource", "resource": {
+                         "name": "cpu", "target": {
+                             "type": "Utilization",
+                             "averageUtilization": 50}}}]}})
+        time.sleep(2.0)  # within the stabilization window: no scale-down yet
+        assert client.resource("deployments").get("web")["spec"]["replicas"] == 3
+    finally:
+        stop(ctrl, factory)
+
+
+# --------------------------------------------------------------- namespace
+
+def test_namespace_purge_on_delete(client):
+    ctrl = NamespaceController(client)
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    try:
+        client.resource("namespaces", None).create(
+            {"apiVersion": "v1", "kind": "Namespace",
+             "metadata": {"name": "team-a"}})
+        pod = make_pod("p1").obj().to_dict()
+        pod["metadata"]["namespace"] = "team-a"
+        client.pods("team-a").create(pod)
+        client.resource("configmaps", "team-a").create(
+            {"apiVersion": "v1", "kind": "ConfigMap",
+             "metadata": {"name": "cm", "namespace": "team-a"}})
+        keep = make_pod("keep").obj().to_dict()
+        client.pods("default").create(keep)
+        client.resource("namespaces", None).delete("team-a")
+        assert wait_until(lambda: not client.pods("team-a").list()
+                          and not client.resource("configmaps", "team-a").list())
+        assert client.pods("default").list()
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+# ------------------------------------------------------------ endpointslice
+
+def test_endpointslice_created_and_sliced(client):
+    ctrl, factory = run_controller(client, EndpointSliceController(client))
+    try:
+        client.services().create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"selector": {"app": "web"},
+                     "ports": [{"port": 80, "targetPort": 8080}]}})
+        for i in range(3):
+            p = make_pod(f"w{i}").label("app", "web").obj().to_dict()
+            p["status"] = {"phase": "Running", "podIP": f"10.0.0.{i+1}",
+                           "conditions": [{"type": "Ready", "status": "True"}]}
+            client.pods().create(p)
+
+        def ok():
+            slices = [s for s in client.resource("endpointslices").list()
+                      if (s["metadata"].get("labels") or {})
+                      .get("kubernetes.io/service-name") == "web"]
+            if len(slices) != 1:
+                return False
+            eps = slices[0].get("endpoints") or []
+            ips = sorted(e["addresses"][0] for e in eps)
+            return (ips == ["10.0.0.1", "10.0.0.2", "10.0.0.3"]
+                    and slices[0]["ports"][0]["port"] == 8080
+                    and all(e["conditions"]["ready"] for e in eps))
+        assert wait_until(ok)
+        # service deleted -> slices cleaned up
+        client.services().delete("web")
+        assert wait_until(lambda: not [
+            s for s in client.resource("endpointslices").list()
+            if (s["metadata"].get("labels") or {})
+            .get("kubernetes.io/service-name") == "web"])
+    finally:
+        stop(ctrl, factory)
