@@ -215,9 +215,19 @@ class LabelSelector:
 
 @dataclass
 class PodAffinityTerm:
+    """core/v1 PodAffinityTerm. ``namespaces`` + ``namespaceSelector`` pick the
+    target namespaces (both empty/nil = the term-owning pod's own namespace; a
+    set namespaceSelector ORs with the explicit list; an EMPTY selector {}
+    matches all namespaces). ``matchLabelKeys``/``mismatchLabelKeys`` merge the
+    owning pod's label values into the selector as In/NotIn requirements at
+    scheduling time (MatchLabelKeysInPodAffinity)."""
+
     topology_key: str
     label_selector: Optional[LabelSelector] = None
     namespaces: list[str] = field(default_factory=list)  # empty = pod's own namespace
+    namespace_selector: Optional[LabelSelector] = None
+    match_label_keys: list[str] = field(default_factory=list)
+    mismatch_label_keys: list[str] = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: dict) -> "PodAffinityTerm":
@@ -225,6 +235,9 @@ class PodAffinityTerm:
             topology_key=d.get("topologyKey", ""),
             label_selector=LabelSelector.from_dict(d.get("labelSelector")),
             namespaces=list(d.get("namespaces") or []),
+            namespace_selector=LabelSelector.from_dict(d.get("namespaceSelector")),
+            match_label_keys=list(d.get("matchLabelKeys") or []),
+            mismatch_label_keys=list(d.get("mismatchLabelKeys") or []),
         )
 
     def to_dict(self) -> dict:
@@ -233,6 +246,12 @@ class PodAffinityTerm:
             d["labelSelector"] = self.label_selector.to_dict()
         if self.namespaces:
             d["namespaces"] = list(self.namespaces)
+        if self.namespace_selector is not None:
+            d["namespaceSelector"] = self.namespace_selector.to_dict()
+        if self.match_label_keys:
+            d["matchLabelKeys"] = list(self.match_label_keys)
+        if self.mismatch_label_keys:
+            d["mismatchLabelKeys"] = list(self.mismatch_label_keys)
         return d
 
 
@@ -375,20 +394,41 @@ UNSATISFIABLE_DO_NOT_SCHEDULE = "DoNotSchedule"
 UNSATISFIABLE_SCHEDULE_ANYWAY = "ScheduleAnyway"
 
 
+NODE_INCLUSION_HONOR = "Honor"
+NODE_INCLUSION_IGNORE = "Ignore"
+
+
 @dataclass
 class TopologySpreadConstraint:
+    """core/v1 TopologySpreadConstraint. ``min_domains`` (DoNotSchedule only):
+    if fewer eligible domains exist, the global minimum is treated as 0.
+    ``node_affinity_policy``/``node_taints_policy`` control whether nodes
+    failing the pod's nodeSelector/nodeAffinity (default: Honor = excluded)
+    or carrying untolerated taints (default: Ignore = included) count when
+    computing skew. ``match_label_keys`` merge the pod's own label values
+    into the selector as In requirements."""
+
     max_skew: int
     topology_key: str
     when_unsatisfiable: str = UNSATISFIABLE_DO_NOT_SCHEDULE
     label_selector: Optional[LabelSelector] = None
+    min_domains: Optional[int] = None
+    node_affinity_policy: str = NODE_INCLUSION_HONOR
+    node_taints_policy: str = NODE_INCLUSION_IGNORE
+    match_label_keys: list[str] = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: dict) -> "TopologySpreadConstraint":
+        md = d.get("minDomains")
         return cls(
             max_skew=int(d.get("maxSkew", 1)),
             topology_key=d.get("topologyKey", ""),
             when_unsatisfiable=d.get("whenUnsatisfiable", UNSATISFIABLE_DO_NOT_SCHEDULE),
             label_selector=LabelSelector.from_dict(d.get("labelSelector")),
+            min_domains=int(md) if md is not None else None,
+            node_affinity_policy=d.get("nodeAffinityPolicy", NODE_INCLUSION_HONOR),
+            node_taints_policy=d.get("nodeTaintsPolicy", NODE_INCLUSION_IGNORE),
+            match_label_keys=list(d.get("matchLabelKeys") or []),
         )
 
     def to_dict(self) -> dict:
@@ -399,6 +439,14 @@ class TopologySpreadConstraint:
         }
         if self.label_selector is not None:
             d["labelSelector"] = self.label_selector.to_dict()
+        if self.min_domains is not None:
+            d["minDomains"] = self.min_domains
+        if self.node_affinity_policy != NODE_INCLUSION_HONOR:
+            d["nodeAffinityPolicy"] = self.node_affinity_policy
+        if self.node_taints_policy != NODE_INCLUSION_IGNORE:
+            d["nodeTaintsPolicy"] = self.node_taints_policy
+        if self.match_label_keys:
+            d["matchLabelKeys"] = list(self.match_label_keys)
         return d
 
 

@@ -37,6 +37,8 @@ from kubernetes_tpu.api.types import (
     EFFECT_NO_EXECUTE,
     EFFECT_NO_SCHEDULE,
     EFFECT_PREFER_NO_SCHEDULE,
+    NODE_INCLUSION_HONOR,
+    NODE_INCLUSION_IGNORE,
     OP_DOES_NOT_EXIST,
     OP_EXISTS,
     OP_GT,
@@ -52,6 +54,11 @@ from kubernetes_tpu.api.types import (
 )
 from kubernetes_tpu.encode.dictionary import StringTable, next_bucket
 from kubernetes_tpu.encode.scaling import UNLIMITED, scale_allocatable, scale_request
+from kubernetes_tpu.encode.termprep import (
+    affinity_term_selector,
+    resolve_term_namespaces,
+    spread_selector,
+)
 
 # --- integer op/effect codes used inside tensors -------------------------------
 
@@ -147,6 +154,10 @@ class ClusterTensors(struct.PyTreeNode):
     ea_sel: "SelectorSet"  # [E,ET,...]
     ea_topo: Any           # [E,ET] int32
     ea_valid: Any          # [E,ET] bool
+    # terms with explicit namespaces/namespaceSelector: resolved ns-id mask
+    # (False rows = "owning pod's own namespace" semantics)
+    ea_ns_explicit: Any    # [E,ET] bool
+    ea_ns_mask: Any        # [E,ET,NSB] bool over interned namespace ids
     # volumes (VolumeRestrictions / NodeVolumeLimits node side)
     used_rwo: Any          # [N,VN] int32 pv-name id of node-exclusive PVs in use
     used_rwo_valid: Any    # [N,VN] bool
@@ -188,18 +199,27 @@ class PodBatch(struct.PyTreeNode):
     aff_sel: SelectorSet    # [P,AT,...] required pod-affinity selectors
     aff_topo: Any           # [P,AT] int32 topology key-id
     aff_valid: Any          # [P,AT] bool
+    aff_ns_explicit: Any    # [P,AT] bool: term has explicit namespaces
+    aff_ns_mask: Any        # [P,AT,NSB] bool: resolved namespace-id set
     anti_sel: SelectorSet   # [P,BT,...] required anti-affinity selectors
     anti_topo: Any          # [P,BT] int32
     anti_valid: Any         # [P,BT] bool
+    anti_ns_explicit: Any   # [P,BT] bool
+    anti_ns_mask: Any       # [P,BT,NSB] bool
     paff_sel: SelectorSet   # [P,CT,...] preferred pod-affinity selectors
     paff_topo: Any          # [P,CT] int32
     paff_weight: Any        # [P,CT] float32 (negative for preferred anti-affinity)
     paff_valid: Any         # [P,CT] bool
+    paff_ns_explicit: Any   # [P,CT] bool
+    paff_ns_mask: Any       # [P,CT,NSB] bool
     sc_sel: SelectorSet     # [P,SC,...] spread-constraint selectors
     sc_topo: Any            # [P,SC] int32
     sc_maxskew: Any         # [P,SC] int32
     sc_hard: Any            # [P,SC] bool (DoNotSchedule)
     sc_valid: Any           # [P,SC] bool
+    sc_min_domains: Any     # [P,SC] int32 (0 = unset)
+    sc_honor_affinity: Any  # [P,SC] bool: nodeAffinityPolicy == Honor
+    sc_honor_taints: Any    # [P,SC] bool: nodeTaintsPolicy == Honor
     # volumes (VolumeBinding/VolumeZone as grouped node-selector terms:
     # OR within a group = any candidate PV; AND across groups = every PVC)
     vol_terms: TermSet      # [P,VT,...]
@@ -231,6 +251,7 @@ class _PatchState:
     ET: int
     EAX: int
     EAV: int
+    NSB: int
     slot_of: dict[str, int] = dc_field(default_factory=dict)
     free: list[int] = dc_field(default_factory=list)
     slot_node: dict[str, int] = dc_field(default_factory=dict)
@@ -287,6 +308,10 @@ class SnapshotEncoder:
         self._image_sizes: list[float] = []
         self._cluster_topo_keys: set[int] = set()
         self._volumes = None  # VolumeCatalog | None
+        self._namespace_labels: dict[str, dict] = {}
+        # does any encoded existing-pod anti term carry a namespaceSelector?
+        # (only then does the cluster encoding depend on namespace labels)
+        self._cluster_ns_selector_terms = False
         self._rwop_in_use: set = set()
         self._patch: Optional[_PatchState] = None
         self.generation = 0
@@ -295,6 +320,19 @@ class SnapshotEncoder:
         """Attach the PVC/PV/StorageClass catalog consulted by the next
         encode_cluster/encode_pods pair (sched/volumebinding.VolumeCatalog)."""
         self._volumes = catalog
+
+    def set_namespaces(self, namespace_labels: dict[str, dict]) -> None:
+        """Attach the namespace-name -> labels snapshot used to resolve
+        affinity terms' namespaceSelector (GetNamespaceLabelsSnapshot
+        analog)."""
+        self._namespace_labels = dict(namespace_labels or {})
+
+    @property
+    def cluster_depends_on_namespace_labels(self) -> bool:
+        """True when the last cluster encoding resolved a namespaceSelector,
+        i.e. namespace-label churn invalidates it (vs. only affecting future
+        pod batches, which always read the fresh snapshot)."""
+        return self._cluster_ns_selector_terms
 
     # -- small helpers ------------------------------------------------------
 
@@ -343,18 +381,31 @@ class SnapshotEncoder:
         epod_label_ids = [self._label_ids(p.metadata.labels) for p in epods]
 
         # existing pods' required anti-affinity terms (symmetry veto) — compile
-        # before fixing K so their keys are covered by the bucket.
+        # before fixing K so their keys are covered by the bucket. Terms are
+        # normalized host-side (encode/termprep.py): matchLabelKeys merged
+        # into the selector using the OWNING pod's labels, namespaces +
+        # namespaceSelector resolved to interned-id lists (None = own ns).
+        self._cluster_ns_selector_terms = False
+
         def _anti_terms(p: Pod) -> list:
             aff = p.spec.affinity
             pan = aff.pod_anti_affinity if aff else None
             terms = []
             for t in (pan.required if pan else []):
-                valid, exprs = self._compile_selector(t.label_selector)
-                terms.append((self.keys.intern(t.topology_key), valid, exprs))
+                eff = affinity_term_selector(t, p.metadata.labels)
+                valid, exprs = self._compile_selector(eff)
+                if t.namespace_selector is not None:
+                    self._cluster_ns_selector_terms = True
+                ns_set = resolve_term_namespaces(
+                    t, p.metadata.namespace, self._namespace_labels)
+                ns_ids = (None if ns_set is None else
+                          tuple(self.namespaces.intern(n) for n in sorted(ns_set)))
+                terms.append((self.keys.intern(t.topology_key), valid, exprs,
+                              ns_ids))
             return terms
 
         ea_terms = [_anti_terms(p) for p in epods]
-        self._cluster_topo_keys = {k for ts in ea_terms for (k, _, _) in ts}
+        self._cluster_topo_keys = {k for ts in ea_terms for (k, _, _, _) in ts}
         # Pre-intern pending pods' labels + anti terms and leave slot headroom
         # so that when they bind, the incremental patch path (apply_pod_deltas)
         # fits them without a full re-encode.
@@ -362,8 +413,14 @@ class SnapshotEncoder:
         pend_terms = []
         for p in pend:
             self._label_ids(p.metadata.labels)
+            self.namespaces.intern(p.metadata.namespace)
             pend_terms.append(_anti_terms(p))
+        for p in epods:
+            self.namespaces.intern(p.metadata.namespace)
         K = next_bucket(len(self.keys), minimum=1)
+        # namespace-mask width: covers every id interned so far (epods, pend
+        # pods, and all resolved term sets), so patches stay in-bucket
+        NSB = next_bucket(len(self.namespaces), minimum=1)
 
         allocatable = np.zeros((N, R), np.int32)
         requested = np.zeros((N, R), np.int32)
@@ -435,17 +492,23 @@ class SnapshotEncoder:
 
         all_terms = ea_terms + pend_terms
         ET = next_bucket(max((len(t) for t in all_terms), default=0))
-        EAX = next_bucket(max((len(ex) for ts in all_terms for (_, _, ex) in ts), default=0))
-        EAV = next_bucket(max((len(v) for ts in all_terms for (_, _, ex) in ts
+        EAX = next_bucket(max((len(ex) for ts in all_terms for (_, _, ex, _) in ts), default=0))
+        EAV = next_bucket(max((len(v) for ts in all_terms for (_, _, ex, _) in ts
                                for (_, _, v, _) in ex), default=0))
         ea_arrs = _selset_arrays((E, ET), EAX, EAV)
         ea_topo = np.full((E, ET), -1, np.int32)
         ea_valid = np.zeros((E, ET), bool)
+        ea_ns_explicit = np.zeros((E, ET), bool)
+        ea_ns_mask = np.zeros((E, ET, NSB), bool)
         for e, terms in enumerate(ea_terms):
-            for t_idx, (topo, valid, exprs) in enumerate(terms):
+            for t_idx, (topo, valid, exprs, ns_ids) in enumerate(terms):
                 ea_topo[e, t_idx] = topo
                 ea_valid[e, t_idx] = True
                 _selset_fill(ea_arrs, (e, t_idx), valid, exprs)
+                if ns_ids is not None:
+                    ea_ns_explicit[e, t_idx] = True
+                    for nid in ns_ids:
+                        ea_ns_mask[e, t_idx, nid] = True
 
         # volumes: node-side VolumeRestrictions / NodeVolumeLimits state
         from kubernetes_tpu.sched.volumebinding import (
@@ -487,7 +550,7 @@ class SnapshotEncoder:
         self._patch = _PatchState(
             generation=self.generation, resources=resources,
             res_index={r: i for i, r in enumerate(resources)},
-            node_index=node_index, K=K, ET=ET, EAX=EAX, EAV=EAV,
+            node_index=node_index, K=K, ET=ET, EAX=EAX, EAV=EAV, NSB=NSB,
             slot_of={p.key: e for e, p in enumerate(epods)},
             free=list(range(len(epods), E))[::-1],
             slot_node={p.key: node_index[p.spec.node_name] for p in epods},
@@ -507,6 +570,7 @@ class SnapshotEncoder:
             epod_node=epod_node, epod_ns=epod_ns, epod_labels=epod_labels,
             epod_valid=epod_valid,
             ea_sel=SelectorSet(**ea_arrs), ea_topo=ea_topo, ea_valid=ea_valid,
+            ea_ns_explicit=ea_ns_explicit, ea_ns_mask=ea_ns_mask,
             used_rwo=used_rwo, used_rwo_valid=used_rwo_valid,
             attach_used=attach_used, attach_limit=attach_limit,
             nom_node=np.zeros(0, np.int32), nom_prio=np.zeros(0, np.int32),
@@ -585,13 +649,23 @@ class SnapshotEncoder:
             pan = aff.pod_anti_affinity if aff else None
             terms = []
             for t in (pan.required if pan else []):
-                valid, exprs = self._compile_selector(t.label_selector)
-                terms.append((self.keys.intern(t.topology_key), valid, exprs))
+                eff = affinity_term_selector(t, p.metadata.labels)
+                valid, exprs = self._compile_selector(eff)
+                if t.namespace_selector is not None:
+                    self._cluster_ns_selector_terms = True
+                ns_set = resolve_term_namespaces(
+                    t, p.metadata.namespace, self._namespace_labels)
+                ns_ids = (None if ns_set is None else
+                          tuple(self.namespaces.intern(n) for n in sorted(ns_set)))
+                terms.append((self.keys.intern(t.topology_key), valid, exprs,
+                              ns_ids))
             if (len(terms) > st.ET
-                    or any(len(ex) > st.EAX for (_, _, ex) in terms)
-                    or any(len(v) > st.EAV for (_, _, ex) in terms
-                           for (_, _, v, _) in ex)):
-                return None
+                    or any(len(ex) > st.EAX for (_, _, ex, _) in terms)
+                    or any(len(v) > st.EAV for (_, _, ex, _) in terms
+                           for (_, _, v, _) in ex)
+                    or any(nid >= st.NSB for (_, _, _, ns) in terms
+                           if ns is not None for nid in ns)):
+                return None  # ns beyond the NSB bucket widens the mask
             compiled.append((p, ni, label_ids, terms,
                              self._request_vector(p, st.resources)))
 
@@ -610,6 +684,8 @@ class SnapshotEncoder:
               for f in ("key", "op", "vals", "expr_valid", "valid")}
         ea_topo = np.array(ct.ea_topo)
         ea_valid = np.array(ct.ea_valid)
+        ea_ns_explicit = np.array(ct.ea_ns_explicit)
+        ea_ns_mask = np.array(ct.ea_ns_mask)
 
         def _clear(slot: int):
             epod_valid[slot] = False
@@ -620,6 +696,8 @@ class SnapshotEncoder:
             ea["expr_valid"][slot, :, :] = False
             ea["key"][slot, :, :] = -1
             ea["vals"][slot, :, :, :] = -1
+            ea_ns_explicit[slot, :] = False
+            ea_ns_mask[slot, :, :] = False
 
         for k in set(deletes):
             slot = st.slot_of.pop(k, None)
@@ -644,10 +722,14 @@ class SnapshotEncoder:
             for kid, vid in label_ids.items():
                 epod_labels[slot, kid] = vid
             epod_valid[slot] = True
-            for t_idx, (topo, valid, exprs) in enumerate(terms):
+            for t_idx, (topo, valid, exprs, ns_ids) in enumerate(terms):
                 ea_topo[slot, t_idx] = topo
                 ea_valid[slot, t_idx] = True
                 _selset_fill(ea, (slot, t_idx), valid, exprs)
+                if ns_ids is not None:
+                    ea_ns_explicit[slot, t_idx] = True
+                    for nid in ns_ids:
+                        ea_ns_mask[slot, t_idx, nid] = True
                 new_topo.add(topo)
             requested[ni] += req_vec
             st.slot_node[key] = ni
@@ -660,6 +742,7 @@ class SnapshotEncoder:
             requested=requested, epod_node=epod_node, epod_ns=epod_ns,
             epod_labels=epod_labels, epod_valid=epod_valid,
             ea_sel=SelectorSet(**ea), ea_topo=ea_topo, ea_valid=ea_valid,
+            ea_ns_explicit=ea_ns_explicit, ea_ns_mask=ea_ns_mask,
         )
 
     # -- selector compilation ----------------------------------------------
@@ -735,11 +818,19 @@ class SnapshotEncoder:
             pan = aff.pod_anti_affinity if aff else None
             own_ns = self.namespaces.intern(p.metadata.namespace)
 
+            def _term_ns(t):
+                ns_set = resolve_term_namespaces(
+                    t, p.metadata.namespace, self._namespace_labels)
+                return (None if ns_set is None else
+                        tuple(self.namespaces.intern(n) for n in sorted(ns_set)))
+
             def _pod_terms(terms):
                 out = []
                 for t in terms:
-                    valid, exprs = self._compile_selector(t.label_selector)
-                    out.append((self.keys.intern(t.topology_key), valid, exprs))
+                    eff = affinity_term_selector(t, p.metadata.labels)
+                    valid, exprs = self._compile_selector(eff)
+                    out.append((self.keys.intern(t.topology_key), valid, exprs,
+                                _term_ns(t)))
                 return out
 
             aff_req = _pod_terms(pa.required if pa else [])
@@ -747,18 +838,26 @@ class SnapshotEncoder:
             paff = []
             for wt in (pa.preferred if pa else []):
                 kid = self.keys.intern(wt.term.topology_key)
-                valid, exprs = self._compile_selector(wt.term.label_selector)
-                paff.append((kid, valid, exprs, float(wt.weight)))
+                eff = affinity_term_selector(wt.term, p.metadata.labels)
+                valid, exprs = self._compile_selector(eff)
+                paff.append((kid, valid, exprs, float(wt.weight),
+                             _term_ns(wt.term)))
             for wt in (pan.preferred if pan else []):
                 kid = self.keys.intern(wt.term.topology_key)
-                valid, exprs = self._compile_selector(wt.term.label_selector)
-                paff.append((kid, valid, exprs, -float(wt.weight)))
+                eff = affinity_term_selector(wt.term, p.metadata.labels)
+                valid, exprs = self._compile_selector(eff)
+                paff.append((kid, valid, exprs, -float(wt.weight),
+                             _term_ns(wt.term)))
             spreads = []
             for sc in p.spec.topology_spread_constraints:
-                valid, exprs = self._compile_selector(sc.label_selector)
+                eff = spread_selector(sc, p.metadata.labels)
+                valid, exprs = self._compile_selector(eff)
                 spreads.append((self.keys.intern(sc.topology_key), valid, exprs,
                                 int(sc.max_skew),
-                                sc.when_unsatisfiable == "DoNotSchedule"))
+                                sc.when_unsatisfiable == "DoNotSchedule",
+                                int(sc.min_domains or 0),
+                                sc.node_affinity_policy != NODE_INCLUSION_IGNORE,
+                                sc.node_taints_policy == NODE_INCLUSION_HONOR))
             labels = self._label_ids(p.metadata.labels)
             # volumes: PVC groups -> (group_id, compiled term) pairs
             from kubernetes_tpu.sched.volumebinding import compile_pod_volumes
@@ -800,15 +899,17 @@ class SnapshotEncoder:
         BT = _bucket(lambda c: len(c["anti_req"]))
         CT = _bucket(lambda c: len(c["paff"]))
         SC = _bucket(lambda c: len(c["spreads"]))
-        AX = _bucket(lambda c: max((len(e) for (_, _, e) in c["aff_req"] + c["anti_req"]), default=0))
-        AX = max(AX, _bucket(lambda c: max((len(e) for (_, _, e, _) in c["paff"]), default=0)))
-        AX = max(AX, _bucket(lambda c: max((len(e) for (_, _, e, _, _) in c["spreads"]), default=0)))
-        AV = _bucket(lambda c: max((len(v) for (_, _, e) in c["aff_req"] + c["anti_req"]
+        AX = _bucket(lambda c: max((len(e) for (_, _, e, _) in c["aff_req"] + c["anti_req"]), default=0))
+        AX = max(AX, _bucket(lambda c: max((len(e) for (_, _, e, _, _) in c["paff"]), default=0)))
+        AX = max(AX, _bucket(lambda c: max((len(t[2]) for t in c["spreads"]), default=0)))
+        AV = _bucket(lambda c: max((len(v) for (_, _, e, _) in c["aff_req"] + c["anti_req"]
                                     for (_, _, v, _) in e), default=0))
-        AV = max(AV, _bucket(lambda c: max((len(v) for (_, _, e, _) in c["paff"]
+        AV = max(AV, _bucket(lambda c: max((len(v) for (_, _, e, _, _) in c["paff"]
                                             for (_, _, v, _) in e), default=0)))
-        AV = max(AV, _bucket(lambda c: max((len(v) for (_, _, e, _, _) in c["spreads"]
-                                            for (_, _, v, _) in e), default=0)))
+        AV = max(AV, _bucket(lambda c: max((len(v) for t in c["spreads"]
+                                            for (_, _, v, _) in t[2]), default=0)))
+        # namespace-mask width: all term ns sets are already interned above
+        NSB = next_bucket(len(self.namespaces), minimum=1)
 
         def _new_termset(T):
             return dict(
@@ -872,18 +973,33 @@ class SnapshotEncoder:
         aff_sel = _new_selset((P, AT))
         aff_topo = np.full((P, AT), -1, np.int32)
         aff_valid = np.zeros((P, AT), bool)
+        aff_ns_explicit = np.zeros((P, AT), bool)
+        aff_ns_mask = np.zeros((P, AT, NSB), bool)
         anti_sel = _new_selset((P, BT))
         anti_topo = np.full((P, BT), -1, np.int32)
         anti_valid = np.zeros((P, BT), bool)
+        anti_ns_explicit = np.zeros((P, BT), bool)
+        anti_ns_mask = np.zeros((P, BT, NSB), bool)
         paff_sel = _new_selset((P, CT))
         paff_topo = np.full((P, CT), -1, np.int32)
         paff_weight = np.zeros((P, CT), np.float32)
         paff_valid = np.zeros((P, CT), bool)
+        paff_ns_explicit = np.zeros((P, CT), bool)
+        paff_ns_mask = np.zeros((P, CT, NSB), bool)
         sc_sel = _new_selset((P, SC))
         sc_topo = np.full((P, SC), -1, np.int32)
         sc_maxskew = np.ones((P, SC), np.int32)
         sc_hard = np.zeros((P, SC), bool)
         sc_valid = np.zeros((P, SC), bool)
+        sc_min_domains = np.zeros((P, SC), np.int32)
+        sc_honor_affinity = np.zeros((P, SC), bool)
+        sc_honor_taints = np.zeros((P, SC), bool)
+
+        def _fill_ns(explicit, mask, p_idx, t_idx, ns_ids):
+            if ns_ids is not None:
+                explicit[p_idx, t_idx] = True
+                for nid in ns_ids:
+                    mask[p_idx, t_idx, nid] = True
 
         for i, c in enumerate(compiled):
             p: Pod = c["pod"]
@@ -928,24 +1044,31 @@ class SnapshotEncoder:
             for ci_idx, img in enumerate(c["images"]):
                 pod_images[i, ci_idx] = img
                 image_bytes[i] += self._image_sizes[img]
-            for a_idx, (topo, valid, exprs) in enumerate(c["aff_req"]):
+            for a_idx, (topo, valid, exprs, ns_ids) in enumerate(c["aff_req"]):
                 aff_topo[i, a_idx] = topo
                 aff_valid[i, a_idx] = True
                 _fill_sel(aff_sel, (i, a_idx), valid, exprs)
-            for a_idx, (topo, valid, exprs) in enumerate(c["anti_req"]):
+                _fill_ns(aff_ns_explicit, aff_ns_mask, i, a_idx, ns_ids)
+            for a_idx, (topo, valid, exprs, ns_ids) in enumerate(c["anti_req"]):
                 anti_topo[i, a_idx] = topo
                 anti_valid[i, a_idx] = True
                 _fill_sel(anti_sel, (i, a_idx), valid, exprs)
-            for a_idx, (topo, valid, exprs, w) in enumerate(c["paff"]):
+                _fill_ns(anti_ns_explicit, anti_ns_mask, i, a_idx, ns_ids)
+            for a_idx, (topo, valid, exprs, w, ns_ids) in enumerate(c["paff"]):
                 paff_topo[i, a_idx] = topo
                 paff_weight[i, a_idx] = w
                 paff_valid[i, a_idx] = True
                 _fill_sel(paff_sel, (i, a_idx), valid, exprs)
-            for a_idx, (topo, valid, exprs, skew, hard) in enumerate(c["spreads"]):
+                _fill_ns(paff_ns_explicit, paff_ns_mask, i, a_idx, ns_ids)
+            for a_idx, (topo, valid, exprs, skew, hard, mind, haff, htaint) \
+                    in enumerate(c["spreads"]):
                 sc_topo[i, a_idx] = topo
                 sc_maxskew[i, a_idx] = skew
                 sc_hard[i, a_idx] = hard
                 sc_valid[i, a_idx] = True
+                sc_min_domains[i, a_idx] = mind
+                sc_honor_affinity[i, a_idx] = haff
+                sc_honor_taints[i, a_idx] = htaint
                 _fill_sel(sc_sel, (i, a_idx), valid, exprs)
 
         batch_topo = {int(k) for k in np.concatenate([
@@ -964,11 +1087,16 @@ class SnapshotEncoder:
             port_valid=pport_valid,
             pod_images=pod_images, image_bytes=image_bytes,
             aff_sel=SelectorSet(**aff_sel), aff_topo=aff_topo, aff_valid=aff_valid,
+            aff_ns_explicit=aff_ns_explicit, aff_ns_mask=aff_ns_mask,
             anti_sel=SelectorSet(**anti_sel), anti_topo=anti_topo, anti_valid=anti_valid,
+            anti_ns_explicit=anti_ns_explicit, anti_ns_mask=anti_ns_mask,
             paff_sel=SelectorSet(**paff_sel), paff_topo=paff_topo,
             paff_weight=paff_weight, paff_valid=paff_valid,
+            paff_ns_explicit=paff_ns_explicit, paff_ns_mask=paff_ns_mask,
             sc_sel=SelectorSet(**sc_sel), sc_topo=sc_topo, sc_maxskew=sc_maxskew,
             sc_hard=sc_hard, sc_valid=sc_valid,
+            sc_min_domains=sc_min_domains, sc_honor_affinity=sc_honor_affinity,
+            sc_honor_taints=sc_honor_taints,
             vol_terms=TermSet(**vol_a), vol_group=vol_group,
             vol_group_valid=vol_group_valid,
             rwo_pv=rwo_pv, rwo_valid=rwo_valid, attach_req=attach_req,

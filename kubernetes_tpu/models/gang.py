@@ -94,12 +94,22 @@ def extend_cluster(ct: ClusterTensors, pb: PodBatch) -> ClusterTensors:
                               _pad_axis(np.asarray(pb.anti_topo), 1, ET, -1)])
     ea_valid = np.concatenate([_pad_axis(np.asarray(ct.ea_valid), 1, ET, False),
                                _pad_axis(np.asarray(pb.anti_valid), 1, ET, False)])
+    # unify the namespace-mask width (the tables only grow, so the larger
+    # bucket covers every id the smaller one can hold)
+    NSB = max(int(ct.ea_ns_mask.shape[2]), int(pb.anti_ns_mask.shape[2]))
+    ea_ns_explicit = np.concatenate([
+        _pad_axis(np.asarray(ct.ea_ns_explicit), 1, ET, False),
+        _pad_axis(np.asarray(pb.anti_ns_explicit), 1, ET, False)])
+    ea_ns_mask = np.concatenate([
+        _pad_axis(_pad_axis(np.asarray(ct.ea_ns_mask), 1, ET, False), 2, NSB, False),
+        _pad_axis(_pad_axis(np.asarray(pb.anti_ns_mask), 1, ET, False), 2, NSB, False)])
     return ct.replace(
         epod_node=np.concatenate([np.asarray(ct.epod_node), np.full(P, -1, np.int32)]),
         epod_ns=np.concatenate([np.asarray(ct.epod_ns), np.asarray(pb.pod_ns)]),
         epod_labels=epod_labels,
         epod_valid=np.concatenate([np.asarray(ct.epod_valid), np.zeros(P, bool)]),
         ea_sel=ea_sel, ea_topo=ea_topo, ea_valid=ea_valid,
+        ea_ns_explicit=ea_ns_explicit, ea_ns_mask=ea_ns_mask,
     )
 
 
@@ -145,36 +155,47 @@ def _relational_veto(ct: ClusterTensors, pb: PodBatch, choice, accept, rank,
     round (anti-affinity both directions, shared hard-spread domain, required
     affinity forcing co-location). Conservative; rejects re-propose next round."""
     from kubernetes_tpu.ops.exprs import eval_selector_set
+    from kubernetes_tpu.ops.topology import _gather_ns
     P = pb.pod_valid.shape[0]
     K = ct.node_labels.shape[1]
     higher = (rank[None, :] < rank[:, None]) & accept[None, :] & accept[:, None]  # [q,p]
     conflict = jnp.zeros((P, P), bool)
+    ns_eq = pb.pod_ns[:, None] == pb.pod_ns[None, :]                # [q,p]
+
+    def _term_ns_ok(explicit, mask):
+        """[q,T,p]: does q's term t apply to p's namespace?"""
+        exp = _gather_ns(mask, pb.pod_ns)                           # [q,T,p]
+        return jnp.where(explicit[..., None], exp, ns_eq[:, None, :])
+
     for k in topo_keys:
         if k < 0 or k >= K:
             continue
         dv = ct.node_labels[:, k]                                   # [N]
         dvc = dv[jnp.clip(choice, 0, dv.shape[0] - 1)]              # [P] chosen domain
         same = (dvc[:, None] == dvc[None, :]) & (dvc[:, None] >= 0)  # [q,p]
-        ns_eq = pb.pod_ns[:, None] == pb.pod_ns[None, :]
         if pb.anti_valid.shape[1] > 0:
             m = eval_selector_set(pb.anti_sel, pb.pod_labels)       # [p_t, q, BT]
             qt = (pb.anti_topo == k) & pb.anti_valid                # [q,BT]
-            # q's term matches p: m[p, q, t]
-            q_hits_p = jnp.any(m & qt[None], axis=-1).T             # [q,p]
-            conflict |= q_hits_p & same & ns_eq
+            ns_ok = _term_ns_ok(pb.anti_ns_explicit, pb.anti_ns_mask)  # [q,BT,p]
+            # q's term matches p (selector + per-term namespaces): m[p, q, t]
+            q_hits_p = jnp.any(jnp.moveaxis(m, 0, 2) & qt[..., None]
+                               & ns_ok, axis=1)                     # [q,p]
+            conflict |= q_hits_p & same
             # symmetry: p's anti term matches q -> q (lower rank) rejected
-            conflict |= q_hits_p.T & same & ns_eq
+            conflict |= q_hits_p.T & same
         if pb.sc_valid.shape[1] > 0:
             m = eval_selector_set(pb.sc_sel, pb.pod_labels)         # [p_t, q, SC]
             qt = (pb.sc_topo == k) & pb.sc_valid & pb.sc_hard
             q_hits_p = jnp.any(m & qt[None], axis=-1).T
-            conflict |= q_hits_p & same & ns_eq
+            conflict |= q_hits_p & same & ns_eq  # spread: own namespace only
         if pb.aff_valid.shape[1] > 0:
             m = eval_selector_set(pb.aff_sel, pb.pod_labels)        # [p_t, q, AT]
             qt = (pb.aff_topo == k) & pb.aff_valid
-            q_hits_p = jnp.any(m & qt[None], axis=-1).T
+            ns_ok = _term_ns_ok(pb.aff_ns_explicit, pb.aff_ns_mask)  # [q,AT,p]
+            q_hits_p = jnp.any(jnp.moveaxis(m, 0, 2) & qt[..., None]
+                               & ns_ok, axis=1)                     # [q,p]
             # required affinity: must be in SAME domain as matching member
-            conflict |= q_hits_p & ~same & ns_eq
+            conflict |= q_hits_p & ~same
     veto = jnp.any(conflict & higher, axis=1)
     return accept & ~veto
 

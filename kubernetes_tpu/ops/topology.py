@@ -16,9 +16,16 @@ same_domain_k is per *distinct topology key* (zone, hostname, ...), a static
 Python tuple at trace time — there are only ever a handful, so the loop
 unrolls into a few [N,N] matmuls that XLA tiles onto the systolic array.
 
-Namespace semantics: terms currently apply to the incoming pod's own
-namespace (explicit ``namespaces`` lists are honored by the oracle but not yet
-encoded tensor-side — TODO round 2).
+Namespace semantics: a term with no explicit namespaces applies to the
+owning pod's own namespace; terms with ``namespaces``/``namespaceSelector``
+carry an encode-time-resolved namespace-id mask (``*_ns_explicit`` +
+``*_ns_mask`` — see encode/termprep.py), matched here by gather.
+
+Spread eligibility: nodes failing the incoming pod's nodeSelector/nodeAffinity
+(nodeAffinityPolicy=Honor, the default) or carrying untolerated taints
+(nodeTaintsPolicy=Honor) are excluded from skew counts and the global
+minimum. ``minDomains``: when fewer eligible domains exist, the global
+minimum is 0 (filtering.go minMatchNum).
 """
 
 from __future__ import annotations
@@ -29,27 +36,61 @@ from kubernetes_tpu.encode.snapshot import ClusterTensors, PodBatch
 from kubernetes_tpu.ops.exprs import eval_selector_set
 
 
-def _term_match_epods(ct: ClusterTensors, sel, pod_ns):
+def _gather_ns(ns_mask, ids):
+    """ns_mask [..., T, NSB] gathered at interned ids [M] -> [..., T, M]
+    (False for out-of-range ids: they were interned after the mask was
+    built, so no term's resolved set can contain them)."""
+    NSB = ns_mask.shape[-1]
+    hit = jnp.take(ns_mask, jnp.clip(ids, 0, NSB - 1), axis=-1)
+    return hit & ((ids >= 0) & (ids < NSB))
+
+
+def _term_match_epods(ct: ClusterTensors, sel, pod_ns,
+                      ns_explicit=None, ns_mask=None):
     """Selector match per (existing pod, pod, term) incl. namespace + validity.
     sel: SelectorSet with leading dims [P,T]. -> [E,P,T] float32."""
     m = eval_selector_set(sel, ct.epod_labels)               # [E,P,T]
-    ns_ok = ct.epod_ns[:, None] == pod_ns[None, :]           # [E,P]
-    return (m & ns_ok[:, :, None] & ct.epod_valid[:, None, None]).astype(jnp.float32)
+    own_ok = ct.epod_ns[:, None] == pod_ns[None, :]          # [E,P]
+    if ns_explicit is None:
+        ns_ok = own_ok[:, :, None]
+    else:
+        exp = _gather_ns(ns_mask, ct.epod_ns)                # [P,T,E]
+        exp = jnp.moveaxis(exp, 2, 0)                        # [E,P,T]
+        ns_ok = jnp.where(ns_explicit[None], exp, own_ok[:, :, None])
+    return (m & ns_ok & ct.epod_valid[:, None, None]).astype(jnp.float32)
 
 
-def _domain_counts(ct: ClusterTensors, match_ept, term_topo, topo_keys):
-    """-> (cnt_dom [P,T,N] f32, node_has_key [P,T,N] bool).
+def _self_ns_ok(pb: PodBatch, ns_explicit, ns_mask):
+    """Does each pod's own namespace fall in its terms' namespace sets?
+    -> [P,T] (True for implicit own-namespace terms)."""
+    NSB = ns_mask.shape[-1]
+    idx = jnp.clip(pb.pod_ns, 0, NSB - 1)[:, None, None]     # [P,1,1]
+    hit = jnp.take_along_axis(ns_mask, idx, axis=2)[..., 0]  # [P,T]
+    hit = hit & ((pb.pod_ns >= 0) & (pb.pod_ns < NSB))[:, None]
+    return jnp.where(ns_explicit, hit, True)
+
+
+def _domain_counts(ct: ClusterTensors, match_ept, term_topo, topo_keys,
+                   elig=None, want_domains=False):
+    """-> (cnt_dom [P,T,N] f32, node_has_key [P,T,N] bool,
+           num_domains [P,T] f32 | None).
 
     cnt_dom[p,t,n] = # existing pods matching term (p,t) whose node shares
     node n's domain for the term's topology key. Nodes lacking the key have
-    has_key False and count 0.
+    has_key False and count 0. ``elig`` [P,T,N] restricts which nodes'
+    pods participate (spread node-inclusion policies); ``want_domains``
+    additionally counts distinct domains with >=1 eligible node.
     """
     N = ct.node_valid.shape[0]
     onehot = (ct.epod_node[:, None] == jnp.arange(N)[None, :]).astype(jnp.float32)
     cnt_pn = jnp.einsum("ept,en->ptn", match_ept, onehot)     # [P,T,N]
+    if elig is not None:
+        cnt_pn = cnt_pn * elig.astype(jnp.float32)
     cnt_dom = jnp.zeros_like(cnt_pn)
     has_key = jnp.zeros(cnt_pn.shape, bool)
+    num_dom = jnp.zeros(cnt_pn.shape[:2], jnp.float32) if want_domains else None
     K = ct.node_labels.shape[1]
+    idx_n = jnp.arange(N)
     for k in topo_keys:
         if k < 0 or k >= K:
             continue
@@ -60,26 +101,57 @@ def _domain_counts(ct: ClusterTensors, match_ept, term_topo, topo_keys):
         sel = term_topo == k                                  # [P,T]
         cnt_dom = jnp.where(sel[..., None], agg, cnt_dom)
         has_key = has_key | (sel[..., None] & present[None, None, :])
-    return cnt_dom, has_key
+        if want_domains:
+            # distinct eligible domains: count nodes that are the FIRST
+            # eligible node of their domain (no eligible same-domain
+            # predecessor)
+            ek = (present[None, None, :] if elig is None
+                  else elig & present[None, None, :])         # [P,T,N]
+            lower = (same & (idx_n[:, None] < idx_n[None, :])).astype(jnp.float32)
+            prior = jnp.einsum("ptm,mn->ptn", ek.astype(jnp.float32), lower) > 0.0
+            nd_k = jnp.sum((ek & ~prior).astype(jnp.float32), axis=-1)  # [P,T]
+            num_dom = jnp.where(sel, nd_k, num_dom)
+    return cnt_dom, has_key, num_dom
 
 
 # ------------------------------------------------------------------- spread
+
+def _spread_policy_elig(ct: ClusterTensors, pb: PodBatch):
+    """Per-constraint node participation [P,S,N]: valid nodes passing
+    nodeAffinityPolicy (Honor default: pod's nodeSelector + required node
+    affinity) and nodeTaintsPolicy (Honor: NoSchedule/NoExecute tolerated;
+    Ignore default). XLA CSE dedupes these against the filter pipeline's
+    identical masks inside one jit program."""
+    from kubernetes_tpu.ops.filters import node_affinity_mask, taint_toleration_mask
+    na = node_affinity_mask(ct, pb)                           # [P,N]
+    tt = taint_toleration_mask(ct, pb)                        # [P,N]
+    ok = (~pb.sc_honor_affinity[..., None] | na[:, None, :])
+    ok &= (~pb.sc_honor_taints[..., None] | tt[:, None, :])
+    return ok & ct.node_valid[None, None, :]
+
 
 def spread_mask(ct: ClusterTensors, pb: PodBatch, topo_keys: tuple[int, ...] = ()):
     """DoNotSchedule constraints: count(domain) + self - min(domain counts)
     must not exceed maxSkew; nodes lacking the topology key are infeasible."""
     if pb.sc_valid.shape[1] == 0:
         return jnp.ones(pb.pod_valid.shape + ct.node_valid.shape, bool)
+    pol = _spread_policy_elig(ct, pb)                         # [P,S,N]
     match = _term_match_epods(ct, pb.sc_sel, pb.pod_ns)       # [E,P,S]
-    cnt, has_key = _domain_counts(ct, match, pb.sc_topo, topo_keys)  # [P,S,N]
+    cnt, has_key, num_dom = _domain_counts(
+        ct, match, pb.sc_topo, topo_keys, elig=pol, want_domains=True)
     # does the pod match its own constraint selector? (it lands in the domain)
     self_m = eval_selector_set(pb.sc_sel, pb.pod_labels)      # [Pt,P,S] over all pods
     P = pb.pod_valid.shape[0]
     self_match = self_m[jnp.arange(P), jnp.arange(P), :]      # [P,S]
     big = jnp.float32(3.4e38)
-    eligible = has_key & ct.node_valid[None, None, :]
+    eligible = has_key & pol
     min_cnt = jnp.min(jnp.where(eligible, cnt, big), axis=-1, keepdims=True)
     min_cnt = jnp.where(jnp.any(eligible, axis=-1, keepdims=True), min_cnt, 0.0)
+    # minDomains (DoNotSchedule only): fewer eligible domains than required
+    # -> global minimum treated as 0
+    min_unmet = (pb.sc_min_domains > 0) & \
+        (num_dom < pb.sc_min_domains.astype(jnp.float32))     # [P,S]
+    min_cnt = jnp.where(min_unmet[..., None], 0.0, min_cnt)
     skew = cnt + self_match[..., None].astype(jnp.float32) - min_cnt
     ok = has_key & (skew <= pb.sc_maxskew[..., None].astype(jnp.float32))
     active = (pb.sc_valid & pb.sc_hard)[..., None]            # soft/pad -> neutral
@@ -92,8 +164,9 @@ def spread_score_raw(ct: ClusterTensors, pb: PodBatch, topo_keys: tuple[int, ...
     P, N = pb.pod_valid.shape[0], ct.node_valid.shape[0]
     if pb.sc_valid.shape[1] == 0:
         return jnp.zeros((P, N), jnp.float32)
+    pol = _spread_policy_elig(ct, pb)
     match = _term_match_epods(ct, pb.sc_sel, pb.pod_ns)
-    cnt, has_key = _domain_counts(ct, match, pb.sc_topo, topo_keys)
+    cnt, has_key, _ = _domain_counts(ct, match, pb.sc_topo, topo_keys, elig=pol)
     active = (pb.sc_valid & ~pb.sc_hard)[..., None]
     return jnp.sum(jnp.where(active & has_key, cnt, 0.0), axis=1)
 
@@ -108,25 +181,28 @@ def interpod_required_mask(ct: ClusterTensors, pb: PodBatch,
     P, N = pb.pod_valid.shape[0], ct.node_valid.shape[0]
     out = jnp.ones((P, N), bool)
     if pb.aff_valid.shape[1] > 0:
-        match = _term_match_epods(ct, pb.aff_sel, pb.pod_ns)
-        cnt, has_key = _domain_counts(ct, match, pb.aff_topo, topo_keys)
+        match = _term_match_epods(ct, pb.aff_sel, pb.pod_ns,
+                                  pb.aff_ns_explicit, pb.aff_ns_mask)
+        cnt, has_key, _ = _domain_counts(ct, match, pb.aff_topo, topo_keys)
         valid = pb.aff_valid[..., None]                         # [P,T,1]
         # filtering.go satisfyPodAffinity: every term's topology key must
         # exist on the node, unconditionally.
         has_all_keys = jnp.all(has_key | ~valid, axis=1)        # [P,N]
         sat = jnp.all((has_key & (cnt >= 1.0)) | ~valid, axis=1)
         # Bootstrap: only when NO term has a matching pair cluster-wide AND
-        # the incoming pod matches ALL its own term selectors (the first pod
-        # of a self-affine gang).
+        # the incoming pod matches ALL its own term selectors INCLUDING their
+        # namespace sets (the first pod of a self-affine gang).
         self_m = eval_selector_set(pb.aff_sel, pb.pod_labels)   # [Pt,P,T]
         self_match = self_m[jnp.arange(P), jnp.arange(P), :]    # [P,T]
+        self_match &= _self_ns_ok(pb, pb.aff_ns_explicit, pb.aff_ns_mask)
         none_any_all = jnp.all(~jnp.any(cnt >= 1.0, axis=-1) | ~pb.aff_valid, axis=1)
         self_all = jnp.all(self_match | ~pb.aff_valid, axis=1)
         bootstrap = none_any_all & self_all                     # [P]
         out &= has_all_keys & (sat | bootstrap[:, None])
     if pb.anti_valid.shape[1] > 0:
-        match = _term_match_epods(ct, pb.anti_sel, pb.pod_ns)
-        cnt, has_key = _domain_counts(ct, match, pb.anti_topo, topo_keys)
+        match = _term_match_epods(ct, pb.anti_sel, pb.pod_ns,
+                                  pb.anti_ns_explicit, pb.anti_ns_mask)
+        cnt, has_key, _ = _domain_counts(ct, match, pb.anti_topo, topo_keys)
         viol = has_key & (cnt >= 1.0)
         out &= jnp.all(~viol | ~pb.anti_valid[..., None], axis=1)
     return out
@@ -135,16 +211,20 @@ def interpod_required_mask(ct: ClusterTensors, pb: PodBatch,
 def interpod_symmetry_mask(ct: ClusterTensors, pb: PodBatch,
                            topo_keys: tuple[int, ...] = ()):
     """Existing pods' required anti-affinity vetoes the newcomer: if existing
-    pod e has an anti term whose selector matches the incoming pod and node n
-    shares e's domain for that term's key -> n infeasible
+    pod e has an anti term whose selector matches the incoming pod (and the
+    incoming pod's namespace is in the term's set — own ns or explicit) and
+    node n shares e's domain for that term's key -> n infeasible
     (interpodaffinity/filtering.go existingPodAntiAffinityMap)."""
     P, N = pb.pod_valid.shape[0], ct.node_valid.shape[0]
     if ct.ea_valid.shape[1] == 0:
         return jnp.ones((P, N), bool)
     # match of each existing anti term against incoming pods: [P,E,ET]
     m = eval_selector_set(ct.ea_sel, pb.pod_labels)           # [P,E,ET]
-    ns_ok = pb.pod_ns[:, None] == ct.epod_ns[None, :]         # [P,E]
-    m = m & ns_ok[:, :, None] & ct.epod_valid[None, :, None] & ct.ea_valid[None]
+    own_ok = pb.pod_ns[:, None] == ct.epod_ns[None, :]        # [P,E]
+    exp = _gather_ns(ct.ea_ns_mask, pb.pod_ns)                # [E,ET,P]
+    exp = jnp.moveaxis(exp, 2, 0)                             # [P,E,ET]
+    ns_ok = jnp.where(ct.ea_ns_explicit[None], exp, own_ok[:, :, None])
+    m = m & ns_ok & ct.epod_valid[None, :, None] & ct.ea_valid[None]
     veto = jnp.zeros((P, N), bool)
     K = ct.node_labels.shape[1]
     for k in topo_keys:
@@ -168,7 +248,8 @@ def interpod_score_raw(ct: ClusterTensors, pb: PodBatch,
     P, N = pb.pod_valid.shape[0], ct.node_valid.shape[0]
     if pb.paff_valid.shape[1] == 0:
         return jnp.zeros((P, N), jnp.float32)
-    match = _term_match_epods(ct, pb.paff_sel, pb.pod_ns)
-    cnt, has_key = _domain_counts(ct, match, pb.paff_topo, topo_keys)  # [P,C,N]
+    match = _term_match_epods(ct, pb.paff_sel, pb.pod_ns,
+                              pb.paff_ns_explicit, pb.paff_ns_mask)
+    cnt, has_key, _ = _domain_counts(ct, match, pb.paff_topo, topo_keys)  # [P,C,N]
     w = jnp.where(pb.paff_valid, pb.paff_weight, 0.0)[..., None]
     return jnp.sum(jnp.where(has_key, cnt, 0.0) * w, axis=1)

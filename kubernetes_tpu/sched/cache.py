@@ -31,6 +31,7 @@ class SchedulerCache:
         self._cached: Optional[tuple[int, ClusterTensors, SnapshotMeta]] = None
         self.assume_ttl = assume_ttl
         self._volumes = None  # VolumeCatalog once any PVC/PV/SC appears
+        self._namespace_labels: dict[str, dict] = {}
         # incremental-snapshot delta tracking (Cache.UpdateSnapshot analog):
         # pod churn accumulates here and patches the cached encoding in place;
         # anything structural (node add/remove, volumes) forces a full encode.
@@ -71,6 +72,30 @@ class SchedulerCache:
     def volume_catalog(self):
         with self._lock:
             return self._volumes
+
+    # ---- namespace labels (Namespace informer feeds this) ----------------
+
+    def update_namespace(self, obj: dict, deleted: bool = False):
+        """Track namespace labels so affinity terms' namespaceSelector
+        resolves at encode time (GetNamespaceLabelsSnapshot analog)."""
+        with self._lock:
+            md = obj.get("metadata") or {}
+            name = md.get("name", "")
+            if deleted:
+                if self._namespace_labels.pop(name, None) is None:
+                    return
+            else:
+                new = dict(md.get("labels") or {})
+                if self._namespace_labels.get(name) == new:
+                    return  # label-neutral churn: keep the encoding valid
+                self._namespace_labels[name] = new
+            self._encoder.set_namespaces(self._namespace_labels)
+            self._generation += 1
+            # Pod batches always read the fresh snapshot at encode time; the
+            # CLUSTER encoding only goes stale if an existing pod's anti term
+            # actually resolved a namespaceSelector against the old labels.
+            if self._encoder.cluster_depends_on_namespace_labels:
+                self._needs_full = True
 
     # ---- node events -----------------------------------------------------
 

@@ -32,6 +32,8 @@ from kubernetes_tpu.api.types import (
     EFFECT_NO_EXECUTE,
     EFFECT_NO_SCHEDULE,
     EFFECT_PREFER_NO_SCHEDULE,
+    NODE_INCLUSION_HONOR,
+    NODE_INCLUSION_IGNORE,
     Node,
     NodeSelectorTerm,
     Pod,
@@ -41,6 +43,11 @@ from kubernetes_tpu.api.types import (
     TopologySpreadConstraint,
 )
 from kubernetes_tpu.encode.scaling import UNLIMITED, scale_allocatable, scale_request
+from kubernetes_tpu.encode.termprep import (
+    affinity_term_selector,
+    resolve_term_namespaces,
+    spread_selector,
+)
 
 UNSCHED_TAINT = Taint(key="node.kubernetes.io/unschedulable", effect=EFFECT_NO_SCHEDULE)
 
@@ -125,12 +132,15 @@ class OracleScheduler:
 
     def __init__(self, nodes: list[Node], bound_pods: Optional[list[Pod]] = None,
                  weights: Optional[dict[str, float]] = None, seed: int = 0,
-                 volumes=None):
+                 volumes=None, namespace_labels: Optional[dict] = None):
         self.states = [NodeState.build(n) for n in nodes]
         self.node_index = {n.metadata.name: i for i, n in enumerate(nodes)}
         self.weights = dict(weights or DEFAULT_WEIGHTS)
         self.seed = seed
         self.volumes = volumes  # VolumeCatalog | None
+        # namespace name -> labels, for namespaceSelector resolution
+        # (GetNamespaceLabelsSnapshot analog)
+        self.namespace_labels = dict(namespace_labels or {})
         for p in bound_pods or []:
             i = self.node_index.get(p.spec.node_name)
             if i is not None:
@@ -199,46 +209,60 @@ class OracleScheduler:
         for sc in pod.spec.topology_spread_constraints:
             if sc.when_unsatisfiable != "DoNotSchedule":
                 continue
-            counts = self._domain_counts(pod, sc)
-            self_match = label_selector_matches(sc.label_selector, pod.metadata.labels)
+            eff = spread_selector(sc, pod.metadata.labels)
+            counts = self._domain_counts(pod, sc, eff)
+            self_match = label_selector_matches(eff, pod.metadata.labels)
             min_count = min(counts.values()) if counts else 0
+            # minDomains: fewer eligible domains than required -> the global
+            # minimum is treated as 0 (filtering.go minMatchNum).
+            if sc.min_domains is not None and len(counts) < sc.min_domains:
+                min_count = 0
             spread.append((sc, counts, min_count, self_match))
         aff_counts = []
+        self_matches_all = True
         for term in (pa.required if pa else []):
+            prep = self._prep_term(term, ns, pod.metadata.labels)
             counts: dict[str, int] = {}
             for st in self.states:
                 dv = st.labels.get(term.topology_key)
                 if dv is None:
                     continue
                 for p in st.pods:
-                    if self._term_matches_pod(term, ns, p):
+                    if self._prepped_matches(prep, ns, p):
                         counts[dv] = counts.get(dv, 0) + 1
+            if not self._prepped_matches(prep, ns, pod):
+                self_matches_all = False
             aff_counts.append((term, counts))
         # filtering.go bootstrap: NO term has a matching pair anywhere AND the
-        # incoming pod matches ALL its own terms.
+        # incoming pod matches ALL its own terms (incl. their namespace sets).
         bootstrap = (bool(aff_counts)
                      and all(not c for _, c in aff_counts)
-                     and all(self._term_matches_pod(t, ns, pod) for t, _ in aff_counts))
+                     and self_matches_all)
         anti_counts = []
         for term in (pan.required if pan else []):
+            prep = self._prep_term(term, ns, pod.metadata.labels)
             counts = {}
             for st in self.states:
                 dv = st.labels.get(term.topology_key)
                 if dv is None:
                     continue
                 for p in st.pods:
-                    if self._term_matches_pod(term, ns, p):
+                    if self._prepped_matches(prep, ns, p):
                         counts[dv] = counts.get(dv, 0) + 1
             anti_counts.append((term, counts))
         # Symmetry: (topology_key, domain value) pairs where some existing
-        # pod's required anti-affinity matches this pod.
+        # pod's required anti-affinity matches this pod. The term resolves
+        # against the EXISTING pod's namespace + labels (it owns the term).
         sym_veto: set[tuple[str, str]] = set()
         for other_st in self.states:
             for p in other_st.pods:
                 paff = p.spec.affinity
                 pananti = paff.pod_anti_affinity if paff else None
                 for term in (pananti.required if pananti else []):
-                    if not self._term_matches_pod(term, p.metadata.namespace, pod):
+                    prep = self._prep_term(
+                        term, p.metadata.namespace, p.metadata.labels)
+                    if not self._prepped_matches(
+                            prep, p.metadata.namespace, pod):
                         continue
                     dv = other_st.labels.get(term.topology_key)
                     if dv is not None:
@@ -272,22 +296,36 @@ class OracleScheduler:
 
     # ---- topology spread -------------------------------------------------
 
-    def _domain_counts(self, pod: Pod, sc: TopologySpreadConstraint):
-        """(counts per domain value, global min over eligible domains).
+    def _spread_node_eligible(self, pod: Pod, sc: TopologySpreadConstraint,
+                              st: NodeState) -> bool:
+        """Does this node participate in the constraint's skew computation?
+        (common.go: has the topology key + nodeAffinityPolicy [default Honor]
+        + nodeTaintsPolicy [default Ignore])."""
+        if sc.topology_key not in st.labels:
+            return False
+        if (sc.node_affinity_policy != NODE_INCLUSION_IGNORE
+                and not self._node_affinity_ok(pod, st.node)):
+            return False
+        if (sc.node_taints_policy == NODE_INCLUSION_HONOR
+                and not tolerates_all(pod.spec.tolerations, st.node.spec.taints,
+                                      (EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE))):
+            return False
+        return True
 
-        Eligible domains = domains of nodes that pass the constraint's node
-        requirements (here: have the topology key). Counts include only pods
-        matching the selector in the incoming pod's namespace.
-        """
+    def _domain_counts(self, pod: Pod, sc: TopologySpreadConstraint, eff_sel):
+        """Counts per domain value over *eligible* nodes only (see
+        ``_spread_node_eligible``); pods on excluded nodes don't count and
+        their domains don't participate in the global minimum. Counts include
+        only pods matching ``eff_sel`` in the incoming pod's namespace."""
         counts: dict[str, int] = {}
         for st in self.states:
-            dv = st.labels.get(sc.topology_key)
-            if dv is None:
+            if not self._spread_node_eligible(pod, sc, st):
                 continue
+            dv = st.labels[sc.topology_key]
             counts.setdefault(dv, 0)
             for p in st.pods:
                 if (p.metadata.namespace == pod.metadata.namespace
-                        and label_selector_matches(sc.label_selector, p.metadata.labels)):
+                        and label_selector_matches(eff_sel, p.metadata.labels)):
                     counts[dv] += 1
         return counts
 
@@ -302,10 +340,18 @@ class OracleScheduler:
 
     # ---- inter-pod affinity ---------------------------------------------
 
-    def _term_matches_pod(self, term, own_ns: str, target: Pod) -> bool:
-        nss = term.namespaces or [own_ns]
-        return (target.metadata.namespace in nss
-                and label_selector_matches(term.label_selector, target.metadata.labels))
+    def _prep_term(self, term, owner_ns: str, owner_labels: dict):
+        """-> (ns_set | None, effective selector) via encode/termprep.py."""
+        return (resolve_term_namespaces(term, owner_ns, self.namespace_labels),
+                affinity_term_selector(term, owner_labels))
+
+    @staticmethod
+    def _prepped_matches(prep, owner_ns: str, target: Pod) -> bool:
+        ns_set, eff = prep
+        tns = target.metadata.namespace
+        if (tns != owner_ns) if ns_set is None else (tns not in ns_set):
+            return False
+        return label_selector_matches(eff, target.metadata.labels)
 
     def _interpod_ok(self, st: NodeState, ctx: dict) -> Optional[str]:
         # Required affinity (filtering.go satisfyPodAffinity): every term's
@@ -459,7 +505,8 @@ class OracleScheduler:
             if sc.when_unsatisfiable != "ScheduleAnyway":
                 continue
             has_any = True
-            counts = self._domain_counts(pod, sc)
+            eff = spread_selector(sc, pod.metadata.labels)
+            counts = self._domain_counts(pod, sc, eff)
             for i, st in enumerate(self.states):
                 dv = st.labels.get(sc.topology_key)
                 raw[i] += np.float32(counts.get(dv, 0) if dv is not None else 0)
@@ -481,6 +528,7 @@ class OracleScheduler:
         if not terms:
             return raw
         for w, term in terms:
+            prep = self._prep_term(term, ns, pod.metadata.labels)
             # count matching pods per domain value
             counts: dict[str, int] = {}
             for st in self.states:
@@ -489,7 +537,7 @@ class OracleScheduler:
                     continue
                 counts.setdefault(dv, 0)
                 for p in st.pods:
-                    if self._term_matches_pod(term, ns, p):
+                    if self._prepped_matches(prep, ns, p):
                         counts[dv] += 1
             for i, st in enumerate(self.states):
                 dv = st.labels.get(term.topology_key)

@@ -167,6 +167,10 @@ class SchedulerRunner:
                              ("storageclasses", "StorageClass")):
             inf = self.factory.informer(plural, None)
             inf.add_event_handler(self._on_volume(kind))
+        ns_inf = self.factory.informer("namespaces", None)
+        ns_inf.add_event_handler(
+            lambda type_, obj, old: self.cache.update_namespace(
+                obj, deleted=(type_ == "DELETED")))
         # PDBs feed preemption's victim selection (default_preemption.go
         # checks budgets when picking victims)
         pdb_inf = self.factory.informer("poddisruptionbudgets", None)

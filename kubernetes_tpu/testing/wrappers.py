@@ -141,14 +141,24 @@ class PodWrapper:
         return target
 
     def pod_affinity(self, topology_key: str, match_labels: dict[str, str],
-                     anti: bool = False) -> "PodWrapper":
-        term = PodAffinityTerm(topology_key=topology_key,
-                               label_selector=LabelSelector(match_labels=dict(match_labels)))
+                     anti: bool = False, namespaces: Optional[list] = None,
+                     namespace_selector: Optional[dict] = None,
+                     match_label_keys: Optional[list] = None,
+                     mismatch_label_keys: Optional[list] = None) -> "PodWrapper":
+        term = PodAffinityTerm(
+            topology_key=topology_key,
+            label_selector=LabelSelector(match_labels=dict(match_labels)),
+            namespaces=list(namespaces or []),
+            namespace_selector=(None if namespace_selector is None
+                                else LabelSelector(match_labels=dict(namespace_selector))),
+            match_label_keys=list(match_label_keys or []),
+            mismatch_label_keys=list(mismatch_label_keys or []))
         self._pod_affinity_target(anti).required.append(term)
         return self
 
-    def pod_anti_affinity(self, topology_key: str, match_labels: dict[str, str]) -> "PodWrapper":
-        return self.pod_affinity(topology_key, match_labels, anti=True)
+    def pod_anti_affinity(self, topology_key: str, match_labels: dict[str, str],
+                          **kw) -> "PodWrapper":
+        return self.pod_affinity(topology_key, match_labels, anti=True, **kw)
 
     def preferred_pod_affinity(self, weight: int, topology_key: str,
                                match_labels: dict[str, str], anti: bool = False) -> "PodWrapper":
@@ -160,10 +170,17 @@ class PodWrapper:
         return self
 
     def spread(self, max_skew: int, topology_key: str, when_unsatisfiable: str,
-               match_labels: Optional[dict[str, str]] = None) -> "PodWrapper":
+               match_labels: Optional[dict[str, str]] = None,
+               min_domains: Optional[int] = None,
+               node_affinity_policy: str = "Honor",
+               node_taints_policy: str = "Ignore",
+               match_label_keys: Optional[list] = None) -> "PodWrapper":
         self.pod.spec.topology_spread_constraints.append(TopologySpreadConstraint(
             max_skew=max_skew, topology_key=topology_key, when_unsatisfiable=when_unsatisfiable,
-            label_selector=LabelSelector(match_labels=dict(match_labels or {}))))
+            label_selector=LabelSelector(match_labels=dict(match_labels or {})),
+            min_domains=min_domains, node_affinity_policy=node_affinity_policy,
+            node_taints_policy=node_taints_policy,
+            match_label_keys=list(match_label_keys or [])))
         return self
 
     def scheduling_gate(self, name: str) -> "PodWrapper":

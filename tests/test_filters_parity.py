@@ -242,3 +242,122 @@ def test_fuzz_filter_parity(seed):
         bound.append(p)
     pods = [random_pod(rng, i, names) for i in range(n_pods)]
     assert_parity(nodes, pods, bound)
+
+
+# -------------------------------------------- relational fuzz (full evaluate)
+
+NAMESPACES = ["default", "team-a", "team-b", "prod"]
+NS_LABELS = {"default": {}, "team-a": {"tier": "gold"},
+             "team-b": {"tier": "bronze"}, "prod": {"env": "prod"}}
+APPS = ["web", "db", "cache"]
+TOPO_KEYS = ["zone", "disk"]
+
+
+def _random_volume_catalog(rng: random.Random):
+    from kubernetes_tpu.sched.volumebinding import VolumeCatalog
+    pvs, pvcs = [], []
+    for i in range(rng.randint(2, 5)):
+        zone = rng.choice(ZONES + [None])
+        pv = {"apiVersion": "v1", "kind": "PersistentVolume",
+              "metadata": {"name": f"pv{i}", "labels": {}},
+              "spec": {"capacity": {"storage": "10Gi"},
+                       "accessModes": [rng.choice(["ReadWriteOnce", "ReadWriteMany"])],
+                       "storageClassName": ""},
+              "status": {"phase": "Available"}}
+        if zone:
+            pv["metadata"]["labels"]["zone"] = zone
+        pvs.append(pv)
+    for i in range(rng.randint(1, 4)):
+        claim = {"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+                 "metadata": {"name": f"c{i}", "namespace": "default"},
+                 "spec": {"accessModes": ["ReadWriteOnce"],
+                          "resources": {"requests": {"storage": "5Gi"}},
+                          "storageClassName": ""},
+                 "status": {}}
+        if rng.random() < 0.5:
+            claim["spec"]["volumeName"] = f"pv{rng.randint(0, len(pvs) - 1)}"
+        pvcs.append(claim)
+    return VolumeCatalog.from_lists(pvcs=pvcs, pvs=pvs, storage_classes=[]), \
+        [c["metadata"]["name"] for c in pvcs]
+
+
+def random_relational_pod(rng: random.Random, i: int, node_names, claim_names):
+    w = make_pod(f"p{i}", namespace=rng.choice(NAMESPACES)).req({
+        "cpu": rng.choice(["100m", "500m"])})
+    w.label("app", rng.choice(APPS))
+    if rng.random() < 0.6:
+        w.label("rev", str(rng.randint(1, 3)))
+    if rng.random() < 0.2:
+        w.node_selector({"zone": rng.choice(ZONES)})
+    if rng.random() < 0.15:
+        w.node_affinity_in("zone", rng.sample(ZONES, k=rng.randint(1, 2)))
+    if rng.random() < 0.15:
+        w.toleration(key="dedicated", operator="Exists")
+    if rng.random() < 0.4:
+        w.spread(rng.randint(1, 2), rng.choice(TOPO_KEYS),
+                 rng.choice(["DoNotSchedule", "DoNotSchedule", "ScheduleAnyway"]),
+                 {"app": rng.choice(APPS)},
+                 min_domains=rng.choice([None, None, 2, 3]),
+                 node_affinity_policy=rng.choice(["Honor", "Honor", "Ignore"]),
+                 node_taints_policy=rng.choice(["Ignore", "Ignore", "Honor"]),
+                 match_label_keys=rng.choice([[], [], ["rev"]]))
+    if rng.random() < 0.4:
+        kw = {}
+        r = rng.random()
+        if r < 0.35:
+            kw["namespaces"] = rng.sample(NAMESPACES, k=rng.randint(1, 2))
+        elif r < 0.55:
+            kw["namespace_selector"] = rng.choice(
+                [{}, {"tier": "gold"}, {"env": "prod"}])
+        mk = rng.random()
+        if mk < 0.25:
+            kw["match_label_keys"] = ["rev"]
+        elif mk < 0.4:
+            kw["mismatch_label_keys"] = ["rev"]
+        w.pod_affinity(rng.choice(TOPO_KEYS), {"app": rng.choice(APPS)},
+                       anti=rng.random() < 0.65, **kw)
+    if claim_names and rng.random() < 0.2:
+        p = w.obj()
+        p.spec.volumes = [{"name": "v0", "persistentVolumeClaim":
+                           {"claimName": rng.choice(claim_names)}}]
+        return p
+    return w.obj()
+
+
+@pytest.mark.parametrize("seed", range(50))
+def test_fuzz_relational_parity(seed):
+    """Full feasibility (filters + spread + inter-pod + volumes) parity on
+    clusters of >=64 nodes with multi-namespace relational workloads —
+    namespaces/namespaceSelector, matchLabelKeys, minDomains, node-inclusion
+    policies, and PVC references all in play."""
+    from kubernetes_tpu.models.schedule_step import evaluate
+
+    rng = random.Random(1000 + seed)
+    n_nodes = rng.randint(64, 96)
+    n_bound, n_pods = rng.randint(10, 30), rng.randint(4, 10)
+    nodes = [random_node(rng, i) for i in range(n_nodes)]
+    names = [n.metadata.name for n in nodes]
+    catalog, claim_names = (_random_volume_catalog(rng)
+                            if rng.random() < 0.4 else (None, []))
+    bound = []
+    for i in range(n_bound):
+        p = random_relational_pod(rng, 100 + i, names, [])
+        p.spec.node_name = rng.choice(names)
+        bound.append(p)
+    pods = [random_relational_pod(rng, i, names, claim_names)
+            for i in range(n_pods)]
+
+    enc = SnapshotEncoder()
+    enc.set_namespaces(NS_LABELS)
+    if catalog is not None:
+        enc.set_volumes(catalog)
+    ct, meta = enc.encode_cluster(nodes, bound, pending_pods=pods)
+    pb = enc.encode_pods(pods, meta)
+    res = evaluate(ct, pb, topo_keys=meta.topo_keys)
+    tm = np.asarray(res.feasible)[:len(pods), :len(nodes)]
+
+    orc = OracleScheduler(nodes, bound, volumes=catalog,
+                          namespace_labels=NS_LABELS)
+    om = np.asarray([orc.feasible(p)[0] for p in pods])
+    np.testing.assert_array_equal(
+        tm, om, err_msg=f"seed={seed} pods={[p.key for p in pods]}")
