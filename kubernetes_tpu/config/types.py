@@ -63,6 +63,9 @@ class Profile:
 @dataclass
 class SchedulerConfiguration:
     profiles: list[Profile] = field(default_factory=lambda: [Profile()])
+    # scheduler-extender webhooks (kube-scheduler/config/v1 Extender);
+    # sched/extender.py calls them during every scheduling cycle
+    extenders: list = field(default_factory=list)  # list[ExtenderConfig]
     batch_size: int = 256          # pods per gang step (pop_batch max)
     max_gang_rounds: int = 64
     seed: int = 0
@@ -84,6 +87,9 @@ class SchedulerConfiguration:
         cfg = cls()
         if d.get("profiles"):
             cfg.profiles = [Profile.from_dict(p) for p in d["profiles"]]
+        if d.get("extenders"):
+            from kubernetes_tpu.sched.extender import ExtenderConfig
+            cfg.extenders = [ExtenderConfig.from_dict(e) for e in d["extenders"]]
         for yaml_key, attr in [
             ("batchSize", "batch_size"), ("maxGangRounds", "max_gang_rounds"),
             ("seed", "seed"), ("backoffInitialSeconds", "backoff_initial_s"),

@@ -19,6 +19,10 @@ from kubernetes_tpu.controllers.manager import ControllerManager
 from kubernetes_tpu.controllers.namespace import NamespaceController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+from kubernetes_tpu.controllers.serviceaccount import (
+    ServiceAccountController,
+    TokenController,
+)
 from kubernetes_tpu.controllers.statefulset import StatefulSetController
 from kubernetes_tpu.controllers.ttlafterfinished import TTLAfterFinishedController
 
@@ -28,6 +32,7 @@ __all__ = [
     "EndpointsController", "EndpointSliceController", "GarbageCollector",
     "HorizontalPodAutoscalerController", "JobController",
     "NamespaceController", "NodeLifecycleController", "ReplicaSetController",
-    "StatefulSetController", "TTLAfterFinishedController", "active_pods",
+    "ServiceAccountController", "StatefulSetController",
+    "TTLAfterFinishedController", "TokenController", "active_pods",
     "controller_of",
 ]

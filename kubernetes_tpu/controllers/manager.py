@@ -25,6 +25,10 @@ from kubernetes_tpu.controllers.namespace import NamespaceController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.pvbinder import PersistentVolumeController
 from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+from kubernetes_tpu.controllers.serviceaccount import (
+    ServiceAccountController,
+    TokenController,
+)
 from kubernetes_tpu.controllers.statefulset import StatefulSetController
 from kubernetes_tpu.controllers.ttlafterfinished import TTLAfterFinishedController
 
@@ -32,7 +36,7 @@ DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "daemonset",
                        "statefulset", "endpoints", "endpointslice",
                        "nodelifecycle", "pvbinder", "disruption", "cronjob",
                        "ttlafterfinished", "horizontalpodautoscaler",
-                       "namespace")
+                       "namespace", "serviceaccount", "serviceaccount-token")
 
 
 class ControllerManager:
@@ -59,6 +63,8 @@ class ControllerManager:
             "horizontalpodautoscaler": HorizontalPodAutoscalerController,
             "namespace": NamespaceController,
             "endpointslice": EndpointSliceController,
+            "serviceaccount": ServiceAccountController,
+            "serviceaccount-token": TokenController,
         }
         self.controllers = [ctors[n](client) for n in controllers]
         self.gc = GarbageCollector(client) if gc_enabled else None
@@ -135,4 +141,6 @@ def _informer_attr(c) -> str:
         "ttlafterfinished": "job_informer",
         "horizontalpodautoscaler": "hpa_informer",
         "disruption": "pdb_informer",
+        "serviceaccount": "ns_informer",
+        "serviceaccount-token": "sa_informer",
     }.get(c.name, "")

@@ -204,7 +204,8 @@ def _gang_round_impl(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
                      seed: int = 0, fit_strategy: str = "LeastAllocated",
                      topo_keys: tuple[int, ...] = (), serial: bool = False,
                      weights: tuple = (), enabled_filters: tuple = (),
-                     cap_scale=1, slot_start=None):
+                     cap_scale=1, slot_start=None, ext_mask=None,
+                     ext_scores=None):
     """Traceable body of one propose/accept/fold round. Returns
     (new_state, progress) where progress counts acceptances (plus serial-mode
     attempts). ``slot_start``: index (may be traced) of this batch's extension
@@ -225,7 +226,8 @@ def _gang_round_impl(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
     res = evaluate(ct_round, pb_round, seed=seed,
                    fit_strategy=fit_strategy, topo_keys=topo_keys,
                    weights=dict(weights) if weights else None,
-                   enabled_filters=frozenset(enabled_filters) if enabled_filters else None)
+                   enabled_filters=frozenset(enabled_filters) if enabled_filters else None,
+                   ext_mask=ext_mask, ext_scores=ext_scores)
     want = res.assigned & ~state.committed & pb.pod_valid
     tried = state.tried
     n_attempted = jnp.int32(0)
@@ -281,7 +283,8 @@ def gang_converge(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
                   seed: int = 0, fit_strategy: str = "LeastAllocated",
                   topo_keys: tuple[int, ...] = (), serial: bool = False,
                   weights: tuple = (), enabled_filters: tuple = (),
-                  max_rounds: int = 64) -> GangState:
+                  max_rounds: int = 64, ext_mask=None,
+                  ext_scores=None) -> GangState:
     """On-device convergence: the whole propose/accept/fold round sequence is
     one XLA program — no device→host sync per round (the reference's per-pod
     loop is host-side; our analog keeps the batch's entire conflict resolution
@@ -296,12 +299,13 @@ def gang_converge(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
     branch costs nothing after convergence."""
     return _converge(ct_ext, pb, state, seed=seed, fit_strategy=fit_strategy,
                      topo_keys=topo_keys, serial=serial, weights=weights,
-                     enabled_filters=enabled_filters, max_rounds=max_rounds)
+                     enabled_filters=enabled_filters, max_rounds=max_rounds,
+                     ext_mask=ext_mask, ext_scores=ext_scores)
 
 
 def _converge(ct_ext, pb, state, *, seed, fit_strategy, topo_keys,
               weights, enabled_filters, max_rounds, serial=False,
-              slot_start=None) -> GangState:
+              slot_start=None, ext_mask=None, ext_scores=None) -> GangState:
     """Shared traceable convergence loop (gang_converge + the drain's
     per-batch step): fori(max_rounds) of cond-guarded rounds."""
     def body(i, carry):
@@ -315,7 +319,8 @@ def _converge(ct_ext, pb, state, *, seed, fit_strategy, topo_keys,
                                     topo_keys=topo_keys, serial=serial,
                                     weights=weights,
                                     enabled_filters=enabled_filters,
-                                    cap_scale=cap, slot_start=slot_start)
+                                    cap_scale=cap, slot_start=slot_start,
+                                    ext_mask=ext_mask, ext_scores=ext_scores)
         _, n = carry
         return jax.lax.cond(n > 0, live, lambda c: c, carry)
 
@@ -328,7 +333,7 @@ def gang_schedule(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
                   fit_strategy: str = "LeastAllocated",
                   topo_keys: tuple[int, ...] = (), serial: bool = False,
                   max_rounds: int = 64, weights=None, enabled_filters=None,
-                  mesh=None):
+                  mesh=None, ext_mask=None, ext_scores=None):
     """Drive rounds until convergence. Returns (assignment [P] np.int32 with -1
     for unschedulable, rounds_used). ``weights`` (plugin->weight) and
     ``enabled_filters`` (set of filter names) carry the active profile's
@@ -351,10 +356,15 @@ def gang_schedule(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
     weights_t = tuple(sorted(weights.items())) if weights else ()
     filters_t = tuple(sorted(enabled_filters)) if enabled_filters else ()
     limit = max(P if serial else max_rounds, 1)
+    if ext_mask is not None:
+        ext_mask = jnp.asarray(ext_mask)
+    if ext_scores is not None:
+        ext_scores = jnp.asarray(ext_scores)
     state = gang_converge(ct_ext, pb, state, seed=seed,
                           fit_strategy=fit_strategy, topo_keys=topo_keys,
                           serial=serial, weights=weights_t,
-                          enabled_filters=filters_t, max_rounds=limit)
+                          enabled_filters=filters_t, max_rounds=limit,
+                          ext_mask=ext_mask, ext_scores=ext_scores)
     # one batched readback: sequential per-array fetches each pay a full
     # host<->device round trip (~100ms on remote-attached TPUs)
     assignment, rounds = jax.device_get((state.assignment, state.rounds))

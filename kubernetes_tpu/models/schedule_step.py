@@ -38,14 +38,18 @@ class StepResult(struct.PyTreeNode):
 def evaluate(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
              weights=None, fit_strategy: str = "LeastAllocated",
              topo_keys: tuple[int, ...] = (),
-             enabled_filters=None) -> StepResult:
+             enabled_filters=None, ext_mask=None,
+             ext_scores=None) -> StepResult:
     """Filter + score + select for the whole batch, assuming an EMPTY batch
     context (no intra-batch interactions — gang.py supplies those).
 
     ``topo_keys``: static tuple of distinct topology key-ids in play
     (meta.topo_keys) — unrolls into a handful of [N,N] domain matmuls.
     ``weights`` / ``enabled_filters``: the active profile's plugin config
-    (None = reference defaults / all filters)."""
+    (None = reference defaults / all filters). ``ext_mask``/``ext_scores``
+    [P,N]: host-computed scheduler-extender feasibility veto and weighted
+    score overlay (sched/extender.py) — the findNodesThatPassExtenders
+    position in the cycle."""
     def _on(name):
         return enabled_filters is None or name in enabled_filters
 
@@ -55,6 +59,8 @@ def evaluate(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
     if _on("InterPodAffinity"):
         feasible &= topology.interpod_required_mask(ct, pb, topo_keys)
         feasible &= topology.interpod_symmetry_mask(ct, pb, topo_keys)
+    if ext_mask is not None:
+        feasible &= ext_mask
     extra = {}
     if pb.sc_valid.shape[1] > 0:
         extra["PodTopologySpread"] = (
@@ -66,6 +72,8 @@ def evaluate(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
             jnp.any(pb.paff_valid, axis=1))
     scores = combined_score(ct, pb, feasible, weights=weights, extra_raw=extra,
                             fit_strategy=fit_strategy)
+    if ext_scores is not None:
+        scores = jnp.where(feasible, scores + ext_scores, scores)
     choice, has = select_host(scores, seed=seed)
     return StepResult(choice=choice.astype(jnp.int32),
                       assigned=has & jnp.any(feasible, axis=-1),

@@ -1,0 +1,15 @@
+"""Pallas TPU kernels — the native tier below the XLA ops.
+
+One kernel lives here today: ``domain_count.match_count``, the fused
+selector-match + per-node count that backs the relational plugins' domain
+counting (see ops/topology.py ``_count_pn``). It exists because XLA cannot
+fuse across the dot boundary between selector evaluation and the one-hot
+contraction, forcing the [E,P,T] match tensor through HBM; the kernel keeps
+it in VMEM. ``benchmarks/pallas_bench.py`` measures the difference on real
+hardware; enablement is opt-in (KTPU_PALLAS=1 / auto — see
+``domain_count.enabled`` for why it defaults off on remote-attached TPUs).
+"""
+
+from kubernetes_tpu.ops.pallas.domain_count import enabled, match_count
+
+__all__ = ["enabled", "match_count"]

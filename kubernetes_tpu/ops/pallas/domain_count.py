@@ -1,0 +1,227 @@
+"""Pallas TPU kernel: fused selector-match + per-node count.
+
+The XLA path (ops/topology.py ``_term_match_epods`` + ``_domain_counts``)
+computes
+
+    match[E,P,T] = selector-eval(sel, epod_labels) & ns_ok & valid
+    cnt_pn[P,T,N] = einsum(match, onehot(epod_node))
+
+XLA cannot fuse across the dot boundary, so the [E,P,T] match tensor round-
+trips HBM (E=16k, P=1k, T=4 -> 256 MB written + read per scheduling step).
+This kernel fuses the whole chain: each grid step loads an existing-pod tile
+into VMEM, evaluates the selector block against it (one-hot key gathers as
+[K,PTb] matmuls on the MXU), applies namespace + validity masks, and
+accumulates straight into the [PTb,Nb] count tile — the match tensor never
+exists outside VMEM.
+
+Reference semantics mirrored: ops/exprs.py eval_selector_set (In/NotIn/
+Exists/DoesNotExist; pad expressions neutral; nil selector matches nothing)
+and ops/topology.py _term_match_epods (own-namespace default, explicit
+resolved ns masks).
+
+Enable: KTPU_PALLAS=1 forces on, =auto enables on a TPU backend after a
+self-test compile, unset/0 = off (the default — see ``enabled``)."""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+# operator codes (encode/snapshot.py OPC)
+_OP_IN, _OP_NOT_IN, _OP_EXISTS, _OP_NOT_EXISTS = 0, 1, 2, 3
+
+# existing-pod / pod-term / node tile sizes. Kept small: Mosaic's register
+# allocator spills (VMEM OOM at compile) when the per-step live set grows —
+# measured 232 MB of spill slots at (512, 128, 512) on v5e.
+_EB, _PTB, _NB = 128, 128, 256
+
+
+def _kernel(epods_ref, key_ref, op_ref, ev_ref, vals_ref, meta_ref,
+            nsmask_ref, out_ref, *, K: int, X: int, V: int, NB: int,
+            ns_width: int):
+    """One (pt, n, e) grid step. epods [EB, K+3] f32 = labels ids | node idx |
+    ns id | valid. meta [PTB, 3] f32 = pod_ns | sel_valid | ns_explicit."""
+    e_i = pl.program_id(2)
+    n_i = pl.program_id(1)
+    epods = epods_ref[:]
+    labels = epods[:, :K]                                   # [EB, K]
+    enode = epods[:, K]                                     # [EB]
+    ens = epods[:, K + 1]
+    evalid_f = epods[:, K + 2]                              # 0/1
+    meta = meta_ref[:]                                      # [PTB, 3]
+    pod_ns = meta[:, 0]
+    sel_valid_f = meta[:, 1]
+    ns_explicit_f = meta[:, 2]
+
+    def ind(cond):  # Mosaic-safe boolean: 0/1 float masks, never stored i1
+        return jnp.where(cond, 1.0, 0.0).astype(jnp.float32)
+
+    # tpu.iota is integer-only: generate int32 and cast
+    kiota = jax.lax.broadcasted_iota(
+        jnp.int32, (K, _PTB), 0).astype(jnp.float32)            # [K, PTB]
+    match = jnp.ones((epods.shape[0], _PTB), jnp.float32)
+    for x in range(X):
+        kx = key_ref[:, x].astype(jnp.float32)              # [PTB]
+        in_range = ind((kx >= 0.0) & (kx < float(K)))
+        onehot_k = ind(kiota == kx[None, :])
+        v = jax.lax.dot(labels, onehot_k,
+                        precision=jax.lax.Precision.HIGHEST)  # [EB, PTB]
+        present = ind(v >= 0.0) * in_range[None, :]
+        in_set = jnp.zeros_like(present)
+        for vi in range(V):
+            val = vals_ref[:, x * V + vi].astype(jnp.float32)  # [PTB]
+            in_set = jnp.maximum(
+                in_set, ind(v == val[None, :]) * ind(val >= 0.0)[None, :])
+        pin = present * in_set                              # In satisfied
+        opx = op_ref[:, x].astype(jnp.float32)[None, :]     # [1, PTB]
+        mx = jnp.where(opx == _OP_IN, pin,
+                       jnp.where(opx == _OP_NOT_IN, 1.0 - pin,
+                                 jnp.where(opx == _OP_EXISTS, present,
+                                           1.0 - present)))
+        valid_x = ev_ref[:, x].astype(jnp.float32)[None, :]
+        match = match * jnp.maximum(mx, 1.0 - valid_x)      # pad exprs neutral
+    # namespace: own-ns equality, or membership in the term's resolved mask
+    own_ok = ind(ens[:, None] == pod_ns[None, :])           # [EB, PTB]
+    ns_iota = jax.lax.broadcasted_iota(
+        jnp.int32, (epods.shape[0], ns_width), 1).astype(jnp.float32)
+    onehot_ns = ind(ns_iota == ens[:, None])
+    # contract over NSB without transposing nsmask (in-kernel transposes
+    # trigger pathological Mosaic relayouts): [EB,NSB] x [PTB,NSB] -> [EB,PTB]
+    exp_ok = ind(jax.lax.dot_general(
+        onehot_ns, nsmask_ref[:], (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST) > 0.0)
+    ns_ok = jnp.where(ns_explicit_f[None, :] > 0.0, exp_ok, own_ok)
+    final = match * ns_ok * evalid_f[:, None] * sel_valid_f[None, :]
+    # scatter-add by node index as an MXU contraction against a one-hot tile
+    niota = jax.lax.broadcasted_iota(
+        jnp.int32, (epods.shape[0], NB), 1).astype(jnp.float32)
+    onehot_n = ind(niota == (enode[:, None] - float(NB) * n_i))
+    # contract over EB: [EB,PTB] x [EB,NB] -> [PTB,NB], no transpose
+    acc = jax.lax.dot_general(
+        final, onehot_n, (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST)                # [PTB, NB]
+
+    @pl.when(e_i == 0)
+    def _():
+        out_ref[:] = jnp.zeros(out_ref.shape, out_ref.dtype)
+    out_ref[:] += acc
+
+
+def _pad_to(a: np.ndarray, axis: int, mult: int, fill):
+    n = a.shape[axis]
+    target = -(-n // mult) * mult
+    if target == n:
+        return a
+    pads = [(0, 0)] * a.ndim
+    pads[axis] = (0, target - n)
+    return jnp.pad(a, pads, constant_values=fill)
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes", "interpret"))
+def match_count(epod_labels, epod_node, epod_ns, epod_valid, sel_key, sel_op,
+                sel_expr_valid, sel_vals, sel_valid, pod_ns,
+                ns_explicit=None, ns_mask=None, n_nodes: int = 0,
+                interpret: bool = False):
+    """Fused cnt_pn: [P,T,N] float32 — # existing pods matching each (pod,
+    term) selector, per node. Drop-in for the match×onehot einsum in
+    ops/topology.py _domain_counts."""
+    P, T, X = sel_key.shape
+    V = sel_vals.shape[-1]
+    E, K = epod_labels.shape
+    N = int(n_nodes)
+    if T == 0 or X == 0 or E == 0 or N == 0:
+        return jnp.zeros((P, T, N), jnp.float32)
+    if V == 0:
+        sel_vals = jnp.full((P, T, X, 1), -1, jnp.int32)
+        V = 1
+    if ns_explicit is None:
+        ns_explicit = jnp.zeros((P, T), bool)
+        ns_mask = jnp.zeros((P, T, 1), bool)
+    NSB = ns_mask.shape[-1]
+
+    # pack existing pods: labels | node | ns | valid, one f32 matrix
+    epods = jnp.concatenate([
+        epod_labels.astype(jnp.float32),
+        epod_node.astype(jnp.float32)[:, None],
+        epod_ns.astype(jnp.float32)[:, None],
+        epod_valid.astype(jnp.float32)[:, None]], axis=1)
+    epods = _pad_to(epods, 0, _EB, 0.0)  # padding rows have valid=0
+
+    PT = P * T
+    key2 = _pad_to(sel_key.reshape(PT, X), 0, _PTB, -1)
+    op2 = _pad_to(sel_op.reshape(PT, X), 0, _PTB, 0)
+    ev2 = _pad_to(sel_expr_valid.reshape(PT, X).astype(jnp.int32), 0, _PTB, 0)
+    vals2 = _pad_to(sel_vals.reshape(PT, X * V), 0, _PTB, -1)
+    meta = jnp.stack([
+        jnp.repeat(pod_ns.astype(jnp.float32), T),
+        sel_valid.reshape(PT).astype(jnp.float32),
+        ns_explicit.reshape(PT).astype(jnp.float32)], axis=1)
+    meta = _pad_to(meta, 0, _PTB, 0.0)
+    nsm = _pad_to(ns_mask.reshape(PT, NSB).astype(jnp.float32), 0, _PTB, 0.0)
+
+    PTp = key2.shape[0]
+    Ep = epods.shape[0]
+    Np = -(-N // _NB) * _NB
+    grid = (PTp // _PTB, Np // _NB, Ep // _EB)
+    out = pl.pallas_call(
+        functools.partial(_kernel, K=K, X=X, V=V, NB=_NB, ns_width=NSB),
+        out_shape=jax.ShapeDtypeStruct((PTp, Np), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_EB, K + 3), lambda pt, n, e: (e, 0)),
+            pl.BlockSpec((_PTB, X), lambda pt, n, e: (pt, 0)),
+            pl.BlockSpec((_PTB, X), lambda pt, n, e: (pt, 0)),
+            pl.BlockSpec((_PTB, X), lambda pt, n, e: (pt, 0)),
+            pl.BlockSpec((_PTB, X * V), lambda pt, n, e: (pt, 0)),
+            pl.BlockSpec((_PTB, 3), lambda pt, n, e: (pt, 0)),
+            pl.BlockSpec((_PTB, NSB), lambda pt, n, e: (pt, 0)),
+        ],
+        out_specs=pl.BlockSpec((_PTB, _NB), lambda pt, n, e: (pt, n)),
+        interpret=interpret,
+    )(epods, key2, op2, ev2, vals2, meta, nsm)
+    return out[:PT, :N].reshape(P, T, N)
+
+
+# ---------------------------------------------------------------- enablement
+
+_ENABLED: bool | None = None
+
+
+def enabled() -> bool:
+    """Opt-in via KTPU_PALLAS=1 (or =auto for TPU-backend + self-test).
+
+    Default is OFF: on remote-attached TPU runtimes (AOT compile over a
+    tunnel) Mosaic compilation of this kernel was measured to stall for
+    minutes, which would block the scheduler's first batch. The interpret-
+    mode parity suite (tests/test_pallas_kernel.py) pins the semantics;
+    benchmarks/pallas_bench.py is the gate for turning it on where the
+    toolchain compiles it promptly."""
+    global _ENABLED
+    if _ENABLED is None:
+        flag = os.environ.get("KTPU_PALLAS", "0").lower()
+        if flag in ("1", "true", "on"):
+            _ENABLED = True
+        elif flag == "auto":
+            _ENABLED = jax.default_backend() == "tpu" and _self_test()
+        else:
+            _ENABLED = False
+    return _ENABLED
+
+
+def _self_test() -> bool:
+    try:
+        out = match_count(
+            jnp.full((4, 2), -1, jnp.int32), jnp.zeros(4, jnp.int32),
+            jnp.zeros(4, jnp.int32), jnp.ones(4, bool),
+            jnp.full((1, 1, 1), -1, jnp.int32), jnp.zeros((1, 1, 1), jnp.int32),
+            jnp.zeros((1, 1, 1), bool), jnp.full((1, 1, 1, 1), -1, jnp.int32),
+            jnp.ones((1, 1), bool), jnp.zeros(1, jnp.int32), n_nodes=2)
+        jax.block_until_ready(out)
+        return True
+    except Exception:
+        return False

@@ -70,20 +70,38 @@ def _self_ns_ok(pb: PodBatch, ns_explicit, ns_mask):
     return jnp.where(ns_explicit, hit, True)
 
 
-def _domain_counts(ct: ClusterTensors, match_ept, term_topo, topo_keys,
+def _count_pn(ct: ClusterTensors, sel, pod_ns, ns_explicit=None, ns_mask=None):
+    """cnt_pn [P,T,N] f32: matching existing pods per (pod, term) per NODE
+    (before domain aggregation). Uses the fused Pallas kernel on TPU
+    (ops/pallas/domain_count.py) — the [E,P,T] match tensor never leaves
+    VMEM; falls back to the XLA match+einsum pair elsewhere."""
+    from kubernetes_tpu.ops.pallas import domain_count as _pk
+    N = ct.node_valid.shape[0]
+    T, X = sel.key.shape[1], sel.key.shape[2]
+    E = ct.epod_valid.shape[0]
+    if _pk.enabled() and T > 0 and X > 0 and E > 0 and N > 0:
+        return _pk.match_count(
+            ct.epod_labels, ct.epod_node, ct.epod_ns, ct.epod_valid,
+            sel.key, sel.op, sel.expr_valid, sel.vals, sel.valid, pod_ns,
+            ns_explicit=ns_explicit, ns_mask=ns_mask, n_nodes=int(N))
+    match_ept = _term_match_epods(ct, sel, pod_ns, ns_explicit, ns_mask)
+    onehot = (ct.epod_node[:, None] == jnp.arange(N)[None, :]).astype(jnp.float32)
+    return jnp.einsum("ept,en->ptn", match_ept, onehot)       # [P,T,N]
+
+
+def _domain_counts(ct: ClusterTensors, cnt_pn, term_topo, topo_keys,
                    elig=None, want_domains=False):
     """-> (cnt_dom [P,T,N] f32, node_has_key [P,T,N] bool,
            num_domains [P,T] f32 | None).
 
     cnt_dom[p,t,n] = # existing pods matching term (p,t) whose node shares
-    node n's domain for the term's topology key. Nodes lacking the key have
-    has_key False and count 0. ``elig`` [P,T,N] restricts which nodes'
-    pods participate (spread node-inclusion policies); ``want_domains``
-    additionally counts distinct domains with >=1 eligible node.
+    node n's domain for the term's topology key (``cnt_pn`` [P,T,N] from
+    ``_count_pn``). Nodes lacking the key have has_key False and count 0.
+    ``elig`` [P,T,N] restricts which nodes' pods participate (spread
+    node-inclusion policies); ``want_domains`` additionally counts distinct
+    domains with >=1 eligible node.
     """
     N = ct.node_valid.shape[0]
-    onehot = (ct.epod_node[:, None] == jnp.arange(N)[None, :]).astype(jnp.float32)
-    cnt_pn = jnp.einsum("ept,en->ptn", match_ept, onehot)     # [P,T,N]
     if elig is not None:
         cnt_pn = cnt_pn * elig.astype(jnp.float32)
     cnt_dom = jnp.zeros_like(cnt_pn)
@@ -136,9 +154,9 @@ def spread_mask(ct: ClusterTensors, pb: PodBatch, topo_keys: tuple[int, ...] = (
     if pb.sc_valid.shape[1] == 0:
         return jnp.ones(pb.pod_valid.shape + ct.node_valid.shape, bool)
     pol = _spread_policy_elig(ct, pb)                         # [P,S,N]
-    match = _term_match_epods(ct, pb.sc_sel, pb.pod_ns)       # [E,P,S]
+    cnt_pn = _count_pn(ct, pb.sc_sel, pb.pod_ns)              # [P,S,N]
     cnt, has_key, num_dom = _domain_counts(
-        ct, match, pb.sc_topo, topo_keys, elig=pol, want_domains=True)
+        ct, cnt_pn, pb.sc_topo, topo_keys, elig=pol, want_domains=True)
     # does the pod match its own constraint selector? (it lands in the domain)
     self_m = eval_selector_set(pb.sc_sel, pb.pod_labels)      # [Pt,P,S] over all pods
     P = pb.pod_valid.shape[0]
@@ -165,8 +183,9 @@ def spread_score_raw(ct: ClusterTensors, pb: PodBatch, topo_keys: tuple[int, ...
     if pb.sc_valid.shape[1] == 0:
         return jnp.zeros((P, N), jnp.float32)
     pol = _spread_policy_elig(ct, pb)
-    match = _term_match_epods(ct, pb.sc_sel, pb.pod_ns)
-    cnt, has_key, _ = _domain_counts(ct, match, pb.sc_topo, topo_keys, elig=pol)
+    cnt_pn = _count_pn(ct, pb.sc_sel, pb.pod_ns)
+    cnt, has_key, _ = _domain_counts(ct, cnt_pn, pb.sc_topo, topo_keys,
+                                     elig=pol)
     active = (pb.sc_valid & ~pb.sc_hard)[..., None]
     return jnp.sum(jnp.where(active & has_key, cnt, 0.0), axis=1)
 
@@ -181,9 +200,9 @@ def interpod_required_mask(ct: ClusterTensors, pb: PodBatch,
     P, N = pb.pod_valid.shape[0], ct.node_valid.shape[0]
     out = jnp.ones((P, N), bool)
     if pb.aff_valid.shape[1] > 0:
-        match = _term_match_epods(ct, pb.aff_sel, pb.pod_ns,
-                                  pb.aff_ns_explicit, pb.aff_ns_mask)
-        cnt, has_key, _ = _domain_counts(ct, match, pb.aff_topo, topo_keys)
+        cnt_pn = _count_pn(ct, pb.aff_sel, pb.pod_ns,
+                           pb.aff_ns_explicit, pb.aff_ns_mask)
+        cnt, has_key, _ = _domain_counts(ct, cnt_pn, pb.aff_topo, topo_keys)
         valid = pb.aff_valid[..., None]                         # [P,T,1]
         # filtering.go satisfyPodAffinity: every term's topology key must
         # exist on the node, unconditionally.
@@ -200,9 +219,9 @@ def interpod_required_mask(ct: ClusterTensors, pb: PodBatch,
         bootstrap = none_any_all & self_all                     # [P]
         out &= has_all_keys & (sat | bootstrap[:, None])
     if pb.anti_valid.shape[1] > 0:
-        match = _term_match_epods(ct, pb.anti_sel, pb.pod_ns,
-                                  pb.anti_ns_explicit, pb.anti_ns_mask)
-        cnt, has_key, _ = _domain_counts(ct, match, pb.anti_topo, topo_keys)
+        cnt_pn = _count_pn(ct, pb.anti_sel, pb.pod_ns,
+                           pb.anti_ns_explicit, pb.anti_ns_mask)
+        cnt, has_key, _ = _domain_counts(ct, cnt_pn, pb.anti_topo, topo_keys)
         viol = has_key & (cnt >= 1.0)
         out &= jnp.all(~viol | ~pb.anti_valid[..., None], axis=1)
     return out
@@ -248,8 +267,8 @@ def interpod_score_raw(ct: ClusterTensors, pb: PodBatch,
     P, N = pb.pod_valid.shape[0], ct.node_valid.shape[0]
     if pb.paff_valid.shape[1] == 0:
         return jnp.zeros((P, N), jnp.float32)
-    match = _term_match_epods(ct, pb.paff_sel, pb.pod_ns,
-                              pb.paff_ns_explicit, pb.paff_ns_mask)
-    cnt, has_key, _ = _domain_counts(ct, match, pb.paff_topo, topo_keys)  # [P,C,N]
+    cnt_pn = _count_pn(ct, pb.paff_sel, pb.pod_ns,
+                       pb.paff_ns_explicit, pb.paff_ns_mask)
+    cnt, has_key, _ = _domain_counts(ct, cnt_pn, pb.paff_topo, topo_keys)  # [P,C,N]
     w = jnp.where(pb.paff_valid, pb.paff_weight, 0.0)[..., None]
     return jnp.sum(jnp.where(has_key, cnt, 0.0) * w, axis=1)

@@ -59,6 +59,11 @@ class Scheduler:
         # PDBs for preemption victim selection; the runner wires this to its
         # poddisruptionbudgets informer
         self.pdb_lister: Callable[[], list] = lambda: []
+        # scheduler extenders (extender.go HTTPExtender analog)
+        from kubernetes_tpu.sched.extender import HTTPExtender, extender_binder
+        self._extenders = [HTTPExtender(c) for c in (cfg.extenders or [])]
+        self._extender_bind = (extender_binder(self._extenders)
+                               if self._extenders else None)
 
     # ---- one batch iteration --------------------------------------------
 
@@ -116,6 +121,28 @@ class Scheduler:
             ct = self.cache.overlay_nominated(ct, meta, entries)
         with TRACER.span("scheduler/encode_pods", pods=len(pods)):
             pb = self.cache.encode_pods(pods, meta)
+        ext_mask = ext_scores = None
+        ext_errors: set = set()
+        if self._extenders:
+            import numpy as np
+            from kubernetes_tpu.sched.extender import run_extenders
+            with TRACER.span("scheduler/extenders", pods=len(pods)):
+                m, s, ext_errors = run_extenders(self._extenders, pods, nodes)
+            Pb, Nb = pb.pod_valid.shape[0], ct.node_valid.shape[0]
+            if m is not None:  # pad to bucket dims; padding is neutral
+                ext_mask = np.ones((Pb, Nb), bool)
+                ext_mask[:m.shape[0], :m.shape[1]] = m
+            if s is not None:
+                ext_scores = np.zeros((Pb, Nb), np.float32)
+                ext_scores[:s.shape[0], :s.shape[1]] = s
+            if ext_errors:
+                # extender transport failure = attempt ERROR: exclude from
+                # the gang batch and requeue with backoff — never feed it to
+                # preemption as if the cluster had no room
+                valid = np.asarray(pb.pod_valid).copy()
+                for i in ext_errors:
+                    valid[i] = False
+                pb = pb.replace(pod_valid=valid)
         serial = not self.features.enabled("TPUBatchScheduling")
         with BATCH_DURATION.time(), TRACER.span(
                 "scheduler/gang_schedule", pods=len(pods), nodes=len(nodes)):
@@ -124,12 +151,19 @@ class Scheduler:
                 topo_keys=meta.topo_keys, serial=serial,
                 max_rounds=self.cfg.max_gang_rounds,
                 weights=profile.weights(),
-                enabled_filters=profile.enabled_filters)
+                enabled_filters=profile.enabled_filters,
+                ext_mask=ext_mask, ext_scores=ext_scores)
         GANG_ROUNDS.observe(rounds)
 
         n_bound = 0
         dt = time.time() - t0
-        for (pod, attempts), a in zip(items, assignment[:len(items)]):
+        for i, ((pod, attempts), a) in enumerate(
+                zip(items, assignment[:len(items)])):
+            if i in ext_errors:
+                self.queue.add_unschedulable(pod, attempts + 1)
+                SCHEDULE_ATTEMPTS.inc({"result": "error"})
+                ATTEMPT_DURATION.observe(dt, {"result": "error"})
+                continue
             if a >= 0:
                 node_name = meta.node_names[int(a)]
                 self._nominated.pop(pod.key, None)
@@ -195,7 +229,12 @@ class Scheduler:
 
     def _bind_one(self, pod: Pod, node_name: str):
         try:
-            ok = self.binder(pod, node_name)
+            ok = None
+            if self._extender_bind is not None:
+                # an interested extender with a bindVerb owns the binding
+                ok = self._extender_bind(pod, node_name)
+            if ok is None:
+                ok = self.binder(pod, node_name)
         except Exception:
             ok = False
         if ok:

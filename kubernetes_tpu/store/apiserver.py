@@ -51,6 +51,8 @@ CORE_RESOURCES = {
     "persistentvolumeclaims": ("PersistentVolumeClaim", True),
     "resourcequotas": ("ResourceQuota", True),
     "limitranges": ("LimitRange", True),
+    "secrets": ("Secret", True),
+    "serviceaccounts": ("ServiceAccount", True),
 }
 STORAGE_RESOURCES = {"storageclasses": ("StorageClass", False)}
 SCHEDULING_RESOURCES = {"priorityclasses": ("PriorityClass", False)}
@@ -144,7 +146,8 @@ class APIServer:
         ``bootstrap`` seeds the default system: roles/bindings."""
         from kubernetes_tpu.store.auth import (
             AuditLog, RBACAuthorizer, TokenAuthenticator, bootstrap_policy)
-        self.authenticator = authenticator or TokenAuthenticator()
+        self.authenticator = authenticator or TokenAuthenticator(
+            secret_source=self.store)
         self.authorizer = authorizer or RBACAuthorizer(self.store)
         self.audit = audit if audit is not None else AuditLog()
         if bootstrap:

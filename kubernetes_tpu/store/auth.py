@@ -60,16 +60,56 @@ class ForbiddenError(Exception):
     """403 — authenticated but not permitted."""
 
 
+SA_TOKEN_TYPE = "kubernetes.io/service-account-token"
+SA_NAME_ANNOTATION = "kubernetes.io/service-account.name"
+
+
 class TokenAuthenticator:
-    """Static bearer-token table (token-auth-file / SA token analog)."""
+    """Static bearer-token table (token-auth-file analog) plus dynamic
+    resolution of ServiceAccount tokens minted by the token controller:
+    Secrets of type ``kubernetes.io/service-account-token`` authenticate as
+    ``system:serviceaccount:<ns>:<name>`` with the serviceaccounts groups
+    (legacy SA token semantics — serviceaccount/tokens_controller.go)."""
 
     def __init__(self, tokens: Optional[dict] = None,
-                 allow_anonymous: bool = True):
+                 allow_anonymous: bool = True, secret_source=None):
         # token -> UserInfo | (name, groups)
         self._tokens: dict[str, UserInfo] = {}
         self.allow_anonymous = allow_anonymous
+        self._secret_source = secret_source  # ObjectStore | None
+        # token -> UserInfo index over SA-token secrets, keyed by the store's
+        # resourceVersion: requests between writes hit the map in O(1); a
+        # write (to anything) invalidates and the next SA-token request
+        # rebuilds. Keeps the plaintext scan off the per-request hot path.
+        self._sa_cache: tuple[int, dict] = (-1, {})
         for tok, who in (tokens or {}).items():
             self.add(tok, who)
+
+    def _sa_lookup(self, token: str) -> Optional[UserInfo]:
+        if self._secret_source is None:
+            return None
+        try:
+            rv = self._secret_source.resource_version
+            if rv != self._sa_cache[0]:
+                secrets, list_rv = self._secret_source.list("Secret")
+                index = {}
+                for s in secrets:
+                    if s.get("type") != SA_TOKEN_TYPE:
+                        continue
+                    tok = (s.get("data") or {}).get("token")
+                    md = s.get("metadata") or {}
+                    ns = md.get("namespace", "default")
+                    sa = (md.get("annotations") or {}).get(SA_NAME_ANNOTATION, "")
+                    if not tok or not sa:
+                        continue
+                    index[tok] = UserInfo(
+                        name=f"system:serviceaccount:{ns}:{sa}",
+                        groups=("system:serviceaccounts",
+                                f"system:serviceaccounts:{ns}"))
+                self._sa_cache = (list_rv, index)
+        except Exception:
+            return None
+        return self._sa_cache[1].get(token)
 
     def add(self, token: str, who) -> "TokenAuthenticator":
         if not isinstance(who, UserInfo):
@@ -84,6 +124,8 @@ class TokenAuthenticator:
         if h.lower().startswith("bearer "):
             token = h[7:].strip()
             user = self._tokens.get(token)
+            if user is None:
+                user = self._sa_lookup(token)
             if user is None:
                 raise AuthError("invalid bearer token")
             return user

@@ -1,0 +1,302 @@
+"""Scheduler extender: HTTP client in the cycle (extender.go HTTPExtender
+analog) and the tensor-backed extender server, including a round trip —
+our scheduler calling our own extender server.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.client.clientset import DirectClient
+from kubernetes_tpu.config.types import SchedulerConfiguration
+from kubernetes_tpu.sched.extender import (
+    ExtenderConfig,
+    HTTPExtender,
+    extender_binder,
+    run_extenders,
+)
+from kubernetes_tpu.sched.extender_server import TPUExtenderServer
+from kubernetes_tpu.store.store import ObjectStore
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+class FakeExtender:
+    """Scriptable extender endpoint: bans nodes by name, boosts one node,
+    records bind calls."""
+
+    def __init__(self, banned=(), boost=None, fail=False):
+        self.banned = set(banned)
+        self.boost = boost
+        self.fail = fail
+        self.bound = []
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                if srv.fail:
+                    self.send_error(500)
+                    return
+                if self.path.endswith("/filter"):
+                    names = payload.get("nodenames") or [
+                        (x.get("metadata") or {}).get("name", "")
+                        for x in ((payload.get("nodes") or {}).get("items") or [])]
+                    body = {"nodenames": [x for x in names
+                                          if x not in srv.banned]}
+                elif self.path.endswith("/prioritize"):
+                    names = payload.get("nodenames") or [
+                        (x.get("metadata") or {}).get("name", "")
+                        for x in ((payload.get("nodes") or {}).get("items") or [])]
+                    body = [{"host": x,
+                             "score": 10 if x == srv.boost else 0}
+                            for x in names]
+                elif self.path.endswith("/bind"):
+                    srv.bound.append((payload.get("podName"),
+                                      payload.get("node")))
+                    body = {}
+                else:
+                    self.send_error(404)
+                    return
+                data = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _ext(url, **kw):
+    return HTTPExtender(ExtenderConfig(
+        url_prefix=url, filter_verb=kw.pop("filter_verb", "filter"),
+        prioritize_verb=kw.pop("prioritize_verb", ""),
+        node_cache_capable=True, **kw))
+
+
+def test_extender_filter_and_prioritize():
+    fake = FakeExtender(banned=["n1"], boost="n2")
+    try:
+        pods = [make_pod("p0").obj()]
+        names = ["n0", "n1", "n2"]
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=fake.url, filter_verb="filter",
+            prioritize_verb="prioritize", weight=2.0,
+            node_cache_capable=True))
+        mask, scores, errs = run_extenders([ext], pods, names)
+        assert not errs
+        np.testing.assert_array_equal(mask, [[True, False, True]])
+        np.testing.assert_array_equal(scores, [[0.0, 0.0, 20.0]])
+    finally:
+        fake.stop()
+
+
+def test_extender_managed_resources_gating():
+    fake = FakeExtender(banned=["n0"])
+    try:
+        cfg = ExtenderConfig(url_prefix=fake.url, filter_verb="filter",
+                             node_cache_capable=True,
+                             managed_resources=["example.com/tpu"])
+        ext = HTTPExtender(cfg)
+        plain = make_pod("plain").req({"cpu": "1"}).obj()
+        managed = make_pod("managed").req({"example.com/tpu": "1"}).obj()
+        mask, _, errs = run_extenders([ext], [plain, managed], ["n0", "n1"])
+        assert not errs
+        # plain skips the extender; managed loses n0
+        np.testing.assert_array_equal(mask, [[True, True], [False, True]])
+    finally:
+        fake.stop()
+
+
+def test_extender_error_policies():
+    fake = FakeExtender(fail=True)
+    try:
+        pods = [make_pod("p0").obj()]
+        ignorable = HTTPExtender(ExtenderConfig(
+            url_prefix=fake.url, filter_verb="filter", ignorable=True,
+            node_cache_capable=True, timeout_s=2.0))
+        mask, _, errs = run_extenders([ignorable], pods, ["n0"])
+        assert not errs
+        assert mask is None  # skipped entirely, no mask produced
+        strict = HTTPExtender(ExtenderConfig(
+            url_prefix=fake.url, filter_verb="filter",
+            node_cache_capable=True, timeout_s=2.0))
+        mask, _, errs = run_extenders([strict], pods, ["n0"])
+        assert mask is None and errs == {0}  # attempt ERROR, not unschedulable
+    finally:
+        fake.stop()
+
+
+def test_extender_duplicate_names_still_filter():
+    """A misbehaving extender returning duplicate node names must not defeat
+    the veto of the nodes it dropped."""
+    pods = [make_pod("p0").obj()]
+
+    class DupExtender(HTTPExtender):
+        def filter(self, pod, node_names):
+            return ["n0", "n0"]  # drops n1, padded with a duplicate
+    ext = DupExtender(ExtenderConfig(url_prefix="http://unused",
+                                     filter_verb="filter"))
+    mask, _, _errs = run_extenders([ext], pods, ["n0", "n1"])
+    np.testing.assert_array_equal(mask, [[True, False]])
+
+
+def test_prioritize_errors_are_ignored():
+    """prioritizeNodesWithExtenders semantics: a failing prioritize never
+    fails the pod, even for non-ignorable extenders."""
+    fake = FakeExtender(fail=True)
+    try:
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=fake.url, prioritize_verb="prioritize",
+            node_cache_capable=True, timeout_s=2.0))
+        mask, scores, errs = run_extenders([ext], [make_pod("p0").obj()], ["n0"])
+        assert not errs
+        assert mask is None and scores is None
+    finally:
+        fake.stop()
+
+
+def test_extender_bind_delegation():
+    fake = FakeExtender()
+    try:
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=fake.url, bind_verb="bind", node_cache_capable=True))
+        bind = extender_binder([ext])
+        pod = make_pod("p0").obj()
+        assert bind(pod, "n3") is True
+        assert fake.bound == [("p0", "n3")]
+        # no interested extender -> None (default binder path)
+        gated = HTTPExtender(ExtenderConfig(
+            url_prefix=fake.url, bind_verb="bind",
+            managed_resources=["example.com/tpu"]))
+        assert extender_binder([gated])(pod, "n3") is None
+    finally:
+        fake.stop()
+
+
+# ------------------------------------------------- scheduler-in-the-loop
+
+def test_scheduler_respects_extender():
+    """End-to-end DirectClient scheduler run: the extender bans the only
+    otherwise-best node and boosts another; binding goes through the gang
+    path with the extender mask."""
+    from kubernetes_tpu.sched.cache import SchedulerCache
+    from kubernetes_tpu.sched.queue import SchedulingQueue
+    from kubernetes_tpu.sched.scheduler import Scheduler
+
+    fake = FakeExtender(banned=["n0"], boost="n2")
+    try:
+        cfg = SchedulerConfiguration(extenders=[ExtenderConfig(
+            url_prefix=fake.url, filter_verb="filter",
+            prioritize_verb="prioritize", weight=100.0,
+            node_cache_capable=True)])
+        cache = SchedulerCache()
+        for i in range(3):
+            cache.add_node(make_node(f"n{i}")
+                           .capacity({"cpu": "8", "pods": "10"}).obj())
+        queue = SchedulingQueue()
+        bound = {}
+        sched = Scheduler(cfg, cache, queue,
+                          binder=lambda p, n: bound.setdefault(p.key, n) or True)
+        pod = make_pod("p0").req({"cpu": "1"}).obj()
+        queue.add(pod)
+        sched.run_once(wait=0.1)
+        sched.wait_for_bindings()
+        assert bound.get("default/p0") == "n2", bound
+    finally:
+        fake.stop()
+
+
+# ------------------------------------------------- tensor-backed server
+
+def test_extender_server_filter_and_prioritize():
+    server = TPUExtenderServer().start()
+    try:
+        nodes = [make_node("big").capacity({"cpu": "8", "pods": "10"}).obj(),
+                 make_node("small").capacity({"cpu": "1", "pods": "10"}).obj()]
+        server.set_cluster(nodes, [])
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=server.url, filter_verb="filter",
+            prioritize_verb="prioritize", node_cache_capable=True,
+            timeout_s=60.0))
+        pod = make_pod("p0").req({"cpu": "4"}).obj()
+        assert ext.filter(pod, ["big", "small"]) == ["big"]
+        scores = ext.prioritize(pod, ["big", "small"])
+        assert scores["big"] > 0
+    finally:
+        server.stop()
+
+
+def test_extender_server_full_node_objects_mode():
+    """nodeCacheCapable=False (the default, what a stock kube-scheduler
+    sends): full Node objects go out, and the response mirrors the request
+    shape with nodes.items."""
+    server = TPUExtenderServer().start()
+    try:
+        nodes = [make_node("big").capacity({"cpu": "8", "pods": "10"}).obj(),
+                 make_node("small").capacity({"cpu": "1", "pods": "10"}).obj()]
+        server.set_cluster(nodes, [])
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=server.url, filter_verb="filter",
+            prioritize_verb="prioritize", timeout_s=60.0))  # NOT cache capable
+        pod = make_pod("p0").req({"cpu": "4"}).obj()
+        assert ext.filter(pod, nodes) == ["big"]
+        scores = ext.prioritize(pod, nodes)
+        assert scores["big"] > 0
+        # the wire really carried full node objects (allocatable visible)
+        raw = ext._args(pod, nodes)
+        assert raw["nodes"]["items"][0]["status"]["allocatable"]["cpu"] == "8"
+    finally:
+        server.stop()
+
+
+def test_extender_server_round_trip_through_scheduler():
+    """Our scheduler consuming our own extender server: the server's filter
+    (backed by the tensor pipeline over ITS view of the cluster) vetoes the
+    node that is full in the server's cluster state."""
+    from kubernetes_tpu.sched.cache import SchedulerCache
+    from kubernetes_tpu.sched.queue import SchedulingQueue
+    from kubernetes_tpu.sched.scheduler import Scheduler
+
+    server = TPUExtenderServer().start()
+    try:
+        nodes = [make_node("n0").capacity({"cpu": "2", "pods": "10"}).obj(),
+                 make_node("n1").capacity({"cpu": "2", "pods": "10"}).obj()]
+        # in the SERVER's view, n0 is already full
+        hog = make_pod("hog").req({"cpu": "2"}).node("n0").obj()
+        server.set_cluster(nodes, [hog])
+
+        cfg = SchedulerConfiguration(extenders=[ExtenderConfig(
+            url_prefix=server.url, filter_verb="filter",
+            node_cache_capable=True, timeout_s=60.0)])
+        cache = SchedulerCache()
+        for n in nodes:
+            cache.add_node(n)  # the local view has BOTH nodes free
+        queue = SchedulingQueue()
+        bound = {}
+        sched = Scheduler(cfg, cache, queue,
+                          binder=lambda p, n: bound.setdefault(p.key, n) or True)
+        pod = make_pod("p0").req({"cpu": "1"}).obj()
+        queue.add(pod)
+        sched.run_once(wait=0.1)
+        sched.wait_for_bindings()
+        assert bound.get("default/p0") == "n1", bound
+    finally:
+        server.stop()
