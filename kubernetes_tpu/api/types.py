@@ -531,6 +531,9 @@ class PodSpec:
     overhead: dict[str, Any] = field(default_factory=dict)
     restart_policy: str = "Always"
     volumes: list[dict[str, Any]] = field(default_factory=list)
+    # DRA (resource.k8s.io): [{"name", "resourceClaimName" |
+    # "resourceClaimTemplateName"}] — pod-level device claims
+    resource_claims: list[dict[str, Any]] = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: dict) -> "PodSpec":
@@ -551,6 +554,7 @@ class PodSpec:
             overhead=dict(d.get("overhead") or {}),
             restart_policy=d.get("restartPolicy", "Always"),
             volumes=list(d.get("volumes") or []),
+            resource_claims=list(d.get("resourceClaims") or []),
         )
 
     def to_dict(self) -> dict:
@@ -579,6 +583,8 @@ class PodSpec:
             d["overhead"] = dict(self.overhead)
         if self.volumes:
             d["volumes"] = list(self.volumes)
+        if self.resource_claims:
+            d["resourceClaims"] = list(self.resource_claims)
         return d
 
 

@@ -263,6 +263,9 @@ class HTTPClient(_Handles):
             "/apis/batch/v1" if plural == "cronjobs" else
             "/apis/autoscaling/v2" if plural == "horizontalpodautoscalers" else
             "/apis/discovery.k8s.io/v1" if plural == "endpointslices" else
+            "/apis/resource.k8s.io/v1" if plural in (
+                "resourceclaims", "resourceclaimtemplates", "deviceclasses",
+                "resourceslices") else
             "/apis/rbac.authorization.k8s.io/v1" if plural in RBAC_RESOURCES
             else "/api/v1")
         p = group

@@ -19,6 +19,7 @@ from kubernetes_tpu.controllers.manager import ControllerManager
 from kubernetes_tpu.controllers.namespace import NamespaceController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+from kubernetes_tpu.controllers.resourceclaim import ResourceClaimController
 from kubernetes_tpu.controllers.serviceaccount import (
     ServiceAccountController,
     TokenController,
@@ -32,6 +33,7 @@ __all__ = [
     "EndpointsController", "EndpointSliceController", "GarbageCollector",
     "HorizontalPodAutoscalerController", "JobController",
     "NamespaceController", "NodeLifecycleController", "ReplicaSetController",
+    "ResourceClaimController",
     "ServiceAccountController", "StatefulSetController",
     "TTLAfterFinishedController", "TokenController", "active_pods",
     "controller_of",

@@ -15,7 +15,7 @@ from kubernetes_tpu.store.apiserver import ALL_RESOURCES
 # kinds tracked in the ownership graph (plural -> kind, namespaced)
 GC_RESOURCES = ("pods", "replicasets", "deployments", "statefulsets",
                 "daemonsets", "jobs", "cronjobs", "endpoints",
-                "endpointslices", "serviceaccounts", "secrets")
+                "endpointslices", "serviceaccounts", "secrets", "resourceclaims")
 
 
 class GarbageCollector:

@@ -25,6 +25,7 @@ from kubernetes_tpu.controllers.namespace import NamespaceController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.pvbinder import PersistentVolumeController
 from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+from kubernetes_tpu.controllers.resourceclaim import ResourceClaimController
 from kubernetes_tpu.controllers.serviceaccount import (
     ServiceAccountController,
     TokenController,
@@ -36,7 +37,8 @@ DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "daemonset",
                        "statefulset", "endpoints", "endpointslice",
                        "nodelifecycle", "pvbinder", "disruption", "cronjob",
                        "ttlafterfinished", "horizontalpodautoscaler",
-                       "namespace", "serviceaccount", "serviceaccount-token")
+                       "namespace", "serviceaccount", "serviceaccount-token",
+                       "resourceclaim")
 
 
 class ControllerManager:
@@ -64,6 +66,7 @@ class ControllerManager:
             "namespace": NamespaceController,
             "endpointslice": EndpointSliceController,
             "serviceaccount": ServiceAccountController,
+            "resourceclaim": ResourceClaimController,
             "serviceaccount-token": TokenController,
         }
         self.controllers = [ctors[n](client) for n in controllers]
@@ -143,4 +146,5 @@ def _informer_attr(c) -> str:
         "disruption": "pdb_informer",
         "serviceaccount": "ns_informer",
         "serviceaccount-token": "sa_informer",
+        "resourceclaim": "pod_informer",
     }.get(c.name, "")

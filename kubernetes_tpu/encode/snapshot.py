@@ -308,6 +308,7 @@ class SnapshotEncoder:
         self._image_sizes: list[float] = []
         self._cluster_topo_keys: set[int] = set()
         self._volumes = None  # VolumeCatalog | None
+        self._dra = None  # sched/dra.DraCatalog | None
         self._namespace_labels: dict[str, dict] = {}
         # does any encoded existing-pod anti term carry a namespaceSelector?
         # (only then does the cluster encoding depend on namespace labels)
@@ -326,6 +327,12 @@ class SnapshotEncoder:
         affinity terms' namespaceSelector (GetNamespaceLabelsSnapshot
         analog)."""
         self._namespace_labels = dict(namespace_labels or {})
+
+    def set_dra(self, catalog) -> None:
+        """Attach the DRA catalog (sched/dra.DraCatalog): device classes
+        become synthetic ``dra:<class>`` resources on the shared axis —
+        slices extend node allocatable, claim demands extend pod requests."""
+        self._dra = catalog
 
     @property
     def cluster_depends_on_namespace_labels(self) -> bool:
@@ -370,6 +377,11 @@ class SnapshotEncoder:
         reserving would widen every relational contraction for nothing)."""
         self.generation += 1
         resources = _resource_union(nodes, bound_pods + list(pending_pods or []))
+        if self._dra is not None:
+            from kubernetes_tpu.sched.dra import DRA_PREFIX
+            for cname in sorted(self._dra.class_names()):
+                if DRA_PREFIX + cname not in resources:
+                    resources.append(DRA_PREFIX + cname)
         R = len(resources)
         N = next_bucket(len(nodes), minimum=1)
 
@@ -450,7 +462,9 @@ class SnapshotEncoder:
         for i, n in enumerate(nodes):
             node_valid[i] = True
             unschedulable[i] = n.spec.unschedulable
-            alloc = n.allocatable_canonical()
+            alloc = dict(n.allocatable_canonical())
+            if self._dra is not None:
+                alloc.update(self._dra.node_capacity(n.metadata.name))
             for r_idx, r in enumerate(resources):
                 if r in alloc:
                     allocatable[i, r_idx] = min(scale_allocatable(r, alloc[r]), UNLIMITED)
@@ -603,9 +617,15 @@ class SnapshotEncoder:
 
     # -- incremental pod deltas --------------------------------------------
 
-    @staticmethod
-    def _request_vector(p: Pod, resources: list[str]) -> np.ndarray:
-        reqs = p.resource_requests()
+    def _effective_requests(self, p: Pod) -> dict:
+        """resource -> canonical amount, including DRA device demands."""
+        reqs = dict(p.resource_requests())
+        if self._dra is not None:
+            reqs.update(self._dra.pod_demands(p))
+        return reqs
+
+    def _request_vector(self, p: Pod, resources: list[str]) -> np.ndarray:
+        reqs = self._effective_requests(p)
         vec = np.zeros(len(resources), np.int32)
         for r_idx, r in enumerate(resources):
             if r in reqs:
@@ -639,7 +659,7 @@ class SnapshotEncoder:
             ni = st.node_index.get(p.spec.node_name, -1)
             if ni < 0:
                 return None
-            reqs = p.resource_requests()
+            reqs = self._effective_requests(p)
             if any(r not in st.res_index for r in reqs):
                 return None          # new resource kind widens R
             label_ids = self._label_ids(p.metadata.labels)
@@ -1008,10 +1028,19 @@ class SnapshotEncoder:
             pod_ns[i] = c["ns"]
             if p.spec.node_name:
                 forced_node[i] = meta.node_index.get(p.spec.node_name, -2)
-            reqs = p.resource_requests()
-            for r_idx, r in enumerate(meta.resources):
-                if r in reqs:
-                    requests[i, r_idx] = scale_request(r, reqs[r])
+            if self._dra is not None and p.spec.resource_claims:
+                if not self._dra.pod_claims_ready(p):
+                    # referenced claim doesn't exist yet (template race):
+                    # hold unschedulable, never drop the device demand
+                    forced_node[i] = -2
+                else:
+                    # an already-allocated claim pins the pod to its node
+                    # (dynamicresources.go Filter on claim.status.allocation)
+                    alloc_node = self._dra.pod_allocated_node(p)
+                    if alloc_node and not p.spec.node_name:
+                        forced_node[i] = meta.node_index.get(alloc_node, -2)
+            vec = self._request_vector(p, meta.resources)
+            requests[i, :len(meta.resources)] = vec
             for kid, vid in c["labels"].items():
                 pod_labels[i, kid] = vid
             for t_idx, (kid, opc, vid, eff) in enumerate(c["tols"]):

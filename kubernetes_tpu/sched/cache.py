@@ -31,6 +31,7 @@ class SchedulerCache:
         self._cached: Optional[tuple[int, ClusterTensors, SnapshotMeta]] = None
         self.assume_ttl = assume_ttl
         self._volumes = None  # VolumeCatalog once any PVC/PV/SC appears
+        self._dra = None      # DraCatalog once any resource.k8s.io object appears
         self._namespace_labels: dict[str, dict] = {}
         # incremental-snapshot delta tracking (Cache.UpdateSnapshot analog):
         # pod churn accumulates here and patches the cached encoding in place;
@@ -72,6 +73,52 @@ class SchedulerCache:
     def volume_catalog(self):
         with self._lock:
             return self._volumes
+
+    # ---- DRA objects (resource.k8s.io informers feed this) ---------------
+
+    def update_dra_object(self, kind: str, obj: dict, deleted: bool = False):
+        """Track ResourceClaim/DeviceClass/ResourceSlice state; device
+        classes become dra:<class> resources in the next encoding.
+
+        Claim STATUS churn (allocation/reservedFor — which the scheduler
+        itself writes on every bind of a claimed pod) must not invalidate
+        the cluster encoding: pod batches read the live catalog at encode
+        time, and the cluster tensors only depend on claim SPECS (bound
+        pods' demands), slices, and the class set."""
+        from kubernetes_tpu.sched.dra import DraCatalog
+        with self._lock:
+            if self._dra is None:
+                self._dra = DraCatalog()
+            md = obj.get("metadata") or {}
+            if kind == "ResourceClaim":
+                key = (md.get("namespace", "default"), md.get("name", ""))
+                space = self._dra.claims
+            elif kind == "DeviceClass":
+                key = md.get("name", "")
+                space = self._dra.classes
+            elif kind == "ResourceSlice":
+                key = md.get("name", "")
+                space = self._dra.slices
+            else:
+                return
+            old = space.get(key)
+            if deleted:
+                if space.pop(key, None) is None:
+                    return
+            else:
+                space[key] = obj
+            self._encoder.set_dra(self._dra)
+            if (kind == "ResourceClaim" and old is not None and not deleted
+                    and DraCatalog.claim_demands(old)
+                    == DraCatalog.claim_demands(obj)):
+                return  # status-only change: encoding-neutral
+            self._generation += 1
+            self._needs_full = True
+
+    @property
+    def dra_catalog(self):
+        with self._lock:
+            return self._dra
 
     # ---- namespace labels (Namespace informer feeds this) ----------------
 

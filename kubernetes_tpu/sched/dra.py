@@ -1,0 +1,175 @@
+"""Dynamic Resource Allocation (DRA) — device claims as scheduling inputs.
+
+Reference: ``pkg/scheduler/framework/plugins/dynamicresources/`` with the
+structured-parameters model (resource.k8s.io/v1): ``ResourceSlice`` publishes
+each node's device inventory, ``DeviceClass`` names a class of devices,
+``ResourceClaim`` requests devices (``spec.devices.requests[]`` with
+``deviceClassName`` + ``count``), pods reference claims via
+``spec.resourceClaims``, and the scheduler allocates devices during the
+scheduling cycle, recording the result in ``claim.status.allocation``.
+
+TPU-first design: instead of a bespoke allocator plugin, device classes ride
+the EXISTING resource axis as synthetic resources named ``dra:<class>`` —
+a node's slice inventory extends its allocatable vector and a pod's claim
+demands extend its request vector. The jitted fit filter, the gang batcher's
+capacity-contention acceptance, and preemption then all handle devices with
+zero new tensor code, which is exactly the property the reference's
+NodeResources machinery lacks and its DRA plugin re-implements host-side.
+The claim OBJECTS keep full API semantics: allocation is written on bind
+(``SchedulerRunner``), ``reservedFor`` tracks the consumer, and the claim
+controller releases allocations when consumers disappear.
+
+Simplifications (documented, not silent): devices within a class are
+fungible (counts, not per-device attributes/selectors), and a claim has a
+single consumer (``reservedFor`` of one — the common template-per-pod
+shape).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kubernetes_tpu.api.types import Pod
+
+DRA_PREFIX = "dra:"
+
+
+@dataclass
+class DraCatalog:
+    """Indexed view of the resource.k8s.io objects (informer-fed)."""
+
+    # (namespace, name) -> ResourceClaim dict
+    claims: dict[tuple, dict] = field(default_factory=dict)
+    # name -> DeviceClass dict
+    classes: dict[str, dict] = field(default_factory=dict)
+    # name -> ResourceSlice dict
+    slices: dict[str, dict] = field(default_factory=dict)
+
+    @classmethod
+    def from_lists(cls, claims=(), classes=(), slices=()) -> "DraCatalog":
+        cat = cls()
+        for c in claims:
+            md = c.get("metadata") or {}
+            cat.claims[(md.get("namespace", "default"), md.get("name", ""))] = c
+        for c in classes:
+            cat.classes[(c.get("metadata") or {}).get("name", "")] = c
+        for s in slices:
+            cat.slices[(s.get("metadata") or {}).get("name", "")] = s
+        return cat
+
+    # ---- claim-side resolution ------------------------------------------
+
+    def pod_claims(self, pod: Pod) -> list[dict]:
+        """Resolve the pod's referenced ResourceClaim objects (template
+        references resolve to the generated per-pod claim named
+        ``<pod>-<ref name>`` — the resourceclaim controller's convention)."""
+        out = []
+        ns = pod.metadata.namespace
+        for ref in pod.spec.resource_claims:
+            name = ref.get("resourceClaimName") or (
+                f"{pod.metadata.name}-{ref.get('name', '')}"
+                if ref.get("resourceClaimTemplateName") else "")
+            claim = self.claims.get((ns, name))
+            if claim is not None:
+                out.append(claim)
+        return out
+
+    @staticmethod
+    def claim_demands(claim: dict) -> dict[str, int]:
+        """class name -> device count requested by the claim."""
+        out: dict[str, int] = {}
+        devices = ((claim.get("spec") or {}).get("devices") or {})
+        for req in devices.get("requests") or []:
+            cls_name = req.get("deviceClassName", "")
+            if not cls_name:
+                continue
+            out[cls_name] = out.get(cls_name, 0) + int(req.get("count", 1))
+        return out
+
+    def pod_claims_ready(self, pod: Pod) -> bool:
+        """Every referenced claim resolves to an existing ResourceClaim.
+        A pod whose template-generated claim hasn't been created yet must be
+        held unschedulable (dynamicresources PreFilter returns Unschedulable)
+        — NOT scheduled with its device demand silently dropped."""
+        ns = pod.metadata.namespace
+        for ref in pod.spec.resource_claims:
+            name = ref.get("resourceClaimName") or (
+                f"{pod.metadata.name}-{ref.get('name', '')}"
+                if ref.get("resourceClaimTemplateName") else "")
+            if not name or (ns, name) not in self.claims:
+                return False
+        return True
+
+    def pod_demands(self, pod: Pod) -> dict[str, int]:
+        """Synthetic request vector extension: ``dra:<class>`` -> count."""
+        out: dict[str, int] = {}
+        for claim in self.pod_claims(pod):
+            for cls_name, n in self.claim_demands(claim).items():
+                key = DRA_PREFIX + cls_name
+                out[key] = out.get(key, 0) + n
+        return out
+
+    def pod_allocated_node(self, pod: Pod) -> Optional[str]:
+        """If any referenced claim is already allocated, the pod is pinned
+        to that node (the allocation's node selector)."""
+        for claim in self.pod_claims(pod):
+            alloc = ((claim.get("status") or {}).get("allocation")) or {}
+            node = alloc.get("nodeName", "")
+            if node:
+                return node
+        return None
+
+    # ---- node-side resolution -------------------------------------------
+
+    def node_capacity(self, node_name: str) -> dict[str, int]:
+        """``dra:<class>`` -> total devices this node publishes via slices."""
+        out: dict[str, int] = {}
+        for s in self.slices.values():
+            spec = s.get("spec") or {}
+            if spec.get("nodeName", "") != node_name:
+                continue
+            for dev in spec.get("devices") or []:
+                cls_name = dev.get("deviceClassName", "")
+                if not cls_name:
+                    continue
+                count = int(dev.get("count", 1))
+                key = DRA_PREFIX + cls_name
+                out[key] = out.get(key, 0) + count
+        return out
+
+    def class_names(self) -> set[str]:
+        """Every device class referenced by any slice or claim (defines
+        which synthetic resources exist this snapshot)."""
+        names: set[str] = set()
+        for s in self.slices.values():
+            for dev in ((s.get("spec") or {}).get("devices")) or []:
+                if dev.get("deviceClassName"):
+                    names.add(dev["deviceClassName"])
+        for c in self.claims.values():
+            names.update(self.claim_demands(c))
+        return names
+
+
+def allocation_patch(claim: dict, node_name: str, pod: Pod) -> dict:
+    """The claim object with allocation + reservedFor recorded (what the
+    scheduler writes in PreBind — dynamicresources.go bindClaim)."""
+    out = dict(claim)
+    status = dict(claim.get("status") or {})
+    status["allocation"] = {"nodeName": node_name}
+    status["reservedFor"] = [{"resource": "pods",
+                              "name": pod.metadata.name,
+                              "uid": pod.metadata.uid}]
+    out["status"] = status
+    return out
+
+
+def release_patch(claim: dict) -> dict:
+    """The claim with its allocation dropped (deallocate — the claim
+    controller applies this when the consuming pod is gone)."""
+    out = dict(claim)
+    status = dict(claim.get("status") or {})
+    status.pop("allocation", None)
+    status.pop("reservedFor", None)
+    out["status"] = status
+    return out

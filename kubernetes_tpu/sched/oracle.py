@@ -124,6 +124,7 @@ class FailReason:
     POD_AFFINITY = "node(s) didn't match pod affinity rules"
     POD_ANTI_AFFINITY = "node(s) didn't satisfy existing pods anti-affinity rules"
     VOLUME = "node(s) had volume node affinity conflict"
+    CLAIM = "pod has missing/unresolved ResourceClaims"
 
 
 class OracleScheduler:
@@ -132,22 +133,44 @@ class OracleScheduler:
 
     def __init__(self, nodes: list[Node], bound_pods: Optional[list[Pod]] = None,
                  weights: Optional[dict[str, float]] = None, seed: int = 0,
-                 volumes=None, namespace_labels: Optional[dict] = None):
+                 volumes=None, namespace_labels: Optional[dict] = None,
+                 dra=None):
         self.states = [NodeState.build(n) for n in nodes]
         self.node_index = {n.metadata.name: i for i, n in enumerate(nodes)}
         self.weights = dict(weights or DEFAULT_WEIGHTS)
         self.seed = seed
         self.volumes = volumes  # VolumeCatalog | None
+        self.dra = dra          # sched/dra.DraCatalog | None
         # namespace name -> labels, for namespaceSelector resolution
         # (GetNamespaceLabelsSnapshot analog)
         self.namespace_labels = dict(namespace_labels or {})
+        if dra is not None:
+            # device slices extend node allocatable as dra:<class> counts —
+            # the same synthetic-resource folding the encoder does
+            for st in self.states:
+                for r, q in dra.node_capacity(st.node.metadata.name).items():
+                    st.allocatable[r] = scale_allocatable(r, q)
         for p in bound_pods or []:
             i = self.node_index.get(p.spec.node_name)
             if i is not None:
                 self.states[i].add_pod(p)
+                self._fold_demands(self.states[i], p)
         from kubernetes_tpu.sched.volumebinding import cluster_volume_state
         self._vol_rwo, self._vol_attach, self._vol_rwop = cluster_volume_state(
             [p for st in self.states for p in st.pods], volumes)
+
+    def _fold_demands(self, st: NodeState, pod: Pod, sign: int = 1):
+        """Fold a pod's DRA device demands into the node's requested map."""
+        if self.dra is None:
+            return
+        for r, q in self.dra.pod_demands(pod).items():
+            st.requested[r] = st.requested.get(r, 0) + sign * scale_request(r, q)
+
+    def _eff_requests(self, pod: Pod) -> dict:
+        reqs = dict(pod.resource_requests())
+        if self.dra is not None:
+            reqs.update(self.dra.pod_demands(pod))
+        return reqs
 
     def _volume_ok(self, pod: Pod, node: Node, vinfo) -> bool:
         """VolumeBinding/Zone/Restrictions/Limits, serial reference form."""
@@ -177,7 +200,13 @@ class OracleScheduler:
             return FailReason.UNSCHEDULABLE
         if pod.spec.node_name and pod.spec.node_name != node.metadata.name:
             return FailReason.NODE_NAME
-        for r, q in pod.resource_requests().items():
+        if self.dra is not None and pod.spec.resource_claims:
+            if not self.dra.pod_claims_ready(pod):
+                return FailReason.CLAIM  # template-generated claim not yet made
+            pin = self.dra.pod_allocated_node(pod)
+            if not pod.spec.node_name and pin and pin != node.metadata.name:
+                return FailReason.NODE_NAME  # allocated claim pins the pod
+        for r, q in self._eff_requests(pod).items():
             need = scale_request(r, q)
             if need > st.allocatable.get(r, 0) - st.requested.get(r, 0):
                 return FailReason.RESOURCES
@@ -565,6 +594,7 @@ class OracleScheduler:
     def assume(self, pod: Pod, node_idx: int):
         pod.spec.node_name = self.states[node_idx].node.metadata.name
         self.states[node_idx].add_pod(pod)
+        self._fold_demands(self.states[node_idx], pod)
 
     def schedule_all(self, pods: list[Pod]):
         """Serial loop over the batch (ScheduleOne x N) in activeQ order —

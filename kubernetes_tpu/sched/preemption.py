@@ -60,7 +60,7 @@ def _violates(pod: Pod, budgets_used: list) -> bool:
 
 
 def find_candidate(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
-                   pdbs: Optional[list[dict]] = None,
+                   pdbs: Optional[list[dict]] = None, dra=None,
                    ) -> Optional[PreemptionResult]:
     """Find the best node + minimal victim set enabling ``pod`` to schedule.
 
@@ -75,7 +75,7 @@ def find_candidate(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
     budgets = _pdb_budgets(pdbs or [], bound_pods)
     best: Optional[tuple] = None
     for i, node in enumerate(nodes):
-        found = _victims_on_node(nodes, bound_pods, pod, node, budgets)
+        found = _victims_on_node(nodes, bound_pods, pod, node, budgets, dra=dra)
         if found is None:
             continue
         victims, violations = found
@@ -92,7 +92,7 @@ def find_candidate(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
         num_pdb_violations=best[3])
 
 
-def _victims_on_node(nodes, bound_pods, pod, node, budgets
+def _victims_on_node(nodes, bound_pods, pod, node, budgets, dra=None
                      ) -> Optional[tuple[list[Pod], int]]:
     on_node = [p for p in bound_pods if p.spec.node_name == node.metadata.name]
     lower = [p for p in on_node if p.spec.priority < pod.spec.priority]
@@ -110,7 +110,10 @@ def _victims_on_node(nodes, bound_pods, pod, node, budgets
 
     def feasible_without(removed: set[str]) -> bool:
         remaining = [p for p in bound_pods if p.metadata.uid not in removed]
-        orc = OracleScheduler(nodes, remaining)
+        # the dra catalog keeps device demand/capacity visible to the
+        # what-if feasibility check (else victimless device shortages
+        # would look solvable by evicting unrelated pods)
+        orc = OracleScheduler(nodes, remaining, dra=dra)
         mask, _ = orc.feasible(pod)
         return bool(mask[ni])
 

@@ -109,11 +109,40 @@ class SchedulerRunner:
             self.queue.move_all_to_active_or_backoff(EVENT_NODE_UPDATE)
         return handler
 
+    def _on_dra(self, kind: str):
+        def handler(type_, obj, old):
+            self.cache.update_dra_object(kind, obj, deleted=type_ == DELETED)
+            # a new slice/claim (or a released allocation) can unblock pods
+            self.queue.move_all_to_active_or_backoff(EVENT_NODE_UPDATE)
+        return handler
+
     # ---- binding via API (DefaultBinder analog) --------------------------
 
     def _bind(self, pod: Pod, node_name: str) -> bool:
-        # PreBind: volumes first (volumebinding.go BindPodVolumes), then the
-        # pod binding itself.
+        # PreBind: claim allocations (dynamicresources.go bindClaim), then
+        # volumes (volumebinding.go BindPodVolumes), then the binding itself.
+        # Any later failure must UNRESERVE the claims we just allocated
+        # (the plugin's Unreserve hook) or the pod stays pinned to a node it
+        # never bound to.
+        allocated: list[dict] = []
+        dra = self.cache.dra_catalog
+        if dra is not None and pod.spec.resource_claims:
+            from kubernetes_tpu.sched.dra import allocation_patch
+            for claim in dra.pod_claims(pod):
+                if ((claim.get("status") or {}).get("allocation")):
+                    continue  # already allocated (shared or re-bind)
+                ns = (claim.get("metadata") or {}).get("namespace", "default")
+                patched = allocation_patch(claim, node_name, pod)
+                try:
+                    self.client.resource("resourceclaims", ns).update_status(
+                        patched)
+                    allocated.append(patched)
+                except ApiError as e:
+                    if e.code != 409:
+                        _LOG.warning("claim allocation for %s failed: %s",
+                                     pod.key, e)
+                        self._unreserve(allocated)
+                        return False
         catalog = self.cache.volume_catalog
         if catalog is not None and pod.pvc_names():
             from kubernetes_tpu.sched.volumebinding import VolumeBinder
@@ -122,11 +151,13 @@ class SchedulerRunner:
             labels = node.metadata.labels if node is not None else {}
             if not VolumeBinder(self.client).bind_pod_volumes(
                     pod, node, catalog, labels, node_name):
+                self._unreserve(allocated)
                 return False
         try:
             self.client.pods(pod.metadata.namespace).bind(pod.metadata.name, node_name)
             return True
         except ApiError as e:
+            self._unreserve(allocated)
             # 409 = another party bound it first (expected race); anything
             # else is a systemic failure worth surfacing, not swallowing.
             label = "conflict" if e.code == 409 else "error"
@@ -135,9 +166,22 @@ class SchedulerRunner:
                 _LOG.warning("bind %s -> %s failed: %s", pod.key, node_name, e)
             return False
         except Exception as e:
+            self._unreserve(allocated)
             BIND_RESULTS.inc({"result": "connection"})
             _LOG.warning("bind %s -> %s: API unreachable: %s", pod.key, node_name, e)
             return False
+
+    def _unreserve(self, allocated: list[dict]) -> None:
+        """Roll back claim allocations written by a failed bind attempt."""
+        from kubernetes_tpu.sched.dra import release_patch
+        for claim in allocated:
+            ns = (claim.get("metadata") or {}).get("namespace", "default")
+            try:
+                self.client.resource("resourceclaims", ns).update_status(
+                    release_patch(claim))
+            except Exception as e:
+                # the claim controller's release sweep is the backstop
+                _LOG.warning("claim unreserve failed (sweep will catch): %s", e)
 
     def _evict(self, victim: Pod):
         # Preemption DELETEs the victim directly (schedule_one.go preempts
@@ -167,6 +211,11 @@ class SchedulerRunner:
                              ("storageclasses", "StorageClass")):
             inf = self.factory.informer(plural, None)
             inf.add_event_handler(self._on_volume(kind))
+        for plural, kind in (("resourceclaims", "ResourceClaim"),
+                             ("deviceclasses", "DeviceClass"),
+                             ("resourceslices", "ResourceSlice")):
+            inf = self.factory.informer(plural, None)
+            inf.add_event_handler(self._on_dra(kind))
         ns_inf = self.factory.informer("namespaces", None)
         ns_inf.add_event_handler(
             lambda type_, obj, old: self.cache.update_namespace(

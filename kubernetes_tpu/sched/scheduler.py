@@ -207,7 +207,8 @@ class Scheduler:
         nodes, _, _ = self.cache.snapshot()
         bound = self.cache.bound_pods(include_assumed=True)
         res = preemption_mod.find_candidate(nodes, bound, pod,
-                                            pdbs=self.pdb_lister())
+                                            pdbs=self.pdb_lister(),
+                                            dra=self.cache.dra_catalog)
         if res is None:
             return None
         for v in res.victims:

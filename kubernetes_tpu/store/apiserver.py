@@ -64,6 +64,12 @@ APPS_RESOURCES = {
     "jobs": ("Job", True),
 }
 BATCH_RESOURCES = {"cronjobs": ("CronJob", True)}
+DRA_RESOURCES = {
+    "resourceclaims": ("ResourceClaim", True),
+    "resourceclaimtemplates": ("ResourceClaimTemplate", True),
+    "deviceclasses": ("DeviceClass", False),
+    "resourceslices": ("ResourceSlice", False),
+}
 AUTOSCALING_RESOURCES = {
     "horizontalpodautoscalers": ("HorizontalPodAutoscaler", True)}
 DISCOVERY_RESOURCES = {"endpointslices": ("EndpointSlice", True)}
@@ -79,7 +85,8 @@ RBAC_RESOURCES = {
 ALL_RESOURCES = {**CORE_RESOURCES, **APPS_RESOURCES, **COORD_RESOURCES,
                  **STORAGE_RESOURCES, **SCHEDULING_RESOURCES,
                  **RBAC_RESOURCES, **POLICY_RESOURCES, **BATCH_RESOURCES,
-                 **AUTOSCALING_RESOURCES, **DISCOVERY_RESOURCES}
+                 **AUTOSCALING_RESOURCES, **DISCOVERY_RESOURCES,
+                 **DRA_RESOURCES}
 KIND_TO_PLURAL = {k: p for p, (k, _) in ALL_RESOURCES.items()}
 
 

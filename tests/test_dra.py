@@ -1,0 +1,179 @@
+"""Dynamic Resource Allocation: device classes as synthetic resources on the
+shared axis (sched/dra.py), claim lifecycle (controllers/resourceclaim.py),
+and allocation-on-bind (sched/runner.py).
+
+Reference: pkg/scheduler/framework/plugins/dynamicresources/ +
+pkg/controller/resourceclaim/.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.client.clientset import DirectClient
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.controllers import ResourceClaimController
+from kubernetes_tpu.encode.snapshot import SnapshotEncoder
+from kubernetes_tpu.models.gang import gang_schedule
+from kubernetes_tpu.models.schedule_step import evaluate
+from kubernetes_tpu.sched.dra import DraCatalog
+from kubernetes_tpu.sched.oracle import OracleScheduler
+from kubernetes_tpu.store.store import ObjectStore
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def claim(name, cls_name="gpu", count=1, ns="default", alloc_node=None):
+    c = {"apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+         "metadata": {"name": name, "namespace": ns},
+         "spec": {"devices": {"requests": [
+             {"name": "r0", "deviceClassName": cls_name, "count": count}]}}}
+    if alloc_node:
+        c["status"] = {"allocation": {"nodeName": alloc_node},
+                       "reservedFor": []}
+    return c
+
+
+def dev_slice(name, node, cls_name="gpu", count=1):
+    return {"apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+            "metadata": {"name": name},
+            "spec": {"nodeName": node,
+                     "devices": [{"name": "d0", "deviceClassName": cls_name,
+                                  "count": count}]}}
+
+
+def pod_with_claim(name, claim_name):
+    p = make_pod(name).req({"cpu": "100m"}).obj()
+    p.spec.resource_claims = [{"name": "dev", "resourceClaimName": claim_name}]
+    return p
+
+
+def both_masks(nodes, pods, bound, catalog):
+    enc = SnapshotEncoder()
+    enc.set_dra(catalog)
+    ct, meta = enc.encode_cluster(nodes, bound, pending_pods=pods)
+    pb = enc.encode_pods(pods, meta)
+    res = evaluate(ct, pb, topo_keys=meta.topo_keys)
+    tm = np.asarray(res.feasible)[:len(pods), :len(nodes)]
+    orc = OracleScheduler(nodes, bound, dra=catalog)
+    om = np.asarray([orc.feasible(p)[0] for p in pods])
+    np.testing.assert_array_equal(tm, om)
+    return tm
+
+
+def test_catalog_resolution():
+    cat = DraCatalog.from_lists(
+        claims=[claim("c1", count=2)],
+        slices=[dev_slice("s1", "n0", count=4)])
+    p = pod_with_claim("p", "c1")
+    assert cat.pod_demands(p) == {"dra:gpu": 2}
+    assert cat.node_capacity("n0") == {"dra:gpu": 4}
+    assert cat.node_capacity("n1") == {}
+    assert cat.class_names() == {"gpu"}
+    assert cat.pod_allocated_node(p) is None
+
+
+def test_claim_filters_to_device_nodes():
+    nodes = [make_node("gpu-node").capacity({"cpu": "8", "pods": "10"}).obj(),
+             make_node("cpu-node").capacity({"cpu": "8", "pods": "10"}).obj()]
+    cat = DraCatalog.from_lists(claims=[claim("c1")],
+                                slices=[dev_slice("s1", "gpu-node")])
+    tm = both_masks(nodes, [pod_with_claim("p", "c1"),
+                            make_pod("plain").req({"cpu": "1"}).obj()],
+                    [], cat)
+    np.testing.assert_array_equal(tm, [[True, False], [True, True]])
+
+
+def test_devices_in_use_by_bound_pods_count():
+    nodes = [make_node("n0").capacity({"cpu": "8", "pods": "10"}).obj()]
+    bound = pod_with_claim("holder", "c0")
+    bound.spec.node_name = "n0"
+    cat = DraCatalog.from_lists(
+        claims=[claim("c0"), claim("c1")],
+        slices=[dev_slice("s1", "n0", count=1)])
+    tm = both_masks(nodes, [pod_with_claim("p", "c1")], [bound], cat)
+    np.testing.assert_array_equal(tm, [[False]])  # only device already held
+
+
+def test_allocated_claim_pins_pod():
+    nodes = [make_node("n0").capacity({"cpu": "8", "pods": "10"}).obj(),
+             make_node("n1").capacity({"cpu": "8", "pods": "10"}).obj()]
+    cat = DraCatalog.from_lists(
+        claims=[claim("c1", alloc_node="n1")],
+        slices=[dev_slice("s0", "n0"), dev_slice("s1", "n1")])
+    tm = both_masks(nodes, [pod_with_claim("p", "c1")], [], cat)
+    np.testing.assert_array_equal(tm, [[False, True]])
+
+
+def test_gang_contends_for_devices():
+    """Two pods, one device: the gang batcher's capacity acceptance must
+    serialize them like any other scarce resource."""
+    nodes = [make_node("n0").capacity({"cpu": "8", "pods": "10"}).obj()]
+    cat = DraCatalog.from_lists(
+        claims=[claim("c1"), claim("c2")],
+        slices=[dev_slice("s1", "n0", count=1)])
+    pods = [pod_with_claim("p1", "c1"), pod_with_claim("p2", "c2")]
+    enc = SnapshotEncoder()
+    enc.set_dra(cat)
+    ct, meta = enc.encode_cluster(nodes, [], pending_pods=pods)
+    pb = enc.encode_pods(pods, meta)
+    assignment, _ = gang_schedule(ct, pb, topo_keys=meta.topo_keys)
+    placed = [a for a in assignment[:2] if a >= 0]
+    assert len(placed) == 1, assignment[:2]
+
+
+# ------------------------------------------------------------- controller
+
+def wait_until(fn, timeout=8.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+@pytest.fixture
+def client():
+    return DirectClient(ObjectStore())
+
+
+def test_claim_template_instantiation_and_release(client):
+    ctrl = ResourceClaimController(client)
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    try:
+        client.resource("resourceclaimtemplates").create({
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaimTemplate",
+            "metadata": {"name": "gpu-tpl", "namespace": "default"},
+            "spec": {"spec": {"devices": {"requests": [
+                {"name": "r0", "deviceClassName": "gpu", "count": 1}]}}}})
+        p = make_pod("worker").obj().to_dict()
+        p["spec"]["resourceClaims"] = [
+            {"name": "dev", "resourceClaimTemplateName": "gpu-tpl"}]
+        client.pods().create(p)
+        assert wait_until(lambda: client.resource("resourceclaims")
+                          .list())
+        got = client.resource("resourceclaims").get("worker-dev")
+        assert got["spec"]["devices"]["requests"][0]["deviceClassName"] == "gpu"
+        assert got["metadata"]["ownerReferences"][0]["kind"] == "Pod"
+
+        # simulate the scheduler's allocation, then finish the pod: the
+        # controller must release the devices
+        got["status"] = {"allocation": {"nodeName": "n0"},
+                         "reservedFor": [{"resource": "pods",
+                                          "name": "worker", "uid": ""}]}
+        client.resource("resourceclaims").update_status(got)
+        pod = client.pods().get("worker")
+        pod["status"] = {"phase": "Succeeded"}
+        client.pods().update(pod)
+        assert wait_until(lambda: not (client.resource("resourceclaims")
+                                       .get("worker-dev").get("status") or {})
+                          .get("allocation"))
+    finally:
+        ctrl.stop()
+        factory.stop_all()
