@@ -1,10 +1,20 @@
 """Kubelet — node agent (SURVEY §2.4): sync loop, pod workers, PLEG,
-status manager, heartbeat, hollow-node (kubemark) mode."""
+probes, volume manager, resource/QoS managers, status manager, heartbeat,
+hollow-node (kubemark) mode."""
 
 from kubernetes_tpu.kubelet.kubelet import HollowNode, Kubelet
 from kubernetes_tpu.kubelet.pleg import GenericPLEG, PodLifecycleEvent
 from kubernetes_tpu.kubelet.pod_workers import PodWorkers
+from kubernetes_tpu.kubelet.prober import ProbeManager
+from kubernetes_tpu.kubelet.resources import (
+    AllocatableAdmitter,
+    CPUManager,
+    pod_qos,
+)
 from kubernetes_tpu.kubelet.runtime import ContainerRuntime, FakeRuntime
+from kubernetes_tpu.kubelet.volumemanager import VolumeManager
 
-__all__ = ["ContainerRuntime", "FakeRuntime", "GenericPLEG", "HollowNode",
-           "Kubelet", "PodLifecycleEvent", "PodWorkers"]
+__all__ = ["AllocatableAdmitter", "CPUManager", "ContainerRuntime",
+           "FakeRuntime", "GenericPLEG", "HollowNode", "Kubelet",
+           "PodLifecycleEvent", "PodWorkers", "ProbeManager",
+           "VolumeManager", "pod_qos"]

@@ -23,12 +23,15 @@ from kubernetes_tpu.client.clientset import ApiError
 from kubernetes_tpu.client.informer import SharedInformer
 from kubernetes_tpu.kubelet.pleg import GenericPLEG
 from kubernetes_tpu.kubelet.pod_workers import PodWorkers
+from kubernetes_tpu.kubelet.prober import ProbeManager
+from kubernetes_tpu.kubelet.resources import AllocatableAdmitter, CPUManager
 from kubernetes_tpu.kubelet.runtime import (
     EXITED,
     RUNNING,
     ContainerRuntime,
     FakeRuntime,
 )
+from kubernetes_tpu.kubelet.volumemanager import VolumeManager
 
 _node_ip_counter = itertools.count(1)
 
@@ -53,11 +56,20 @@ class Kubelet:
         self.register_node = register_node
         self.pleg = GenericPLEG(self.runtime)
         self.workers = PodWorkers(self._sync_pod)
+        self.prober = ProbeManager(self.runtime, self._on_liveness_failure,
+                                   self._on_readiness_change)
+        self.volumes = VolumeManager()
+        self.admitter = AllocatableAdmitter(self.allocatable)
+        from kubernetes_tpu.api.resource import canonical
+        self.cpu_manager = CPUManager(max(1, canonical(
+            "cpu", str(self.allocatable.get("cpu", "1"))) // 1000))
         self._informer: Optional[SharedInformer] = None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._pods_lock = threading.Lock()
         self._pods: dict[str, dict] = {}  # uid -> latest pod object
+        self._admitted: dict[str, dict] = {}  # uid -> pod as admitted
+        self._rejected: dict[str, str] = {}   # uid -> rejection reason
 
     def _next_pod_ip(self) -> str:
         n = next(self._pod_ip_seq)
@@ -110,13 +122,17 @@ class Kubelet:
     def start(self, wait_sync: float = 10.0):
         if self.register_node:
             self._register()
+        # managers first: informer handlers fire during cache sync and
+        # _sync_pod's mount gate needs the reconciler already running
+        self.pleg.start()
+        self.prober.start()
+        self.volumes.start()
         self._informer = SharedInformer(
             self.client.resource("pods", None),
             field_selector=f"spec.nodeName={self.node_name}")
         self._informer.add_event_handler(self._on_pod_event)
         self._informer.start()
         self._informer.wait_for_cache_sync(wait_sync)
-        self.pleg.start()
         for target in (self._heartbeat_loop, self._pleg_loop):
             t = threading.Thread(target=target, daemon=True)
             t.start()
@@ -127,8 +143,27 @@ class Kubelet:
         self._stop.set()
         self.pleg.stop()
         self.workers.stop()
+        self.prober.stop()
+        self.volumes.stop()
         if self._informer is not None:
             self._informer.stop()
+
+    # ---- probe callbacks -------------------------------------------------
+
+    def _on_liveness_failure(self, pod_uid: str, container: str):
+        """prober: liveness/startup exhausted its failureThreshold — kill the
+        container; the next SyncPod applies the restart policy."""
+        self.runtime.stop_container(pod_uid, container, exit_code=137)
+        with self._pods_lock:
+            pod = self._pods.get(pod_uid)
+        if pod is not None:
+            self.workers.update_pod(pod_uid, pod)
+
+    def _on_readiness_change(self, pod_uid: str):
+        with self._pods_lock:
+            pod = self._pods.get(pod_uid)
+        if pod is not None:
+            self.workers.update_pod(pod_uid, pod)
 
     def _on_pod_event(self, type_, obj, old):
         uid = (obj.get("metadata") or {}).get("uid", "")
@@ -159,16 +194,44 @@ class Kubelet:
 
     def _sync_pod(self, uid: str, pod: Optional[dict]) -> None:
         if pod is None:
-            self.runtime.stop_pod_sandbox(uid)
+            self._teardown(uid)
             return
         md = pod.get("metadata") or {}
         spec = pod.get("spec") or {}
         phase = (pod.get("status") or {}).get("phase", "Pending")
         if phase in ("Succeeded", "Failed"):
-            self.runtime.stop_pod_sandbox(uid)
+            self._teardown(uid, keep_admitted=uid in self._rejected)
             return
+        # node-side admission (lifecycle.PredicateAdmitHandler): allocatable
+        # fit + exclusive-cpu availability; rejection marks the pod Failed
+        if uid in self._rejected:
+            # re-assert in case the Failed status write was lost
+            self._fail_pod(pod, self._rejected[uid])
+            return
+        if uid not in self._admitted:
+            ok, reason = self.admitter.admit(pod)
+            if ok:
+                try:
+                    self.cpu_manager.allocate(pod)
+                except RuntimeError:
+                    self.admitter.release(uid)
+                    ok, reason = False, "UnexpectedAdmissionError"
+            if not ok:
+                self._rejected[uid] = reason
+                self._fail_pod(pod, reason)
+                return
+            self._admitted[uid] = pod
+            self.volumes.add_pod(pod)
+            self.prober.add_pod(pod)
         sb = self.runtime.get_sandbox(uid)
         if sb is None:
+            # WaitForAttachAndMount gates the sandbox (volume_manager.go)
+            if not self.volumes.wait_for_attach_and_mount(pod):
+                # nothing else will re-sync a sandbox-less pod (no PLEG
+                # events yet): schedule the retry ourselves
+                threading.Timer(0.5, self.workers.update_pod,
+                                args=(uid, pod)).start()
+                return
             sb = self.runtime.run_pod_sandbox(uid, md.get("name", ""),
                                               md.get("namespace", "default"))
         restart_policy = spec.get("restartPolicy", "Always")
@@ -178,13 +241,37 @@ class Kubelet:
             if cs is None:
                 self.runtime.create_container(uid, name, c.get("image", ""))
                 self.runtime.start_container(uid, name)
+                self.prober.container_restarted(uid, name)
             elif cs.state == EXITED:
                 restart = (restart_policy == "Always"
                            or (restart_policy == "OnFailure" and cs.exit_code != 0))
                 if restart:
                     self.runtime.create_container(uid, name, c.get("image", ""))
                     self.runtime.start_container(uid, name)
+                    self.prober.container_restarted(uid, name)
         self._update_status(pod, self.runtime.get_sandbox(uid))
+
+    def _teardown(self, uid: str, keep_admitted: bool = False) -> None:
+        self.runtime.stop_pod_sandbox(uid)
+        self.prober.remove_pod(uid)
+        if not keep_admitted:
+            self._rejected.pop(uid, None)
+            admitted = self._admitted.pop(uid, None)
+            if admitted is not None:
+                self.volumes.remove_pod(admitted)
+            self.admitter.release(uid)
+            self.cpu_manager.release(uid)
+
+    def _fail_pod(self, pod: dict, reason: str) -> None:
+        md = pod.get("metadata") or {}
+        status = {**(pod.get("status") or {}),
+                  "phase": "Failed", "reason": reason,
+                  "message": f"Pod was rejected: {reason}"}
+        try:
+            self.client.pods(md.get("namespace", "default")).update_status(
+                {**pod, "status": status})
+        except ApiError:
+            pass
 
     # ---- status manager --------------------------------------------------
 
@@ -209,7 +296,10 @@ class Kubelet:
 
     def _update_status(self, pod: dict, sb) -> None:
         phase = self._compute_phase(pod, sb)
-        running = phase == "Running"
+        # Ready = running AND every readiness/startup probe reports ready
+        # (status_manager consults the prober's results cache)
+        ready = phase == "Running" and self.prober.pod_ready(pod)
+        running = ready
         status = {
             "phase": phase,
             "hostIP": f"192.168.0.{self.node_idx % 250}",
@@ -242,11 +332,15 @@ class HollowNode:
                  exit_after: Optional[float] = None,
                  start_latency: float = 0.0, **kubelet_kw):
         self.kubelet = Kubelet(client, node_name, **kubelet_kw)
-        # swap in a runtime wired to this kubelet's IP allocator
+        # swap in a runtime wired to this kubelet's IP allocator; every
+        # runtime-bound manager must be rebuilt against it
         self.kubelet.runtime = FakeRuntime(exit_after=exit_after,
                                            start_latency=start_latency,
                                            ip_alloc=self.kubelet._next_pod_ip)
         self.kubelet.pleg = GenericPLEG(self.kubelet.runtime)
+        self.kubelet.prober = ProbeManager(
+            self.kubelet.runtime, self.kubelet._on_liveness_failure,
+            self.kubelet._on_readiness_change)
 
     def start(self, **kw):
         self.kubelet.start(**kw)

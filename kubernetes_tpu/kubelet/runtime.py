@@ -28,6 +28,9 @@ class ContainerStatus:
     started_at: float = 0.0
     finished_at: float = 0.0
     restart_count: int = 0
+    # hollow health signal probed by kubelet/prober.py (the exec/http probe
+    # handler analog): tests flip it via FakeRuntime.set_health
+    healthy: bool = True
 
 
 @dataclass
@@ -60,6 +63,16 @@ class ContainerRuntime:
 
     def get_sandbox(self, pod_uid: str) -> Optional[PodSandboxStatus]:
         raise NotImplementedError
+
+    def stop_container(self, pod_uid: str, name: str, exit_code: int = 137) -> None:
+        raise NotImplementedError
+
+    def probe(self, pod_uid: str, name: str) -> bool:
+        """Execute the probe handler against a container (exec/http analog).
+        Default: RUNNING and healthy."""
+        sb = self.get_sandbox(pod_uid)
+        c = sb.containers.get(name) if sb else None
+        return c is not None and c.state == RUNNING and c.healthy
 
 
 class FakeRuntime(ContainerRuntime):
@@ -114,6 +127,22 @@ class FakeRuntime(ContainerRuntime):
             c = self._sandboxes[pod_uid].containers[name]
             c.state = RUNNING
             c.started_at = time.time()
+
+    def stop_container(self, pod_uid, name, exit_code: int = 137):
+        with self._lock:
+            sb = self._sandboxes.get(pod_uid)
+            c = sb.containers.get(name) if sb else None
+            if c is not None and c.state == RUNNING:
+                c.state = EXITED
+                c.exit_code = exit_code
+                c.finished_at = time.time()
+
+    def set_health(self, pod_uid, name, healthy: bool):
+        """Test hook: flip the hollow probe signal for a container."""
+        with self._lock:
+            sb = self._sandboxes.get(pod_uid)
+            if sb is not None and name in sb.containers:
+                sb.containers[name].healthy = healthy
 
     def _tick_locked(self):
         if self.exit_after is None:

@@ -1,0 +1,107 @@
+"""Volume manager — desired-state vs actual-state mount reconcile.
+
+Reference: ``pkg/kubelet/volumemanager/`` (``volume_manager.go``:
+DesiredStateOfWorld populated from admitted pods' volumes, the reconciler
+loop mounting what's desired-but-unmounted and unmounting what's
+mounted-but-undesired; ``WaitForAttachAndMount`` gating container start).
+
+The hollow "mount" records the volume in the actual-state map (optionally
+resolving a PVC to its bound PV name like the operation executor does); the
+load-bearing parts are the reconcile algebra and the start gate, which are
+real.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+def pod_volume_names(pod: dict) -> list[str]:
+    """Unique volume identifiers for a pod: pvc:<claim> for PVC-backed
+    volumes (node-level identity — two pods sharing a claim share the
+    mount), else <uid>/<name> for pod-local volumes."""
+    uid = (pod.get("metadata") or {}).get("uid", "")
+    out = []
+    for v in (pod.get("spec") or {}).get("volumes") or []:
+        pvc = (v.get("persistentVolumeClaim") or {}).get("claimName")
+        out.append(f"pvc:{pvc}" if pvc else f"{uid}/{v.get('name', '')}")
+    return out
+
+
+class VolumeManager:
+    def __init__(self, reconcile_s: float = 0.1):
+        self.reconcile_s = reconcile_s
+        self._lock = threading.Lock()
+        self._desired: dict[str, set] = {}   # volume id -> {pod uids}
+        self._mounted: set = set()           # volume ids actually mounted
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.mount_ops: list[tuple[str, str]] = []  # ("mount"/"unmount", vol)
+
+    # ---- desired state (pod admission/removal) ---------------------------
+
+    def add_pod(self, pod: dict) -> None:
+        uid = (pod.get("metadata") or {}).get("uid", "")
+        with self._lock:
+            for vol in pod_volume_names(pod):
+                self._desired.setdefault(vol, set()).add(uid)
+
+    def remove_pod(self, pod: dict) -> None:
+        uid = (pod.get("metadata") or {}).get("uid", "")
+        with self._lock:
+            for vol in list(self._desired):
+                self._desired[vol].discard(uid)
+                if not self._desired[vol]:
+                    del self._desired[vol]
+
+    # ---- reconcile -------------------------------------------------------
+
+    def reconcile_once(self) -> None:
+        with self._lock:
+            want = set(self._desired)
+            to_mount = want - self._mounted
+            to_unmount = self._mounted - want
+            for vol in sorted(to_mount):
+                self._mounted.add(vol)
+                self.mount_ops.append(("mount", vol))
+            for vol in sorted(to_unmount):
+                self._mounted.discard(vol)
+                self.mount_ops.append(("unmount", vol))
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.reconcile_s):
+            self.reconcile_once()
+
+    def start(self) -> "VolumeManager":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="volume-manager")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # ---- the start gate --------------------------------------------------
+
+    def wait_for_attach_and_mount(self, pod: dict, timeout: float = 5.0) -> bool:
+        """Block until every volume the pod needs is mounted (the SyncPod
+        gate before containers start)."""
+        want = set(pod_volume_names(pod))
+        if not want:
+            return True
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                if want <= self._mounted:
+                    return True
+            time.sleep(min(self.reconcile_s, 0.05))
+        with self._lock:
+            return want <= self._mounted
+
+    def mounted_volumes(self) -> set:
+        with self._lock:
+            return set(self._mounted)

@@ -1,0 +1,210 @@
+"""Kubelet node managers: probes (liveness/readiness/startup), QoS and
+allocatable admission, CPU pinning, and the volume-manager reconcile.
+
+Reference: pkg/kubelet/prober/, pkg/kubelet/cm/cpumanager/,
+pkg/kubelet/lifecycle/predicate.go, pkg/kubelet/volumemanager/.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client.clientset import DirectClient
+from kubernetes_tpu.kubelet import (
+    AllocatableAdmitter,
+    CPUManager,
+    Kubelet,
+    VolumeManager,
+    pod_qos,
+)
+from kubernetes_tpu.kubelet.runtime import EXITED, RUNNING, FakeRuntime
+from kubernetes_tpu.store.store import ObjectStore
+
+
+def wait_until(fn, timeout=10.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+def mkpod(name, uid=None, containers=None, **spec_extra):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default",
+                         "uid": uid or f"uid-{name}"},
+            "spec": {"containers": containers or [{"name": "c"}],
+                     "nodeName": "n0", **spec_extra},
+            "status": {}}
+
+
+@pytest.fixture
+def cluster():
+    client = DirectClient(ObjectStore())
+    kubelet = Kubelet(client, "n0", heartbeat_period=0.5,
+                      allocatable={"cpu": "4", "memory": "8Gi", "pods": "10"})
+    kubelet.prober.tick_s = 0.05
+    kubelet.start()
+    yield client, kubelet
+    kubelet.stop()
+
+
+# ------------------------------------------------------------------ probes
+
+def test_readiness_probe_gates_ready_condition(cluster):
+    client, kubelet = cluster
+    client.pods().create(mkpod("web", containers=[
+        {"name": "c", "readinessProbe": {"periodSeconds": 0.1,
+                                         "failureThreshold": 2}}]))
+
+    def ready():
+        st = client.pods().get("web").get("status") or {}
+        return any(c.get("type") == "Ready" and c.get("status") == "True"
+                   for c in st.get("conditions") or [])
+    assert wait_until(ready)
+    # probe starts failing -> Ready goes False without a restart
+    uid = client.pods().get("web")["metadata"]["uid"]
+    kubelet.runtime.set_health(uid, "c", False)
+    assert wait_until(lambda: not ready())
+    sb = kubelet.runtime.get_sandbox(uid)
+    assert sb.containers["c"].state == RUNNING  # readiness never kills
+    assert sb.containers["c"].restart_count == 0
+    # recovers
+    kubelet.runtime.set_health(uid, "c", True)
+    assert wait_until(ready)
+
+
+def test_liveness_probe_restarts_container(cluster):
+    client, kubelet = cluster
+    client.pods().create(mkpod("app", containers=[
+        {"name": "c", "livenessProbe": {"periodSeconds": 0.1,
+                                        "failureThreshold": 2}}]))
+    uid = client.pods().get("app")["metadata"]["uid"]
+    assert wait_until(lambda: kubelet.runtime.get_sandbox(uid)
+                      and kubelet.runtime.get_sandbox(uid).containers
+                      .get("c", None) is not None
+                      and kubelet.runtime.get_sandbox(uid).containers["c"].state
+                      == RUNNING)
+    kubelet.runtime.set_health(uid, "c", False)
+    # killed and restarted (restart_count grows); health stays bad so it
+    # keeps getting restarted
+    assert wait_until(lambda: kubelet.runtime.get_sandbox(uid)
+                      .containers["c"].restart_count >= 1)
+    kubelet.runtime.set_health(uid, "c", True)
+
+
+def test_startup_probe_gates_and_kills(cluster):
+    client, kubelet = cluster
+    client.pods().create(mkpod("slow", containers=[
+        {"name": "c",
+         "startupProbe": {"periodSeconds": 0.1, "failureThreshold": 3},
+         "readinessProbe": {"periodSeconds": 0.1}}]))
+    uid = client.pods().get("slow")["metadata"]["uid"]
+    assert wait_until(lambda: kubelet.runtime.get_sandbox(uid) is not None)
+
+    def ready():
+        st = client.pods().get("slow").get("status") or {}
+        return any(c.get("type") == "Ready" and c.get("status") == "True"
+                   for c in st.get("conditions") or [])
+    # healthy container: startup succeeds, readiness follows
+    assert wait_until(ready)
+
+
+# ------------------------------------------------------------- QoS classes
+
+def test_pod_qos_classes():
+    guaranteed = mkpod("g", containers=[{"name": "c", "resources": {
+        "requests": {"cpu": "1", "memory": "1Gi"},
+        "limits": {"cpu": "1", "memory": "1Gi"}}}])
+    burstable = mkpod("b", containers=[{"name": "c", "resources": {
+        "requests": {"cpu": "1"}}}])
+    besteffort = mkpod("e")
+    assert pod_qos(guaranteed) == "Guaranteed"
+    assert pod_qos(burstable) == "Burstable"
+    assert pod_qos(besteffort) == "BestEffort"
+
+
+# ------------------------------------------------------ allocatable admission
+
+def test_admitter_rejects_overcommit():
+    adm = AllocatableAdmitter({"cpu": "2", "memory": "2Gi", "pods": "10"})
+    big = mkpod("big", containers=[{"name": "c", "resources": {
+        "requests": {"cpu": "1500m"}}}])
+    ok, _ = adm.admit(big)
+    assert ok
+    second = mkpod("second", uid="uid-2", containers=[{"name": "c",
+        "resources": {"requests": {"cpu": "1"}}}])
+    ok, reason = adm.admit(second)
+    assert not ok and reason == "OutOfCpu"
+    adm.release("uid-big")
+    ok, _ = adm.admit(second)
+    assert ok
+
+
+def test_kubelet_fails_overcommitted_pod(cluster):
+    client, kubelet = cluster
+    client.pods().create(mkpod("huge", containers=[{"name": "c", "resources": {
+        "requests": {"cpu": "64"}}}]))
+    assert wait_until(lambda: (client.pods().get("huge").get("status") or {})
+                      .get("phase") == "Failed")
+    st = client.pods().get("huge")["status"]
+    assert st.get("reason", "").startswith("OutOf")
+
+
+# ------------------------------------------------------------- cpu manager
+
+def test_cpu_manager_exclusive_pinning():
+    cm = CPUManager(4)
+    g1 = mkpod("g1", uid="u1", containers=[{"name": "c", "resources": {
+        "requests": {"cpu": "2", "memory": "1Gi"},
+        "limits": {"cpu": "2", "memory": "1Gi"}}}])
+    g2 = mkpod("g2", uid="u2", containers=[{"name": "c", "resources": {
+        "requests": {"cpu": "2", "memory": "1Gi"},
+        "limits": {"cpu": "2", "memory": "1Gi"}}}])
+    s1 = cm.allocate(g1)
+    s2 = cm.allocate(g2)
+    assert len(s1) == 2 and len(s2) == 2 and not (s1 & s2)
+    g3 = mkpod("g3", uid="u3", containers=[{"name": "c", "resources": {
+        "requests": {"cpu": "1", "memory": "1Gi"},
+        "limits": {"cpu": "1", "memory": "1Gi"}}}])
+    with pytest.raises(RuntimeError):
+        cm.allocate(g3)
+    cm.release("u1")
+    assert len(cm.allocate(g3)) == 1
+    # burstable / fractional pods share the pool
+    frac = mkpod("f", uid="u4", containers=[{"name": "c", "resources": {
+        "requests": {"cpu": "1500m", "memory": "1Gi"},
+        "limits": {"cpu": "1500m", "memory": "1Gi"}}}])
+    assert cm.allocate(frac) is None
+
+
+# ----------------------------------------------------------- volume manager
+
+def test_volume_manager_reconcile_and_gate():
+    vm = VolumeManager(reconcile_s=0.02)
+    pod = mkpod("v", volumes=[
+        {"name": "data", "persistentVolumeClaim": {"claimName": "claim-a"}},
+        {"name": "scratch", "emptyDir": {}}])
+    vm.add_pod(pod)
+    assert not vm.wait_for_attach_and_mount(pod, timeout=0.01)  # not reconciled
+    vm.reconcile_once()
+    assert vm.wait_for_attach_and_mount(pod, timeout=0.5)
+    assert "pvc:claim-a" in vm.mounted_volumes()
+    vm.remove_pod(pod)
+    vm.reconcile_once()
+    assert not vm.mounted_volumes()
+    ops = [op for op, _ in vm.mount_ops]
+    assert ops.count("mount") == 2 and ops.count("unmount") == 2
+
+
+def test_kubelet_mounts_volumes_before_start(cluster):
+    client, kubelet = cluster
+    client.pods().create(mkpod("dbpod", volumes=[
+        {"name": "data", "persistentVolumeClaim": {"claimName": "pvc-1"}}]))
+    uid = client.pods().get("dbpod")["metadata"]["uid"]
+    assert wait_until(lambda: kubelet.runtime.get_sandbox(uid) is not None)
+    assert "pvc:pvc-1" in kubelet.volumes.mounted_volumes()
+    client.pods().delete("dbpod")
+    assert wait_until(lambda: "pvc:pvc-1" not in
+                      kubelet.volumes.mounted_volumes())
