@@ -44,12 +44,37 @@ ALIASES = {
 }
 
 
-def resolve_plural(res: str) -> str:
+def resolve_plural(res: str, client: Optional[HTTPClient] = None) -> str:
     res = res.lower()
     plural = ALIASES.get(res, res)
-    if plural not in ALL_RESOURCES:
-        raise SystemExit(f"error: unknown resource type {res!r}")
-    return plural
+    if plural in ALL_RESOURCES:
+        return plural
+    # maybe a custom resource: sweep the server's CRDs (RESTMapper reload)
+    if client is not None:
+        try:
+            client.discover_custom()
+        except ApiError:
+            pass
+        if client.custom_lookup(plural) is not None:
+            return plural
+    raise SystemExit(f"error: unknown resource type {res!r}")
+
+
+def _kind_info(client: HTTPClient, plural: str):
+    """-> (kind, namespaced) for built-in or discovered custom resources."""
+    reg = ALL_RESOURCES.get(plural) or client.custom_lookup(plural)
+    return reg[0], reg[1]
+
+
+def kind_to_plural(client: HTTPClient, kind: str) -> Optional[str]:
+    plural = KIND_TO_PLURAL.get(kind)
+    if plural is not None:
+        return plural
+    try:
+        client.discover_custom()
+    except ApiError:
+        return None
+    return client.custom_kind_to_plural(kind)
 
 
 def obj_age(obj: dict) -> str:
@@ -145,8 +170,8 @@ def load_manifests(path: str) -> list[dict]:
 
 
 def cmd_get(client: HTTPClient, args, out) -> int:
-    plural = resolve_plural(args.resource)
-    _, namespaced = ALL_RESOURCES[plural]
+    plural = resolve_plural(args.resource, client)
+    _, namespaced = _kind_info(client, plural)
     ns = None if args.all_namespaces else (args.namespace if namespaced else None)
     res = client.resource(plural, ns)
     if args.name:
@@ -169,12 +194,12 @@ def cmd_apply(client: HTTPClient, args, out) -> int:
     rc = 0
     for doc in load_manifests(args.filename):
         kind = doc.get("kind", "")
-        plural = KIND_TO_PLURAL.get(kind)
+        plural = kind_to_plural(client, kind)
         if plural is None:
             out.write(f"error: unknown kind {kind!r}\n")
             rc = 1
             continue
-        _, namespaced = ALL_RESOURCES[plural]
+        _, namespaced = _kind_info(client, plural)
         md = doc.setdefault("metadata", {})
         ns = md.get("namespace", args.namespace) if namespaced else None
         if namespaced:
@@ -212,17 +237,17 @@ def cmd_delete(client: HTTPClient, args, out) -> int:
     targets: list[tuple[str, Optional[str], str]] = []
     if args.filename:
         for doc in load_manifests(args.filename):
-            plural = KIND_TO_PLURAL.get(doc.get("kind", ""), None)
+            plural = kind_to_plural(client, doc.get("kind", ""))
             if plural is None:
                 continue
-            _, namespaced = ALL_RESOURCES[plural]
+            _, namespaced = _kind_info(client, plural)
             md = doc.get("metadata") or {}
             targets.append((plural,
                             md.get("namespace", args.namespace) if namespaced else None,
                             md.get("name", "")))
     else:
-        plural = resolve_plural(args.resource)
-        _, namespaced = ALL_RESOURCES[plural]
+        plural = resolve_plural(args.resource, client)
+        _, namespaced = _kind_info(client, plural)
         targets.append((plural, args.namespace if namespaced else None, args.name))
     for plural, ns, name in targets:
         try:
@@ -236,8 +261,8 @@ def cmd_delete(client: HTTPClient, args, out) -> int:
 
 
 def cmd_describe(client: HTTPClient, args, out) -> int:
-    plural = resolve_plural(args.resource)
-    _, namespaced = ALL_RESOURCES[plural]
+    plural = resolve_plural(args.resource, client)
+    _, namespaced = _kind_info(client, plural)
     obj = client.resource(plural, args.namespace if namespaced else None).get(args.name)
     md = obj.get("metadata") or {}
     out.write(f"Name:         {md.get('name')}\n")
@@ -273,7 +298,7 @@ def cmd_describe(client: HTTPClient, args, out) -> int:
 
 
 def cmd_scale(client: HTTPClient, args, out) -> int:
-    plural = resolve_plural(args.resource)
+    plural = resolve_plural(args.resource, client)
     res = client.resource(plural, args.namespace)
     obj = res.get(args.name)
     obj.setdefault("spec", {})["replicas"] = args.replicas

@@ -66,7 +66,15 @@ class ResourceClient:
     def __init__(self, transport, plural: str, namespace: Optional[str]):
         self._t = transport
         self.plural = plural
-        self.kind, self.namespaced = ALL_RESOURCES[plural]
+        reg = ALL_RESOURCES.get(plural)
+        if reg is None:
+            reg = transport.custom_lookup(plural)
+            if reg is None:
+                raise KeyError(
+                    f"unknown resource {plural!r}: built-ins are static; "
+                    "custom resources need client.register_custom(...) or "
+                    "client.discover_custom()")
+        self.kind, self.namespaced = reg[0], reg[1]
         self.namespace = namespace if self.namespaced else None
 
     def create(self, obj: dict) -> dict:
@@ -125,6 +133,46 @@ class _Handles:
 
     def resource(self, plural: str, ns: Optional[str] = "default") -> ResourceClient:
         return ResourceClient(self, plural, ns)
+
+    # ---- custom resources (CRDs) -----------------------------------------
+
+    def register_custom(self, plural: str, kind: str, namespaced: bool = True,
+                        group: str = "example.com/v1") -> None:
+        """Teach this client a CustomResourceDefinition's served resource
+        (dynamic-client analog: plural -> kind/scope/API path)."""
+        if not hasattr(self, "_custom"):
+            self._custom: dict[str, tuple] = {}
+        self._custom[plural] = (kind, namespaced, group)
+
+    def custom_lookup(self, plural: str):
+        return getattr(self, "_custom", {}).get(plural)
+
+    def custom_kind_to_plural(self, kind: str) -> Optional[str]:
+        """Reverse mapping over registered custom resources."""
+        for plural, (k, _ns, _g) in getattr(self, "_custom", {}).items():
+            if k == kind:
+                return plural
+        return None
+
+    def discover_custom(self) -> int:
+        """Rebuild the custom-resource table from the server's CRDs (the
+        discovery client's group/version sweep) — deleted/renamed CRDs are
+        pruned, not just added. -> # registered."""
+        table: dict[str, tuple] = {}
+        for crd in self.resource("customresourcedefinitions", None).list():
+            spec = crd.get("spec") or {}
+            names = spec.get("names") or {}
+            versions = spec.get("versions") or [{"name": "v1"}]
+            version = next((v.get("name") for v in versions
+                            if v.get("served", True) and v.get("name")),
+                           "v1")
+            if names.get("plural") and names.get("kind"):
+                table[names["plural"]] = (
+                    names["kind"],
+                    spec.get("scope", "Namespaced") == "Namespaced",
+                    f"{spec.get('group', '')}/{version}")
+        self._custom = table
+        return len(table)
 
 
 class DirectClient(_Handles):
@@ -255,6 +303,10 @@ class HTTPClient(_Handles):
         return h
 
     def _path(self, plural, ns, name=None, sub=None, query=""):
+        custom = self.custom_lookup(plural)
+        if custom is not None and plural not in ALL_RESOURCES:
+            return self._path_for(f"/apis/{custom[2]}", plural, ns, name, sub,
+                                  query)
         group = "/apis/apps/v1" if plural in APPS_RESOURCES else (
             "/apis/coordination.k8s.io/v1" if plural == "leases" else
             "/apis/storage.k8s.io/v1" if plural == "storageclasses" else
@@ -266,8 +318,13 @@ class HTTPClient(_Handles):
             "/apis/resource.k8s.io/v1" if plural in (
                 "resourceclaims", "resourceclaimtemplates", "deviceclasses",
                 "resourceslices") else
+            "/apis/apiextensions.k8s.io/v1"
+            if plural == "customresourcedefinitions" else
             "/apis/rbac.authorization.k8s.io/v1" if plural in RBAC_RESOURCES
             else "/api/v1")
+        return self._path_for(group, plural, ns, name, sub, query)
+
+    def _path_for(self, group, plural, ns, name, sub, query):
         p = group
         if ns:
             p += f"/namespaces/{ns}"

@@ -64,6 +64,8 @@ APPS_RESOURCES = {
     "jobs": ("Job", True),
 }
 BATCH_RESOURCES = {"cronjobs": ("CronJob", True)}
+APIEXT_RESOURCES = {
+    "customresourcedefinitions": ("CustomResourceDefinition", False)}
 DRA_RESOURCES = {
     "resourceclaims": ("ResourceClaim", True),
     "resourceclaimtemplates": ("ResourceClaimTemplate", True),
@@ -86,7 +88,7 @@ ALL_RESOURCES = {**CORE_RESOURCES, **APPS_RESOURCES, **COORD_RESOURCES,
                  **STORAGE_RESOURCES, **SCHEDULING_RESOURCES,
                  **RBAC_RESOURCES, **POLICY_RESOURCES, **BATCH_RESOURCES,
                  **AUTOSCALING_RESOURCES, **DISCOVERY_RESOURCES,
-                 **DRA_RESOURCES}
+                 **DRA_RESOURCES, **APIEXT_RESOURCES}
 KIND_TO_PLURAL = {k: p for p, (k, _) in ALL_RESOURCES.items()}
 
 
@@ -118,10 +120,91 @@ class APIServer:
         self.authenticator = None  # set by enable_auth
         self.authorizer = None
         self.audit = None
+        # dynamic resources served for stored CustomResourceDefinitions
+        # (apiextensions-apiserver analog): plural -> (Kind, namespaced).
+        # The lock serializes validate+write: collision checks are
+        # check-then-act and handler threads race (ThreadingHTTPServer).
+        self.custom_resources: dict[str, tuple[str, bool]] = {}
+        self._crd_lock = threading.RLock()
+        self._rebuild_custom()  # durable restore may already hold CRDs
         self._httpd = _HTTPServer((host, port), self._make_handler())
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    # ---- CRDs (apiextensions.k8s.io) -------------------------------------
+
+    def _rebuild_custom(self) -> None:
+        crds, _ = self.store.list("CustomResourceDefinition")
+        table: dict[str, tuple[str, bool]] = {}
+        for crd in crds:
+            spec = crd.get("spec") or {}
+            names = spec.get("names") or {}
+            plural, kind = names.get("plural", ""), names.get("kind", "")
+            if plural and kind and plural not in ALL_RESOURCES:
+                table[plural] = (kind, spec.get("scope", "Namespaced")
+                                 == "Namespaced")
+        self.custom_resources = table
+
+    def validate_crd(self, body: dict) -> Optional[str]:
+        """-> error message or None (apiextensions validation essentials).
+        Both plural AND kind must be collision-free against built-ins and
+        every other stored CRD — the store is keyed by kind and the delete
+        cascade removes by kind, so a shared kind would let one CRD serve
+        (or wipe) another's objects."""
+        spec = body.get("spec") or {}
+        names = spec.get("names") or {}
+        if not spec.get("group"):
+            return "spec.group is required"
+        plural, kind = names.get("plural"), names.get("kind")
+        if not plural or not kind:
+            return "spec.names.plural and spec.names.kind are required"
+        if plural in ALL_RESOURCES:
+            return f"plural {plural!r} shadows a built-in resource"
+        builtin_kinds = {k for (k, _ns) in ALL_RESOURCES.values()}
+        if kind in builtin_kinds:
+            return f"kind {kind!r} shadows a built-in kind"
+        my_name = (body.get("metadata") or {}).get("name", "")
+        others, _ = self.store.list("CustomResourceDefinition")
+        for other in others:
+            omd = other.get("metadata") or {}
+            onames = (other.get("spec") or {}).get("names") or {}
+            if omd.get("name") == my_name:
+                # updating self: plural/kind are immutable — the store keys
+                # objects by kind, so changing either would orphan every
+                # existing instance (unroutable AND missed by the cascade)
+                if onames.get("plural") != plural or onames.get("kind") != kind:
+                    return "spec.names.plural and spec.names.kind are immutable"
+                continue
+            if onames.get("plural") == plural:
+                return f"plural {plural!r} already served by CRD " \
+                       f"{omd.get('name')!r}"
+            if onames.get("kind") == kind:
+                return f"kind {kind!r} already served by CRD " \
+                       f"{omd.get('name')!r}"
+        return None
+
+    def _crd_guard(self, kind: str):
+        """Serialize CRD validate+write+table-rebuild; no-op otherwise."""
+        import contextlib
+        return (self._crd_lock if kind == "CustomResourceDefinition"
+                else contextlib.nullcontext())
+
+    def _on_crd_change(self, crd: dict, deleted: bool) -> None:
+        """Refresh the serving table; deleting a CRD deletes its instances
+        (the apiextensions finalizer's cascade)."""
+        if deleted:
+            kind = ((crd.get("spec") or {}).get("names") or {}).get("kind", "")
+            if kind:
+                objs, _ = self.store.list(kind)
+                for o in objs:
+                    md = o.get("metadata") or {}
+                    try:
+                        self.store.delete(kind, md.get("namespace", ""),
+                                          md.get("name", ""))
+                    except NotFound:
+                        pass
+        self._rebuild_custom()
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -359,9 +442,12 @@ class APIServer:
                 if not rest:
                     return None
                 plural = rest[0]
-                if plural not in ALL_RESOURCES:
+                if plural in ALL_RESOURCES:
+                    kind, namespaced = ALL_RESOURCES[plural]
+                elif plural in server.custom_resources:
+                    kind, namespaced = server.custom_resources[plural]
+                else:
                     return None
-                kind, namespaced = ALL_RESOURCES[plural]
                 name = rest[1] if len(rest) > 1 else None
                 sub = rest[2] if len(rest) > 2 else None
                 return plural, kind, ns, name, sub
@@ -514,24 +600,31 @@ class APIServer:
                     except NotFound as e:
                         return self._error(404, str(e), "NotFound")
                     return self._send_json(200, out)
-                try:
-                    body = server._admit("CREATE", kind, body)
-                except AdmissionError as e:
-                    return self._error(400, str(e), "AdmissionDenied")
-                commits = server._pop_commits(body)
-                md = body.setdefault("metadata", {})
-                if ns:
-                    md["namespace"] = ns
-                try:
-                    out = server.store.create(kind, body)
-                except AlreadyExists as e:
-                    server._commit(commits, False)
-                    return self._error(409, str(e), "AlreadyExists")
-                except Exception:
-                    server._commit(commits, False)
-                    raise
-                server._commit(commits, True)
-                return self._send_json(201, out)
+                with server._crd_guard(kind):
+                    if kind == "CustomResourceDefinition":
+                        err = server.validate_crd(body)
+                        if err:
+                            return self._error(400, err, "Invalid")
+                    try:
+                        body = server._admit("CREATE", kind, body)
+                    except AdmissionError as e:
+                        return self._error(400, str(e), "AdmissionDenied")
+                    commits = server._pop_commits(body)
+                    md = body.setdefault("metadata", {})
+                    if ns:
+                        md["namespace"] = ns
+                    try:
+                        out = server.store.create(kind, body)
+                    except AlreadyExists as e:
+                        server._commit(commits, False)
+                        return self._error(409, str(e), "AlreadyExists")
+                    except Exception:
+                        server._commit(commits, False)
+                        raise
+                    server._commit(commits, True)
+                    if kind == "CustomResourceDefinition":
+                        server._on_crd_change(out, deleted=False)
+                    return self._send_json(201, out)
 
             def do_PUT(self):
                 return self._shaped("put", self._do_PUT)
@@ -545,29 +638,36 @@ class APIServer:
                     body = self._read_body()
                 except _BadRequest as e:
                     return self._error(400, str(e), "BadRequest")
-                try:
-                    body = server._admit("UPDATE", kind, body)
-                except AdmissionError as e:
-                    return self._error(400, str(e), "AdmissionDenied")
-                commits = server._pop_commits(body)
-                if sub == "status":
+                with server._crd_guard(kind):
+                    if kind == "CustomResourceDefinition" and sub != "status":
+                        err = server.validate_crd(body)
+                        if err:
+                            return self._error(400, err, "Invalid")
                     try:
-                        cur = server.store.get(kind, ns or "", name)
+                        body = server._admit("UPDATE", kind, body)
+                    except AdmissionError as e:
+                        return self._error(400, str(e), "AdmissionDenied")
+                    commits = server._pop_commits(body)
+                    if sub == "status":
+                        try:
+                            cur = server.store.get(kind, ns or "", name)
+                        except NotFound as e:
+                            return self._error(404, str(e), "NotFound")
+                        cur["status"] = body.get("status", body)
+                        body = cur
+                    expect = self.headers.get("If-Match") or None
+                    try:
+                        out = server.store.update(kind, body, expect_rv=expect)
                     except NotFound as e:
+                        server._commit(commits, False)
                         return self._error(404, str(e), "NotFound")
-                    cur["status"] = body.get("status", body)
-                    body = cur
-                expect = self.headers.get("If-Match") or None
-                try:
-                    out = server.store.update(kind, body, expect_rv=expect)
-                except NotFound as e:
-                    server._commit(commits, False)
-                    return self._error(404, str(e), "NotFound")
-                except Conflict as e:
-                    server._commit(commits, False)
-                    return self._error(409, str(e), "Conflict")
-                server._commit(commits, True)
-                return self._send_json(200, out)
+                    except Conflict as e:
+                        server._commit(commits, False)
+                        return self._error(409, str(e), "Conflict")
+                    server._commit(commits, True)
+                    if kind == "CustomResourceDefinition":
+                        server._on_crd_change(out, deleted=False)
+                    return self._send_json(200, out)
 
             def do_DELETE(self):
                 return self._shaped("delete", self._do_DELETE)
@@ -579,11 +679,14 @@ class APIServer:
                 plural, kind, ns, name, _ = r
                 if name is None:
                     return self._error(405, "collection delete unsupported")
-                try:
-                    out = server.store.delete(kind, ns or "", name)
-                except NotFound as e:
-                    return self._error(404, str(e), "NotFound")
-                return self._send_json(200, out)
+                with server._crd_guard(kind):
+                    try:
+                        out = server.store.delete(kind, ns or "", name)
+                    except NotFound as e:
+                        return self._error(404, str(e), "NotFound")
+                    if kind == "CustomResourceDefinition":
+                        server._on_crd_change(out, deleted=True)
+                    return self._send_json(200, out)
 
         return Handler
 
