@@ -30,10 +30,33 @@ minimum is 0 (filtering.go minMatchNum).
 
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 
 from kubernetes_tpu.encode.snapshot import ClusterTensors, PodBatch
 from kubernetes_tpu.ops.exprs import eval_selector_set
+
+# Above this node count the [N,N] same-domain matmuls are replaced by a
+# FACTORED formulation — scatter-add per interned domain VALUE then gather
+# back per node: O(P*T*(N+V)) memory instead of O(N^2). The matmul rides
+# the MXU and wins at benchmark scale; the factored path is the blockwise/
+# long-context analog (SURVEY §5) that keeps 50k+-node clusters in HBM.
+# KTPU_DOMAIN_FACTORED=1/0 forces; unset = auto by threshold. The flag is
+# read at TRACE time: set it before the first compile (jit caches bake the
+# branch per tensor shape; toggling later does not recompile same-shape
+# programs). Auto mode is cache-consistent because the threshold is a pure
+# function of the static node-bucket shape.
+_FACTORED_THRESHOLD = 8192
+
+
+def _use_factored(n_nodes: int) -> bool:
+    flag = os.environ.get("KTPU_DOMAIN_FACTORED", "auto").lower()
+    if flag in ("1", "true", "on"):
+        return True
+    if flag in ("0", "false", "off"):
+        return False
+    return n_nodes > _FACTORED_THRESHOLD
 
 
 def _gather_ns(ns_mask, ids):
@@ -108,26 +131,44 @@ def _domain_counts(ct: ClusterTensors, cnt_pn, term_topo, topo_keys,
     has_key = jnp.zeros(cnt_pn.shape, bool)
     num_dom = jnp.zeros(cnt_pn.shape[:2], jnp.float32) if want_domains else None
     K = ct.node_labels.shape[1]
+    V = ct.label_value_num.shape[0]
+    factored = _use_factored(int(N))
     idx_n = jnp.arange(N)
     for k in topo_keys:
         if k < 0 or k >= K:
             continue
         dv = ct.node_labels[:, k]                             # [N]
         present = dv >= 0
-        same = ((dv[:, None] == dv[None, :]) & present[:, None] & present[None, :])
-        agg = jnp.einsum("ptn,nm->ptm", cnt_pn, same.astype(jnp.float32))
         sel = term_topo == k                                  # [P,T]
+        dv_safe = jnp.clip(dv, 0, max(V - 1, 0))
+        if factored:
+            # scatter per-VALUE, gather per node: O(P*T*(N+V)), no [N,N]
+            src = cnt_pn * present[None, None, :].astype(jnp.float32)
+            cnt_val = jnp.zeros(cnt_pn.shape[:2] + (V,), jnp.float32) \
+                .at[:, :, dv_safe].add(src)                   # [P,T,V]
+            agg = cnt_val[:, :, dv_safe] * present[None, None, :]
+        else:
+            same = ((dv[:, None] == dv[None, :])
+                    & present[:, None] & present[None, :])
+            agg = jnp.einsum("ptn,nm->ptm", cnt_pn, same.astype(jnp.float32))
         cnt_dom = jnp.where(sel[..., None], agg, cnt_dom)
         has_key = has_key | (sel[..., None] & present[None, None, :])
         if want_domains:
-            # distinct eligible domains: count nodes that are the FIRST
-            # eligible node of their domain (no eligible same-domain
-            # predecessor)
             ek = (present[None, None, :] if elig is None
                   else elig & present[None, None, :])         # [P,T,N]
-            lower = (same & (idx_n[:, None] < idx_n[None, :])).astype(jnp.float32)
-            prior = jnp.einsum("ptm,mn->ptn", ek.astype(jnp.float32), lower) > 0.0
-            nd_k = jnp.sum((ek & ~prior).astype(jnp.float32), axis=-1)  # [P,T]
+            if factored:
+                # distinct domains = distinct values hit by >=1 eligible node
+                hit = jnp.zeros(cnt_pn.shape[:2] + (V,), jnp.float32) \
+                    .at[:, :, dv_safe].add(ek.astype(jnp.float32))
+                nd_k = jnp.sum((hit > 0.0).astype(jnp.float32), axis=-1)
+            else:
+                # count nodes that are the FIRST eligible node of their
+                # domain (no eligible same-domain predecessor)
+                lower = (same & (idx_n[:, None] < idx_n[None, :])
+                         ).astype(jnp.float32)
+                prior = jnp.einsum("ptm,mn->ptn", ek.astype(jnp.float32),
+                                   lower) > 0.0
+                nd_k = jnp.sum((ek & ~prior).astype(jnp.float32), axis=-1)
             num_dom = jnp.where(sel, nd_k, num_dom)
     return cnt_dom, has_key, num_dom
 
@@ -246,6 +287,8 @@ def interpod_symmetry_mask(ct: ClusterTensors, pb: PodBatch,
     m = m & ns_ok & ct.epod_valid[None, :, None] & ct.ea_valid[None]
     veto = jnp.zeros((P, N), bool)
     K = ct.node_labels.shape[1]
+    V = ct.label_value_num.shape[0]
+    factored = _use_factored(int(N))
     for k in topo_keys:
         if k < 0 or k >= K:
             continue
@@ -254,9 +297,19 @@ def interpod_symmetry_mask(ct: ClusterTensors, pb: PodBatch,
         dv_e = dv[jnp.clip(ct.epod_node, 0, max(N - 1, 0))]
         dv_e = jnp.where(ct.epod_node >= 0, dv_e, -1)         # [E]
         wm = jnp.any(m & (ct.ea_topo == k)[None], axis=-1)    # [P,E]
-        same = (dv_e[:, None] == dv[None, :]) & (dv_e[:, None] >= 0)  # [E,N]
-        veto |= jnp.einsum("pe,en->pn", wm.astype(jnp.float32),
-                           same.astype(jnp.float32)) > 0.0
+        if factored:
+            # veto per VALUE then gather per node: no [E,N] materialization
+            dve_safe = jnp.clip(dv_e, 0, max(V - 1, 0))
+            src = (wm & (dv_e >= 0)[None, :]).astype(jnp.float32)
+            vv = jnp.zeros((P, V), jnp.float32) \
+                .at[:, dve_safe].add(src)                     # [P,V]
+            dv_safe = jnp.clip(dv, 0, max(V - 1, 0))
+            veto |= (vv[:, dv_safe] > 0.0) & (dv >= 0)[None, :]
+        else:
+            same = ((dv_e[:, None] == dv[None, :])
+                    & (dv_e[:, None] >= 0))                   # [E,N]
+            veto |= jnp.einsum("pe,en->pn", wm.astype(jnp.float32),
+                               same.astype(jnp.float32)) > 0.0
     return ~veto
 
 

@@ -258,6 +258,55 @@ def test_spread_node_taints_policy_honor_shrinks_min():
 
 # ----------------------------------------------------- spread matchLabelKeys
 
+def test_factored_domain_counts_bit_parity(monkeypatch):
+    """The factored (scatter-per-value) domain path used above the node
+    threshold must be bit-identical to the [N,N] matmul path — same masks,
+    same scores, on a workload exercising spread (minDomains + policies),
+    (anti-)affinity across namespaces, and the symmetry veto."""
+    import random
+
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+    rng = random.Random(7)
+    nodes = [make_node(f"n{i}").capacity({"cpu": "16", "pods": "40"})
+             .label("zone", f"z{i % 5}").obj() for i in range(24)]
+    bound = []
+    for i in range(30):
+        w = make_pod(f"b{i}", namespace=rng.choice(["default", "team-a"])) \
+            .label("app", rng.choice(["web", "db"]))
+        if rng.random() < 0.4:
+            w.pod_anti_affinity("zone", {"app": "web"},
+                                namespaces=["default", "team-a"])
+        p = w.obj()
+        p.spec.node_name = f"n{rng.randint(0, 23)}"
+        bound.append(p)
+    pods = []
+    for i in range(6):
+        w = make_pod(f"p{i}").label("app", rng.choice(["web", "db"]))
+        w.spread(1, "zone", "DoNotSchedule", {"app": "web"},
+                 min_domains=rng.choice([None, 3]))
+        if rng.random() < 0.7:
+            w.pod_affinity("zone", {"app": "db"},
+                           anti=rng.random() < 0.5,
+                           namespaces=["default", "team-a"])
+        pods.append(w.obj())
+
+    def full_eval():
+        enc = SnapshotEncoder()
+        ct, meta = enc.encode_cluster(nodes, bound, pending_pods=pods)
+        pb = enc.encode_pods(pods, meta)
+        res = evaluate(ct, pb, topo_keys=meta.topo_keys)
+        return (np.asarray(res.feasible)[:len(pods), :len(nodes)],
+                np.asarray(res.scores)[:len(pods), :len(nodes)])
+
+    monkeypatch.setenv("KTPU_DOMAIN_FACTORED", "0")
+    feas_mm, scores_mm = full_eval()
+    monkeypatch.setenv("KTPU_DOMAIN_FACTORED", "1")
+    feas_f, scores_f = full_eval()
+    np.testing.assert_array_equal(feas_mm, feas_f)
+    np.testing.assert_array_equal(scores_mm, scores_f)
+
+
 def test_spread_match_label_keys():
     """matchLabelKeys scopes spread counting to the pod's own rollout: the
     old generation's pods don't count against the new one."""
