@@ -37,6 +37,9 @@ class Profile:
     score_weights: dict[str, float] = field(default_factory=dict)  # override/disable(0)
     fit_strategy: str = "LeastAllocated"
     percentage_of_nodes_to_score: int = 0  # compat; TPU path scores all nodes
+    # out-of-tree plugin names enabled for this profile (sched/framework.py
+    # Registry); None = every registered plugin, [] = none
+    out_of_tree: Optional[list] = None
 
     @property
     def enabled_filters(self) -> Optional[set]:
@@ -57,6 +60,8 @@ class Profile:
             score_weights={k: float(v) for k, v in (d.get("scoreWeights") or {}).items()},
             fit_strategy=d.get("fitStrategy", "LeastAllocated"),
             percentage_of_nodes_to_score=int(d.get("percentageOfNodesToScore", 0)),
+            out_of_tree=(list(d["outOfTree"])
+                         if d.get("outOfTree") is not None else None),
         )
 
 

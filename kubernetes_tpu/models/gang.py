@@ -205,7 +205,7 @@ def _gang_round_impl(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
                      topo_keys: tuple[int, ...] = (), serial: bool = False,
                      weights: tuple = (), enabled_filters: tuple = (),
                      cap_scale=1, slot_start=None, ext_mask=None,
-                     ext_scores=None):
+                     ext_scores=None, plugins: tuple = ()):
     """Traceable body of one propose/accept/fold round. Returns
     (new_state, progress) where progress counts acceptances (plus serial-mode
     attempts). ``slot_start``: index (may be traced) of this batch's extension
@@ -227,7 +227,7 @@ def _gang_round_impl(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
                    fit_strategy=fit_strategy, topo_keys=topo_keys,
                    weights=dict(weights) if weights else None,
                    enabled_filters=frozenset(enabled_filters) if enabled_filters else None,
-                   ext_mask=ext_mask, ext_scores=ext_scores)
+                   ext_mask=ext_mask, ext_scores=ext_scores, plugins=plugins)
     want = res.assigned & ~state.committed & pb.pod_valid
     tried = state.tried
     n_attempted = jnp.int32(0)
@@ -273,18 +273,18 @@ def _gang_round_impl(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
 
 gang_round = partial(jax.jit, static_argnames=(
     "seed", "fit_strategy", "topo_keys", "serial", "weights",
-    "enabled_filters"))(_gang_round_impl)
+    "enabled_filters", "plugins"))(_gang_round_impl)
 
 
 @partial(jax.jit, static_argnames=("seed", "fit_strategy", "topo_keys",
                                    "serial", "weights", "enabled_filters",
-                                   "max_rounds"))
+                                   "max_rounds", "plugins"))
 def gang_converge(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
                   seed: int = 0, fit_strategy: str = "LeastAllocated",
                   topo_keys: tuple[int, ...] = (), serial: bool = False,
                   weights: tuple = (), enabled_filters: tuple = (),
                   max_rounds: int = 64, ext_mask=None,
-                  ext_scores=None) -> GangState:
+                  ext_scores=None, plugins: tuple = ()) -> GangState:
     """On-device convergence: the whole propose/accept/fold round sequence is
     one XLA program — no device→host sync per round (the reference's per-pod
     loop is host-side; our analog keeps the batch's entire conflict resolution
@@ -300,12 +300,13 @@ def gang_converge(ct_ext: ClusterTensors, pb: PodBatch, state: GangState,
     return _converge(ct_ext, pb, state, seed=seed, fit_strategy=fit_strategy,
                      topo_keys=topo_keys, serial=serial, weights=weights,
                      enabled_filters=enabled_filters, max_rounds=max_rounds,
-                     ext_mask=ext_mask, ext_scores=ext_scores)
+                     ext_mask=ext_mask, ext_scores=ext_scores, plugins=plugins)
 
 
 def _converge(ct_ext, pb, state, *, seed, fit_strategy, topo_keys,
               weights, enabled_filters, max_rounds, serial=False,
-              slot_start=None, ext_mask=None, ext_scores=None) -> GangState:
+              slot_start=None, ext_mask=None, ext_scores=None,
+              plugins: tuple = ()) -> GangState:
     """Shared traceable convergence loop (gang_converge + the drain's
     per-batch step): fori(max_rounds) of cond-guarded rounds."""
     def body(i, carry):
@@ -320,7 +321,8 @@ def _converge(ct_ext, pb, state, *, seed, fit_strategy, topo_keys,
                                     weights=weights,
                                     enabled_filters=enabled_filters,
                                     cap_scale=cap, slot_start=slot_start,
-                                    ext_mask=ext_mask, ext_scores=ext_scores)
+                                    ext_mask=ext_mask, ext_scores=ext_scores,
+                                    plugins=plugins)
         _, n = carry
         return jax.lax.cond(n > 0, live, lambda c: c, carry)
 
@@ -333,7 +335,8 @@ def gang_schedule(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
                   fit_strategy: str = "LeastAllocated",
                   topo_keys: tuple[int, ...] = (), serial: bool = False,
                   max_rounds: int = 64, weights=None, enabled_filters=None,
-                  mesh=None, ext_mask=None, ext_scores=None):
+                  mesh=None, ext_mask=None, ext_scores=None,
+                  plugins: tuple = ()):
     """Drive rounds until convergence. Returns (assignment [P] np.int32 with -1
     for unschedulable, rounds_used). ``weights`` (plugin->weight) and
     ``enabled_filters`` (set of filter names) carry the active profile's
@@ -364,7 +367,8 @@ def gang_schedule(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
                           fit_strategy=fit_strategy, topo_keys=topo_keys,
                           serial=serial, weights=weights_t,
                           enabled_filters=filters_t, max_rounds=limit,
-                          ext_mask=ext_mask, ext_scores=ext_scores)
+                          ext_mask=ext_mask, ext_scores=ext_scores,
+                          plugins=plugins)
     # one batched readback: sequential per-array fetches each pay a full
     # host<->device round trip (~100ms on remote-attached TPUs)
     assignment, rounds = jax.device_get((state.assignment, state.rounds))

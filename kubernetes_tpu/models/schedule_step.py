@@ -39,7 +39,7 @@ def evaluate(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
              weights=None, fit_strategy: str = "LeastAllocated",
              topo_keys: tuple[int, ...] = (),
              enabled_filters=None, ext_mask=None,
-             ext_scores=None) -> StepResult:
+             ext_scores=None, plugins: tuple = ()) -> StepResult:
     """Filter + score + select for the whole batch, assuming an EMPTY batch
     context (no intra-batch interactions — gang.py supplies those).
 
@@ -49,7 +49,10 @@ def evaluate(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
     (None = reference defaults / all filters). ``ext_mask``/``ext_scores``
     [P,N]: host-computed scheduler-extender feasibility veto and weighted
     score overlay (sched/extender.py) — the findNodesThatPassExtenders
-    position in the cycle."""
+    position in the cycle. ``plugins``: static tuple of out-of-tree
+    TensorPlugins (sched/framework.py) traced INTO this program — their
+    filters AND into feasibility, their scores merge through the shared
+    normalize pipeline."""
     def _on(name):
         return enabled_filters is None or name in enabled_filters
 
@@ -61,7 +64,21 @@ def evaluate(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
         feasible &= topology.interpod_symmetry_mask(ct, pb, topo_keys)
     if ext_mask is not None:
         feasible &= ext_mask
+    for plugin in plugins:
+        if plugin.filter_fn is not None:
+            feasible &= plugin.filter_fn(ct, pb, topo_keys)
     extra = {}
+    score_plugins = [p for p in plugins if p.score_fn is not None]
+    if score_plugins:
+        # weight applies AFTER normalization, exactly like in-tree plugins
+        # (normalize rescales raw magnitudes away). Plugin defaults sit
+        # UNDER the profile map so a profile's scoreWeights override —
+        # including disable(0) — wins over the plugin's own weight.
+        weights = {**{p.name: p.weight for p in score_plugins},
+                   **(weights or {})}
+    for plugin in score_plugins:
+        extra[plugin.name] = (plugin.score_fn(ct, pb, topo_keys),
+                              plugin.normalize, None)
     if pb.sc_valid.shape[1] > 0:
         extra["PodTopologySpread"] = (
             topology.spread_score_raw(ct, pb, topo_keys), "default_reverse",

@@ -35,13 +35,14 @@ class SchedulerRunner:
     """Owns informers, cache, queue, scheduler; drives the loop."""
 
     def __init__(self, client, cfg: Optional[SchedulerConfiguration] = None,
-                 identity: str = "kubernetes-tpu-scheduler"):
+                 identity: str = "kubernetes-tpu-scheduler", registry=None):
         self.client = client
         self.cfg = cfg or SchedulerConfiguration()
         self.cache = SchedulerCache(assume_ttl=self.cfg.assume_ttl_s)
         self.queue = SchedulingQueue(backoff_initial=self.cfg.backoff_initial_s,
                                      backoff_max=self.cfg.backoff_max_s)
-        self.scheduler = Scheduler(self.cfg, self.cache, self.queue, self._bind)
+        self.scheduler = Scheduler(self.cfg, self.cache, self.queue, self._bind,
+                                   registry=registry)
         self.scheduler._evict = self._evict  # preemption deletes via API
         self.factory = InformerFactory(client)
         self.identity = identity

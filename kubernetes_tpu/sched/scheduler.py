@@ -42,7 +42,8 @@ class Scheduler:
     def __init__(self, cfg: SchedulerConfiguration, cache: SchedulerCache,
                  queue: SchedulingQueue, binder: Binder,
                  feature_gate=DEFAULT_FEATURE_GATE,
-                 preemptor: Optional[Callable] = None):
+                 preemptor: Optional[Callable] = None,
+                 registry=None):
         self.cfg = cfg
         self.cache = cache
         self.queue = queue
@@ -64,6 +65,19 @@ class Scheduler:
         self._extenders = [HTTPExtender(c) for c in (cfg.extenders or [])]
         self._extender_bind = (extender_binder(self._extenders)
                                if self._extenders else None)
+        # out-of-tree plugin registry (framework.Registry analog). Profiles
+        # referencing unregistered names fail fast here, like upstream's
+        # config validation — register plugins before constructing.
+        from kubernetes_tpu.sched.framework import Registry
+        self.registry = registry if registry is not None else Registry()
+        known = {p.name for p in self.registry.tensor_plugins()} \
+            | {p.name for p in self.registry.lifecycle_plugins()}
+        for prof in cfg.profiles:
+            unknown = set(prof.out_of_tree or ()) - known
+            if unknown:
+                raise ValueError(
+                    f"profile {prof.scheduler_name!r} references "
+                    f"unregistered out-of-tree plugins: {sorted(unknown)}")
 
     # ---- one batch iteration --------------------------------------------
 
@@ -144,6 +158,9 @@ class Scheduler:
                     valid[i] = False
                 pb = pb.replace(pod_valid=valid)
         serial = not self.features.enabled("TPUBatchScheduling")
+        oot = (None if profile.out_of_tree is None
+               else set(profile.out_of_tree))
+        plugins = self.registry.tensor_plugins(oot)
         with BATCH_DURATION.time(), TRACER.span(
                 "scheduler/gang_schedule", pods=len(pods), nodes=len(nodes)):
             assignment, rounds = gang_schedule(
@@ -152,7 +169,7 @@ class Scheduler:
                 max_rounds=self.cfg.max_gang_rounds,
                 weights=profile.weights(),
                 enabled_filters=profile.enabled_filters,
-                ext_mask=ext_mask, ext_scores=ext_scores)
+                ext_mask=ext_mask, ext_scores=ext_scores, plugins=plugins)
         GANG_ROUNDS.observe(rounds)
 
         n_bound = 0
@@ -229,15 +246,34 @@ class Scheduler:
         self._bind_threads.append(t)
 
     def _bind_one(self, pod: Pod, node_name: str):
+        from kubernetes_tpu.sched import framework as fw
+        # lifecycle hooks honor the pod's profile opt-in like tensor plugins
+        profile = self.cfg.profile_for(pod.spec.scheduler_name)
+        oot = (None if profile is None or profile.out_of_tree is None
+               else set(profile.out_of_tree))
+        lifecycle = self.registry.lifecycle_plugins(oot)
+        rollback: list = []
         try:
-            ok = None
-            if self._extender_bind is not None:
-                # an interested extender with a bindVerb owns the binding
-                ok = self._extender_bind(pod, node_name)
-            if ok is None:
-                ok = self.binder(pod, node_name)
+            # Permit -> PreBind -> Bind (framework extension-point order);
+            # plugins that allowed/prepared join the unreserve rollback set
+            ok, permitted = fw.run_permit(lifecycle, pod, node_name)
+            rollback.extend(permitted)
+            if ok:
+                ok, prebound = fw.run_pre_bind(lifecycle, pod, node_name)
+                rollback.extend(p for p in prebound if p not in rollback)
+            if ok:
+                delegated = None
+                if self._extender_bind is not None:
+                    # an interested extender with a bindVerb owns the binding
+                    delegated = self._extender_bind(pod, node_name)
+                ok = (self.binder(pod, node_name) if delegated is None
+                      else delegated)
         except Exception:
             ok = False
+        if ok:
+            fw.run_post_bind(lifecycle, pod, node_name)
+        else:
+            fw.run_unreserve(rollback, pod, node_name)
         if ok:
             self.cache.finish_binding(pod.key)
         else:

@@ -1,0 +1,219 @@
+"""Out-of-tree framework plugins: tensor Filter/Score extensions traced into
+the gang program, and host-side Permit/PreBind/PostBind/Unreserve hooks on
+the binding cycle (sched/framework.py — pkg/scheduler/framework Registry
+analog)."""
+
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_tpu.config.types import Profile, SchedulerConfiguration
+from kubernetes_tpu.sched.cache import SchedulerCache
+from kubernetes_tpu.sched.framework import (
+    ALLOW,
+    DENY,
+    WAIT,
+    LifecyclePlugin,
+    Registry,
+    TensorPlugin,
+)
+from kubernetes_tpu.sched.queue import SchedulingQueue
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def build(cfg=None, registry=None, n_nodes=3, cpu="8"):
+    cache = SchedulerCache()
+    for i in range(n_nodes):
+        cache.add_node(make_node(f"n{i}")
+                       .capacity({"cpu": cpu, "pods": "10"}).obj())
+    queue = SchedulingQueue()
+    bound = {}
+    sched = Scheduler(cfg or SchedulerConfiguration(), cache, queue,
+                      binder=lambda p, n: bound.setdefault(p.key, n) or True,
+                      registry=registry)
+    return sched, queue, bound
+
+
+# ---------------------------------------------------------- tensor plugins
+
+def test_custom_filter_plugin_vetoes_nodes():
+    """A registered filter runs inside the jitted program: only node 1
+    survives (everything else vetoed by index)."""
+    reg = Registry().register(TensorPlugin(
+        name="OnlyNodeOne",
+        filter_fn=lambda ct, pb, tk: (
+            jnp.arange(ct.node_valid.shape[0]) == 1)[None, :]
+            | jnp.zeros((pb.pod_valid.shape[0], 1), bool)))
+    sched, queue, bound = build(registry=reg)
+    pod = make_pod("p0").req({"cpu": "1"}).obj()
+    queue.add(pod)
+    sched.run_once(wait=0.1)
+    sched.wait_for_bindings()
+    assert bound.get("default/p0") == "n1", bound
+
+
+def test_custom_score_plugin_steers():
+    reg = Registry().register(TensorPlugin(
+        name="PreferNodeTwo", weight=1000.0,
+        score_fn=lambda ct, pb, tk: (
+            (jnp.arange(ct.node_valid.shape[0]) == 2).astype(jnp.float32)
+            [None, :] * jnp.ones((pb.pod_valid.shape[0], 1), jnp.float32))))
+    sched, queue, bound = build(registry=reg)
+    pod = make_pod("p0").req({"cpu": "1"}).obj()
+    queue.add(pod)
+    sched.run_once(wait=0.1)
+    sched.wait_for_bindings()
+    assert bound.get("default/p0") == "n2", bound
+
+
+def test_profile_opt_out_disables_plugin():
+    reg = Registry().register(TensorPlugin(
+        name="VetoEverything",
+        filter_fn=lambda ct, pb, tk: jnp.zeros(
+            (pb.pod_valid.shape[0], ct.node_valid.shape[0]), bool)))
+    cfg = SchedulerConfiguration(profiles=[Profile(out_of_tree=[])])
+    sched, queue, bound = build(cfg=cfg, registry=reg)
+    pod = make_pod("p0").req({"cpu": "1"}).obj()
+    queue.add(pod)
+    sched.run_once(wait=0.1)
+    sched.wait_for_bindings()
+    assert bound, "opted-out plugin must not veto"
+    # and with the plugin enabled (default profile), nothing binds
+    reg2 = Registry().register(TensorPlugin(
+        name="VetoEverything",
+        filter_fn=lambda ct, pb, tk: jnp.zeros(
+            (pb.pod_valid.shape[0], ct.node_valid.shape[0]), bool)))
+    sched2, queue2, bound2 = build(registry=reg2)
+    queue2.add(make_pod("p1").req({"cpu": "1"}).obj())
+    sched2.run_once(wait=0.1)
+    sched2.wait_for_bindings()
+    assert not bound2
+
+
+def test_registry_rejects_duplicates():
+    reg = Registry().register(TensorPlugin(name="X"))
+    with pytest.raises(ValueError):
+        reg.register(TensorPlugin(name="X"))
+    reg.register(LifecyclePlugin(name="X"))  # separate namespace is fine
+
+
+def test_registry_rejects_in_tree_names():
+    with pytest.raises(ValueError):
+        Registry().register(TensorPlugin(name="PodTopologySpread"))
+    with pytest.raises(ValueError):
+        Registry().register(TensorPlugin(name="TaintToleration"))
+
+
+def test_unknown_profile_plugin_name_fails_fast():
+    cfg = SchedulerConfiguration(profiles=[Profile(out_of_tree=["Typo"])])
+    with pytest.raises(ValueError):
+        build(cfg=cfg, registry=Registry())
+
+
+def test_profile_score_weight_zero_disables_plugin():
+    """A profile's scoreWeights override — including disable(0) — beats the
+    plugin's own weight."""
+    reg = Registry().register(TensorPlugin(
+        name="PreferNodeTwo", weight=1000.0,
+        score_fn=lambda ct, pb, tk: (
+            (jnp.arange(ct.node_valid.shape[0]) == 2).astype(jnp.float32)
+            [None, :] * jnp.ones((pb.pod_valid.shape[0], 1), jnp.float32))))
+    cfg = SchedulerConfiguration(profiles=[
+        Profile(score_weights={"PreferNodeTwo": 0.0})])
+    sched, queue, bound = build(cfg=cfg, registry=reg)
+    queue.add(make_pod("p0").req({"cpu": "1"}).obj())
+    sched.run_once(wait=0.1)
+    sched.wait_for_bindings()
+    # without the steering score all nodes tie; the tie-break picks n0 here
+    assert bound.get("default/p0") != "n2", bound
+
+
+# ------------------------------------------------------- lifecycle plugins
+
+def test_permit_deny_blocks_binding():
+    reg = Registry().register(LifecyclePlugin(
+        name="Gatekeeper", permit=lambda pod, node: DENY))
+    sched, queue, bound = build(registry=reg)
+    queue.add(make_pod("p0").req({"cpu": "1"}).obj())
+    sched.run_once(wait=0.1)
+    sched.wait_for_bindings()
+    assert not bound  # denied at Permit; pod requeued, never bound
+
+
+def test_permit_wait_then_allow():
+    state = {"ready": False}
+
+    def permit(pod, node):
+        return ALLOW if state["ready"] else (WAIT, 0.05)
+    reg = Registry().register(LifecyclePlugin(name="Warmup", permit=permit))
+    sched, queue, bound = build(registry=reg)
+    queue.add(make_pod("p0").req({"cpu": "1"}).obj())
+    sched.run_once(wait=0.1)
+    time.sleep(0.2)
+    state["ready"] = True
+    sched.wait_for_bindings(timeout=10.0)
+    assert bound.get("default/p0")
+
+
+def test_pre_bind_failure_unreserves():
+    calls = []
+    reg = Registry().register(LifecyclePlugin(
+        name="SideEffect",
+        pre_bind=lambda pod, node: calls.append(("pre", pod.metadata.name))
+        or True,
+        unreserve=lambda pod, node: calls.append(("undo", pod.metadata.name)),
+    )).register(LifecyclePlugin(
+        name="ZFailer", pre_bind=lambda pod, node: False))  # sorts LAST
+    sched, queue, bound = build(registry=reg)
+    queue.add(make_pod("p0").req({"cpu": "1"}).obj())
+    sched.run_once(wait=0.1)
+    sched.wait_for_bindings()
+    assert not bound
+    # ZFailer sorts after SideEffect: SideEffect's pre_bind ran, then rolled
+    # back via its unreserve
+    assert ("pre", "p0") in calls and ("undo", "p0") in calls
+
+
+def test_permit_allowed_joins_unreserve_rollback():
+    """A permit-only plugin that allowed gets unreserved when the BIND
+    itself fails (the reservation-at-permit pattern)."""
+    calls = []
+    reg = Registry().register(LifecyclePlugin(
+        name="Reserver",
+        permit=lambda pod, node: calls.append("permit") or ALLOW,
+        unreserve=lambda pod, node: calls.append("undo")))
+    cache = SchedulerCache()
+    cache.add_node(make_node("n0").capacity({"cpu": "8", "pods": "10"}).obj())
+    queue = SchedulingQueue()
+    sched = Scheduler(SchedulerConfiguration(), cache, queue,
+                      binder=lambda p, n: False,  # bind always fails
+                      registry=reg)
+    queue.add(make_pod("p0").req({"cpu": "1"}).obj())
+    sched.run_once(wait=0.1)
+    sched.wait_for_bindings()
+    assert calls == ["permit", "undo"]
+
+
+def test_profile_opt_out_disables_lifecycle_plugin():
+    reg = Registry().register(LifecyclePlugin(
+        name="Gatekeeper", permit=lambda pod, node: DENY))
+    cfg = SchedulerConfiguration(profiles=[Profile(out_of_tree=[])])
+    sched, queue, bound = build(cfg=cfg, registry=reg)
+    queue.add(make_pod("p0").req({"cpu": "1"}).obj())
+    sched.run_once(wait=0.1)
+    sched.wait_for_bindings()
+    assert bound  # opted-out permit must not block binding
+
+
+def test_post_bind_notified():
+    seen = []
+    reg = Registry().register(LifecyclePlugin(
+        name="Notify",
+        post_bind=lambda pod, node: seen.append((pod.metadata.name, node))))
+    sched, queue, bound = build(registry=reg)
+    queue.add(make_pod("p0").req({"cpu": "1"}).obj())
+    sched.run_once(wait=0.1)
+    sched.wait_for_bindings()
+    assert bound and seen and seen[0][0] == "p0"
