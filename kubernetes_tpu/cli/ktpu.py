@@ -334,6 +334,8 @@ def cmd_drain(client: HTTPClient, args, out) -> int:
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="ktpu", description=__doc__.split("\n")[0])
     ap.add_argument("--server", "-s", default="http://127.0.0.1:8001")
+    ap.add_argument("--token", default=None,
+                    help="bearer token (rest.Config.BearerToken analog)")
     ap.add_argument("--namespace", "-n", default="default")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -371,7 +373,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None, out=None) -> int:
     out = out or sys.stdout
     args = build_parser().parse_args(argv)
-    client = HTTPClient(args.server)
+    client = HTTPClient(args.server, token=args.token)
     try:
         if args.cmd == "get":
             return cmd_get(client, args, out)

@@ -14,6 +14,7 @@ Profiles: pods are grouped by spec.schedulerName; unknown names are ignored
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Optional
@@ -32,6 +33,9 @@ from kubernetes_tpu.models.gang import gang_schedule
 from kubernetes_tpu.sched.cache import SchedulerCache
 from kubernetes_tpu.sched import preemption as preemption_mod
 from kubernetes_tpu.sched.queue import SchedulingQueue
+from kubernetes_tpu.utils import sanity
+
+_LOG = logging.getLogger(__name__)
 
 # binder(pod, node_name) -> bool success. The client layer supplies the real
 # POST pods/<p>/binding; tests pass a lambda.
@@ -171,6 +175,9 @@ class Scheduler:
                 enabled_filters=profile.enabled_filters,
                 ext_mask=ext_mask, ext_scores=ext_scores, plugins=plugins)
         GANG_ROUNDS.observe(rounds)
+        if sanity.check_enabled():
+            for problem in sanity.check_assignment(assignment, len(nodes)):
+                _LOG.error("KTPU_CHECK: %s (batch of %d)", problem, len(pods))
 
         n_bound = 0
         dt = time.time() - t0

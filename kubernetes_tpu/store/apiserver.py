@@ -215,7 +215,10 @@ class APIServer:
         return self
 
     def stop(self):
-        self._httpd.shutdown()
+        if self._thread is not None:
+            # shutdown() waits on an event only serve_forever() sets —
+            # calling it on a never-started server deadlocks forever
+            self._httpd.shutdown()
         self._httpd.server_close()
         self.store.close()
 

@@ -1,0 +1,85 @@
+"""Tensor-path sanity checking — the race/NaN "sanitizer" analog.
+
+The reference leans on Go's race detector and strict types; the tensor
+path's equivalent hazards are NaN poisoning (a NaN score silently wins or
+loses every argmax), out-of-range gathers (clipped silently on TPU), and
+assignments pointing at pad nodes. Two tools:
+
+- ``check_step_result`` — host-side invariant sweep over a StepResult for
+  tests and debug harnesses (it needs the [P,N] tensors). The scheduler's
+  production ``KTPU_CHECK=1`` gate runs ``check_assignment`` per batch —
+  the gang path only materializes the final assignment vector, so that is
+  the invariant it can check without extra device->host traffic.
+- ``checked_evaluate`` — ``jax.experimental.checkify`` wrapper of the
+  schedule step with NaN checks enabled, for tests and debugging sessions
+  (checkify instruments every op, so it is NOT for the hot path).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def check_enabled() -> bool:
+    return os.environ.get("KTPU_CHECK", "0").lower() in ("1", "true", "on")
+
+
+def check_step_result(res, n_real_nodes: int) -> list[str]:
+    """-> list of invariant violations (empty = clean).
+
+    Invariants: scores are never NaN; feasible entries have finite scores;
+    infeasible entries are -inf; an assigned pod's choice is a REAL node
+    (not bucket padding) that its own mask marked feasible.
+    """
+    problems: list[str] = []
+    scores = np.asarray(res.scores)
+    feasible = np.asarray(res.feasible)
+    choice = np.asarray(res.choice)
+    assigned = np.asarray(res.assigned)
+    if np.isnan(scores).any():
+        problems.append(f"NaN scores at {int(np.isnan(scores).sum())} entries")
+    if not np.isfinite(scores[feasible]).all():
+        problems.append("non-finite score on a feasible (pod, node)")
+    if np.isfinite(scores[~feasible]).any():
+        problems.append("finite score on an infeasible (pod, node)")
+    if assigned.any():
+        ch = choice[assigned]
+        if (ch < 0).any() or (ch >= n_real_nodes).any():
+            problems.append("assignment outside the real node range "
+                            f"(max {int(ch.max())} vs {n_real_nodes})")
+        else:
+            picked = feasible[np.flatnonzero(assigned), ch]
+            if not picked.all():
+                problems.append("pod assigned to a node its mask rejected")
+    return problems
+
+
+def check_assignment(assignment, n_real_nodes: int) -> list[str]:
+    """Bounds sweep for a gang/drain assignment vector ([-1, n_real))."""
+    a = np.asarray(assignment)
+    bad = (a >= n_real_nodes) | (a < -1)
+    if bad.any():
+        return [f"{int(bad.sum())} assignments outside [-1, {n_real_nodes})"]
+    return []
+
+
+def checked_evaluate(ct, pb, **kw):
+    """checkify-instrumented evaluate: raises on NaN/inf generation and
+    out-of-bounds indexing anywhere in the traced program."""
+    import jax
+    from jax.experimental import checkify
+
+    from kubernetes_tpu.models.schedule_step import evaluate
+
+    # config (topo_keys, weights, ...) is static by closure; checkify
+    # composes over jit. NaN checks only: -inf on infeasible entries and
+    # where-guarded divisions are intentional, so float_checks' inf/div
+    # errors would false-positive.
+    checked = checkify.checkify(
+        jax.jit(lambda c, p: evaluate(c, p, **kw)),
+        errors=checkify.nan_checks)
+    err, res = checked(ct, pb)
+    err.throw()
+    return res

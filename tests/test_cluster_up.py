@@ -1,0 +1,128 @@
+"""Cluster bootstrap (kubeadm analog) + tensor sanity checking.
+
+Reference: cmd/kubeadm (init/join); the sanity module is the tensor path's
+race-detector/sanitizer analog (utils/sanity.py).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.cli.cluster import LocalCluster, join
+from kubernetes_tpu.encode.snapshot import SnapshotEncoder
+from kubernetes_tpu.models.schedule_step import evaluate
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+from kubernetes_tpu.utils.sanity import (
+    check_assignment,
+    check_step_result,
+    checked_evaluate,
+)
+
+
+def wait_until(fn, timeout=30.0, interval=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+def test_cluster_init_schedules_and_runs_a_deployment():
+    with LocalCluster(nodes=2) as cluster:
+        client = cluster.client
+        client.resource("deployments").create({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"replicas": 3,
+                     "selector": {"matchLabels": {"app": "web"}},
+                     "template": {"metadata": {"labels": {"app": "web"}},
+                                  "spec": {"containers": [{"name": "c",
+                                      "resources": {"requests":
+                                                    {"cpu": "100m"}}}]}}}})
+
+        def running():
+            pods = [p for p in client.pods().list()
+                    if (p["metadata"].get("labels") or {}).get("app") == "web"]
+            return (len(pods) == 3
+                    and all(p["spec"].get("nodeName") for p in pods)
+                    and all((p.get("status") or {}).get("phase") == "Running"
+                            for p in pods))
+        assert wait_until(running), [
+            (p["metadata"]["name"], p["spec"].get("nodeName"),
+             (p.get("status") or {}).get("phase"))
+            for p in client.pods().list()]
+
+
+def test_cluster_with_auth_mints_admin_credential():
+    """auth=True: the control plane runs with a minted system:masters token
+    (kubeadm admin.conf analog) — writes succeed, anonymous writes don't."""
+    from kubernetes_tpu.client.clientset import ApiError, HTTPClient
+    with LocalCluster(nodes=1, auth=True) as cluster:
+        assert cluster.admin_token
+        assert wait_until(lambda: cluster.client.nodes().list())
+        anonymous = HTTPClient(cluster.server.url)
+        with pytest.raises(ApiError) as e:
+            anonymous.pods().create({"apiVersion": "v1", "kind": "Pod",
+                                     "metadata": {"name": "x",
+                                                  "namespace": "default"},
+                                     "spec": {"containers": [{"name": "c"}]}})
+        assert e.value.code == 403
+        # the admin client CAN write
+        cluster.client.pods().create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "ok", "namespace": "default"},
+            "spec": {"containers": [{"name": "c",
+                "resources": {"requests": {"cpu": "100m"}}}]}})
+        assert wait_until(lambda: cluster.client.pods().get("ok")
+                          ["spec"].get("nodeName"))
+
+
+def test_join_adds_schedulable_nodes():
+    with LocalCluster(nodes=1) as cluster:
+        workers = join(cluster.server.url, n=2, name_prefix="joined")
+        try:
+            assert wait_until(lambda: len(cluster.client.nodes().list()) == 3)
+            names = {n["metadata"]["name"]
+                     for n in cluster.client.nodes().list()}
+            assert {"joined-0", "joined-1"} <= names
+        finally:
+            for w in workers:
+                w.stop()
+
+
+# ------------------------------------------------------------- sanity gate
+
+def _small_cluster():
+    nodes = [make_node(f"n{i}").capacity({"cpu": "4", "pods": "10"})
+             .label("zone", f"z{i % 2}").obj() for i in range(4)]
+    pods = [make_pod(f"p{i}").req({"cpu": "1"})
+            .label("app", "web")
+            .spread(1, "zone", "DoNotSchedule", {"app": "web"}).obj()
+            for i in range(3)]
+    enc = SnapshotEncoder()
+    ct, meta = enc.encode_cluster(nodes, [], pending_pods=pods)
+    pb = enc.encode_pods(pods, meta)
+    return ct, pb, meta, len(nodes)
+
+
+def test_step_result_invariants_clean():
+    ct, pb, meta, n = _small_cluster()
+    res = evaluate(ct, pb, topo_keys=meta.topo_keys)
+    assert check_step_result(res, n) == []
+    assert check_assignment(np.asarray(res.choice), n + 10) == []
+    assert check_assignment(np.asarray([n + 10]), n) != []
+
+
+def test_checked_evaluate_passes_on_healthy_input():
+    ct, pb, meta, _ = _small_cluster()
+    res = checked_evaluate(ct, pb, topo_keys=meta.topo_keys)
+    assert bool(np.asarray(res.assigned).any())
+
+
+def test_check_step_result_flags_nan():
+    ct, pb, meta, n = _small_cluster()
+    res = evaluate(ct, pb, topo_keys=meta.topo_keys)
+    poisoned = res.replace(scores=np.asarray(res.scores) * np.nan)
+    assert any("NaN" in p for p in check_step_result(poisoned, n))
