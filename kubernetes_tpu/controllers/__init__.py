@@ -18,7 +18,11 @@ from kubernetes_tpu.controllers.job import JobController
 from kubernetes_tpu.controllers.manager import ControllerManager
 from kubernetes_tpu.controllers.namespace import NamespaceController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
-from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+from kubernetes_tpu.controllers.podgc import PodGCController
+from kubernetes_tpu.controllers.replicaset import (
+    ReplicaSetController,
+    ReplicationControllerController,
+)
 from kubernetes_tpu.controllers.resourceclaim import ResourceClaimController
 from kubernetes_tpu.controllers.serviceaccount import (
     ServiceAccountController,
@@ -32,7 +36,8 @@ __all__ = [
     "DaemonSetController", "DeploymentController", "DisruptionController",
     "EndpointsController", "EndpointSliceController", "GarbageCollector",
     "HorizontalPodAutoscalerController", "JobController",
-    "NamespaceController", "NodeLifecycleController", "ReplicaSetController",
+    "NamespaceController", "NodeLifecycleController", "PodGCController",
+    "ReplicaSetController", "ReplicationControllerController",
     "ResourceClaimController",
     "ServiceAccountController", "StatefulSetController",
     "TTLAfterFinishedController", "TokenController", "active_pods",

@@ -15,7 +15,8 @@ from kubernetes_tpu.store.apiserver import ALL_RESOURCES
 # kinds tracked in the ownership graph (plural -> kind, namespaced)
 GC_RESOURCES = ("pods", "replicasets", "deployments", "statefulsets",
                 "daemonsets", "jobs", "cronjobs", "endpoints",
-                "endpointslices", "serviceaccounts", "secrets", "resourceclaims")
+                "endpointslices", "serviceaccounts", "secrets", "resourceclaims",
+                "replicationcontrollers")
 
 
 class GarbageCollector:

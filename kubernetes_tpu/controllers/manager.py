@@ -24,7 +24,11 @@ from kubernetes_tpu.controllers.job import JobController
 from kubernetes_tpu.controllers.namespace import NamespaceController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.pvbinder import PersistentVolumeController
-from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+from kubernetes_tpu.controllers.podgc import PodGCController
+from kubernetes_tpu.controllers.replicaset import (
+    ReplicaSetController,
+    ReplicationControllerController,
+)
 from kubernetes_tpu.controllers.resourceclaim import ResourceClaimController
 from kubernetes_tpu.controllers.serviceaccount import (
     ServiceAccountController,
@@ -38,7 +42,7 @@ DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "daemonset",
                        "nodelifecycle", "pvbinder", "disruption", "cronjob",
                        "ttlafterfinished", "horizontalpodautoscaler",
                        "namespace", "serviceaccount", "serviceaccount-token",
-                       "resourceclaim")
+                       "resourceclaim", "replicationcontroller", "podgc")
 
 
 class ControllerManager:
@@ -53,6 +57,8 @@ class ControllerManager:
         ctors = {
             "deployment": DeploymentController,
             "replicaset": ReplicaSetController,
+            "replicationcontroller": ReplicationControllerController,
+            "podgc": PodGCController,
             "job": JobController,
             "daemonset": DaemonSetController,
             "statefulset": StatefulSetController,
@@ -133,6 +139,7 @@ def _informer_attr(c) -> str:
     return {
         "deployment": "dep_informer",
         "replicaset": "rs_informer",
+        "replicationcontroller": "rs_informer",
         "job": "job_informer",
         "daemonset": "ds_informer",
         "statefulset": "ss_informer",

@@ -43,6 +43,8 @@ def pod_from_template(rs: dict, kind: str = "ReplicaSet") -> dict:
 
 class ReplicaSetController(Controller):
     name = "replicaset"
+    plural = "replicasets"
+    kind = "ReplicaSet"
 
     def __init__(self, client):
         super().__init__(client)
@@ -50,17 +52,20 @@ class ReplicaSetController(Controller):
         self.pod_informer = None
 
     def register(self, factory: InformerFactory) -> None:
-        self.rs_informer = factory.informer("replicasets", None)
+        self.rs_informer = factory.informer(self.plural, None)
         self.rs_informer.add_event_handler(self.handler())
         self.pod_informer = factory.informer("pods", None)
         self.pod_informer.add_event_handler(
-            self.handler(lambda obj: self.enqueue_owner(obj, "ReplicaSet")))
+            self.handler(lambda obj: self.enqueue_owner(obj, self.kind)))
+
+    def _selector(self, rs: dict):
+        return LabelSelector.from_dict((rs.get("spec") or {}).get("selector"))
 
     # ---- syncReplicaSet --------------------------------------------------
 
     def _owned_pods(self, rs: dict) -> list[dict]:
         ns = (rs.get("metadata") or {}).get("namespace", "")
-        sel = LabelSelector.from_dict((rs.get("spec") or {}).get("selector"))
+        sel = self._selector(rs)
         out = []
         for p in self.pod_informer.store.list():
             md = p.get("metadata") or {}
@@ -84,7 +89,7 @@ class ReplicaSetController(Controller):
         pods_api = self.client.pods(ns)
         if diff > 0:
             for _ in range(min(diff, BURST_REPLICAS)):
-                pods_api.create(pod_from_template(rs))
+                pods_api.create(pod_from_template(rs, self.kind))
         elif diff < 0:
             # delete highest-cost pods first: unscheduled, then not-ready,
             # then youngest (getPodsToDelete ranking, simplified)
@@ -115,8 +120,28 @@ class ReplicaSetController(Controller):
         if rs.get("status") != new_status:
             obj = {**rs, "status": new_status}
             try:
-                self.client.resource("replicasets",
+                self.client.resource(self.plural,
                                      rs["metadata"].get("namespace")).update_status(obj)
             except ApiError as e:
                 if e.code not in (404, 409):
                     raise
+
+
+class ReplicationControllerController(ReplicaSetController):
+    """Legacy ReplicationController — same reconcile with v1 semantics.
+
+    Reference: ``pkg/controller/replication`` (a thin adapter over the
+    ReplicaSet logic upstream too). RC selectors are plain label MAPS, not
+    LabelSelectors, and default to the template's labels when unset.
+    """
+
+    name = "replicationcontroller"
+    plural = "replicationcontrollers"
+    kind = "ReplicationController"
+
+    def _selector(self, rc: dict):
+        sel = (rc.get("spec") or {}).get("selector")
+        if not sel:
+            tpl = ((rc.get("spec") or {}).get("template") or {})
+            sel = (tpl.get("metadata") or {}).get("labels") or {}
+        return LabelSelector(match_labels=dict(sel))

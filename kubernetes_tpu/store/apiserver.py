@@ -52,6 +52,7 @@ CORE_RESOURCES = {
     "resourcequotas": ("ResourceQuota", True),
     "limitranges": ("LimitRange", True),
     "secrets": ("Secret", True),
+    "replicationcontrollers": ("ReplicationController", True),
     "serviceaccounts": ("ServiceAccount", True),
 }
 STORAGE_RESOURCES = {"storageclasses": ("StorageClass", False)}

@@ -266,6 +266,86 @@ def test_namespace_purge_on_delete(client):
         factory.stop_all()
 
 
+# ---------------------------------------------------------------- podgc
+
+def test_podgc_sweeps():
+    from kubernetes_tpu.controllers import PodGCController
+    client = DirectClient(ObjectStore())
+    client.nodes().create({"apiVersion": "v1", "kind": "Node",
+                           "metadata": {"name": "alive"}, "status": {}})
+    ctrl = PodGCController(client, terminated_threshold=1, quarantine_s=0.2)
+    ctrl.tick_interval = 0.1
+    ctrl2, factory = run_controller(client, ctrl)
+    try:
+        # (a) two terminated pods, threshold 1 -> oldest goes
+        for i, ts in ((0, 10.0), (1, 20.0)):
+            p = make_pod(f"done{i}").obj().to_dict()
+            p["metadata"]["creationTimestamp"] = ts
+            p["spec"]["nodeName"] = "alive"
+            p["status"] = {"phase": "Succeeded"}
+            client.pods().create(p)
+        # (b) orphan on a node that doesn't exist
+        orphan = make_pod("orphan").obj().to_dict()
+        orphan["spec"]["nodeName"] = "ghost-node"
+        orphan["status"] = {"phase": "Running"}
+        client.pods().create(orphan)
+        # (c) terminating but never scheduled
+        limbo = make_pod("limbo").obj().to_dict()
+        limbo["metadata"]["deletionTimestamp"] = 123.0
+        client.pods().create(limbo)
+        # survivor
+        keeper = make_pod("keeper").obj().to_dict()
+        keeper["spec"]["nodeName"] = "alive"
+        keeper["status"] = {"phase": "Running"}
+        client.pods().create(keeper)
+        # controller-owned terminated pod: NOT the threshold sweep's business
+        owned = make_pod("job-done").obj().to_dict()
+        owned["metadata"]["creationTimestamp"] = 1.0  # oldest of all
+        owned["metadata"]["ownerReferences"] = [{
+            "kind": "Job", "name": "j", "uid": "u1", "controller": True}]
+        owned["spec"]["nodeName"] = "alive"
+        owned["status"] = {"phase": "Succeeded"}
+        client.pods().create(owned)
+        assert wait_until(lambda: {p["metadata"]["name"]
+                                   for p in client.pods().list()}
+                          == {"done1", "keeper", "job-done"})
+    finally:
+        stop(ctrl2, factory)
+
+
+# ------------------------------------------------- replicationcontroller
+
+def test_replicationcontroller_map_selector():
+    from kubernetes_tpu.controllers import ReplicationControllerController
+    client = DirectClient(ObjectStore())
+    ctrl, factory = run_controller(
+        client, ReplicationControllerController(client))
+    try:
+        client.resource("replicationcontrollers").create({
+            "apiVersion": "v1", "kind": "ReplicationController",
+            "metadata": {"name": "legacy", "namespace": "default"},
+            "spec": {"replicas": 2,
+                     "selector": {"app": "legacy"},  # v1 MAP selector
+                     "template": {"metadata": {"labels": {"app": "legacy"}},
+                                  "spec": {"containers": [{"name": "c"}]}}}})
+        assert wait_until(lambda: len(client.pods().list()) == 2)
+        for p in client.pods().list():
+            ref = p["metadata"]["ownerReferences"][0]
+            assert ref["kind"] == "ReplicationController"
+        assert wait_until(lambda: (client.resource("replicationcontrollers")
+                                   .get("legacy").get("status") or {})
+                          .get("replicas") == 2)
+        # scale down
+        rc = client.resource("replicationcontrollers").get("legacy")
+        rc["spec"]["replicas"] = 1
+        client.resource("replicationcontrollers").update(rc)
+        assert wait_until(lambda: len([
+            p for p in client.pods().list()
+            if not (p["metadata"].get("deletionTimestamp"))]) == 1)
+    finally:
+        stop(ctrl, factory)
+
+
 # ------------------------------------------------------- serviceaccount
 
 def test_default_serviceaccount_and_token(client):
