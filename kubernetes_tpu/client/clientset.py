@@ -293,6 +293,32 @@ class HTTPClient(_Handles):
         self.timeout = timeout
         self.token = token
         self.impersonate = impersonate
+        # per-thread persistent connection (keep-alive): the server speaks
+        # HTTP/1.1 with Content-Length, so reusing the socket removes the
+        # TCP handshake every request paid under urllib — the dominant cost
+        # of the connected scheduling path's bind/status chatter
+        self._local = threading.local()
+
+    def _conn(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            import http.client
+            from urllib.parse import urlsplit
+            parts = urlsplit(self.base)
+            cls = (http.client.HTTPSConnection if parts.scheme == "https"
+                   else http.client.HTTPConnection)
+            conn = cls(parts.hostname, parts.port, timeout=self.timeout)
+            self._local.conn = conn
+        return conn
+
+    def _drop_conn(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            self._local.conn = None
 
     def _auth_headers(self) -> dict:
         h = {}
@@ -338,35 +364,61 @@ class HTTPClient(_Handles):
         return self.base + p
 
     def _req(self, method, url, body=None, headers=None):
+        import http.client
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method,
-                                     headers={"Content-Type": "application/json",
-                                              **self._auth_headers(),
-                                              **(headers or {})})
+        path = url[len(self.base):] or "/"
+        all_headers = {"Content-Type": "application/json",
+                       **self._auth_headers(), **(headers or {})}
         # One retry on transport-level failures (reset/refused under load
-        # bursts). A retried NAMED write that actually committed surfaces as
+        # bursts, or a keep-alive socket the server closed between requests).
+        # A retried NAMED write that actually committed surfaces as
         # 409/AlreadyExists — the expected optimistic-concurrency outcome.
         # generateName creates are NOT idempotent (the server mints a fresh
         # name each time, so a lost-response retry would duplicate the
-        # object); those fail fast and rely on the controller's resync.
+        # object); those run on a FRESH connection (no stale-keep-alive
+        # hazard) and fail fast, relying on the controller's resync.
         retriable = not (method == "POST" and isinstance(body, dict)
                          and (body.get("metadata") or {}).get("generateName")
                          and not (body.get("metadata") or {}).get("name"))
-        for attempt in (0, 1):
+        if not retriable:
+            self._drop_conn()
+        stale_retry_used = False
+        attempt = 0
+        while True:
+            reused = getattr(self._local, "conn", None) is not None
+            conn = self._conn()
             try:
-                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                    return json.loads(resp.read() or b"{}")
-            except urllib.error.HTTPError as e:
-                try:
-                    status = json.loads(e.read())
-                except Exception:
-                    status = {}
-                raise ApiError(e.code, status.get("message", str(e)),
-                               status.get("reason", "")) from None
-            except (ConnectionError, urllib.error.URLError, TimeoutError):
-                if attempt or not retriable:
-                    raise
-                time.sleep(0.05)
+                conn.request(method, path, body=data, headers=all_headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                if resp.will_close:
+                    self._drop_conn()
+                if resp.status >= 400:
+                    try:
+                        status = json.loads(payload)
+                    except Exception:
+                        status = {}
+                    raise ApiError(resp.status,
+                                   status.get("message", f"HTTP {resp.status}"),
+                                   status.get("reason", ""))
+                return json.loads(payload or b"{}")
+            except ApiError:
+                raise
+            except (http.client.HTTPException, ConnectionError, OSError,
+                    TimeoutError):
+                self._drop_conn()
+                # A failure on a REUSED socket is almost always a stale
+                # keep-alive the server closed between requests: retry on a
+                # fresh connection WITHOUT burning the transport-retry
+                # budget (which exists for genuine transient failures).
+                if reused and not stale_retry_used:
+                    stale_retry_used = True
+                    continue
+                if attempt == 0 and retriable:
+                    attempt = 1
+                    time.sleep(0.05)
+                    continue
+                raise
 
     def create(self, plural, kind, ns, obj):
         return self._req("POST", self._path(plural, ns), obj)

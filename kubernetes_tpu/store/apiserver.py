@@ -102,11 +102,40 @@ class _BadRequest(Exception):
 
 
 class _HTTPServer(ThreadingHTTPServer):
-    # http.server's default listen backlog of 5 drops (RSTs) connections
-    # under controller/binder bursts — every client request is a fresh TCP
-    # connection (urllib does not keep-alive), so bursts of a few dozen
-    # concurrent binds overflow it instantly.
+    # Generous listen backlog: clients hold per-thread keep-alive
+    # connections now, so backlog pressure comes from many components
+    # CONNECTING at once (startup, reconnect storms after a restart)
+    # rather than per-request churn — but a burst of fresh connections
+    # would still overflow http.server's default backlog of 5 instantly.
     request_queue_size = 128
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        # live connections, so stop() can sever keep-alive sockets whose
+        # handler threads would otherwise keep serving after shutdown()
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+
+    def close_all_connections(self):
+        import socket as _socket
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def handle_error(self, request, client_address):
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionResetError, BrokenPipeError)):
+            return  # severed keep-alive (stop() or client teardown)
+        super().handle_error(request, client_address)
 
 
 class APIServer:
@@ -220,6 +249,10 @@ class APIServer:
             # shutdown() waits on an event only serve_forever() sets —
             # calling it on a never-started server deadlocks forever
             self._httpd.shutdown()
+        # sever established keep-alive connections: shutdown() only stops
+        # the ACCEPT loop — handler threads would keep serving (and
+        # mutating the store) on pooled client sockets after stop
+        self._httpd.close_all_connections()
         self._httpd.server_close()
         self.store.close()
 
@@ -303,10 +336,24 @@ class APIServer:
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
+            def setup(self):
+                super().setup()
+                with self.server._conns_lock:
+                    self.server._conns.add(self.connection)
+
+            def finish(self):
+                with self.server._conns_lock:
+                    self.server._conns.discard(self.connection)
+                super().finish()
+
             def log_message(self, *a):
                 pass
 
             def _shaped(self, verb: str, fn):
+                # per-REQUEST state: one handler instance serves every
+                # request on a keep-alive connection
+                self._body_consumed = False
+                self._last_code = 200
                 """The filter chain, in DefaultBuildHandlerChain order:
                 authn (401) -> audit -> impersonation (403) -> APF (429) ->
                 authz (403) -> handler. Watches are long-running and exempt
@@ -343,6 +390,7 @@ class APIServer:
                 try:
                     server.flow.acquire(level)
                 except RejectedError as e:
+                    self._drain_body()
                     body = json.dumps({"kind": "Status", "status": "Failure",
                                        "message": "too many requests",
                                        "reason": "TooManyRequests",
@@ -401,7 +449,29 @@ class APIServer:
                     self._audit(code if code is not None
                                 else getattr(self, "_last_code", 200))
 
+            def _drain_body(self):
+                """Consume an unread request body before responding: with
+                keep-alive (HTTP/1.1), leftover body bytes would be parsed
+                as the NEXT request line, 400ing every later request on the
+                connection. Error/authz paths respond without ever calling
+                _read_body, so this runs in front of every response."""
+                if getattr(self, "_body_consumed", False):
+                    return
+                self._body_consumed = True
+                n = int(self.headers.get("Content-Length") or 0)
+                if n > 1 << 20:
+                    # don't buffer attacker-sized bodies on pre-auth error
+                    # paths: give up keep-alive for this connection instead
+                    self.close_connection = True
+                    return
+                if n:
+                    try:
+                        self.rfile.read(n)
+                    except Exception:
+                        self.close_connection = True
+
             def _send_json(self, code: int, obj):
+                self._drain_body()
                 self._last_code = code
                 body = json.dumps(obj).encode()
                 self.send_response(code)
@@ -416,6 +486,7 @@ class APIServer:
                                        "code": code})
 
             def _read_body(self) -> dict:
+                self._body_consumed = True
                 n = int(self.headers.get("Content-Length", 0))
                 if not n:
                     return {}
