@@ -288,6 +288,16 @@ def cmd_describe(client: HTTPClient, args, out) -> int:
             out.write("Conditions:\n")
             for c in st["conditions"]:
                 out.write(f"  {c.get('type')}: {c.get('status')}\n")
+        from kubernetes_tpu.utils.events import events_for
+        evs = events_for(client, md.get("namespace", "default"),
+                         md.get("name", ""), uid=md.get("uid"))
+        if evs:
+            out.write("Events:\n")
+            for e in evs:
+                count = e.get("count", 1)
+                suffix = f" (x{count})" if count > 1 else ""
+                out.write(f"  {e.get('type')}  {e.get('reason')}  "
+                          f"{e.get('message')}{suffix}\n")
     else:
         import yaml
         out.write("Spec:\n")

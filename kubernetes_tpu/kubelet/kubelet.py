@@ -70,6 +70,8 @@ class Kubelet:
         self._pods: dict[str, dict] = {}  # uid -> latest pod object
         self._admitted: dict[str, dict] = {}  # uid -> pod as admitted
         self._rejected: dict[str, str] = {}   # uid -> rejection reason
+        from kubernetes_tpu.utils.events import EventRecorder
+        self.recorder = EventRecorder(client, f"kubelet/{node_name}")
 
     def _next_pod_ip(self) -> str:
         n = next(self._pod_ip_seq)
@@ -153,6 +155,11 @@ class Kubelet:
     def _on_liveness_failure(self, pod_uid: str, container: str):
         """prober: liveness/startup exhausted its failureThreshold — kill the
         container; the next SyncPod applies the restart policy."""
+        with self._pods_lock:
+            failing = self._pods.get(pod_uid)
+        if failing is not None:
+            self.recorder.event(failing, "Warning", "Unhealthy",
+                                f"container {container} failed its probe; killing")
         self.runtime.stop_container(pod_uid, container, exit_code=137)
         with self._pods_lock:
             pod = self._pods.get(pod_uid)
@@ -263,6 +270,8 @@ class Kubelet:
             self.cpu_manager.release(uid)
 
     def _fail_pod(self, pod: dict, reason: str) -> None:
+        self.recorder.event(pod, "Warning", reason,
+                            f"Pod was rejected by node {self.node_name}")
         md = pod.get("metadata") or {}
         status = {**(pod.get("status") or {}),
                   "phase": "Failed", "reason": reason,

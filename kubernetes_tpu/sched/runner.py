@@ -43,6 +43,8 @@ class SchedulerRunner:
                                      backoff_max=self.cfg.backoff_max_s)
         self.scheduler = Scheduler(self.cfg, self.cache, self.queue, self._bind,
                                    registry=registry)
+        from kubernetes_tpu.utils.events import EventRecorder
+        self.scheduler.recorder = EventRecorder(client, "default-scheduler")
         self.scheduler._evict = self._evict  # preemption deletes via API
         self.factory = InformerFactory(client)
         self.identity = identity

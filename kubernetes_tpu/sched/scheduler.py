@@ -34,6 +34,7 @@ from kubernetes_tpu.sched.cache import SchedulerCache
 from kubernetes_tpu.sched import preemption as preemption_mod
 from kubernetes_tpu.sched.queue import SchedulingQueue
 from kubernetes_tpu.utils import sanity
+from kubernetes_tpu.utils.events import NullRecorder
 
 _LOG = logging.getLogger(__name__)
 
@@ -69,6 +70,9 @@ class Scheduler:
         self._extenders = [HTTPExtender(c) for c in (cfg.extenders or [])]
         self._extender_bind = (extender_binder(self._extenders)
                                if self._extenders else None)
+        # event recording (record.EventRecorder analog); the runner wires
+        # a real recorder, library users keep the no-op default
+        self.recorder = NullRecorder()
         # out-of-tree plugin registry (framework.Registry analog). Profiles
         # referencing unregistered names fail fast here, like upstream's
         # config validation — register plugins before constructing.
@@ -209,7 +213,11 @@ class Scheduler:
             # Bound by another party while in-flight (its own bound copy may
             # even be why the gang step couldn't place it). Requeueing would
             # cycle it through backoffQ forever — no future event clears it.
+            # No FailedScheduling event either: the pod IS scheduled.
             return
+        self.recorder.event(pod, "Warning", "FailedScheduling",
+                            "no node satisfied the pod's scheduling "
+                            "constraints this cycle")
         nominated = None
         if pod.spec.priority > 0 and self.features.enabled("PreemptionSimulation"):
             nominated = self.preemptor(pod)
@@ -279,6 +287,8 @@ class Scheduler:
             ok = False
         if ok:
             fw.run_post_bind(lifecycle, pod, node_name)
+            self.recorder.event(pod, "Normal", "Scheduled",
+                                f"Successfully assigned {pod.key} to {node_name}")
         else:
             fw.run_unreserve(rollback, pod, node_name)
         if ok:

@@ -291,7 +291,9 @@ def bootstrap_policy() -> list[dict]:
               "resources": ["pods", "nodes", "persistentvolumes",
                             "persistentvolumeclaims", "storageclasses",
                             "namespaces", "poddisruptionbudgets"]},
-             {"verbs": ["create"], "resources": ["pods/binding", "events"]},
+             {"verbs": ["create", "get", "update", "patch"],
+              "resources": ["events"]},
+             {"verbs": ["create"], "resources": ["pods/binding"]},
              {"verbs": ["update", "patch"], "resources": ["pods/status"]},
              # preemption DELETEs victims directly (schedule_one.go), so the
              # scheduler holds delete on pods as upstream bootstrap policy does

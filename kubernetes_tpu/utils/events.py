@@ -1,0 +1,114 @@
+"""Event recording — the EventRecorder/EventBroadcaster analog.
+
+Reference: ``staging/src/k8s.io/client-go/tools/record/event.go``: components
+record typed Events against objects ("FailedScheduling", "Scheduled",
+"Killing", ...); identical events within a window aggregate into one Event
+with a bumped ``count`` instead of flooding the store. Consumers read them
+via ``kubectl describe`` / ``kubectl get events``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Optional
+
+EVENT_NORMAL, EVENT_WARNING = "Normal", "Warning"
+
+
+class EventRecorder:
+    """Write-behind recorder over a clientset: dedups (object, reason,
+    message) within ``aggregate_window_s`` by bumping count, like the
+    EventCorrelator. Never lets event failures break the caller."""
+
+    def __init__(self, client, component: str,
+                 aggregate_window_s: float = 600.0):
+        self.client = client
+        self.component = component
+        self.aggregate_window_s = aggregate_window_s
+        self._lock = threading.Lock()
+        # (ns, involved name, reason, message) -> (event name, count, ts)
+        self._seen: dict[tuple, tuple[str, int, float]] = {}
+        # per-recorder sequence keeps names unique within one millisecond
+        self._seq = itertools.count()
+
+    def event(self, obj, type_: str, reason: str, message: str) -> None:
+        if isinstance(obj, dict):
+            md = obj.get("metadata") or {}
+            kind = obj.get("kind", "")
+        else:  # typed api objects
+            md = {"name": obj.metadata.name,
+                  "namespace": obj.metadata.namespace,
+                  "uid": obj.metadata.uid}
+            kind = type(obj).__name__
+        ns = md.get("namespace") or "default"
+        name = md.get("name", "")
+        key = (ns, name, reason, message)
+        now = time.time()
+        # bookkeeping under the lock, HTTP OUTSIDE it: event() runs inline
+        # in the scheduler loop and kubelet threads — a slow apiserver must
+        # not serialize them on this lock. The race (two threads creating
+        # the same logical event) costs one duplicate, like upstream's
+        # approximate correlator.
+        with self._lock:
+            # prune entries too old to ever aggregate again (leak guard)
+            cutoff = now - self.aggregate_window_s
+            for k in [k for k, v in self._seen.items() if v[2] < cutoff]:
+                del self._seen[k]
+            prior = self._seen.get(key)
+            if prior is None:
+                ev_name = (f"{name}.{next(self._seq):x}"
+                           f".{int(now * 1000) & 0xFFFFFF:x}")
+                self._seen[key] = (ev_name, 1, now)
+            else:
+                ev_name = prior[0]
+                self._seen[key] = (ev_name, prior[1] + 1, prior[2])
+        try:
+            if prior is not None:
+                try:
+                    ev = self.client.resource("events", ns).get(ev_name)
+                    ev["count"] = ev.get("count", 1) + 1
+                    ev["lastTimestamp"] = now
+                    self.client.resource("events", ns).update(ev)
+                    return
+                except Exception:
+                    pass  # fall through: write a fresh event
+            self.client.resource("events", ns).create({
+                "apiVersion": "v1", "kind": "Event",
+                "metadata": {"name": ev_name, "namespace": ns},
+                "involvedObject": {"kind": kind, "name": name,
+                                   "namespace": ns,
+                                   "uid": md.get("uid", "")},
+                "type": type_, "reason": reason, "message": message,
+                "source": {"component": self.component},
+                "count": 1, "firstTimestamp": now, "lastTimestamp": now})
+        except Exception:
+            pass  # events are best-effort, never break the control loop
+
+
+class NullRecorder:
+    """No-op recorder for components constructed without a client."""
+
+    def event(self, obj, type_, reason, message) -> None:
+        pass
+
+
+def events_for(client, namespace: str, name: str,
+               uid: Optional[str] = None) -> list[dict]:
+    """Events whose involvedObject matches (describe's Events section).
+    ``uid`` filters out a same-named PRIOR incarnation's events; events
+    recorded without a uid still match (best effort)."""
+    try:
+        out = []
+        for e in client.resource("events", namespace).list():
+            inv = e.get("involvedObject") or {}
+            if inv.get("name") != name:
+                continue
+            if uid and inv.get("uid") and inv["uid"] != uid:
+                continue
+            out.append(e)
+    except Exception:
+        return []
+    out.sort(key=lambda e: e.get("lastTimestamp") or 0)
+    return out
