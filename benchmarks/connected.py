@@ -42,17 +42,37 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
             HTTPClient(server.url),
             SchedulerConfiguration(batch_size=batch_size))
         _warm_jit(runner, nodes, pods, batch_size, log)
+
+        # Completion detector: a watch stream counting pods whose nodeName
+        # got set — one cheap event per binding instead of re-listing (and
+        # deep-copying) the whole pod set in a poll loop, which at 2k+ pods
+        # steals enough GIL time to distort the measurement itself.
+        import threading
+        bound_names: set = set()
+        all_bound = threading.Event()
+        _, rv0 = seed_client.pods("default").list_rv()
+
+        def _count_bindings():
+            try:
+                for ev in seed_client.pods("default").watch(since_rv=rv0):
+                    if (ev.object or {}).get("spec", {}).get("nodeName"):
+                        bound_names.add(ev.object["metadata"]["name"])
+                        if len(bound_names) >= n_pods:
+                            all_bound.set()
+                            return
+            except Exception:
+                pass  # server stopping
+
+        watcher = threading.Thread(target=_count_bindings, daemon=True)
+        watcher.start()
         t_start = time.time()
         runner.start()
-        pods_api = seed_client.pods("default")
-        deadline = t_start + timeout
-        bound = 0
-        while time.time() < deadline:
-            bound = sum(1 for p in pods_api.list() if p["spec"].get("nodeName"))
-            if bound >= n_pods:
-                break
-            time.sleep(0.25)
+        completed = all_bound.wait(timeout)
         dt = time.time() - t_start
+        bound = len(bound_names)
+        if not completed:  # watch died or timed out: relist for the truth
+            bound = sum(1 for p in seed_client.pods("default").list()
+                        if p["spec"].get("nodeName"))
         runner.stop()
         # p99 attempt latency (scheduled results) from the live histogram —
         # bucket upper bound, like Prometheus histogram_quantile

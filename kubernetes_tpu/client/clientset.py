@@ -15,7 +15,9 @@ create/get/list/update/update_status/delete/watch/bind/evict.
 
 from __future__ import annotations
 
+import http.client
 import json
+import socket
 import threading
 import time
 import urllib.error
@@ -35,6 +37,29 @@ from kubernetes_tpu.store.store import (
     ObjectStore,
     TooOld,
 )
+
+
+def _set_nodelay(sock) -> None:
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except (OSError, AttributeError):
+        pass  # non-TCP transport (tests) or already-closed socket
+
+
+class _NoDelayHTTPConnection(http.client.HTTPConnection):
+    """Keep-alive connection with TCP_NODELAY: small JSON request/response
+    pairs otherwise stall ~40ms each behind Nagle + delayed ACK, capping one
+    connection at ~25 req/s."""
+
+    def connect(self):
+        super().connect()
+        _set_nodelay(self.sock)
+
+
+class _NoDelayHTTPSConnection(http.client.HTTPSConnection):
+    def connect(self):
+        super().connect()
+        _set_nodelay(self.sock)
 
 
 class ApiError(Exception):
@@ -302,11 +327,10 @@ class HTTPClient(_Handles):
     def _conn(self):
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            import http.client
             from urllib.parse import urlsplit
             parts = urlsplit(self.base)
-            cls = (http.client.HTTPSConnection if parts.scheme == "https"
-                   else http.client.HTTPConnection)
+            cls = (_NoDelayHTTPSConnection if parts.scheme == "https"
+                   else _NoDelayHTTPConnection)
             conn = cls(parts.hostname, parts.port, timeout=self.timeout)
             self._local.conn = conn
         return conn

@@ -78,6 +78,7 @@ class SchedulerConfiguration:
     backoff_max_s: float = 10.0
     assume_ttl_s: float = 30.0
     client_qps: float = 0.0        # 0 = uncapped (reference default: 50)
+    bind_workers: int = 16         # binding-cycle pool size (goroutine analog)
     parallelism: int = 16          # compat field; unused on TPU
     leader_elect: bool = False
 
@@ -100,6 +101,7 @@ class SchedulerConfiguration:
             ("seed", "seed"), ("backoffInitialSeconds", "backoff_initial_s"),
             ("backoffMaxSeconds", "backoff_max_s"), ("assumeTTLSeconds", "assume_ttl_s"),
             ("clientQPS", "client_qps"), ("parallelism", "parallelism"),
+            ("bindWorkers", "bind_workers"),
             ("leaderElect", "leader_elect"),
         ]:
             if yaml_key in d:
@@ -143,3 +145,5 @@ def validate(cfg: SchedulerConfiguration):
         raise ValidationError("batchSize must be >= 1")
     if cfg.max_gang_rounds < 1:
         raise ValidationError("maxGangRounds must be >= 1")
+    if cfg.bind_workers < 1:
+        raise ValidationError("bindWorkers must be >= 1")

@@ -13,8 +13,8 @@ import time
 from bisect import bisect_right
 from typing import Optional
 
-DEFAULT_BUCKETS = (0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5,
-                   1.0, 2.0, 5.0, 10.0)
+DEFAULT_BUCKETS = (0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.15, 0.2,
+                   0.3, 0.4, 0.5, 0.75, 1.0, 2.0, 5.0, 10.0)
 
 
 def _label_key(labels: Optional[dict]) -> tuple:
@@ -83,15 +83,18 @@ class Histogram(_Metric):
         self._sums: dict[tuple, float] = {}
         self._totals: dict[tuple, int] = {}
 
-    def observe(self, value: float, labels: Optional[dict] = None):
+    def observe(self, value: float, labels: Optional[dict] = None, n: int = 1):
+        """Record ``value`` ``n`` times (n>1: one batched lock acquisition —
+        the scheduler observes one identical attempt duration per pod in a
+        gang batch)."""
         k = _label_key(labels)
         with self._lock:
             counts = self._counts.setdefault(k, [0] * len(self.buckets))
             i = bisect_right(self.buckets, value)
             for j in range(i, len(self.buckets)):
-                counts[j] += 1
-            self._sums[k] = self._sums.get(k, 0.0) + value
-            self._totals[k] = self._totals.get(k, 0) + 1
+                counts[j] += n
+            self._sums[k] = self._sums.get(k, 0.0) + value * n
+            self._totals[k] = self._totals.get(k, 0) + n
 
     def time(self, labels: Optional[dict] = None):
         return _Timer(self, labels)

@@ -3,13 +3,17 @@
 Reference: ``staging/src/k8s.io/client-go/tools/record/event.go``: components
 record typed Events against objects ("FailedScheduling", "Scheduled",
 "Killing", ...); identical events within a window aggregate into one Event
-with a bumped ``count`` instead of flooding the store. Consumers read them
-via ``kubectl describe`` / ``kubectl get events``.
+with a bumped ``count`` instead of flooding the store. Recording is
+NON-BLOCKING, exactly like upstream (``recorder.Event`` pushes onto the
+broadcaster's channel; watchers do the API writes on their own goroutine) —
+the scheduler's binding cycle must never stall on an event POST. Consumers
+read them via ``kubectl describe`` / ``kubectl get events``.
 """
 
 from __future__ import annotations
 
 import itertools
+import queue
 import threading
 import time
 from typing import Optional
@@ -20,7 +24,10 @@ EVENT_NORMAL, EVENT_WARNING = "Normal", "Warning"
 class EventRecorder:
     """Write-behind recorder over a clientset: dedups (object, reason,
     message) within ``aggregate_window_s`` by bumping count, like the
-    EventCorrelator. Never lets event failures break the caller."""
+    EventCorrelator. ``event()`` only enqueues; a single background sink
+    thread performs the API writes (EventBroadcaster.StartRecordingToSink).
+    Never lets event failures break the caller. ``flush()`` waits for the
+    queue to drain (tests / shutdown)."""
 
     def __init__(self, client, component: str,
                  aggregate_window_s: float = 600.0):
@@ -32,6 +39,9 @@ class EventRecorder:
         self._seen: dict[tuple, tuple[str, int, float]] = {}
         # per-recorder sequence keeps names unique within one millisecond
         self._seq = itertools.count()
+        self._q: "queue.Queue[Optional[tuple]]" = queue.Queue(maxsize=4096)
+        self._sink: Optional[threading.Thread] = None
+        self._last_prune = 0.0
 
     def event(self, obj, type_: str, reason: str, message: str) -> None:
         if isinstance(obj, dict):
@@ -46,16 +56,15 @@ class EventRecorder:
         name = md.get("name", "")
         key = (ns, name, reason, message)
         now = time.time()
-        # bookkeeping under the lock, HTTP OUTSIDE it: event() runs inline
-        # in the scheduler loop and kubelet threads — a slow apiserver must
-        # not serialize them on this lock. The race (two threads creating
-        # the same logical event) costs one duplicate, like upstream's
-        # approximate correlator.
         with self._lock:
-            # prune entries too old to ever aggregate again (leak guard)
-            cutoff = now - self.aggregate_window_s
-            for k in [k for k, v in self._seen.items() if v[2] < cutoff]:
-                del self._seen[k]
+            # prune entries too old to ever aggregate again (leak guard);
+            # at most once per minute — event() runs on the scheduling loop,
+            # and a full _seen scan per call would be O(events^2) per cycle
+            if now - self._last_prune > 60.0:
+                self._last_prune = now
+                cutoff = now - self.aggregate_window_s
+                for k in [k for k, v in self._seen.items() if v[2] < cutoff]:
+                    del self._seen[k]
             prior = self._seen.get(key)
             if prior is None:
                 ev_name = (f"{name}.{next(self._seq):x}"
@@ -64,27 +73,58 @@ class EventRecorder:
             else:
                 ev_name = prior[0]
                 self._seen[key] = (ev_name, prior[1] + 1, prior[2])
-        try:
-            if prior is not None:
-                try:
-                    ev = self.client.resource("events", ns).get(ev_name)
-                    ev["count"] = ev.get("count", 1) + 1
-                    ev["lastTimestamp"] = now
-                    self.client.resource("events", ns).update(ev)
-                    return
-                except Exception:
-                    pass  # fall through: write a fresh event
-            self.client.resource("events", ns).create({
-                "apiVersion": "v1", "kind": "Event",
-                "metadata": {"name": ev_name, "namespace": ns},
-                "involvedObject": {"kind": kind, "name": name,
-                                   "namespace": ns,
-                                   "uid": md.get("uid", "")},
-                "type": type_, "reason": reason, "message": message,
-                "source": {"component": self.component},
-                "count": 1, "firstTimestamp": now, "lastTimestamp": now})
-        except Exception:
-            pass  # events are best-effort, never break the control loop
+            if self._sink is None or not self._sink.is_alive():
+                self._sink = threading.Thread(target=self._drain, daemon=True,
+                                              name=f"events/{self.component}")
+                self._sink.start()
+            # enqueue under the lock: a same-key racer must not get its
+            # aggregate (get+update) item into the queue ahead of the
+            # original create item
+            try:  # full queue = drop, like the broadcaster's channel overflow
+                self._q.put_nowait(
+                    (ns, name, kind, md.get("uid", ""), ev_name,
+                     prior is not None, type_, reason, message, now))
+            except queue.Full:
+                pass
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is not None:
+                    self._write(*item)
+            except Exception:
+                pass  # events are best-effort, never break the control loop
+            finally:
+                self._q.task_done()
+
+    def _write(self, ns, name, kind, uid, ev_name, aggregate,
+               type_, reason, message, now) -> None:
+        if aggregate:
+            try:
+                ev = self.client.resource("events", ns).get(ev_name)
+                ev["count"] = ev.get("count", 1) + 1
+                ev["lastTimestamp"] = now
+                self.client.resource("events", ns).update(ev)
+                return
+            except Exception:
+                pass  # fall through: write a fresh event
+        self.client.resource("events", ns).create({
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": ev_name, "namespace": ns},
+            "involvedObject": {"kind": kind, "name": name,
+                               "namespace": ns, "uid": uid},
+            "type": type_, "reason": reason, "message": message,
+            "source": {"component": self.component},
+            "count": 1, "firstTimestamp": now, "lastTimestamp": now})
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Wait until every event recorded so far has been written."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._q.unfinished_tasks == 0:
+                return
+            time.sleep(0.005)
 
 
 class NullRecorder:
@@ -101,11 +141,11 @@ def events_for(client, namespace: str, name: str,
     recorded without a uid still match (best effort)."""
     try:
         out = []
-        for e in client.resource("events", namespace).list():
-            inv = e.get("involvedObject") or {}
-            if inv.get("name") != name:
-                continue
-            if uid and inv.get("uid") and inv["uid"] != uid:
+        listed = client.resource("events", namespace).list(
+            field_selector=f"involvedObject.name={name}")
+        for e in listed:
+            if uid and (e.get("involvedObject") or {}).get("uid") \
+                    and e["involvedObject"]["uid"] != uid:
                 continue
             out.append(e)
     except Exception:

@@ -5,6 +5,8 @@ authenticate (401) -> audit -> impersonation -> APF -> authorize (403);
 RBAC semantics from plugin/pkg/auth/authorizer/rbac.
 """
 
+import time
+
 import pytest
 
 from kubernetes_tpu.client.clientset import ApiError, HTTPClient
@@ -130,7 +132,16 @@ def test_audit_log_records(server):
     c.nodes().create(make_node("n1").obj().to_dict())
     with pytest.raises(ApiError):
         HTTPClient(server.url).pods().list()
-    evs = server.audit.events
+    # audit logs at ResponseComplete (after the response bytes go out, like
+    # upstream); give the handler thread a beat to finish the finally block
+    deadline = time.time() + 2.0
+    while time.time() < deadline:
+        evs = list(server.audit.events)
+        if (any(e["user"] == "admin" and e["verb"] == "POST"
+                and e["code"] == 201 for e in evs)
+                and any(e["code"] == 401 for e in evs)):
+            break
+        time.sleep(0.01)
     assert any(e["user"] == "admin" and e["verb"] == "POST"
                and e["code"] == 201 for e in evs)
     assert any(e["code"] == 401 for e in evs)

@@ -22,6 +22,7 @@ def test_recorder_writes_and_aggregates():
     rec.event(pod_obj(), "Warning", "FailedScheduling", "no nodes")
     rec.event(pod_obj(), "Warning", "FailedScheduling", "no nodes")
     rec.event(pod_obj(), "Normal", "Scheduled", "assigned to n0")
+    rec.flush()  # recording is async (broadcaster-style); settle before reading
     evs = events_for(client, "default", "p0")
     by_reason = {e["reason"]: e for e in evs}
     assert by_reason["FailedScheduling"]["count"] == 2  # aggregated
@@ -76,8 +77,9 @@ def test_describe_shows_events():
         # record against the LIVE object: describe filters events by the
         # pod's uid, so a stale incarnation's events don't show
         real = client.pods().get("p0")
-        EventRecorder(client, "tester").event(
-            real, "Warning", "Unhealthy", "probe failed")
+        rec = EventRecorder(client, "tester")
+        rec.event(real, "Warning", "Unhealthy", "probe failed")
+        rec.flush()
         out = io.StringIO()
         rc = ktpu_main(["--server", server.url, "describe", "pods", "p0"],
                        out=out)
