@@ -1,78 +1,127 @@
-"""Connected-path benchmark: SchedulerRunner against the in-process apiserver.
+"""Connected-path benchmark: SchedulerRunner against a SEPARATE-PROCESS
+apiserver.
 
 The raw gang numbers (scheduler_perf.py) measure the device program alone;
-this measures the PRODUCT — informers watching the apiserver, the scheduling
-queue, the cache's incremental snapshot encode, the gang step, and async
-binding POSTs — the same window the reference's scheduler_perf measures
-against a real apiserver with hollow nodes (SURVEY §4: integration tier +
-kubemark).
-
-Pods are created first (queue fills via the watch), then the scheduler loop
-starts; throughput = pods bound / time from loop start to last binding
-visible in the store.
+this measures the PRODUCT — informers watching the apiserver over HTTP, the
+scheduling queue, the cache's incremental encode, the device-resident fused
+drain, and bulk binding POSTs — in the reference's deployment shape: the
+apiserver and the scheduler are separate processes (separate binaries
+upstream), so API serving and watch fan-out do not share the scheduler's
+interpreter. The measured window matches upstream scheduler_perf's
+``createPods`` op: scheduler running and synced, clock starts at pod
+creation, stops when the last binding is visible in the store.
 """
 
 from __future__ import annotations
 
+import multiprocessing as mp
 import time
+
+
+def _serve(conn) -> None:
+    """Server process: in-memory store + HTTP apiserver until told to stop."""
+    from kubernetes_tpu.store.apiserver import APIServer
+    server = APIServer().start()
+    conn.send(server.port)
+    conn.recv()  # any message = stop
+    server.stop()
+
+
+def _watch_bound(url: str, ns: str, rv0: int, n_pods: int,
+                 count, done, dead, ready) -> None:
+    """Watcher process: count pods whose nodeName got set (one event per
+    binding); its JSON decode burns its own interpreter, not the
+    scheduler's."""
+    from kubernetes_tpu.client.clientset import HTTPClient
+    client = HTTPClient(url, timeout=30.0)
+    seen: set = set()
+    try:
+        w = client.pods(ns).watch(since_rv=rv0)
+        ready.set()  # stream established; the clock may start
+        for ev in w:
+            if (ev.object or {}).get("spec", {}).get("nodeName"):
+                seen.add(ev.object["metadata"]["name"])
+                count.value = len(seen)
+                if len(seen) >= n_pods:
+                    done.set()
+                    return
+    except Exception:
+        import traceback
+        traceback.print_exc()
+    dead.set()
 
 
 def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
                   batch_size: int = 512, timeout: float = 300.0,
                   log=lambda *a: None) -> dict:
-    from kubernetes_tpu.client.clientset import DirectClient, HTTPClient
+    from kubernetes_tpu.client.clientset import HTTPClient
     from kubernetes_tpu.config.types import SchedulerConfiguration
     from kubernetes_tpu.metrics.registry import ATTEMPT_DURATION
     from kubernetes_tpu.sched.runner import SchedulerRunner
-    from kubernetes_tpu.store.apiserver import APIServer
     from benchmarks.workloads import mixed_heterogeneous
 
-    server = APIServer().start()
+    ctx = mp.get_context("spawn")  # never fork a live TPU client
+    parent, child = ctx.Pipe()
+    server = ctx.Process(target=_serve, args=(child,), daemon=True)
+    server.start()
+    port = parent.recv()
+    url = f"http://127.0.0.1:{port}"
     try:
-        seed_client = DirectClient(server.store)  # fast seeding path
+        seed_client = HTTPClient(url, timeout=120.0)
         nodes, pods = mixed_heterogeneous(pods=n_pods, nodes=n_nodes)
         t0 = time.time()
-        for n in nodes:
-            seed_client.nodes().create(n.to_dict())
-        for p in pods:
-            seed_client.pods(p.metadata.namespace).create(p.to_dict())
-        log(f"  seeded {n_nodes} nodes + {n_pods} pods in {time.time()-t0:.1f}s")
+        seed_client.nodes().create_many([n.to_dict() for n in nodes])
+        log(f"  seeded {n_nodes} nodes in {time.time()-t0:.1f}s")
 
         runner = SchedulerRunner(
-            HTTPClient(server.url),
+            HTTPClient(url),
             SchedulerConfiguration(batch_size=batch_size))
-        _warm_jit(runner, nodes, pods, batch_size, log)
+        # informers first (nodes sync into the scheduler cache); the loop
+        # starts after pod creation so the first pop drains a deep backlog
+        runner.start(start_loop=False)
+        _warm_jit(runner, pods, batch_size, n_pods, log)
 
-        # Completion detector: a watch stream counting pods whose nodeName
-        # got set — one cheap event per binding instead of re-listing (and
-        # deep-copying) the whole pod set in a poll loop, which at 2k+ pods
-        # steals enough GIL time to distort the measurement itself.
-        import threading
-        bound_names: set = set()
-        all_bound = threading.Event()
         _, rv0 = seed_client.pods("default").list_rv()
-
-        def _count_bindings():
-            try:
-                for ev in seed_client.pods("default").watch(since_rv=rv0):
-                    if (ev.object or {}).get("spec", {}).get("nodeName"):
-                        bound_names.add(ev.object["metadata"]["name"])
-                        if len(bound_names) >= n_pods:
-                            all_bound.set()
-                            return
-            except Exception:
-                pass  # server stopping
-
-        watcher = threading.Thread(target=_count_bindings, daemon=True)
+        count = ctx.Value("i", 0)
+        all_bound, watch_dead, ready = ctx.Event(), ctx.Event(), ctx.Event()
+        watcher = ctx.Process(target=_watch_bound,
+                              args=(url, "default", rv0, n_pods,
+                                    count, all_bound, watch_dead, ready),
+                              daemon=True)
         watcher.start()
+        ready.wait(30.0)  # spawn + import + stream setup is seconds
+
         t_start = time.time()
-        runner.start()
-        completed = all_bound.wait(timeout)
+        by_ns: dict = {}
+        for p in pods:
+            by_ns.setdefault(p.metadata.namespace, []).append(p.to_dict())
+        for ns, objs in by_ns.items():
+            seed_client.pods(ns).create_many(objs)
+        t_created = time.time()
+        runner.start_loop()
+        deadline = t_start + timeout
+        completed = False
+        while time.time() < deadline:
+            if all_bound.wait(timeout=0.02):
+                completed = True
+                break
+            if watch_dead.is_set():
+                # watch failed: poll the store for the truth instead of
+                # silently waiting out the timeout with a dead detector
+                n = sum(1 for p in seed_client.pods("default").list()
+                        if p["spec"].get("nodeName"))
+                count.value = n
+                if n >= n_pods:
+                    completed = True
+                    break
+                time.sleep(0.2)
         dt = time.time() - t_start
-        bound = len(bound_names)
-        if not completed:  # watch died or timed out: relist for the truth
+        bound = count.value
+        if not completed:  # timed out: relist for the truth
             bound = sum(1 for p in seed_client.pods("default").list()
                         if p["spec"].get("nodeName"))
+        log(f"  created {n_pods} pods in {t_created-t_start:.1f}s; "
+            f"all bound at +{dt:.1f}s")
         runner.stop()
         # p99 attempt latency (scheduled results) from the live histogram —
         # bucket upper bound, like Prometheus histogram_quantile
@@ -82,34 +131,29 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
             "SchedulingThroughput": round(bound / dt, 1) if dt > 0 else 0.0,
             "bound": bound, "pods": n_pods, "nodes": n_nodes,
             "measure_s": round(dt, 2),
+            "watch_degraded": watch_dead.is_set(),
             "p99_attempt_latency_s": p99,
         }
     finally:
-        server.stop()
+        try:
+            parent.send("stop")
+        except Exception:
+            pass
+        server.join(timeout=5.0)
+        if server.is_alive():
+            server.terminate()
 
 
-def _warm_jit(runner, nodes, pods, batch_size, log):
-    """Compile the gang program at the exact shapes/static-args the runner's
-    first batch will use (a long-lived scheduler amortizes this once per shape
-    bucket; the measured window is steady-state, as in scheduler_perf)."""
-    from kubernetes_tpu.models.gang import gang_schedule
-    from kubernetes_tpu.sched.cache import SchedulerCache
-
+def _warm_jit(runner, pods, batch_size, n_pods, log):
+    """Compile the fused drain and arm the device-resident cluster context
+    at the exact shapes the runner's pops will use, against the runner's OWN
+    cache — so the measured window is pure steady state (a long-lived
+    scheduler amortizes this once per shape bucket, as in scheduler_perf)."""
     t0 = time.time()
-    cache = SchedulerCache()
-    for n in nodes:
-        cache.add_node(n)
-    profile = runner.cfg.profile_for(pods[0].spec.scheduler_name)
-    batch = pods[:batch_size]
-    _, ct, meta = cache.snapshot(pending_pods=batch, slot_headroom=len(pods))
-    pb = cache.encode_pods(batch, meta)
-    gang_schedule(ct, pb, seed=runner.cfg.seed,
-                  fit_strategy=profile.fit_strategy,
-                  topo_keys=meta.topo_keys,
-                  max_rounds=runner.cfg.max_gang_rounds,
-                  weights=profile.weights(),
-                  enabled_filters=profile.enabled_filters)
-    log(f"  jit warmup {time.time()-t0:.1f}s")
+    armed = runner.scheduler.warm_drain(
+        pods, slot_headroom=n_pods
+        + batch_size * runner.cfg.max_drain_batches)
+    log(f"  jit warmup {time.time()-t0:.1f}s (ctx armed: {armed})")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,72 @@
+"""Wall-clock breakdown of the connected run: tracer spans + bind timing.
+Diagnostic tool, not part of the bench suite."""
+import collections
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_tpu.utils.tracing import TRACER
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.sched.runner import SchedulerRunner
+
+# instrument _bind_one and runner._bind
+bind_stats = {"n": 0, "t": 0.0}
+orig_bind_one = Scheduler._bind_one
+
+
+def timed_bind_one(self, pod, node_name):
+    t0 = time.time()
+    try:
+        return orig_bind_one(self, pod, node_name)
+    finally:
+        bind_stats["n"] += 1
+        bind_stats["t"] += time.time() - t0
+
+
+Scheduler._bind_one = timed_bind_one
+
+run_stats = {"n": 0, "t": 0.0, "assume_t": 0.0}
+orig_run_once = Scheduler.run_once
+
+
+def timed_run_once(self, wait=0.5):
+    t0 = time.time()
+    out = orig_run_once(self, wait)
+    if out:
+        run_stats["n"] += 1
+        run_stats["t"] += time.time() - t0
+    return out
+
+
+Scheduler.run_once = timed_run_once
+
+start_inf = {"t": 0.0}
+orig_start = SchedulerRunner.start
+
+
+def timed_start(self, wait_sync=10.0, **kw):
+    t0 = time.time()
+    out = orig_start(self, wait_sync, **kw)
+    start_inf["t"] = time.time() - t0
+    return out
+
+
+SchedulerRunner.start = timed_start
+
+from benchmarks.connected import run_connected
+res = run_connected(n_pods=int(os.environ.get("PODS", "2000")),
+                    n_nodes=int(os.environ.get("NODES", "1000")),
+                    log=lambda *a: print(*a, file=sys.stderr))
+print(res)
+print(f"runner.start (informer sync): {start_inf['t']:.2f}s")
+print(f"run_once: n={run_stats['n']} total={run_stats['t']:.2f}s")
+print(f"bind_one: n={bind_stats['n']} total={bind_stats['t']:.2f}s "
+      f"avg={1000*bind_stats['t']/max(bind_stats['n'],1):.1f}ms")
+agg = collections.defaultdict(lambda: [0, 0.0])
+for s in TRACER.spans():
+    agg[s.name][0] += 1
+    agg[s.name][1] += s.duration_ms
+for name, (n, ms) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+    print(f"  span {name}: n={n} total={ms/1000:.2f}s")

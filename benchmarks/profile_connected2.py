@@ -1,0 +1,62 @@
+"""Busy-thread sampling profile of the connected run's measured window."""
+import collections
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+IDLE = {"wait", "select", "poll", "accept", "_wait_for_tstate_lock",
+        "get", "readline", "readinto", "recv", "recv_into"}
+samples = collections.Counter()
+stop = threading.Event()
+started = threading.Event()
+
+
+def sampler():
+    started.wait()
+    while not stop.is_set():
+        for tid, frame in sys._current_frames().items():
+            if tid == threading.get_ident():
+                continue
+            stack = []
+            f = frame
+            while f is not None and len(stack) < 50:
+                stack.append(f)
+                f = f.f_back
+            top = stack[0].f_code
+            if top.co_name in IDLE:
+                # attribute to the nearest repo frame below, if any is NOT
+                # an idle wrapper (i.e. the thread is blocked, skip it)
+                continue
+            # attribute to top frame plus nearest repo frame
+            repo = next((g for g in stack
+                         if "/repo/" in g.f_code.co_filename), None)
+            key = f"{os.path.basename(top.co_filename)}:{top.co_name}"
+            if repo is not None and repo.f_code is not top:
+                key += f" <{os.path.basename(repo.f_code.co_filename)}:{repo.f_code.co_name}>"
+            samples[key] += 1
+        time.sleep(0.002)
+
+
+t = threading.Thread(target=sampler, daemon=True)
+t.start()
+
+
+def log(*a):
+    print(*a, file=sys.stderr)
+    if "warmup" in str(a[0]):
+        started.set()
+
+
+from benchmarks.connected import run_connected
+res = run_connected(n_pods=int(os.environ.get("PODS", "10000")),
+                    n_nodes=int(os.environ.get("NODES", "5000")),
+                    log=log)
+stop.set()
+print(res)
+total = sum(samples.values())
+print(f"--- busy samples: {total} ---")
+for k, v in samples.most_common(35):
+    print(f"{v:6d} {100*v/max(total,1):5.1f}% {k}")

@@ -105,6 +105,17 @@ class ResourceClient:
     def create(self, obj: dict) -> dict:
         return self._t.create(self.plural, self.kind, self.namespace, obj)
 
+    def create_many(self, objs: list[dict]) -> list[dict]:
+        """Batch create: one store lock pass on the direct transport, one
+        v1 List POST over HTTP. Transports lacking a bulk path fall back to
+        sequential creates. Returns created objects (server identity
+        stamped; the HTTP transport merges stamped metadata into the
+        inputs rather than echoing full objects)."""
+        fn = getattr(self._t, "create_many", None)
+        if fn is not None:
+            return fn(self.plural, self.kind, self.namespace, objs)
+        return [self.create(o) for o in objs]
+
     def get(self, name: str) -> dict:
         return self._t.get(self.plural, self.kind, self.namespace, name)
 
@@ -135,6 +146,11 @@ class ResourceClient:
     # pod subresources
     def bind(self, name: str, node_name: str) -> dict:
         return self._t.bind(self.namespace, name, node_name)
+
+    def bind_many(self, bindings: list[tuple[str, str, str]]) -> list[Optional[str]]:
+        """Bulk bind: ``[(namespace, name, node_name)]`` in one request.
+        Returns per-item error message or None (success), request order."""
+        return self._t.bind_many(bindings)
 
     def evict(self, name: str) -> dict:
         return self._t.evict(self.namespace, name)
@@ -227,6 +243,18 @@ class DirectClient(_Handles):
         return self.store.create(kind, obj)
 
     @_api_errors
+    def create_many(self, plural, kind, ns, objs):
+        prepped = []
+        for obj in objs:
+            obj = self._react("create", plural, obj)
+            obj.setdefault("metadata", {})
+            if ns:
+                obj["metadata"].setdefault("namespace", ns)
+            obj.setdefault("kind", kind)
+            prepped.append(obj)
+        return self.store.create_many(kind, prepped)
+
+    @_api_errors
     def get(self, plural, kind, ns, name):
         return self.store.get(kind, ns or "", name)
 
@@ -253,7 +281,11 @@ class DirectClient(_Handles):
         return self.store.delete(kind, ns or "", name)
 
     def watch(self, plural, kind, ns, since_rv):
-        w = self.store.watch(kind, since_rv=since_rv)
+        # Store events share the authoritative object (zero-copy fan-out);
+        # HTTP consumers get fresh dicts from JSON decode, but in-process
+        # consumers could alias store internals — detach here to keep the
+        # fake-clientset contract (handlers may scribble on what they get).
+        w = _CopyingWatch(self.store.watch(kind, since_rv=since_rv))
         if ns is None:
             return w
         return _NamespaceFilteredWatch(w, ns)
@@ -268,9 +300,41 @@ class DirectClient(_Handles):
         return self.store.update("Pod", pod,
                                  expect_rv=pod["metadata"]["resourceVersion"])
 
+    def bind_many(self, bindings):
+        return self.store.bind_many(bindings)
+
     @_api_errors
     def evict(self, ns, name):
         return self.store.delete("Pod", ns or "", name)
+
+
+class _CopyingWatch:
+    """Delivers store events with detached payload copies (DirectClient)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    @property
+    def closed(self) -> bool:
+        return self._inner.closed
+
+    def get(self, timeout: float = 0.2):
+        from kubernetes_tpu.store.store import Event, fastcopy
+        ev = self._inner.get(timeout)
+        if ev is None:
+            return None
+        return Event(ev.type, fastcopy(ev.object), ev.resource_version)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from kubernetes_tpu.store.store import Event, fastcopy
+        ev = next(self._inner)
+        return Event(ev.type, fastcopy(ev.object), ev.resource_version)
+
+    def stop(self):
+        self._inner.stop()
 
 
 class _NamespaceFilteredWatch:
@@ -447,6 +511,24 @@ class HTTPClient(_Handles):
     def create(self, plural, kind, ns, obj):
         return self._req("POST", self._path(plural, ns), obj)
 
+    def create_many(self, plural, kind, ns, objs):
+        """POST a v1 List manifest: one request creates every item. Returns
+        the inputs with server-stamped metadata (resourceVersion/uid/...)
+        merged in — the wire carries metadata only, not full echo objects."""
+        out = self._req("POST", self._path(plural, ns),
+                        {"kind": "List", "items": objs})
+        results = out.get("results", [])
+        errs = [r.get("message") for r in results if r.get("code") not in (200, 201)]
+        created = []
+        for obj, r in zip(objs, results):
+            if r.get("code") in (200, 201) and r.get("metadata"):
+                obj = dict(obj)
+                obj["metadata"] = r["metadata"]
+            created.append(obj)
+        if errs:
+            raise ApiError(409, "; ".join(errs), "BulkCreateFailed")
+        return created
+
     def get(self, plural, kind, ns, name):
         return self._req("GET", self._path(plural, ns, name))
 
@@ -474,6 +556,15 @@ class HTTPClient(_Handles):
     def bind(self, ns, name, node_name):
         return self._req("POST", self._path("pods", ns, name, "binding"),
                          {"target": {"kind": "Node", "name": node_name}})
+
+    def bind_many(self, bindings):
+        out = self._req("POST", self._path("pods", None, "-", "binding"),
+                        {"bindings": [
+                            {"namespace": ns, "name": name,
+                             "target": {"kind": "Node", "name": node}}
+                            for ns, name, node in bindings]})
+        return [None if r.get("code") == 200 else r.get("message", "error")
+                for r in out.get("results", [])]
 
     def evict(self, ns, name):
         return self._req("POST", self._path("pods", ns, name, "eviction"), {})

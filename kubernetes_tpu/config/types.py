@@ -72,6 +72,11 @@ class SchedulerConfiguration:
     # sched/extender.py calls them during every scheduling cycle
     extenders: list = field(default_factory=list)  # list[ExtenderConfig]
     batch_size: int = 256          # pods per gang step (pop_batch max)
+    # Deep-backlog drain: when one pop yields more than batch_size pods the
+    # loop fuses up to this many batches into ONE device program (lax.scan,
+    # models/gang.py gang_drain) — one dispatch + one readback for the whole
+    # backlog instead of a ~100ms round trip per batch on remote TPUs.
+    max_drain_batches: int = 8
     max_gang_rounds: int = 64
     seed: int = 0
     backoff_initial_s: float = 1.0
@@ -98,6 +103,7 @@ class SchedulerConfiguration:
             cfg.extenders = [ExtenderConfig.from_dict(e) for e in d["extenders"]]
         for yaml_key, attr in [
             ("batchSize", "batch_size"), ("maxGangRounds", "max_gang_rounds"),
+            ("maxDrainBatches", "max_drain_batches"),
             ("seed", "seed"), ("backoffInitialSeconds", "backoff_initial_s"),
             ("backoffMaxSeconds", "backoff_max_s"), ("assumeTTLSeconds", "assume_ttl_s"),
             ("clientQPS", "client_qps"), ("parallelism", "parallelism"),
@@ -145,5 +151,7 @@ def validate(cfg: SchedulerConfiguration):
         raise ValidationError("batchSize must be >= 1")
     if cfg.max_gang_rounds < 1:
         raise ValidationError("maxGangRounds must be >= 1")
+    if cfg.max_drain_batches < 1:
+        raise ValidationError("maxDrainBatches must be >= 1")
     if cfg.bind_workers < 1:
         raise ValidationError("bindWorkers must be >= 1")

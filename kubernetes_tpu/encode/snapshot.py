@@ -804,8 +804,12 @@ class SnapshotEncoder:
 
     # -- pod side -----------------------------------------------------------
 
-    def encode_pods(self, pods: list[Pod], meta: SnapshotMeta) -> PodBatch:
-        P = next_bucket(len(pods), minimum=1)
+    def encode_pods(self, pods: list[Pod], meta: SnapshotMeta,
+                    min_p: int = 1) -> PodBatch:
+        """``min_p`` pins the pod-axis bucket floor so callers with a fixed
+        batch shape (the fused drain) never trigger a smaller-bucket
+        recompile for a partial chunk."""
+        P = next_bucket(len(pods), minimum=min_p)
         R = len(meta.resources)
         meta.pod_keys = [p.key for p in pods]
 

@@ -422,11 +422,13 @@ def extend_cluster_drain(ct: ClusterTensors, pbs: list[PodBatch]
 
 
 @partial(jax.jit, static_argnames=("e0", "seed", "fit_strategy", "topo_keys",
-                                   "weights", "enabled_filters", "max_rounds"))
+                                   "weights", "enabled_filters", "max_rounds",
+                                   "plugins"))
 def _gang_drain_compiled(ct_all: ClusterTensors, pb_stack: PodBatch, e0: int,
                          seed: int, fit_strategy: str,
                          topo_keys: tuple[int, ...], weights: tuple,
-                         enabled_filters: tuple, max_rounds: int):
+                         enabled_filters: tuple, max_rounds: int,
+                         plugins: tuple = ()):
     B, P = pb_stack.pod_valid.shape
 
     def batch_body(carry, xs):
@@ -442,7 +444,8 @@ def _gang_drain_compiled(ct_all: ClusterTensors, pb_stack: PodBatch, e0: int,
         st = _converge(ct_b, pb, st0, seed=seed, fit_strategy=fit_strategy,
                        topo_keys=topo_keys, weights=weights,
                        enabled_filters=enabled_filters,
-                       max_rounds=max_rounds, slot_start=start)
+                       max_rounds=max_rounds, slot_start=start,
+                       plugins=plugins)
         epod_node = jax.lax.dynamic_update_slice(
             epod_node, st.assignment, (start,))
         epod_valid = jax.lax.dynamic_update_slice(
@@ -459,6 +462,206 @@ def _gang_drain_compiled(ct_all: ClusterTensors, pb_stack: PodBatch, e0: int,
 
 
 _stage = jax.jit(lambda tree: tree)
+
+
+# -- device-resident drain: cluster tensors stay in HBM across drains --------
+#
+# The connected scheduler's steady state is a loop of drains over an almost-
+# unchanged cluster. Re-uploading the full encoding every drain (tens of MB
+# over a remote-attached TPU link) dominated the connected path's wall time;
+# this keeps ``ct_all`` device-resident and per drain ships only the new pod
+# batches (~1MB): refill the extension rows from the batch, run the scan,
+# then FOLD committed pods into free base existing-pod slots on device — the
+# donate-buffers snapshot update of SURVEY §7 phase 8.
+
+def _flat(x):
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+
+def _jpad(a, axis: int, size: int, fill):
+    if a.shape[axis] == size:
+        return a
+    pads = [(0, 0)] * a.ndim
+    pads[axis] = (0, size - a.shape[axis])
+    return jnp.pad(a, pads, constant_values=fill)
+
+
+def drain_widths_fit(ct_all: ClusterTensors, pb_stack: PodBatch) -> bool:
+    """Host-side guard: the batch's bucket widths must fit the resident
+    extension slots (they only grow when pods carry new label keys / wider
+    anti-affinity terms — fall back to a host re-encode when they do)."""
+    return (pb_stack.pod_labels.shape[2] <= ct_all.epod_labels.shape[1]
+            and pb_stack.anti_valid.shape[2] <= ct_all.ea_valid.shape[1]
+            and pb_stack.anti_sel.key.shape[3] <= ct_all.ea_sel.key.shape[2]
+            and pb_stack.anti_sel.vals.shape[4] <= ct_all.ea_sel.vals.shape[3]
+            and pb_stack.anti_ns_mask.shape[3] <= ct_all.ea_ns_mask.shape[2]
+            and pb_stack.requests.shape[2] == ct_all.requested.shape[1])
+
+
+@partial(jax.jit, donate_argnums=(0,),
+         static_argnames=("e0", "seed", "fit_strategy", "topo_keys",
+                          "weights", "enabled_filters", "max_rounds",
+                          "plugins"))
+def drain_step(ct_all: ClusterTensors, pb_stack: PodBatch, fill,
+               e0: int, seed: int, fit_strategy: str,
+               topo_keys: tuple[int, ...], weights: tuple,
+               enabled_filters: tuple, max_rounds: int,
+               plugins: tuple = ()):
+    """One fused drain over a DEVICE-RESIDENT cluster encoding.
+
+    ``ct_all``: donated; rows [0,e0) are base existing-pod slots (``fill`` of
+    them occupied, packed), rows [e0,e0+B*P) are extension slots whose content
+    this call overwrites from ``pb_stack``. Returns
+    ``(assignments [B,P], rounds [B], new_ct_all, new_fill)`` where
+    ``new_ct_all`` has every committed pod folded into base slots
+    [fill, fill+n) and the extension region invalidated — ready to be the
+    next call's ``ct_all`` with zero host↔device traffic.
+    """
+    B, P = pb_stack.pod_valid.shape
+    K = ct_all.epod_labels.shape[1]
+    ET = ct_all.ea_valid.shape[1]
+    AX = ct_all.ea_sel.key.shape[2]
+    AV = ct_all.ea_sel.vals.shape[3]
+    NSB = ct_all.ea_ns_mask.shape[2]
+    BP = B * P
+
+    def ext(base, new):
+        return jnp.concatenate([base[:e0], new], axis=0)
+
+    ct_r = ct_all.replace(
+        epod_node=ext(ct_all.epod_node, jnp.full(BP, -1, jnp.int32)),
+        epod_ns=ext(ct_all.epod_ns, _flat(pb_stack.pod_ns)),
+        epod_labels=ext(ct_all.epod_labels,
+                        _jpad(_flat(pb_stack.pod_labels), 1, K, -1)),
+        epod_valid=ext(ct_all.epod_valid, jnp.zeros(BP, bool)),
+        ea_sel=SelectorSet(
+            key=ext(ct_all.ea_sel.key,
+                    _jpad(_jpad(_flat(pb_stack.anti_sel.key), 1, ET, -1),
+                          2, AX, -1)),
+            op=ext(ct_all.ea_sel.op,
+                   _jpad(_jpad(_flat(pb_stack.anti_sel.op), 1, ET, 0),
+                         2, AX, 0)),
+            vals=ext(ct_all.ea_sel.vals,
+                     _jpad(_jpad(_jpad(_flat(pb_stack.anti_sel.vals),
+                                       1, ET, -1), 2, AX, -1), 3, AV, -1)),
+            expr_valid=ext(ct_all.ea_sel.expr_valid,
+                           _jpad(_jpad(_flat(pb_stack.anti_sel.expr_valid),
+                                       1, ET, False), 2, AX, False)),
+            valid=ext(ct_all.ea_sel.valid,
+                      _jpad(_flat(pb_stack.anti_sel.valid), 1, ET, False))),
+        ea_topo=ext(ct_all.ea_topo, _jpad(_flat(pb_stack.anti_topo), 1, ET, -1)),
+        ea_valid=ext(ct_all.ea_valid,
+                     _jpad(_flat(pb_stack.anti_valid), 1, ET, False)),
+        ea_ns_explicit=ext(ct_all.ea_ns_explicit,
+                           _jpad(_flat(pb_stack.anti_ns_explicit), 1, ET, False)),
+        ea_ns_mask=ext(ct_all.ea_ns_mask,
+                       _jpad(_jpad(_flat(pb_stack.anti_ns_mask), 1, ET, False),
+                             2, NSB, False)),
+    )
+
+    def batch_body(carry, xs):
+        requested, epod_node, epod_valid = carry
+        pb, b = xs
+        start = e0 + b * P
+        ct_b = ct_r.replace(epod_node=epod_node, epod_valid=epod_valid)
+        st0 = GangState(requested=requested,
+                        committed=jnp.zeros(P, bool),
+                        assignment=jnp.full(P, -1, jnp.int32),
+                        tried=jnp.zeros(P, bool),
+                        rounds=jnp.zeros((), jnp.int32))
+        st = _converge(ct_b, pb, st0, seed=seed, fit_strategy=fit_strategy,
+                       topo_keys=topo_keys, weights=weights,
+                       enabled_filters=enabled_filters,
+                       max_rounds=max_rounds, slot_start=start,
+                       plugins=plugins)
+        epod_node = jax.lax.dynamic_update_slice(
+            epod_node, st.assignment, (start,))
+        epod_valid = jax.lax.dynamic_update_slice(
+            epod_valid, st.committed, (start,))
+        return ((st.requested, epod_node, epod_valid),
+                (st.assignment, st.rounds))
+
+    carry0 = (ct_r.requested, ct_r.epod_node, ct_r.epod_valid)
+    (requested, epod_node, epod_valid), (assignments, rounds) = jax.lax.scan(
+        batch_body, carry0, (pb_stack, jnp.arange(B)))
+
+    # ---- fold committed pods into base slots [fill, fill+n) --------------
+    flags = _flat(assignments >= 0)
+    # exclusive prefix count -> packed destinations; uncommitted rows get an
+    # out-of-bounds index and are dropped by the scatter
+    dest = jnp.where(flags, fill + jnp.cumsum(flags) - flags, e0 + BP)
+
+    def fold(arr):
+        return arr.at[dest].set(arr[e0:], mode="drop")
+
+    ct_out = ct_r.replace(
+        requested=requested,
+        epod_node=epod_node.at[dest].set(_flat(assignments), mode="drop"),
+        epod_ns=fold(ct_r.epod_ns),
+        epod_labels=fold(ct_r.epod_labels),
+        # fold then invalidate the extension region (labels/terms of dead
+        # rows are inert once the valid flags drop)
+        epod_valid=epod_valid.at[dest].set(flags, mode="drop")
+                             .at[e0:].set(False),
+        ea_sel=SelectorSet(key=fold(ct_r.ea_sel.key), op=fold(ct_r.ea_sel.op),
+                           vals=fold(ct_r.ea_sel.vals),
+                           expr_valid=fold(ct_r.ea_sel.expr_valid),
+                           valid=fold(ct_r.ea_sel.valid)),
+        ea_topo=fold(ct_r.ea_topo),
+        ea_valid=fold(ct_r.ea_valid).at[e0:].set(False),
+        ea_ns_explicit=fold(ct_r.ea_ns_explicit),
+        ea_ns_mask=fold(ct_r.ea_ns_mask),
+    )
+    new_fill = fill + jnp.sum(flags, dtype=jnp.int32)
+    return assignments, rounds, ct_out, new_fill
+
+
+def pad_batch_to(pb_stack: PodBatch, shapes: list[tuple]):
+    """Pad every leaf of a stacked PodBatch up to recorded target shapes so
+    runtime drains reuse ONE compiled program regardless of each pop's
+    bucket widths (pop composition varies; padding is inert behind validity
+    flags). Returns None when any leaf EXCEEDS its target — the caller must
+    rebuild/recompile at the wider shape."""
+    leaves = jax.tree_util.tree_leaves(pb_stack)
+    treedef = jax.tree_util.tree_structure(pb_stack)
+    out = []
+    for leaf, target in zip(leaves, shapes):
+        a = np.asarray(leaf)
+        if a.shape == tuple(target):
+            out.append(a)
+            continue
+        if any(s > t for s, t in zip(a.shape, target)):
+            return None
+        if a.dtype == bool:
+            fill = False
+        elif np.issubdtype(a.dtype, np.floating):
+            fill = 0.0
+        else:
+            fill = -1
+        out.append(_pad_to(a, tuple(target), fill))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def batch_shapes(pb_stack: PodBatch) -> list[tuple]:
+    return [tuple(np.asarray(l).shape)
+            for l in jax.tree_util.tree_leaves(pb_stack)]
+
+
+def build_drain_context(ct: ClusterTensors, pbs: list[PodBatch]):
+    """Host-side one-time prep for the device-resident drain: unify the batch
+    buckets, chain extension slots (content is placeholder — drain_step
+    refills it), stage everything into HBM. Returns
+    ``(ct_all_device, e0, fill0)`` or None when base epod slots aren't packed
+    (fold targets assume [0,fill) occupied, [fill,e0) free — true after any
+    full encode; host-side patches with deletes can leave holes)."""
+    pbs_u = unify_batches(pbs)
+    ct_all, e0 = extend_cluster_drain(ct, pbs_u)
+    valid = np.asarray(ct_all.epod_valid)[:e0]
+    fill0 = int(valid.sum())
+    if fill0 and not valid[:fill0].all():
+        return None  # holes: device fold would overwrite occupied slots
+    ct_dev = _stage(ct_all)
+    return ct_dev, e0, fill0
 
 
 def prepare_drain(ct: ClusterTensors, pbs: list[PodBatch], stage: bool = True):
@@ -480,7 +683,8 @@ def gang_drain(ct: ClusterTensors = None, pbs: list[PodBatch] = None,
                seed: int = 0,
                fit_strategy: str = "LeastAllocated",
                topo_keys: tuple[int, ...] = (), weights=None,
-               enabled_filters=None, max_rounds: int = 64, prepared=None):
+               enabled_filters=None, max_rounds: int = 64, prepared=None,
+               plugins: tuple = ()):
     """Schedule a whole queue of batches as ONE device program.
 
     ``lax.scan`` over the batch axis, each step a full gang convergence,
@@ -504,7 +708,7 @@ def gang_drain(ct: ClusterTensors = None, pbs: list[PodBatch] = None,
     out = _gang_drain_compiled(
         ct_all, pb_stack, e0=e0, seed=seed, fit_strategy=fit_strategy,
         topo_keys=topo_keys, weights=weights_t, enabled_filters=filters_t,
-        max_rounds=max_rounds)
+        max_rounds=max_rounds, plugins=plugins)
     # one batched readback (sequential np.asarray fetches pay a full
     # host<->device round trip each on remote-attached TPUs)
     return jax.device_get(out)

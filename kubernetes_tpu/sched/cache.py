@@ -23,6 +23,11 @@ from kubernetes_tpu.encode.snapshot import ClusterTensors, SnapshotEncoder, Snap
 class SchedulerCache:
     def __init__(self, assume_ttl: float = 30.0):
         self._lock = threading.Lock()
+        # Serializes ENCODER work (snapshot/encode_pods/overlay): the state
+        # lock above stays cheap for informer handlers, while concurrent
+        # snapshot() callers (scheduling loop + binder workers' volume path)
+        # must not interleave delta pops/encodes on the shared encoder.
+        self._encode_lock = threading.Lock()
         self._nodes: dict[str, Node] = {}
         self._pods: dict[str, Pod] = {}          # bound (confirmed) pods by key
         self._assumed: dict[str, tuple[Pod, float]] = {}  # key -> (pod, deadline)
@@ -183,12 +188,26 @@ class SchedulerCache:
     # ---- pod events ------------------------------------------------------
 
     def add_pod(self, pod: Pod):
-        """Bound pod observed (informer). Confirms an assume if present."""
+        """Bound pod observed (informer). Confirms an assume if present.
+
+        Confirmation of an assume on the SAME node is encoding-neutral: the
+        assume already patched this pod into the tensors, and nothing the
+        encoder reads (node, namespace, labels, requests) changes between
+        the assumed copy and the watch-confirmed object — so the cached
+        encoding stays valid and the confirm costs a dict move, not a
+        tensor patch. Under a binding storm this removes one incremental
+        patch per bound pod (the whole fleet confirms within seconds)."""
         with self._lock:
             if not pod.spec.node_name:
                 return
-            self._assumed.pop(pod.key, None)
+            prior = self._assumed.pop(pod.key, None)
             self._pods[pod.key] = pod
+            if prior is not None:
+                ap = prior[0]
+                if (ap.spec.node_name == pod.spec.node_name
+                        and ap.metadata.labels == pod.metadata.labels
+                        and pod.key not in self._delta_deletes):
+                    return  # pure confirmation: encoding unaffected
             self._generation += 1
             self._delta_upserts[pod.key] = pod
             self._delta_deletes.discard(pod.key)
@@ -213,12 +232,17 @@ class SchedulerCache:
 
     def assume(self, pod: Pod, node_name: str):
         """Optimistically treat the pod as bound NOW (AssumePod); the binding
-        confirms via add_pod or expires after assume_ttl. Stores a COPY — the
+        confirms via add_pod or expires after assume_ttl. Stores a copy — the
         caller's pod object stays unbound so a failed binding can requeue it
-        cleanly (the reference deep-copies into the cache for the same reason)."""
+        cleanly (the reference deep-copies into the cache for the same
+        reason). The copy is two-level (new Pod + new spec, shared leaves):
+        nothing mutates pod subtrees in place — informers build a fresh Pod
+        per event — so a structural deep copy (~30us/pod, the old path) only
+        burned time on the hot batch loop."""
+        import dataclasses
         with self._lock:
-            p = deep_copy(pod)
-            p.spec.node_name = node_name
+            p = dataclasses.replace(
+                pod, spec=dataclasses.replace(pod.spec, node_name=node_name))
             self._assumed[p.key] = (p, time.time() + self.assume_ttl)
             self._generation += 1
             self._delta_upserts[p.key] = p
@@ -260,45 +284,98 @@ class SchedulerCache:
 
         ``pending_pods`` widen the resource axis; passing a batch with a new
         extended resource forces the full path (rare).
+
+        Locking: state is COLLECTED under the state lock, then the encode
+        runs under the ENCODE lock only — the state lock is shared with
+        every informer handler, and holding it across a multi-hundred-ms
+        encode made each watch event (add_pod) stall behind the batch cycle
+        (lock-convoy, not useful work). Deltas that arrive mid-encode simply
+        stay queued for the next snapshot; if a structural change lands
+        mid-encode, _needs_full survives (we only clear flags captured
+        before the encode began). The encode lock serializes concurrent
+        snapshot() callers (scheduling loop + binder workers) so delta pops
+        can't interleave on the shared encoder.
         """
+        with self._encode_lock:
+            return self._snapshot_serialized(pending_pods, slot_headroom)
+
+    def _snapshot_serialized(self, pending_pods, slot_headroom):
         with self._lock:
             self._expire_assumed_locked()
             nodes = list(self._nodes.values())
             gen = self._generation
-            if self._cached is not None and not self._needs_full:
-                _, ct, meta = self._cached
-                known = set(meta.resources)
-                if not any(r not in known for p in (pending_pods or [])
-                           for r in p.resource_requests()):
+            cached = self._cached
+            needs_full = self._needs_full
+            upserts = deletes = None
+            bound = None
+            if cached is not None and not needs_full:
+                _, ct0, meta0 = cached
+                known = set(meta0.resources)
+                widen = any(r not in known for p in (pending_pods or [])
+                            for r in p.resource_requests())
+                if not widen:
                     if not self._delta_upserts and not self._delta_deletes:
-                        return nodes, ct, meta
-                    patched = self._encoder.apply_pod_deltas(
-                        ct, meta, list(self._delta_upserts.values()),
-                        list(self._delta_deletes))
-                    if patched is not None:
-                        self._delta_upserts.clear()
-                        self._delta_deletes.clear()
-                        self._cached = (gen, patched, meta)
-                        return nodes, patched, meta
-            bound = list(self._pods.values()) + [p for p, _ in self._assumed.values()]
-            ct, meta = self._encoder.encode_cluster(nodes, bound,
-                                                    pending_pods=pending_pods,
-                                                    slot_headroom=slot_headroom)
-            self._delta_upserts.clear()
-            self._delta_deletes.clear()
-            self._needs_full = False
-            self._cached = (gen, ct, meta)
-            return nodes, ct, meta
+                        return nodes, ct0, meta0
+                    upserts = list(self._delta_upserts.values())
+                    deletes = list(self._delta_deletes)
+                    self._delta_upserts.clear()
+                    self._delta_deletes.clear()
+            if upserts is None:
+                bound = (list(self._pods.values())
+                         + [p for p, _ in self._assumed.values()])
+                self._delta_upserts.clear()
+                self._delta_deletes.clear()
 
-    def encode_pods(self, pods: list[Pod], meta: SnapshotMeta):
+        # ---- encode outside the lock (scheduler thread only) -------------
+        if upserts is not None:
+            _, ct0, meta0 = cached
+            patched = self._encoder.apply_pod_deltas(ct0, meta0, upserts,
+                                                     deletes)
+            if patched is not None:
+                with self._lock:
+                    self._cached = (gen, patched, meta0)
+                return nodes, patched, meta0
+            # patch didn't fit the buckets: fall through to a full encode,
+            # folding the popped deltas back into the bound view
+            with self._lock:
+                bound = (list(self._pods.values())
+                         + [p for p, _ in self._assumed.values()])
+                self._delta_upserts.clear()
+                self._delta_deletes.clear()
+        ct, meta = self._encoder.encode_cluster(nodes, bound,
+                                                pending_pods=pending_pods,
+                                                slot_headroom=slot_headroom)
         with self._lock:
-            return self._encoder.encode_pods(pods, meta)
+            self._cached = (gen, ct, meta)
+            if self._generation == gen:
+                self._needs_full = False
+        return nodes, ct, meta
+
+    def encode_pods(self, pods: list[Pod], meta: SnapshotMeta,
+                    min_p: int = 1):
+        with self._encode_lock:
+            return self._encoder.encode_pods(pods, meta, min_p=min_p)
 
     def overlay_nominated(self, ct, meta, entries):
         """ct with nominated-pod reservations applied (encoder.with_nominated);
         entries: [(node_name, priority, Pod)]."""
-        with self._lock:
+        with self._encode_lock:
             return self._encoder.with_nominated(ct, meta, entries)
+
+    def get_node(self, name: str) -> Optional[Node]:
+        """Cheap single-node lookup (binder-side volume labels); avoids a
+        full snapshot from non-scheduling threads."""
+        with self._lock:
+            return self._nodes.get(name)
+
+    def delta_info(self) -> tuple[int, set, bool, bool]:
+        """-> (generation, pending upsert keys, any deletes pending,
+        needs_full). The device-resident drain uses this to prove its HBM
+        replica of the encoding is still exactly one fold behind the cache
+        (every pending delta is an assume it already folded device-side)."""
+        with self._lock:
+            return (self._generation, set(self._delta_upserts),
+                    bool(self._delta_deletes), self._needs_full)
 
     def bound_pods(self, include_assumed: bool = True) -> list[Pod]:
         with self._lock:

@@ -49,6 +49,12 @@ class SchedulingQueue:
         self._backoff: list[tuple[float, _QueuedPod]] = []  # heap: (expiry, item)
         self._unschedulable: dict[str, _QueuedPod] = {}
         self._keys_queued: set[str] = set()
+        # key -> CURRENT queued item. Deletion is lazy: delete() drops the
+        # entry and consumers skip heap items that are no longer current —
+        # eager deletion rebuilt the whole activeQ heap per call, which is
+        # O(queue) work per binding-confirmation event (10k bound pods while
+        # 10k more sit queued = O(n^2) on the watch thread).
+        self._entries: dict[str, _QueuedPod] = {}
         self._seq = itertools.count()
         self.backoff_initial = backoff_initial
         self.backoff_max = backoff_max
@@ -70,15 +76,14 @@ class SchedulingQueue:
             k = self._key(pod)
             if k in self._keys_queued:
                 return
+            item = _QueuedPod(self._sort_key(pod), pod, timestamp=time.time())
+            self._entries[k] = item
+            self._keys_queued.add(k)
             if pod.spec.scheduling_gates:
                 # SchedulingGates PreEnqueue: hold until gates cleared.
-                self._unschedulable[k] = _QueuedPod(self._sort_key(pod), pod,
-                                                    timestamp=time.time())
-                self._keys_queued.add(k)
+                self._unschedulable[k] = item
                 return
-            heapq.heappush(self._active, _QueuedPod(self._sort_key(pod), pod,
-                                                    timestamp=time.time()))
-            self._keys_queued.add(k)
+            heapq.heappush(self._active, item)
             self._lock.notify_all()
 
     def add_unschedulable(self, pod: Pod, attempts: int):
@@ -92,6 +97,8 @@ class SchedulingQueue:
                               timestamp=time.time())
             delay = min(self.backoff_initial * (2 ** max(attempts - 1, 0)),
                         self.backoff_max)
+            self._entries[k] = item
+            self._unschedulable.pop(k, None)
             heapq.heappush(self._backoff, (time.time() + delay, item))
             self._keys_queued.add(k)
             self._lock.notify_all()
@@ -100,20 +107,23 @@ class SchedulingQueue:
         """No event expected to help soon: unschedulable map (event-driven requeue)."""
         with self._lock:
             k = self._key(pod)
-            self._unschedulable[k] = _QueuedPod(self._sort_key(pod), pod,
-                                                attempts=attempts,
-                                                timestamp=time.time())
+            item = _QueuedPod(self._sort_key(pod), pod, attempts=attempts,
+                              timestamp=time.time())
+            self._entries[k] = item
+            self._unschedulable[k] = item
             self._keys_queued.add(k)
 
     def delete(self, pod: Pod):
+        # Lazy: drop the membership records; stale heap entries are skipped
+        # by consumers when they surface (O(1) here instead of O(queue)).
         with self._lock:
             k = self._key(pod)
             self._keys_queued.discard(k)
             self._unschedulable.pop(k, None)
-            self._active = [q for q in self._active if q.pod.key != k]
-            heapq.heapify(self._active)
-            self._backoff = [(e, q) for e, q in self._backoff if q.pod.key != k]
-            heapq.heapify(self._backoff)
+            self._entries.pop(k, None)
+
+    def _current_locked(self, item: _QueuedPod) -> bool:
+        return self._entries.get(item.pod.key) is item
 
     def move_all_to_active_or_backoff(self, event: str):
         """Cluster event: unschedulable pods get another chance
@@ -123,7 +133,8 @@ class SchedulingQueue:
                 if item.pod.spec.scheduling_gates:
                     continue  # still gated; activate_gated handles gate removal
                 del self._unschedulable[k]
-                heapq.heappush(self._active, item)
+                if self._current_locked(item):
+                    heapq.heappush(self._active, item)
             self._lock.notify_all()
 
     def activate_gated(self, pod: Pod):
@@ -131,7 +142,8 @@ class SchedulingQueue:
         with self._lock:
             k = self._key(pod)
             item = self._unschedulable.pop(k, None)
-            if item is not None and not pod.spec.scheduling_gates:
+            if (item is not None and not pod.spec.scheduling_gates
+                    and self._current_locked(item)):
                 item.pod = pod
                 heapq.heappush(self._active, item)
                 self._lock.notify_all()
@@ -143,16 +155,24 @@ class SchedulingQueue:
         moved = False
         while self._backoff and self._backoff[0][0] <= now:
             _, item = heapq.heappop(self._backoff)
-            heapq.heappush(self._active, item)
-            moved = True
+            if self._current_locked(item):
+                heapq.heappush(self._active, item)
+                moved = True
         # unschedulable timeout sweep
         for k, item in list(self._unschedulable.items()):
             if (not item.pod.spec.scheduling_gates
                     and now - item.timestamp > self.unschedulable_timeout):
                 del self._unschedulable[k]
-                heapq.heappush(self._active, item)
-                moved = True
+                if self._current_locked(item):
+                    heapq.heappush(self._active, item)
+                    moved = True
         return moved
+
+    def _active_has_current_locked(self) -> bool:
+        # drop stale heap heads so waiters don't wake for deleted pods
+        while self._active and not self._current_locked(self._active[0]):
+            heapq.heappop(self._active)
+        return bool(self._active)
 
     def pop_batch(self, max_batch: int = 256, wait: float = 0.5
                   ) -> list[tuple[Pod, int]]:
@@ -162,16 +182,19 @@ class SchedulingQueue:
         with self._lock:
             while not self.closed:
                 self._flush_backoff_locked()
-                if self._active:
+                if self._active_has_current_locked():
                     break
                 timeout = min(0.05, max(deadline - time.time(), 0.01))
                 self._lock.wait(timeout)
-                if time.time() > deadline and not self._active:
+                if time.time() > deadline and not self._active_has_current_locked():
                     return []
             out = []
             while self._active and len(out) < max_batch:
                 item = heapq.heappop(self._active)
+                if not self._current_locked(item):
+                    continue  # lazily-deleted or superseded entry
                 self._keys_queued.discard(item.pod.key)
+                self._entries.pop(item.pod.key, None)
                 out.append((item.pod, item.attempts))
             return out
 
@@ -182,5 +205,7 @@ class SchedulingQueue:
 
     def stats(self) -> dict[str, int]:
         with self._lock:
-            return {"active": len(self._active), "backoff": len(self._backoff),
-                    "unschedulable": len(self._unschedulable)}
+            nb = sum(1 for _, it in self._backoff if self._current_locked(it))
+            nu = len(self._unschedulable)
+            na = max(len(self._keys_queued) - nb - nu, 0)
+            return {"active": na, "backoff": nb, "unschedulable": nu}

@@ -42,7 +42,8 @@ class SchedulerRunner:
         self.queue = SchedulingQueue(backoff_initial=self.cfg.backoff_initial_s,
                                      backoff_max=self.cfg.backoff_max_s)
         self.scheduler = Scheduler(self.cfg, self.cache, self.queue, self._bind,
-                                   registry=registry)
+                                   registry=registry,
+                                   bulk_binder=self._bind_many)
         from kubernetes_tpu.utils.events import EventRecorder
         self.scheduler.recorder = EventRecorder(client, "default-scheduler")
         self.scheduler._evict = self._evict  # preemption deletes via API
@@ -149,8 +150,7 @@ class SchedulerRunner:
         catalog = self.cache.volume_catalog
         if catalog is not None and pod.pvc_names():
             from kubernetes_tpu.sched.volumebinding import VolumeBinder
-            node = next((n for n in self.cache.snapshot()[0]
-                         if n.metadata.name == node_name), None)
+            node = self.cache.get_node(node_name)
             labels = node.metadata.labels if node is not None else {}
             if not VolumeBinder(self.client).bind_pod_volumes(
                     pod, node, catalog, labels, node_name):
@@ -173,6 +173,35 @@ class SchedulerRunner:
             BIND_RESULTS.inc({"result": "connection"})
             _LOG.warning("bind %s -> %s: API unreachable: %s", pod.key, node_name, e)
             return False
+
+    def _bind_many(self, pairs) -> list[bool]:
+        """Bulk DefaultBinder: one POST pods/-/binding for a whole gang
+        batch. Only plain pods reach this (the scheduler routes DRA/volume/
+        lifecycle pods through _bind); per-item 409s are expected races."""
+        try:
+            errs = self.client.pods("default").bind_many(
+                [(p.metadata.namespace, p.metadata.name, node)
+                 for p, node in pairs])
+        except ApiError as e:
+            BIND_RESULTS.inc({"result": "error"}, by=len(pairs))
+            _LOG.warning("bulk bind of %d pods failed: %s", len(pairs), e)
+            return [False] * len(pairs)
+        except Exception as e:
+            BIND_RESULTS.inc({"result": "connection"}, by=len(pairs))
+            _LOG.warning("bulk bind: API unreachable: %s", e)
+            return [False] * len(pairs)
+        out = []
+        for (pod, node), err in zip(pairs, errs):
+            if err is None:
+                out.append(True)
+            else:
+                label = "conflict" if "bound" in err else "error"
+                BIND_RESULTS.inc({"result": label})
+                if label != "conflict":
+                    _LOG.warning("bind %s -> %s failed: %s",
+                                 pod.key, node, err)
+                out.append(False)
+        return out
 
     def _unreserve(self, allocated: list[dict]) -> None:
         """Roll back claim allocations written by a failed bind attempt."""
@@ -204,7 +233,20 @@ class SchedulerRunner:
 
     # ---- lifecycle -------------------------------------------------------
 
-    def start(self, wait_sync: float = 10.0):
+    def start(self, wait_sync: float = 10.0, start_loop: bool = True):
+        """Start informers (+ scheduling loop). ``start_loop=False`` starts
+        only the informer layer — callers that need to warm caches/JIT
+        against synced state first (benchmarks, tests) call ``start_loop()``
+        afterwards."""
+        return self._start(wait_sync, start_loop)
+
+    def start_loop(self):
+        """Start the scheduling loop (after a start(start_loop=False))."""
+        if self.cfg.leader_elect:
+            raise RuntimeError("leader election owns the loop lifecycle")
+        self._start_loop()
+
+    def _start(self, wait_sync: float, start_loop: bool):
         pods = self.factory.informer("pods", None)
         pods.add_event_handler(self._on_pod)
         nodes = self.factory.informer("nodes", None)
@@ -238,7 +280,7 @@ class SchedulerRunner:
             t = threading.Thread(target=elector.run, args=(self._stop,), daemon=True)
             t.start()
             self._threads.append(t)
-        else:
+        elif start_loop:
             self._start_loop()
         return self
 
@@ -272,4 +314,5 @@ class SchedulerRunner:
         self._stop.set()
         self._stop_loop()
         self.queue.close()
+        self.scheduler.close()
         self.factory.stop_all()

@@ -31,7 +31,10 @@ from typing import Any, Callable, Optional
 
 ADDED, MODIFIED, DELETED, ERROR = "ADDED", "MODIFIED", "DELETED", "ERROR"
 
-REPLAY_WINDOW = 1024  # events kept for watch replay before "too old"
+# Events kept per kind for watch replay before "too old" (etcd compaction
+# analog). Sized so a reconnecting watcher survives a full binding storm
+# (create+bind = 2 events/pod) at the 10k-pod benchmark scale.
+REPLAY_WINDOW = 32768
 
 
 class Conflict(Exception):
@@ -50,11 +53,36 @@ class TooOld(Exception):
     """Requested watch resourceVersion compacted away; caller must relist."""
 
 
+def fastcopy(o):
+    """Structural copy of an already wire-shaped object (dict/list/scalars).
+    ~2x faster than a json round-trip; used for copies of objects the store
+    has already normalized (create/update inputs still json-round-trip so
+    tuples/np scalars are coerced to the wire shape exactly once)."""
+    if isinstance(o, dict):
+        return {k: fastcopy(v) for k, v in o.items()}
+    if isinstance(o, list):
+        return [fastcopy(v) for v in o]
+    return o
+
+
 @dataclass
 class Event:
     type: str
     object: dict
     resource_version: int
+    _wire: Optional[bytes] = None  # cached watch-stream line (lazy, shared)
+
+    def wire(self) -> bytes:
+        """Serialized ``{"type":...,"object":...}\\n`` watch line. Computed
+        once and shared by every HTTP watch stream fanning this event out —
+        per-watcher re-serialization was the apiserver's top cost under
+        binding storms. Benign race: two threads may both compute it."""
+        w = self._wire
+        if w is None:
+            w = json.dumps({"type": self.type, "object": self.object}
+                           ).encode() + b"\n"
+            self._wire = w
+        return w
 
 
 def obj_key(obj: dict) -> tuple[str, str]:
@@ -141,11 +169,14 @@ class ObjectStore:
         return self._rv
 
     def _emit_locked(self, kind: str, ev: Event):
-        # Detach the event payload from the authoritative dict: watchers (and
-        # informer caches) must never alias store internals. Event objects are
-        # shared among watchers and treated as immutable, like the reference's
-        # informer-cache convention.
-        ev = Event(ev.type, json.loads(json.dumps(ev.object)), ev.resource_version)
+        # Event payloads SHARE the authoritative object: the store never
+        # mutates a stored dict in place (every write REPLACES space[k] with
+        # a fresh object), so sharing is safe as long as consumers treat
+        # event objects as read-only — the reference's informer-cache
+        # convention ("you must deep-copy before mutating"), which get()/
+        # list() honor by returning copies. A binding storm emits tens of
+        # thousands of events; the per-event detach copy was measurable
+        # against the whole connected path.
         hist = self._history.setdefault(kind, [])
         hist.append(ev)
         if len(hist) > REPLAY_WINDOW:
@@ -231,7 +262,25 @@ class ObjectStore:
 
     # ---- CRUD ------------------------------------------------------------
 
-    def create(self, kind: str, obj: dict) -> dict:
+    def _prepare_create_locked(self, kind: str, obj: dict) -> dict:
+        """Registry PrepareForCreate hooks shared by create/create_many:
+        Service ClusterIP allocation (pkg/registry/core/service/ipallocator)
+        from 10.96.0.0/12."""
+        if kind == "Service":
+            spec = obj.get("spec") or {}
+            if not spec.get("clusterIP") and spec.get("type") != "ExternalName":
+                self._svc_ip_seq = getattr(self, "_svc_ip_seq", 0) + 1
+                n = self._svc_ip_seq
+                obj = dict(obj)
+                obj["spec"] = {**spec,
+                               "clusterIP": f"10.96.{n // 250}.{n % 250 + 1}"}
+        return obj
+
+    def create(self, kind: str, obj: dict, owned: bool = False) -> dict:
+        """``owned=True``: the caller transfers ownership of ``obj`` (it is a
+        freshly-parsed, wire-shaped dict nothing else aliases — e.g. an HTTP
+        request body) so the defensive copy/normalization round-trip is
+        skipped."""
         with self._lock:
             md = obj.get("metadata") or {}
             if not md.get("name") and md.get("generateName"):
@@ -245,18 +294,10 @@ class ObjectStore:
             space = self._data.setdefault(kind, {})
             if k in space:
                 raise AlreadyExists(f"{kind} {k}")
-            if kind == "Service":
-                # service registry PrepareForCreate: ClusterIP allocation
-                # (pkg/registry/core/service/ipallocator) from 10.96.0.0/12
-                spec = obj.get("spec") or {}
-                if not spec.get("clusterIP") and spec.get("type") != "ExternalName":
-                    self._svc_ip_seq = getattr(self, "_svc_ip_seq", 0) + 1
-                    n = self._svc_ip_seq
-                    obj = dict(obj)
-                    obj["spec"] = {**spec,
-                                   "clusterIP": f"10.96.{n // 250}.{n % 250 + 1}"}
+            obj = self._prepare_create_locked(kind, obj)
             rv = self._bump_locked()
-            obj = json.loads(json.dumps(obj))  # defensive copy, wire-shaped
+            if not owned:
+                obj = json.loads(json.dumps(obj))  # defensive copy, wire-shaped
             md = obj.setdefault("metadata", {})
             md["resourceVersion"] = str(rv)
             # registry.Store.Create stamps identity server-side
@@ -268,12 +309,50 @@ class ObjectStore:
             self._journal_locked({"op": "set", "kind": kind, "ns": k[0],
                                   "name": k[1], "rv": rv, "obj": obj})
             self._emit_locked(kind, Event(ADDED, obj, rv))
-            return json.loads(json.dumps(obj))
+            return fastcopy(obj)
+
+    def create_many(self, kind: str, objs: list[dict]) -> list[dict]:
+        """Create a batch of objects in one lock pass (seeding / apply of a
+        manifest List). Per-item AlreadyExists surfaces as an exception AFTER
+        the siblings commit — callers wanting all-or-nothing pre-check names.
+        Semantically identical to N create() calls, minus N-1 lock
+        round-trips and defensive-copy passes."""
+        out = []
+        errors = []
+        with self._lock:
+            space = self._data.setdefault(kind, {})
+            for obj in objs:
+                md = obj.get("metadata") or {}
+                if not md.get("name") and md.get("generateName"):
+                    obj = dict(obj)
+                    obj["metadata"] = {**md,
+                                       "name": f"{md['generateName']}{self._rv + 1:05x}"}
+                k = obj_key(obj)
+                if k in space:
+                    errors.append(f"{kind} {k}")
+                    continue
+                obj = self._prepare_create_locked(kind, obj)
+                rv = self._bump_locked()
+                obj = json.loads(json.dumps(obj))
+                md = obj.setdefault("metadata", {})
+                md["resourceVersion"] = str(rv)
+                md.setdefault("uid", f"uid-s{rv}")
+                if "creationTimestamp" not in md:
+                    import time as _time
+                    md["creationTimestamp"] = _time.time()
+                space[k] = obj
+                self._journal_locked({"op": "set", "kind": kind, "ns": k[0],
+                                      "name": k[1], "rv": rv, "obj": obj})
+                self._emit_locked(kind, Event(ADDED, obj, rv))
+                out.append(fastcopy(obj))
+        if errors:
+            raise AlreadyExists("; ".join(errors))
+        return out
 
     def get(self, kind: str, namespace: str, name: str) -> dict:
         with self._lock:
             try:
-                return json.loads(json.dumps(self._data[kind][(namespace or "", name)]))
+                return fastcopy(self._data[kind][(namespace or "", name)])
             except KeyError:
                 raise NotFound(f"{kind} {namespace}/{name}") from None
 
@@ -288,10 +367,11 @@ class ObjectStore:
                     continue
                 if selector is not None and not selector(obj):
                     continue
-                items.append(json.loads(json.dumps(obj)))
+                items.append(fastcopy(obj))
             return items, self._rv
 
-    def update(self, kind: str, obj: dict, expect_rv: Optional[str] = None) -> dict:
+    def update(self, kind: str, obj: dict, expect_rv: Optional[str] = None,
+               owned: bool = False) -> dict:
         with self._lock:
             k = obj_key(obj)
             space = self._data.setdefault(kind, {})
@@ -302,13 +382,53 @@ class ObjectStore:
                 raise Conflict(f"{kind} {k}: rv {expect_rv} != "
                                f"{current['metadata']['resourceVersion']}")
             rv = self._bump_locked()
-            obj = json.loads(json.dumps(obj))
+            if not owned:
+                obj = json.loads(json.dumps(obj))
             obj.setdefault("metadata", {})["resourceVersion"] = str(rv)
             space[k] = obj
             self._journal_locked({"op": "set", "kind": kind, "ns": k[0],
                                   "name": k[1], "rv": rv, "obj": obj})
             self._emit_locked(kind, Event(MODIFIED, obj, rv))
-            return json.loads(json.dumps(obj))
+            return fastcopy(obj)
+
+    def bind_many(self, bindings: list[tuple[str, str, str]]
+                  ) -> list[Optional[str]]:
+        """Apply many pod bindings in ONE lock pass: for each
+        ``(namespace, name, node_name)`` set spec.nodeName if unset.
+        Returns a per-item error string (or None on success) — successes
+        commit even when siblings fail, exactly like N independent binding
+        POSTs, minus N-1 round trips and lock acquisitions.
+
+        This is the storage half of the bulk-binding fast path (reference:
+        ``pkg/registry/core/pod/storage/storage.go`` BindingREST.Create,
+        generalized to a batch — the reference has no bulk variant; its
+        scheduler binds one pod per POST, which is exactly the per-pod
+        round-trip cost this path removes)."""
+        out: list[Optional[str]] = []
+        with self._lock:
+            space = self._data.setdefault("Pod", {})
+            for ns, name, node_name in bindings:
+                k = (ns or "", name)
+                pod = space.get(k)
+                if pod is None:
+                    out.append(f"Pod {ns}/{name} not found")
+                    continue
+                if (pod.get("spec") or {}).get("nodeName"):
+                    out.append("pod already bound")
+                    continue
+                # no expect_rv needed: the whole check-then-set runs under
+                # the store lock, so no other writer can interleave
+                rv = self._bump_locked()
+                pod = fastcopy(pod)
+                pod.setdefault("spec", {})["nodeName"] = node_name
+                pod.setdefault("status", {}).setdefault("phase", "Pending")
+                pod["metadata"]["resourceVersion"] = str(rv)
+                space[k] = pod
+                self._journal_locked({"op": "set", "kind": "Pod", "ns": k[0],
+                                      "name": k[1], "rv": rv, "obj": pod})
+                self._emit_locked("Pod", Event(MODIFIED, pod, rv))
+                out.append(None)
+        return out
 
     def delete(self, kind: str, namespace: str, name: str) -> dict:
         with self._lock:
@@ -316,7 +436,7 @@ class ObjectStore:
             space = self._data.setdefault(kind, {})
             if k not in space:
                 raise NotFound(f"{kind} {namespace}/{name}")
-            obj = json.loads(json.dumps(space.pop(k)))
+            obj = fastcopy(space.pop(k))
             rv = self._bump_locked()
             obj["metadata"]["resourceVersion"] = str(rv)
             self._journal_locked({"op": "del", "kind": kind, "ns": k[0],

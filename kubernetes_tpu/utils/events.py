@@ -90,33 +90,62 @@ class EventRecorder:
     def _drain(self) -> None:
         while True:
             item = self._q.get()
+            # Batch: collect everything already queued behind this item and
+            # flush creates in ONE bulk API call per namespace. Under a
+            # binding storm ("Scheduled" per pod) the per-event POST chain
+            # was ~25% of the whole connected path's host time.
+            batch = [item]
             try:
-                if item is not None:
-                    self._write(*item)
+                while len(batch) < 512:
+                    batch.append(self._q.get_nowait())
+            except queue.Empty:
+                pass
+            creates: dict[str, list] = {}
+            pending: dict[tuple, dict] = {}  # (ns, ev_name) -> queued create
+            try:
+                for it in batch:
+                    if it is None:
+                        continue
+                    (ns, name, kind, uid, ev_name, aggregate,
+                     type_, reason, message, now) = it
+                    if aggregate:
+                        prior = pending.get((ns, ev_name))
+                        if prior is not None:
+                            # original create is in THIS batch: fold in place
+                            prior["count"] += 1
+                            prior["lastTimestamp"] = now
+                            continue
+                        try:
+                            self._write_aggregate(ns, ev_name, now)
+                            continue
+                        except Exception:
+                            pass  # fall through: write a fresh event
+                    pending[(ns, ev_name)] = obj = {
+                        "apiVersion": "v1", "kind": "Event",
+                        "metadata": {"name": ev_name, "namespace": ns},
+                        "involvedObject": {"kind": kind, "name": name,
+                                           "namespace": ns, "uid": uid},
+                        "type": type_, "reason": reason, "message": message,
+                        "source": {"component": self.component},
+                        "count": 1, "firstTimestamp": now,
+                        "lastTimestamp": now}
+                    creates.setdefault(ns, []).append(obj)
+                for ns, objs in creates.items():
+                    try:
+                        self.client.resource("events", ns).create_many(objs)
+                    except Exception:
+                        pass  # events are best-effort
             except Exception:
-                pass  # events are best-effort, never break the control loop
+                pass  # never break the control loop
             finally:
-                self._q.task_done()
+                for _ in batch:
+                    self._q.task_done()
 
-    def _write(self, ns, name, kind, uid, ev_name, aggregate,
-               type_, reason, message, now) -> None:
-        if aggregate:
-            try:
-                ev = self.client.resource("events", ns).get(ev_name)
-                ev["count"] = ev.get("count", 1) + 1
-                ev["lastTimestamp"] = now
-                self.client.resource("events", ns).update(ev)
-                return
-            except Exception:
-                pass  # fall through: write a fresh event
-        self.client.resource("events", ns).create({
-            "apiVersion": "v1", "kind": "Event",
-            "metadata": {"name": ev_name, "namespace": ns},
-            "involvedObject": {"kind": kind, "name": name,
-                               "namespace": ns, "uid": uid},
-            "type": type_, "reason": reason, "message": message,
-            "source": {"component": self.component},
-            "count": 1, "firstTimestamp": now, "lastTimestamp": now})
+    def _write_aggregate(self, ns, ev_name, now) -> None:
+        ev = self.client.resource("events", ns).get(ev_name)
+        ev["count"] = ev.get("count", 1) + 1
+        ev["lastTimestamp"] = now
+        self.client.resource("events", ns).update(ev)
 
     def flush(self, timeout: float = 5.0) -> None:
         """Wait until every event recorded so far has been written."""

@@ -248,13 +248,16 @@ def test_leader_election_failover():
     stop_b.set()
 
 
-def test_store_tooold_is_per_kind():
+def test_store_tooold_is_per_kind(monkeypatch):
     """Global rv churn on one kind must not compact another kind's replay
-    window (regression: TooOld used the global rv, so any watcher >1024 total
-    writes behind got a spurious 410 even with its kind's history intact)."""
+    window (regression: TooOld used the global rv, so any watcher more than
+    a full replay window of total writes behind got a spurious 410 even with
+    its kind's history intact)."""
+    import kubernetes_tpu.store.store as store_mod
+    monkeypatch.setattr(store_mod, "REPLAY_WINDOW", 64)
     s = ObjectStore()
     s.create("Pod", make_pod("p").obj().to_dict())
-    for i in range(1200):  # > REPLAY_WINDOW writes on an unrelated kind
+    for i in range(100):  # > REPLAY_WINDOW writes on an unrelated kind
         s.create("Lease", {"metadata": {"name": f"l{i}", "namespace": "ns"}})
     w = s.watch("Pod", since_rv=0)
     ev = w.get(timeout=1.0)
