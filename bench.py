@@ -60,10 +60,20 @@ def main():
     if os.environ.get("BENCH_CONNECTED", "1") != "0" and not only_case:
         log("[bench] connected-path run ...")
         connected = run_connected(
-            n_pods=int(os.environ.get("BENCH_CONNECTED_PODS", "2000")),
-            n_nodes=int(os.environ.get("BENCH_CONNECTED_NODES", "1000")),
+            n_pods=int(os.environ.get("BENCH_CONNECTED_PODS", "10000")),
+            n_nodes=int(os.environ.get("BENCH_CONNECTED_NODES", "5000")),
             log=log)
         log("[bench] " + json.dumps(connected))
+
+    preemption = None
+    if os.environ.get("BENCH_PREEMPTION", "1") != "0" and not only_case:
+        from benchmarks.preemption_bench import run_preemption
+        log("[bench] preemption run ...")
+        preemption = run_preemption(
+            n_nodes=int(os.environ.get("BENCH_PREEMPT_NODES", "5000")),
+            n_preemptors=int(os.environ.get("BENCH_PREEMPT_PODS", "128")),
+            log=log)
+        log("[bench] " + json.dumps(preemption))
 
     head = next((r for r in results
                  if (r["case"], r["workload"]) == HEADLINE), None)
@@ -90,6 +100,7 @@ def main():
              "p99_s": r.get("p99_schedule_latency_s"),
              "passed": r["passed"]} for r in results],
         "connected": connected,
+        "preemption": preemption,
     }
     print(json.dumps(out))
 

@@ -51,8 +51,41 @@ def _watch_bound(url: str, ns: str, rv0: int, n_pods: int,
     dead.set()
 
 
+def _churn_loop(client, stop, period_s: float = 0.1, counter=None) -> None:
+    """scheduler_perf's ``churn`` op analog: recycle nodes and short-lived
+    pods (namespace ``churn``, excluded from the measured set) during the
+    measured window. Exercises event-driven requeue
+    (MoveAllToActiveOrBackoffQueue on node events), cache delta deletes,
+    and the drain context's invalidate-and-rebuild path under load."""
+    import itertools
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+    seq = itertools.count()
+    live_nodes: list = []
+    live_pods: list = []
+    while not stop.is_set():
+        i = next(seq)
+        try:
+            node = make_node(f"churn-n{i}").capacity(
+                {"cpu": "2", "memory": "4Gi", "pods": "8"}).obj()
+            client.nodes().create(node.to_dict())
+            live_nodes.append(node.metadata.name)
+            pod = make_pod(f"churn-p{i}", "churn").req({"cpu": "100m"}).obj()
+            client.pods("churn").create(pod.to_dict())
+            live_pods.append(pod.metadata.name)
+            if len(live_nodes) > 3:
+                client.nodes().delete(live_nodes.pop(0))
+            if len(live_pods) > 3:
+                client.pods("churn").delete(live_pods.pop(0))
+            if counter is not None:
+                counter["ops"] = counter.get("ops", 0) + 4
+        except Exception:
+            pass  # churn is background noise; the bench owns correctness
+        stop.wait(period_s)
+
+
 def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
-                  batch_size: int = 512, timeout: float = 300.0,
+                  batch_size: int = 512, drain_batches: int = 8,
+                  timeout: float = 300.0, churn: bool = False,
                   log=lambda *a: None) -> dict:
     from kubernetes_tpu.client.clientset import HTTPClient
     from kubernetes_tpu.config.types import SchedulerConfiguration
@@ -75,7 +108,8 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
 
         runner = SchedulerRunner(
             HTTPClient(url),
-            SchedulerConfiguration(batch_size=batch_size))
+            SchedulerConfiguration(batch_size=batch_size,
+                                   max_drain_batches=drain_batches))
         # informers first (nodes sync into the scheduler cache); the loop
         # starts after pod creation so the first pop drains a deep backlog
         runner.start(start_loop=False)
@@ -90,6 +124,16 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
                               daemon=True)
         watcher.start()
         ready.wait(30.0)  # spawn + import + stream setup is seconds
+
+        churn_stop = None
+        churn_stats: dict = {}
+        if churn:
+            import threading
+            churn_stop = threading.Event()
+            threading.Thread(target=_churn_loop,
+                             args=(HTTPClient(url), churn_stop),
+                             kwargs={"counter": churn_stats},
+                             daemon=True).start()
 
         t_start = time.time()
         by_ns: dict = {}
@@ -122,18 +166,24 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
                         if p["spec"].get("nodeName"))
         log(f"  created {n_pods} pods in {t_created-t_start:.1f}s; "
             f"all bound at +{dt:.1f}s")
+        if churn_stop is not None:
+            churn_stop.set()
         runner.stop()
         # p99 attempt latency (scheduled results) from the live histogram —
         # bucket upper bound, like Prometheus histogram_quantile
         p99 = ATTEMPT_DURATION.percentile(0.99, {"result": "scheduled"})
-        return {
-            "case": "ConnectedScheduler", "workload": f"{n_pods}x{n_nodes}",
+        out = {
+            "case": "ConnectedChurn" if churn else "ConnectedScheduler",
+            "workload": f"{n_pods}x{n_nodes}",
             "SchedulingThroughput": round(bound / dt, 1) if dt > 0 else 0.0,
             "bound": bound, "pods": n_pods, "nodes": n_nodes,
             "measure_s": round(dt, 2),
             "watch_degraded": watch_dead.is_set(),
             "p99_attempt_latency_s": p99,
         }
+        if churn:
+            out["churn_api_ops"] = churn_stats.get("ops", 0)
+        return out
     finally:
         try:
             parent.send("stop")
@@ -164,5 +214,7 @@ if __name__ == "__main__":
     res = run_connected(
         n_pods=int(os.environ.get("BENCH_CONNECTED_PODS", "2000")),
         n_nodes=int(os.environ.get("BENCH_CONNECTED_NODES", "1000")),
+        batch_size=int(os.environ.get("BENCH_CONNECTED_BATCH", "512")),
+        drain_batches=int(os.environ.get("BENCH_CONNECTED_DRAIN", "8")),
         log=lambda *a: print(*a, file=sys.stderr))
     print(json.dumps(res))

@@ -113,10 +113,40 @@ def mixed_heterogeneous(pods: int = 10000, nodes: int = 5000, seed: int = 0):
     return ns, ps
 
 
+def huge_cluster(pods: int = 4096, nodes: int = 16384, seed: int = 0):
+    """Beyond-threshold scale: crosses ops/topology.py's
+    ``_FACTORED_THRESHOLD`` (8192 nodes) so domain counting runs the
+    factored O(N+V) formulation instead of one-hot matmuls — the 50k-node
+    scaling design point. Hard AND soft spread constraints so both the
+    filter and scoring factored paths execute."""
+    import random
+    rng = random.Random(seed)
+    ns = []
+    for i in range(nodes):
+        ns.append(
+            make_node(f"hn{i}")
+            .capacity({"cpu": "16", "memory": "64Gi", "pods": "110"})
+            .label("topology.kubernetes.io/zone", f"zone-{i % 64}")
+            .obj())
+    ps = []
+    for i in range(pods):
+        w = (make_pod(f"hp{i}").req({"cpu": "500m", "memory": "1Gi"})
+             .label("app", f"s{i % 32}"))
+        if rng.random() < 0.5:
+            w.spread(1, "topology.kubernetes.io/zone", "DoNotSchedule",
+                     {"app": f"s{i % 32}"})
+        else:
+            w.spread(2, "topology.kubernetes.io/zone", "ScheduleAnyway",
+                     {"app": f"s{i % 32}"})
+        ps.append(w.obj())
+    return ns, ps
+
+
 WORKLOADS = {
     "SchedulingBasic": scheduling_basic,
     "NodeResourcesFit": noderesources_fit,
     "SchedulingPodAntiAffinity": pod_anti_affinity,
     "PreferredTopologySpreading": preferred_topology_spreading,
     "MixedHeterogeneous": mixed_heterogeneous,
+    "HugeCluster": huge_cluster,
 }

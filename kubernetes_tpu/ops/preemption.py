@@ -1,0 +1,186 @@
+"""Tensorized preemption dry-run — DryRunPreemption as one device program.
+
+Reference: ``pkg/scheduler/framework/preemption/preemption.go``
+(``DryRunPreemption`` fans the per-node victim simulation across 16
+goroutines; ``SelectVictimsOnNode`` removes lower-priority pods until the
+preemptor fits, non-PDB-violating victims first) and
+``default_preemption.go`` (``pickOneNodeForPreemption``: fewest PDB
+violations, then lowest max victim priority, then fewest victims, then node
+order).
+
+TPU inversion: the victim search is a masked ``[N, V+1]`` program — victims
+sorted per node in eviction order, capacity release as an exclusive prefix
+sum over the victim axis, so "does the preemptor fit node n after evicting
+its first k victims?" is one fused comparison for every (n, k) at once. The
+device ranks candidates by the reference's pickOneNode key; the host then
+EXACTLY verifies the winner (full filter set incl. relational terms +
+reprieve) via the same ``_victims_on_node`` the serial path uses — so the
+result is always sound, the device only accelerates the O(N×V) narrowing.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.encode.dictionary import next_bucket
+from kubernetes_tpu.encode.scaling import scale_allocatable, scale_request
+
+EFFECTS = ("NoSchedule", "NoExecute")
+_INT_MIN = np.iinfo(np.int32).min + 1
+
+
+@jax.jit
+def _dry_run(allocatable, requested, static_mask, vic_req, vic_valid,
+             vic_violating, vic_prio, need):
+    """[N,R],[N,R],[N],[N,V,R],[N,V],[N,V],[N,V],[R] ->
+    (any_feasible [N], k_min [N], violations_at_k [N], max_prio_at_k [N]).
+
+    k_min = fewest leading victims (in eviction order) whose removal fits
+    the preemptor; prefix sums release capacity, cumulative max tracks the
+    pickOneNode "highest victim priority" metric."""
+    N, V, R = vic_req.shape
+    freed = jnp.cumsum(jnp.where(vic_valid[..., None], vic_req, 0), axis=1)
+    freed = jnp.concatenate([jnp.zeros((N, 1, R), freed.dtype), freed], axis=1)
+    fits = jnp.all(requested[:, None, :] - freed + need[None, None, :]
+                   <= allocatable[:, None, :], axis=-1)          # [N,V+1]
+    # prefix k is only removable if victims 0..k-1 all exist
+    kvalid = jnp.concatenate(
+        [jnp.ones((N, 1), bool),
+         jnp.cumprod(vic_valid, axis=1).astype(bool)], axis=1)
+    feasible = fits & kvalid & static_mask[:, None]
+    k_min = jnp.argmax(feasible, axis=1)                         # first True
+    any_f = jnp.any(feasible, axis=1)
+    viol_cum = jnp.concatenate(
+        [jnp.zeros((N, 1), jnp.int32),
+         jnp.cumsum((vic_violating & vic_valid).astype(jnp.int32), axis=1)],
+        axis=1)
+    prio_cummax = jnp.concatenate(
+        [jnp.full((N, 1), _INT_MIN, jnp.int32),
+         jax.lax.cummax(jnp.where(vic_valid, vic_prio, _INT_MIN), axis=1)],
+        axis=1)
+    take = lambda a: jnp.take_along_axis(a, k_min[:, None], axis=1)[:, 0]
+    return any_f, k_min, take(viol_cum), take(prio_cummax)
+
+
+def _static_mask(nodes: list[Node], pod: Pod) -> np.ndarray:
+    """Victim-independent filters: unschedulable, nodeName, taints, node
+    affinity. Relational/ports/volume feasibility is settled by the exact
+    host verification of the winning candidate (removing victims can only
+    HELP those, so this mask never wrongly excludes a candidate — except
+    taint/affinity, which victims cannot change)."""
+    from kubernetes_tpu.sched.oracle import (
+        UNSCHED_TAINT, OracleScheduler, tolerates_all)
+    orc = OracleScheduler(nodes, [])
+    out = np.zeros(len(nodes), bool)
+    for i, node in enumerate(nodes):
+        if node.spec.unschedulable and not any(
+                t.tolerates(UNSCHED_TAINT) for t in pod.spec.tolerations):
+            continue
+        if pod.spec.node_name and pod.spec.node_name != node.metadata.name:
+            continue
+        if not tolerates_all(pod.spec.tolerations, node.spec.taints, EFFECTS):
+            continue
+        if not orc._node_affinity_ok(pod, node):
+            continue
+        out[i] = True
+    return out
+
+
+def dry_run_candidates(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
+                       budgets: list[tuple], dra=None
+                       ) -> tuple[list[tuple[tuple, int, int]], bool]:
+    """Device-ranked preemption candidates: ``([(pickOneNode_key,
+    node_index, k_victims)] best-first, zero_evict_exists)``. The candidate
+    list is empty when no node can be made feasible by evicting
+    lower-priority pods (resource-wise); ``zero_evict_exists`` flags nodes
+    that fit WITHOUT evictions — meaning the main cycle's failure was
+    something this dry-run doesn't model (relational/ports/volumes) and the
+    caller should run the exact scan."""
+    from kubernetes_tpu.sched.preemption import _violates
+
+    # resource axes: everything the preemptor demands
+    reqs = dict(pod.resource_requests())
+    if dra is not None:
+        reqs.update(dra.pod_demands(pod))
+    if not reqs:
+        reqs = {"pods": 1}
+    reqs.setdefault("pods", 1)
+    resources = sorted(reqs)
+    R = len(resources)
+    need = np.array([scale_request(r, reqs[r]) for r in resources], np.int64)
+
+    name_to_i = {n.metadata.name: i for i, n in enumerate(nodes)}
+    N = len(nodes)
+    allocatable = np.zeros((N, R), np.int64)
+    for i, n in enumerate(nodes):
+        alloc = n.allocatable_canonical()
+        if dra is not None:
+            alloc.update(dra.node_capacity(n.metadata.name))
+        for j, r in enumerate(resources):
+            if r == "pods" and r not in alloc:
+                allocatable[i, j] = np.iinfo(np.int32).max
+            else:
+                allocatable[i, j] = scale_allocatable(r, alloc.get(r, 0))
+
+    def req_vec(p: Pod) -> np.ndarray:
+        pr = dict(p.resource_requests())
+        if dra is not None:
+            pr.update(dra.pod_demands(p))
+        v = np.zeros(R, np.int64)
+        for j, r in enumerate(resources):
+            v[j] = scale_request(r, pr.get(r, 0)) if r != "pods" else \
+                scale_request(r, pr.get(r, 1))
+        return v
+
+    requested = np.zeros((N, R), np.int64)
+    per_node: dict[int, list[Pod]] = {}
+    for p in bound_pods:
+        i = name_to_i.get(p.spec.node_name)
+        if i is None:
+            continue
+        requested[i] += req_vec(p)
+        if p.spec.priority < pod.spec.priority:
+            per_node.setdefault(i, []).append(p)
+    if not per_node:
+        return [], False
+
+    # eviction order per node: non-violating victims (priority asc) before
+    # violating ones, exactly like SelectVictimsOnNode's two-phase removal
+    V = next_bucket(max(len(v) for v in per_node.values()), minimum=1)
+    vic_req = np.zeros((N, V, R), np.int64)
+    vic_valid = np.zeros((N, V), bool)
+    vic_violating = np.zeros((N, V), bool)
+    vic_prio = np.zeros((N, V), np.int32)
+    for i, victims in per_node.items():
+        used = [[ns, sel, allowed, 0] for (ns, sel, allowed) in budgets]
+        flagged = [(p, _violates(p, used))
+                   for p in sorted(victims, key=lambda p: p.spec.priority)]
+        ordered = ([(p, v) for p, v in flagged if not v]
+                   + [(p, v) for p, v in flagged if v])
+        for k, (p, v) in enumerate(ordered):
+            vic_req[i, k] = req_vec(p)
+            vic_valid[i, k] = True
+            vic_violating[i, k] = v
+            vic_prio[i, k] = p.spec.priority
+
+    any_f, k_min, viols, maxprio = jax.device_get(_dry_run(
+        allocatable, requested, _static_mask(nodes, pod),
+        vic_req, vic_valid, vic_violating, vic_prio, need))
+    out = []
+    zero_evict = False
+    for i in range(N):
+        if not any_f[i]:
+            continue
+        if k_min[i] == 0:
+            zero_evict = True  # fits with no eviction: failure wasn't resources
+            continue
+        key = (int(viols[i]), int(maxprio[i]), int(k_min[i]), i)
+        out.append((key, i, int(k_min[i])))
+    out.sort()
+    return out, zero_evict

@@ -408,6 +408,40 @@ class OracleScheduler:
                 return FailReason.POD_ANTI_AFFINITY
         return None
 
+    # ---- incremental what-if support (preemption dry-run verification) ---
+
+    def remove_bound(self, pod: Pod) -> None:
+        """Temporarily evict a bound pod from the simulation (preemption
+        what-if); O(node) instead of rebuilding the oracle."""
+        i = self.node_index.get(pod.spec.node_name)
+        if i is None:
+            return
+        self.states[i].remove_pod(pod)
+        self._fold_demands(self.states[i], pod, sign=-1)
+        self._refresh_volume_state()
+
+    def restore_bound(self, pod: Pod) -> None:
+        """Undo remove_bound (the reprieve pass re-adds victims)."""
+        i = self.node_index.get(pod.spec.node_name)
+        if i is None:
+            return
+        self.states[i].add_pod(pod)
+        self._fold_demands(self.states[i], pod)
+        self._refresh_volume_state()
+
+    def _refresh_volume_state(self) -> None:
+        if self.volumes is None:
+            return  # volume tensors unused without a catalog
+        from kubernetes_tpu.sched.volumebinding import cluster_volume_state
+        self._vol_rwo, self._vol_attach, self._vol_rwop = cluster_volume_state(
+            [p for st in self.states for p in st.pods], self.volumes)
+
+    def feasible_one(self, pod: Pod, ni: int) -> bool:
+        """Feasibility of ``pod`` on node index ``ni`` only — the per-node
+        half of DryRunPreemption's re-filter, without scanning the fleet."""
+        ctx = self._pod_ctx(pod)
+        return self._filter_one(pod, self.states[ni], ni, ctx) is None
+
     def feasible(self, pod: Pod):
         """-> (mask list[bool], reasons dict node_name -> reason)."""
         ctx = self._pod_ctx(pod)

@@ -4,11 +4,18 @@ Reference: ``pkg/scheduler/framework/plugins/defaultpreemption/
 default_preemption.go`` (``SelectVictimsOnNode``) and
 ``framework/preemption/preemption.go`` (``Evaluator``, ``DryRunPreemption``).
 
-Round-1 implementation simulates on the oracle (host-side): the reference's
-DryRunPreemption is itself a per-node simulation loop, and preemption runs
-only for pods that already failed the (fast) main cycle, so the volume is low.
-A tensorized dry-run (vmap over candidate victim prefixes) is a later round's
-optimization.
+Two paths:
+
+``find_candidate``          the exact serial simulation (per node: evict
+                            lower-priority pods until feasible, reprieve,
+                            pickOneNode) — the parity reference.
+``find_candidate_tensor``   the TPU path: ops/preemption.py runs the whole
+                            N×V victim dry-run as ONE device program
+                            (prefix-sum capacity release), the host exactly
+                            verifies + reprieves only the ranked winners.
+                            Falls back to the exact scan whenever the device
+                            narrowing can't be trusted (relational/port/
+                            volume-driven failures).
 """
 
 from __future__ import annotations
@@ -73,9 +80,13 @@ def find_candidate(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
     then node order.
     """
     budgets = _pdb_budgets(pdbs or [], bound_pods)
+    # one shared simulation, mutated and restored per node trial — building
+    # a fresh oracle per candidate node is O(nodes x bound) each
+    orc = OracleScheduler(nodes, bound_pods, dra=dra)
     best: Optional[tuple] = None
     for i, node in enumerate(nodes):
-        found = _victims_on_node(nodes, bound_pods, pod, node, budgets, dra=dra)
+        found = _victims_on_node(nodes, bound_pods, pod, node, budgets,
+                                 dra=dra, orc=orc)
         if found is None:
             continue
         victims, violations = found
@@ -92,7 +103,47 @@ def find_candidate(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
         num_pdb_violations=best[3])
 
 
-def _victims_on_node(nodes, bound_pods, pod, node, budgets, dra=None
+def find_candidate_tensor(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
+                          pdbs: Optional[list[dict]] = None, dra=None,
+                          verify_limit: int = 8
+                          ) -> Optional[PreemptionResult]:
+    """Device-narrowed preemption: rank (node, victim-count) candidates with
+    one [N,V+1] dry-run program, then exactly verify + reprieve the winners
+    host-side. Sound by construction (every returned result passed the full
+    serial check); falls back to the exact scan when the failure could be
+    relational/port/volume-driven — i.e. when some node looks feasible with
+    ZERO evictions resource-wise (so something the dry-run doesn't model
+    blocked the main cycle), or when the device path errors."""
+    from kubernetes_tpu.ops.preemption import dry_run_candidates
+    budgets = _pdb_budgets(pdbs or [], bound_pods)
+    try:
+        cands, zero_evict = dry_run_candidates(nodes, bound_pods, pod,
+                                               budgets, dra=dra)
+    except Exception:
+        return find_candidate(nodes, bound_pods, pod, pdbs=pdbs, dra=dra)
+    if zero_evict:
+        # some node fits without evicting anyone: the main-cycle failure was
+        # relational/ports/volumes, which the dry-run doesn't model
+        return find_candidate(nodes, bound_pods, pod, pdbs=pdbs, dra=dra)
+    if not cands:
+        return None  # no node becomes resource-feasible by evicting
+    orc = OracleScheduler(nodes, bound_pods, dra=dra)
+    for _key, ni, _k in cands[:verify_limit]:
+        found = _victims_on_node(nodes, bound_pods, pod, nodes[ni], budgets,
+                                 dra=dra, orc=orc)
+        if found is not None:
+            victims, violations = found
+            return PreemptionResult(
+                node_name=nodes[ni].metadata.name,
+                victims=sorted(victims, key=lambda p: p.spec.priority),
+                num_pdb_violations=violations)
+    # ranked candidates failed exact verification (relational terms the
+    # dry-run doesn't model): the serial scan is the source of truth
+    return find_candidate(nodes, bound_pods, pod, pdbs=pdbs, dra=dra)
+
+
+def _victims_on_node(nodes, bound_pods, pod, node, budgets, dra=None,
+                     orc: Optional[OracleScheduler] = None
                      ) -> Optional[tuple[list[Pod], int]]:
     on_node = [p for p in bound_pods if p.spec.node_name == node.metadata.name]
     lower = [p for p in on_node if p.spec.priority < pod.spec.priority]
@@ -108,35 +159,46 @@ def _victims_on_node(nodes, bound_pods, pod, node, budgets, dra=None
     violating_uids = {p.metadata.uid for p, v in flagged if v}
     ni = next(i for i, n in enumerate(nodes) if n.metadata.name == node.metadata.name)
 
-    def feasible_without(removed: set[str]) -> bool:
-        remaining = [p for p in bound_pods if p.metadata.uid not in removed]
-        # the dra catalog keeps device demand/capacity visible to the
-        # what-if feasibility check (else victimless device shortages
-        # would look solvable by evicting unrelated pods)
-        orc = OracleScheduler(nodes, remaining, dra=dra)
-        mask, _ = orc.feasible(pod)
-        return bool(mask[ni])
-
-    removed: set[str] = set()
-    victims: list[Pod] = []
-    ok = False
-    for v in ordered:
-        removed.add(v.metadata.uid)
-        victims.append(v)
-        if feasible_without(removed):
-            ok = True
-            break
-    if not ok:
-        return None
-    # Reprieve: re-add victims that aren't actually needed — PDB-violating
-    # candidates first (so budgets are preserved whenever possible), then by
-    # priority desc, mirroring SelectVictimsOnNode's two reprieve passes.
-    for v in sorted(victims,
-                    key=lambda p: (p.metadata.uid not in violating_uids,
-                                   -p.spec.priority)):
-        trial = removed - {v.metadata.uid}
-        if feasible_without(trial):
-            removed = trial
-            victims = [p for p in victims if p.metadata.uid != v.metadata.uid]
-    violations = sum(1 for v in victims if v.metadata.uid in violating_uids)
-    return victims, violations
+    # One oracle, mutated incrementally and RESTORED before returning (so a
+    # caller-shared instance survives many node trials): the old per-probe
+    # rebuild was O(nodes x bound) per candidate victim, which dominated
+    # preemption at fleet scale; remove/restore are O(node) and the
+    # single-node re-filter is what DryRunPreemption's per-node simulation
+    # does. The dra catalog keeps device demand/capacity visible to the
+    # what-if check (else victimless device shortages would look solvable
+    # by evicting unrelated pods).
+    if orc is None:
+        orc = OracleScheduler(nodes, bound_pods, dra=dra)
+    removed_now: list[Pod] = []
+    try:
+        victims: list[Pod] = []
+        ok = False
+        for v in ordered:
+            orc.remove_bound(v)
+            removed_now.append(v)
+            victims.append(v)
+            if orc.feasible_one(pod, ni):
+                ok = True
+                break
+        if not ok:
+            return None
+        # Reprieve: re-add victims that aren't actually needed —
+        # PDB-violating candidates first (so budgets are preserved whenever
+        # possible), then by priority desc, mirroring SelectVictimsOnNode's
+        # two reprieve passes.
+        for v in sorted(victims,
+                        key=lambda p: (p.metadata.uid not in violating_uids,
+                                       -p.spec.priority)):
+            orc.restore_bound(v)
+            removed_now.remove(v)
+            if orc.feasible_one(pod, ni):
+                victims = [p for p in victims
+                           if p.metadata.uid != v.metadata.uid]
+            else:
+                orc.remove_bound(v)  # still needed
+                removed_now.append(v)
+        violations = sum(1 for v in victims if v.metadata.uid in violating_uids)
+        return victims, violations
+    finally:
+        for v in removed_now:
+            orc.restore_bound(v)

@@ -116,9 +116,19 @@ class Scheduler:
         backlog takes the fused drain path (one device program for many
         batches, models/gang.py gang_drain) while shallow pops run the
         single-batch program."""
+        # land the in-flight drain's bindings as soon as the device is done
+        # (don't let finished results sit behind a blocking pop)
+        pend = self._pending_drain
+        if pend is not None:
+            try:
+                ready = pend["assignments"].is_ready()
+            except Exception:
+                ready = True
+            if ready:
+                self._resolve_pending()
         batch = self.queue.pop_batch(
             self.cfg.batch_size * max(1, self.cfg.max_drain_batches),
-            wait=wait)
+            wait=0.05 if self._pending_drain is not None else wait)
         if not batch:
             return self._resolve_pending()
         stats = self.queue.stats()
@@ -527,9 +537,9 @@ class Scheduler:
     def _default_preempt(self, pod: Pod) -> Optional[str]:
         nodes, _, _ = self.cache.snapshot()
         bound = self.cache.bound_pods(include_assumed=True)
-        res = preemption_mod.find_candidate(nodes, bound, pod,
-                                            pdbs=self.pdb_lister(),
-                                            dra=self.cache.dra_catalog)
+        res = preemption_mod.find_candidate_tensor(
+            nodes, bound, pod, pdbs=self.pdb_lister(),
+            dra=self.cache.dra_catalog)
         if res is None:
             return None
         for v in res.victims:
