@@ -63,10 +63,14 @@ class _NoDelayHTTPSConnection(http.client.HTTPSConnection):
 
 
 class ApiError(Exception):
-    def __init__(self, code: int, message: str, reason: str = ""):
+    def __init__(self, code: int, message: str, reason: str = "",
+                 items: "list[dict] | None" = None):
         super().__init__(f"{code}: {message}")
         self.code = code
         self.reason = reason
+        # bulk verbs: per-item outcomes [(index, code, message)] so callers
+        # can tell which siblings committed before a partial failure
+        self.items = items or []
 
 
 def _api_errors(fn):
@@ -518,15 +522,28 @@ class HTTPClient(_Handles):
         out = self._req("POST", self._path(plural, ns),
                         {"kind": "List", "items": objs})
         results = out.get("results", [])
-        errs = [r.get("message") for r in results if r.get("code") not in (200, 201)]
+        failures = [(i, int(r.get("code", 500)), r.get("message", "error"))
+                    for i, r in enumerate(results)
+                    if r.get("code") not in (200, 201)]
         created = []
         for obj, r in zip(objs, results):
             if r.get("code") in (200, 201) and r.get("metadata"):
                 obj = dict(obj)
                 obj["metadata"] = r["metadata"]
             created.append(obj)
-        if errs:
-            raise ApiError(409, "; ".join(errs), "BulkCreateFailed")
+        if failures:
+            # Surface the ACTUAL per-item codes (an admission 400 must not
+            # masquerade as a 409) and which siblings committed: successful
+            # items are already persisted server-side, unlike the sequential
+            # fallback which stops at the first failure.
+            codes = {c for _, c, _ in failures}
+            code = failures[0][1] if len(codes) == 1 else 422
+            raise ApiError(
+                code,
+                "; ".join(f"items[{i}]: {m}" for i, _, m in failures),
+                "BulkCreateFailed",
+                items=[{"index": i, "code": c, "message": m}
+                       for i, c, m in failures])
         return created
 
     def get(self, plural, kind, ns, name):

@@ -298,10 +298,8 @@ class Scheduler:
         use_ctx = False
         n_prev = 0
         if ctx is not None and ctx["profile"] == profile.scheduler_name:
-            gen, up_keys, has_dels, needs_full = self.cache.delta_info()
             known = set(ctx["meta"].resources)
-            use_ctx = (gen == ctx["gen"] and not has_dels and not needs_full
-                       and up_keys <= ctx["folded"]
+            use_ctx = (self._ctx_current(ctx, ctx["gen"])
                        and ctx["fill_bound"] + len(pods) <= ctx["e0"]
                        and not any(r not in known for p in pods
                                    for r in p.resource_requests()))
@@ -395,6 +393,17 @@ class Scheduler:
         }
         return n_prev
 
+    def _ctx_current(self, ctx, gen_expected: int) -> bool:
+        """True when the HBM-resident drain context provably reflects the
+        cache at ``gen_expected``: the generation matches and every pending
+        delta is an upsert this loop already folded device-side (no deletes,
+        no structural invalidation). The single predicate shared by the
+        dispatch-side use_ctx check and both resolve-side currency checks —
+        the gen term is the load-bearing one (see _resolve_pending)."""
+        gen, up_keys, has_dels, needs_full = self.cache.delta_info()
+        return (gen == gen_expected and not has_dels and not needs_full
+                and up_keys <= ctx["folded"])
+
     def _resolve_pending(self) -> int:
         """Block on the in-flight drain's results and apply them host-side:
         assume + bulk-bind the placements, requeue the failures, re-sync the
@@ -409,8 +418,19 @@ class Scheduler:
             assignments, rounds, fill = jax.device_get(
                 (pend["assignments"], pend["rounds"], pend["new_fill"]))
         ctx, meta, profile = pend["ctx"], pend["meta"], pend["profile"]
-        if self._drain_ctx is ctx:
-            ctx["fill_bound"] = int(fill)
+        active = self._drain_ctx is ctx
+        if active:
+            pend_count = sum(len(c) for c in pend["chunks"])
+            # Context-currency precondition, captured BEFORE this resolve's
+            # assumes land: every pending delta must already be a fold this
+            # loop performed device-side. Anything foreign (a pod bound or
+            # removed by another party since dispatch) means the resident
+            # encoding never saw it — the context must be dropped, not
+            # re-synced, or a snapshot consumed mid-resolve (e.g. by the
+            # preemptor) would absorb the foreign change into a gen bump the
+            # encoding doesn't reflect.
+            gen0 = ctx["gen"]
+            ctx_clean = self._ctx_current(ctx, gen0)
         GANG_ROUNDS.observe(int(np.sum(rounds)))
         n_bound = n_unsched = 0
         to_bind: list[tuple[Pod, str]] = []
@@ -431,10 +451,23 @@ class Scheduler:
                 else:
                     self._handle_failure(pod, attempts)
                     n_unsched += 1
-        # re-sync the context's generation: if it moved by exactly our
-        # assumes (all folded device-side already), the next drain reuses
-        # the resident encoding with zero host work
-        ctx["gen"] = self.cache.delta_info()[0]
+        # Re-sync the context: it survives only when it was provably current
+        # before this resolve AND the generation moved by EXACTLY our
+        # assumes since. The gen arithmetic is what makes this air-tight: a
+        # foreign upsert whose key collides with an already-folded pod (a
+        # competing binder re-binding it elsewhere) passes the subset test,
+        # and a snapshot consumed mid-resolve (the preemptor's) empties the
+        # pending sets — but neither can undo the extra gen bump, since
+        # snapshot() never advances _generation. fill_bound is ADJUSTED,
+        # never overwritten: drains dispatched after this one already
+        # reserved their own += len(pods) on top, so only this drain's
+        # unused reservation (pend_count - n_bound) is released.
+        if active and self._drain_ctx is ctx:
+            if ctx_clean and self._ctx_current(ctx, gen0 + n_bound):
+                ctx["gen"] = gen0 + n_bound
+                ctx["fill_bound"] -= (pend_count - n_bound)
+            else:
+                self._drain_ctx = None
         self._bind_async_batch(to_bind, profile)
         dt = time.time() - pend["t0"]
         for result, n in (("scheduled", n_bound),
