@@ -135,6 +135,9 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
                              kwargs={"counter": churn_stats},
                              daemon=True).start()
 
+        from kubernetes_tpu.utils.tracing import TRACER
+        TRACER.max_spans = 200_000  # keep long/timed-out windows untruncated
+        TRACER.reset()  # spans from here on belong to the measured window
         t_start = time.time()
         by_ns: dict = {}
         for p in pods:
@@ -145,7 +148,12 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         runner.start_loop()
         deadline = t_start + timeout
         completed = False
+        milestones: dict = {}  # fraction bound -> seconds since t_start
         while time.time() < deadline:
+            n = count.value
+            for frac in (0.25, 0.5, 0.75):
+                if n >= n_pods * frac and frac not in milestones:
+                    milestones[frac] = round(time.time() - t_start, 2)
             if all_bound.wait(timeout=0.02):
                 completed = True
                 break
@@ -164,6 +172,10 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         if not completed:  # timed out: relist for the truth
             bound = sum(1 for p in seed_client.pods("default").list()
                         if p["spec"].get("nodeName"))
+        # fractions crossed inside the final wait (or a sub-interval run)
+        for frac in (0.25, 0.5, 0.75):
+            if bound >= n_pods * frac and frac not in milestones:
+                milestones[frac] = round(dt, 2)
         log(f"  created {n_pods} pods in {t_created-t_start:.1f}s; "
             f"all bound at +{dt:.1f}s")
         if churn_stop is not None:
@@ -172,6 +184,13 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         # p99 attempt latency (scheduled results) from the live histogram —
         # bucket upper bound, like Prometheus histogram_quantile
         p99 = ATTEMPT_DURATION.percentile(0.99, {"result": "scheduled"})
+        p50 = ATTEMPT_DURATION.percentile(0.50, {"result": "scheduled"})
+        # where the window went: scheduler-side span totals (ms) + the bind
+        # progress curve, so a BENCH file diagnoses its own bottleneck
+        span_ms: dict = {}
+        for s in TRACER.spans():
+            span_ms[s.name] = round(span_ms.get(s.name, 0.0)
+                                    + s.duration_ms, 1)
         out = {
             "case": "ConnectedChurn" if churn else "ConnectedScheduler",
             "workload": f"{n_pods}x{n_nodes}",
@@ -180,6 +199,10 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
             "measure_s": round(dt, 2),
             "watch_degraded": watch_dead.is_set(),
             "p99_attempt_latency_s": p99,
+            "p50_attempt_latency_s": p50,
+            "create_s": round(t_created - t_start, 2),
+            "bound_frac_s": milestones,
+            "span_ms": span_ms,
         }
         if churn:
             out["churn_api_ops"] = churn_stats.get("ops", 0)

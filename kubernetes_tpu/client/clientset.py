@@ -39,6 +39,14 @@ from kubernetes_tpu.store.store import (
 )
 
 
+try:  # binary wire format (protobuf-negotiation analog); JSON fallback
+    import msgpack as _client_msgpack
+except Exception:  # pragma: no cover - msgpack is baked into the image
+    _client_msgpack = None
+
+_MSGPACK_CT = "application/x-msgpack"
+
+
 def _set_nodelay(sock) -> None:
     try:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -381,11 +389,19 @@ class HTTPClient(_Handles):
 
     def __init__(self, base_url: str, timeout: float = 10.0,
                  token: Optional[str] = None,
-                 impersonate: Optional[str] = None):
+                 impersonate: Optional[str] = None,
+                 wire: str = "msgpack"):
         self.base = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token
         self.impersonate = impersonate
+        # Wire format: msgpack by default (the protobuf-negotiation analog;
+        # ~4x cheaper encode / ~2x decode than JSON on pod-sized objects —
+        # the connected path moves every object several times, so the
+        # serializer is a first-order cost). ``wire="json"`` forces the
+        # text protocol; either way the server negotiates per request, so
+        # mixed-format clients interoperate freely.
+        self._mp = _client_msgpack if wire == "msgpack" else None
         # per-thread persistent connection (keep-alive): the server speaks
         # HTTP/1.1 with Content-Length, so reusing the socket removes the
         # TCP handshake every request paid under urllib — the dominant cost
@@ -457,9 +473,15 @@ class HTTPClient(_Handles):
 
     def _req(self, method, url, body=None, headers=None):
         import http.client
-        data = json.dumps(body).encode() if body is not None else None
+        mp = self._mp
+        if mp is not None:
+            data = mp.packb(body) if body is not None else None
+            ctype = _MSGPACK_CT
+        else:
+            data = json.dumps(body).encode() if body is not None else None
+            ctype = "application/json"
         path = url[len(self.base):] or "/"
-        all_headers = {"Content-Type": "application/json",
+        all_headers = {"Content-Type": ctype, "Accept": ctype,
                        **self._auth_headers(), **(headers or {})}
         # One retry on transport-level failures (reset/refused under load
         # bursts, or a keep-alive socket the server closed between requests).
@@ -485,15 +507,34 @@ class HTTPClient(_Handles):
                 payload = resp.read()
                 if resp.will_close:
                     self._drop_conn()
+                is_mp = _MSGPACK_CT in (resp.getheader("Content-Type") or "")
                 if resp.status >= 400:
                     try:
-                        status = json.loads(payload)
+                        status = (_client_msgpack.unpackb(payload) if is_mp
+                                  else json.loads(payload))
                     except Exception:
                         status = {}
-                    raise ApiError(resp.status,
-                                   status.get("message", f"HTTP {resp.status}"),
+                    msg = status.get("message", f"HTTP {resp.status}")
+                    if (resp.status == 400 and mp is not None
+                            and "invalid JSON body" in msg):
+                        # Server can't speak msgpack (no module there): it
+                        # read our binary body as JSON. Downgrade this client
+                        # to the text wire permanently and replay the
+                        # request — negotiation is Accept-driven for
+                        # responses but bodies need this one-shot probe.
+                        self._mp = mp = None
+                        data = (json.dumps(body).encode()
+                                if body is not None else None)
+                        all_headers = {**all_headers,
+                                       "Content-Type": "application/json",
+                                       "Accept": "application/json"}
+                        continue
+                    raise ApiError(resp.status, msg,
                                    status.get("reason", ""))
-                return json.loads(payload or b"{}")
+                if not payload:
+                    return {}
+                return (_client_msgpack.unpackb(payload) if is_mp
+                        else json.loads(payload))
             except ApiError:
                 raise
             except (http.client.HTTPException, ConnectionError, OSError,
@@ -591,7 +632,8 @@ class HTTPClient(_Handles):
 
 
 class _HTTPWatch:
-    """Streaming watch over chunked JSON lines."""
+    """Streaming watch: chunked msgpack frames (negotiated via Accept,
+    heartbeat = nil) or newline-JSON lines (heartbeat = bare newline)."""
 
     HEARTBEAT_GRACE = 5.0  # server heartbeats ~1s; silence beyond this = dead
 
@@ -599,16 +641,24 @@ class _HTTPWatch:
         self._url = client._path(plural, ns,
                                  query=f"watch=true&resourceVersion={since_rv}")
         self.closed = False
+        headers = client._auth_headers()
+        if client._mp is not None:
+            headers["Accept"] = _MSGPACK_CT
         # read timeout doubles as the liveness window: the server heartbeats
-        # every ~1s, so a blocking readline that times out means a dead peer.
+        # every ~1s, so a blocking read that times out means a dead peer.
         self._resp = urllib.request.urlopen(
-            urllib.request.Request(self._url, headers=client._auth_headers()),
+            urllib.request.Request(self._url, headers=headers),
             timeout=self.HEARTBEAT_GRACE)
+        got_ct = self._resp.headers.get("Content-Type") or ""
+        self._unpacker = (_client_msgpack.Unpacker()
+                          if _MSGPACK_CT in got_ct else None)
         self._lock = threading.Lock()
 
     def get(self, timeout: float = 0.2) -> Optional[Event]:
         if self.closed:
             return None
+        if self._unpacker is not None:
+            return self._get_msgpack()
         try:
             line = self._resp.readline()
         except Exception:  # socket timeout (no heartbeat) or closed
@@ -625,6 +675,30 @@ class _HTTPWatch:
             return None
         rv = int(d["object"].get("metadata", {}).get("resourceVersion", "0"))
         return Event(d["type"], d["object"], rv)
+
+    def _get_msgpack(self) -> Optional[Event]:
+        while True:
+            try:
+                d = next(self._unpacker)
+            except StopIteration:
+                # buffer dry: pull more bytes off the socket (read1 returns
+                # whatever the current chunk has without waiting for a full
+                # buffer; blocking beyond HEARTBEAT_GRACE means a dead peer)
+                try:
+                    data = self._resp.read1(1 << 16)
+                except Exception:
+                    self.closed = True
+                    return None
+                if not data:
+                    self.closed = True
+                    return None
+                self._unpacker.feed(data)
+                continue
+            if d is None:
+                return None  # heartbeat (nil frame)
+            rv = int(d["object"].get("metadata", {})
+                     .get("resourceVersion", "0"))
+            return Event(d["type"], d["object"], rv)
 
     def __iter__(self):
         return self

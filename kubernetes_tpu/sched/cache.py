@@ -215,6 +215,38 @@ class SchedulerCache:
     def update_pod(self, pod: Pod):
         self.add_pod(pod)
 
+    def confirm(self, pod_key: str, node_name: str, labels: dict,
+                spec: Optional[dict] = None) -> bool:
+        """Fast-path bind confirmation: promote the assumed copy to bound
+        when the watch event matches it — the dict-level twin of add_pod's
+        pure-confirmation branch. Lets the informer skip a full
+        Pod.from_dict per binding event: under a gang bind storm every bound
+        pod produces exactly one MODIFIED whose only news is the node the
+        cache already assumed.
+
+        ``spec``: the event's raw spec dict; when given, it must equal the
+        assumed copy's spec (nodeName aside) or the promotion is refused —
+        a spec PUT racing the bind would otherwise install the stale assumed
+        copy as bound with no later event to heal it (add_pod stores the
+        fresh watch object instead, so the fallback self-heals). Returns
+        False when there is nothing to confirm (caller falls back)."""
+        with self._lock:
+            prior = self._assumed.get(pod_key)
+            if prior is None or pod_key in self._delta_deletes:
+                return False
+            ap = prior[0]
+            if ap.spec.node_name != node_name or ap.metadata.labels != labels:
+                return False
+            if spec is not None:
+                mine = ap.spec.to_dict()
+                mine.pop("nodeName", None)
+                theirs = {k: v for k, v in spec.items() if k != "nodeName"}
+                if mine != theirs:
+                    return False
+            del self._assumed[pod_key]
+            self._pods[pod_key] = ap
+            return True
+
     def is_bound(self, pod_key: str) -> bool:
         """True if the pod is recorded as bound (confirmed via watch)."""
         with self._lock:
@@ -247,6 +279,25 @@ class SchedulerCache:
             self._generation += 1
             self._delta_upserts[p.key] = p
             self._delta_deletes.discard(p.key)
+
+    def assume_many(self, pairs: list) -> None:
+        """assume() for a whole drain's winners in ONE lock pass — the gang
+        step commits thousands of placements per resolve, and a lock
+        round-trip per pod was measurable against the connected window.
+        ``pairs``: [(Pod, node_name)]. Advances the generation by exactly
+        len(pairs), which the drain context's resolve-side currency check
+        (scheduler._resolve_pending) counts on."""
+        import dataclasses
+        with self._lock:
+            deadline = time.time() + self.assume_ttl
+            for pod, node_name in pairs:
+                p = dataclasses.replace(
+                    pod, spec=dataclasses.replace(pod.spec,
+                                                  node_name=node_name))
+                self._assumed[p.key] = (p, deadline)
+                self._delta_upserts[p.key] = p
+                self._delta_deletes.discard(p.key)
+            self._generation += len(pairs)
 
     def finish_binding(self, pod_key: str):
         """Binding RPC done; keep assumed until the watch confirms (TTL holds)."""

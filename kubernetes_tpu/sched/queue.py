@@ -114,10 +114,12 @@ class SchedulingQueue:
             self._keys_queued.add(k)
 
     def delete(self, pod: Pod):
+        self.delete_key(self._key(pod))
+
+    def delete_key(self, k: str):
         # Lazy: drop the membership records; stale heap entries are skipped
         # by consumers when they surface (O(1) here instead of O(queue)).
         with self._lock:
-            k = self._key(pod)
             self._keys_queued.discard(k)
             self._unschedulable.pop(k, None)
             self._entries.pop(k, None)

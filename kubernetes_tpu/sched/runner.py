@@ -61,6 +61,21 @@ class SchedulerRunner:
     # ---- event handlers (pkg/scheduler/eventhandlers.go analog) ----------
 
     def _on_pod(self, type_, obj, old):
+        if type_ != DELETED:
+            # Fast path for bind confirmations: a gang bind storm is one
+            # MODIFIED per pod whose only news is the nodeName the cache
+            # already assumed — confirm from the raw dict and skip the full
+            # Pod.from_dict (a first-order cost at 10k events/s).
+            spec = obj.get("spec") or {}
+            nn = spec.get("nodeName")
+            if nn and (obj.get("status") or {}).get("phase") \
+                    not in ("Succeeded", "Failed"):
+                md = obj.get("metadata") or {}
+                key = f"{md.get('namespace', 'default')}/{md.get('name', '')}"
+                if self.cache.confirm(key, nn, md.get("labels") or {},
+                                      spec=spec):
+                    self.queue.delete_key(key)
+                    return
         try:
             pod = Pod.from_dict(obj)
         except Exception:

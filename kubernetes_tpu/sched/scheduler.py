@@ -387,7 +387,7 @@ class Scheduler:
         n_prev += self._resolve_pending()
         self._pending_drain = {
             "assignments": assignments, "rounds": rounds,
-            "new_fill": new_fill, "chunks": chunks, "ctx": ctx,
+            "chunks": chunks, "ctx": ctx,
             "meta": meta, "n_nodes": len(nodes), "profile": profile,
             "t0": t0,
         }
@@ -414,9 +414,13 @@ class Scheduler:
         self._pending_drain = None
         import jax
         import numpy as np
-        with BATCH_DURATION.time():
-            assignments, rounds, fill = jax.device_get(
-                (pend["assignments"], pend["rounds"], pend["new_fill"]))
+        from kubernetes_tpu.utils.tracing import TRACER
+        with BATCH_DURATION.time(), TRACER.span("scheduler/resolve_wait"):
+            # fill_bound is maintained purely by the dispatch-side
+            # reservation arithmetic (adjusted below); the device fill stays
+            # resident as ctx["fill_dev"] and is never fetched
+            assignments, rounds = jax.device_get(
+                (pend["assignments"], pend["rounds"]))
         ctx, meta, profile = pend["ctx"], pend["meta"], pend["profile"]
         active = self._drain_ctx is ctx
         if active:
@@ -432,25 +436,37 @@ class Scheduler:
             gen0 = ctx["gen"]
             ctx_clean = self._ctx_current(ctx, gen0)
         GANG_ROUNDS.observe(int(np.sum(rounds)))
-        n_bound = n_unsched = 0
         to_bind: list[tuple[Pod, str]] = []
-        for b, chunk in enumerate(pend["chunks"]):
-            assignment = assignments[b]
-            if sanity.check_enabled():
-                for problem in sanity.check_assignment(
-                        assignment, pend["n_nodes"]):
-                    _LOG.error("KTPU_CHECK: %s (drain chunk %d)", problem, b)
-            for (pod, attempts), a in zip(chunk, assignment[:len(chunk)]):
-                if a >= 0:
-                    node_name = meta.node_names[int(a)]
-                    self._nominated.pop(pod.key, None)
-                    self.cache.assume(pod, node_name)
-                    ctx["folded"].add(pod.key)
-                    to_bind.append((pod, node_name))
-                    n_bound += 1
-                else:
-                    self._handle_failure(pod, attempts)
-                    n_unsched += 1
+        failures: list[tuple[Pod, int]] = []
+        with TRACER.span("scheduler/apply"):
+            for b, chunk in enumerate(pend["chunks"]):
+                assignment = assignments[b]
+                if sanity.check_enabled():
+                    for problem in sanity.check_assignment(
+                            assignment, pend["n_nodes"]):
+                        _LOG.error("KTPU_CHECK: %s (drain chunk %d)",
+                                   problem, b)
+                node_names = meta.node_names
+                for (pod, attempts), a in zip(chunk,
+                                              assignment[:len(chunk)]):
+                    if a >= 0:
+                        to_bind.append((pod, node_names[int(a)]))
+                    else:
+                        failures.append((pod, attempts))
+            if to_bind:
+                # one lock pass for the whole drain's winners; failures are
+                # handled AFTER so their preemption dry-runs see every winner
+                self.cache.assume_many(to_bind)
+                folded = ctx["folded"]
+                nominated = self._nominated
+                for pod, _node in to_bind:
+                    folded.add(pod.key)
+                    if nominated:
+                        nominated.pop(pod.key, None)
+        n_bound = len(to_bind)
+        n_unsched = len(failures)
+        for pod, attempts in failures:
+            self._handle_failure(pod, attempts)
         # Re-sync the context: it survives only when it was provably current
         # before this resolve AND the generation moved by EXACTLY our
         # assumes since. The gen arithmetic is what makes this air-tight: a

@@ -27,12 +27,20 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
+try:  # binary wire format (protobuf-negotiation analog); JSON remains default
+    import msgpack as _msgpack
+except Exception:  # pragma: no cover - msgpack is baked into the image
+    _msgpack = None
+
+MSGPACK_CT = "application/x-msgpack"
+
 from kubernetes_tpu.api.selectors import compile_list_selector
 from kubernetes_tpu.metrics.registry import REGISTRY
 from kubernetes_tpu.store.flowcontrol import RejectedError
 from kubernetes_tpu.store.store import (
     AlreadyExists,
     Conflict,
+    Event,
     NotFound,
     ObjectStore,
     TooOld,
@@ -475,12 +483,23 @@ class APIServer:
                     except Exception:
                         self.close_connection = True
 
+            def _wants_msgpack(self) -> bool:
+                return (_msgpack is not None
+                        and MSGPACK_CT in self.headers.get("Accept", ""))
+
             def _send_json(self, code: int, obj):
+                """Respond in the NEGOTIATED format (the name is historic):
+                msgpack when the client's Accept asks for it, JSON otherwise —
+                the serializer-negotiation analog of the reference's
+                JSON/protobuf content types."""
                 self._drain_body()
                 self._last_code = code
-                body = json.dumps(obj).encode()
+                if self._wants_msgpack():
+                    body, ctype = _msgpack.packb(obj), MSGPACK_CT
+                else:
+                    body, ctype = json.dumps(obj).encode(), "application/json"
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -496,10 +515,18 @@ class APIServer:
                 if not n:
                     return {}
                 raw = self.rfile.read(n)
-                try:
-                    out = json.loads(raw)
-                except json.JSONDecodeError as e:
-                    raise _BadRequest(f"invalid JSON body: {e}") from None
+                if (_msgpack is not None and MSGPACK_CT
+                        in self.headers.get("Content-Type", "")):
+                    try:
+                        out = _msgpack.unpackb(raw)
+                    except Exception as e:
+                        raise _BadRequest(
+                            f"invalid msgpack body: {e}") from None
+                else:
+                    try:
+                        out = json.loads(raw)
+                    except json.JSONDecodeError as e:
+                        raise _BadRequest(f"invalid JSON body: {e}") from None
                 if not isinstance(out, dict):
                     raise _BadRequest("body must be a JSON object")
                 return out
@@ -583,8 +610,22 @@ class APIServer:
                     w = server.store.watch(kind, since_rv=since)
                 except TooOld:
                     return self._error(410, "resourceVersion too old", "Expired")
+                # Stream format rides the Accept header: msgpack frames
+                # (heartbeat = single nil byte 0xc0) or newline-JSON lines
+                # (heartbeat = bare newline). Event payload bytes are
+                # serialized once per event PER FORMAT and shared across
+                # every watcher of that format.
+                use_mp = self._wants_msgpack()
+                if use_mp:
+                    payload = Event.wire_msgpack
+                    heartbeat = b"1\r\n\xc0\r\n"
+                    ctype = MSGPACK_CT
+                else:
+                    payload = Event.wire
+                    heartbeat = b"1\r\n\n\r\n"
+                    ctype = "application/json"
                 self.send_response(200)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 try:
@@ -601,8 +642,8 @@ class APIServer:
                             break
                         if ev is None:
                             idle += 1
-                            if idle >= 2:  # ~1s heartbeat: empty payload line
-                                self.wfile.write(b"1\r\n\n\r\n")
+                            if idle >= 2:  # ~1s heartbeat
+                                self.wfile.write(heartbeat)
                                 self.wfile.flush()
                                 idle = 0
                             continue
@@ -623,7 +664,7 @@ class APIServer:
                                                    {}).get("namespace", "") != ns:
                                 continue
                             # serialized once per event, shared across watchers
-                            line = e.wire()
+                            line = payload(e)
                             chunks.append(hex(len(line))[2:].encode() + b"\r\n"
                                           + line + b"\r\n")
                         if chunks:

@@ -1,0 +1,158 @@
+"""Binary wire format (msgpack) negotiation + the informer's fast-confirm
+path.
+
+The reference negotiates JSON vs protobuf per request via Content-Type /
+Accept (``apimachinery/pkg/runtime/serializer/negotiation``); here the binary
+format is msgpack, the default for HTTPClient, with JSON interop preserved —
+a JSON client and a msgpack client against the same server must observe
+identical state. Watch streams negotiate the same way (msgpack frames with a
+nil heartbeat vs newline-JSON lines).
+"""
+
+import pytest
+
+from kubernetes_tpu.client.clientset import ApiError, HTTPClient
+from kubernetes_tpu.store.apiserver import APIServer
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+@pytest.fixture()
+def api():
+    server = APIServer().start()
+    yield server
+    server.stop()
+
+
+def test_msgpack_crud_roundtrip(api):
+    c = HTTPClient(api.url)  # msgpack by default
+    assert c._mp is not None, "msgpack should be the default wire format"
+    c.nodes().create(make_node("n0").capacity(
+        {"cpu": "4", "memory": "8Gi", "pods": "16"}).obj().to_dict())
+    c.pods("default").create(
+        make_pod("a").req({"cpu": "100m"}).obj().to_dict())
+    got = c.pods("default").get("a")
+    assert got["metadata"]["name"] == "a"
+    assert got["spec"]["containers"][0]["resources"]["requests"]["cpu"] \
+        == "100m"
+    # errors carry Status payloads in the negotiated format too
+    with pytest.raises(ApiError) as ei:
+        c.pods("default").get("missing")
+    assert ei.value.code == 404 and ei.value.reason == "NotFound"
+
+
+def test_json_and_msgpack_clients_interoperate(api):
+    mp, js = HTTPClient(api.url), HTTPClient(api.url, wire="json")
+    assert js._mp is None
+    mp.pods("default").create(make_pod("frm-mp").obj().to_dict())
+    js.pods("default").create(make_pod("frm-js").obj().to_dict())
+    # each sees the other's writes, identical shape
+    a = js.pods("default").get("frm-mp")
+    b = mp.pods("default").get("frm-js")
+    assert a["metadata"]["name"] == "frm-mp"
+    assert b["metadata"]["name"] == "frm-js"
+    assert mp.pods("default").get("frm-js") == js.pods("default").get("frm-js")
+
+
+@pytest.mark.parametrize("wire", ["msgpack", "json"])
+def test_watch_stream_formats(api, wire):
+    c = HTTPClient(api.url, wire=wire)
+    _, rv = c.pods("default").list_rv()
+    w = c.pods("default").watch(since_rv=rv)
+    seed = HTTPClient(api.url, wire="json" if wire == "msgpack" else "msgpack")
+    seed.pods("default").create_many(
+        [make_pod(f"w{i}").obj().to_dict() for i in range(5)])
+    seen = []
+    import time
+    deadline = time.time() + 10.0
+    while len(seen) < 5 and time.time() < deadline:
+        ev = w.get(timeout=1.0)
+        if ev is not None:
+            seen.append(ev)
+    assert [e.object["metadata"]["name"] for e in seen] == \
+        [f"w{i}" for i in range(5)]
+    assert all(e.type == "ADDED" for e in seen)
+    w.stop()
+
+
+def test_msgpack_bulk_create_and_bind(api):
+    c = HTTPClient(api.url)
+    c.nodes().create_many([make_node(f"n{i}").capacity(
+        {"cpu": "4", "memory": "8Gi", "pods": "16"}).obj().to_dict()
+        for i in range(2)])
+    created = c.pods("default").create_many(
+        [make_pod(f"b{i}").req({"cpu": "100m"}).obj().to_dict()
+         for i in range(4)])
+    assert all(o["metadata"].get("resourceVersion") for o in created)
+    errs = c.pods("default").bind_many(
+        [("default", f"b{i}", f"n{i % 2}") for i in range(4)])
+    assert errs == [None] * 4
+    assert c.pods("default").get("b3")["spec"]["nodeName"] == "n1"
+
+
+def test_cache_confirm_fast_path():
+    """confirm() promotes a matching assumed pod without a gen bump;
+    mismatches fall back (return False) so add_pod handles them."""
+    from kubernetes_tpu.sched.cache import SchedulerCache
+    cache = SchedulerCache()
+    pod = make_pod("x").req({"cpu": "100m"}).obj()
+    cache.assume(pod, "n1")
+    gen0 = cache.delta_info()[0]
+    # wrong node: no promotion
+    assert not cache.confirm(pod.key, "n2", dict(pod.metadata.labels))
+    # right node + labels: promoted to bound, encoding-neutral
+    assert cache.confirm(pod.key, "n1", dict(pod.metadata.labels))
+    assert cache.is_bound(pod.key)
+    assert cache.delta_info()[0] == gen0
+    # nothing assumed anymore: second confirm is a no-op fallback
+    assert not cache.confirm(pod.key, "n1", dict(pod.metadata.labels))
+
+
+def test_cache_confirm_spec_guard():
+    """The wire-shaped bind event (to_dict + nodeName, exactly what the
+    server emits) passes the spec guard; a spec that changed since the
+    assume (e.g. a tolerations PUT racing the bind) is refused so the
+    fallback add_pod can install the fresh object."""
+    from kubernetes_tpu.sched.cache import SchedulerCache
+    cache = SchedulerCache()
+    pod = make_pod("x").req({"cpu": "100m"}).obj()
+    wire = pod.to_dict()
+    wire["spec"]["nodeName"] = "n1"
+    cache.assume(pod, "n1")
+    changed = {**wire["spec"], "tolerations": [{"key": "k", "operator":
+                                                "Exists"}]}
+    assert not cache.confirm(pod.key, "n1", dict(pod.metadata.labels),
+                             spec=changed)
+    assert cache.confirm(pod.key, "n1", dict(pod.metadata.labels),
+                         spec=wire["spec"])
+    assert cache.is_bound(pod.key)
+
+
+def test_runner_fast_confirm_via_watch(api):
+    """End-to-end: the runner's informer confirms its own binding from the
+    raw watch dict (no stale queue entry, pod bound in cache)."""
+    import time
+    from kubernetes_tpu.config.types import SchedulerConfiguration
+    from kubernetes_tpu.sched.runner import SchedulerRunner
+
+    seed = HTTPClient(api.url)
+    seed.nodes().create(make_node("n0").capacity(
+        {"cpu": "4", "memory": "8Gi", "pods": "16"}).obj().to_dict())
+    runner = SchedulerRunner(HTTPClient(api.url),
+                             SchedulerConfiguration(batch_size=4))
+    runner.start()
+    try:
+        seed.pods("default").create(
+            make_pod("fc").req({"cpu": "100m"}).obj().to_dict())
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            obj = seed.pods("default").get("fc")
+            if obj["spec"].get("nodeName"):
+                break
+            time.sleep(0.05)
+        assert obj["spec"].get("nodeName") == "n0"
+        deadline = time.time() + 10.0
+        while time.time() < deadline and not runner.cache.is_bound("default/fc"):
+            time.sleep(0.05)
+        assert runner.cache.is_bound("default/fc")
+    finally:
+        runner.stop()
