@@ -75,6 +75,16 @@ def main():
             log=log)
         log("[bench] " + json.dumps(preemption))
 
+    connected_preemption = None
+    if os.environ.get("BENCH_PREEMPTION", "1") != "0" and not only_case:
+        from benchmarks.connected import run_connected_preemption
+        log("[bench] connected preemption run ...")
+        connected_preemption = run_connected_preemption(
+            n_nodes=int(os.environ.get("BENCH_CPREEMPT_NODES", "5000")),
+            n_high=int(os.environ.get("BENCH_CPREEMPT_PODS", "128")),
+            log=log)
+        log("[bench] " + json.dumps(connected_preemption))
+
     head = next((r for r in results
                  if (r["case"], r["workload"]) == HEADLINE), None)
     is_headline = head is not None
@@ -101,6 +111,7 @@ def main():
              "passed": r["passed"]} for r in results],
         "connected": connected,
         "preemption": preemption,
+        "connected_preemption": connected_preemption,
     }
     print(json.dumps(out))
 

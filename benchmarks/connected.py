@@ -217,6 +217,107 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
             server.terminate()
 
 
+def run_connected_preemption(n_nodes: int = 5000, n_high: int = 128,
+                             pods_per_node: int = 2, timeout: float = 300.0,
+                             log=lambda *a: None) -> dict:
+    """Mixed schedule+preempt through the PRODUCT: a saturated cluster
+    behind the live apiserver, a wave of high-priority pods arrives, and
+    the connected scheduler's failure path must wave-preempt (evict via the
+    API), nominate, and re-bind — measured pod-creation to last binding
+    visible, like the plain connected run. Exercises
+    scheduler._handle_failures -> _default_preempt_wave -> runner._evict
+    end to end (VERDICT r3: preemption had never run through the product)."""
+    from kubernetes_tpu.client.clientset import HTTPClient
+    from kubernetes_tpu.config.types import SchedulerConfiguration
+    from kubernetes_tpu.sched.runner import SchedulerRunner
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+    ctx = mp.get_context("spawn")
+    parent, child = ctx.Pipe()
+    server = ctx.Process(target=_serve, args=(child,), daemon=True)
+    server.start()
+    port = parent.recv()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        seed_client = HTTPClient(url, timeout=120.0)
+        t0 = time.time()
+        seed_client.nodes().create_many(
+            [make_node(f"n{i}").capacity(
+                {"cpu": "8", "memory": "32Gi", "pods": "32"}).obj().to_dict()
+             for i in range(n_nodes)])
+        low = []
+        for i in range(n_nodes):
+            for j in range(pods_per_node):
+                low.append(make_pod(f"low-{i}-{j}", "default")
+                           .req({"cpu": "4", "memory": "4Gi"})
+                           .priority(1 + (i + j) % 5).node(f"n{i}").obj()
+                           .to_dict())
+        seed_client.pods("default").create_many(low)
+        log(f"  seeded {n_nodes} nodes + {len(low)} bound low-prio pods "
+            f"in {time.time()-t0:.1f}s")
+
+        runner = SchedulerRunner(
+            HTTPClient(url), SchedulerConfiguration(batch_size=256,
+                                                    max_drain_batches=1))
+        runner.start(wait_sync=60.0, start_loop=False)
+
+        high = [make_pod(f"hi-{k}", "preempt")
+                .req({"cpu": "6", "memory": "8Gi"}).priority(100).obj()
+                for k in range(n_high)]
+        _, rv0 = seed_client.pods("preempt").list_rv()
+        count = ctx.Value("i", 0)
+        all_bound, watch_dead, ready = ctx.Event(), ctx.Event(), ctx.Event()
+        watcher = ctx.Process(target=_watch_bound,
+                              args=(url, "preempt", rv0, n_high,
+                                    count, all_bound, watch_dead, ready),
+                              daemon=True)
+        watcher.start()
+        ready.wait(30.0)
+
+        t_start = time.time()
+        seed_client.pods("preempt").create_many([p.to_dict() for p in high])
+        runner.start_loop()
+        deadline = t_start + timeout
+        completed = False
+        while time.time() < deadline:
+            if all_bound.wait(timeout=0.05):
+                completed = True
+                break
+            if watch_dead.is_set():
+                n = sum(1 for p in seed_client.pods("preempt").list()
+                        if p["spec"].get("nodeName"))
+                count.value = n
+                if n >= n_high:
+                    completed = True
+                    break
+                time.sleep(0.2)
+        dt = time.time() - t_start
+        bound = count.value
+        if not completed:
+            bound = sum(1 for p in seed_client.pods("preempt").list()
+                        if p["spec"].get("nodeName"))
+        log(f"  {bound}/{n_high} preemptors bound at +{dt:.1f}s")
+        runner.stop()
+        remaining = len(seed_client.pods("default").list())
+        return {
+            "case": "ConnectedPreemption",
+            "workload": f"{n_high}x{n_nodes}",
+            "PreemptionThroughput": round(bound / dt, 1) if dt > 0 else 0.0,
+            "resolved": bound, "preemptors": n_high, "nodes": n_nodes,
+            "measure_s": round(dt, 2),
+            "victims_evicted": len(low) - remaining,
+            "watch_degraded": watch_dead.is_set(),
+        }
+    finally:
+        try:
+            parent.send("stop")
+        except Exception:
+            pass
+        server.join(timeout=5.0)
+        if server.is_alive():
+            server.terminate()
+
+
 def _warm_jit(runner, pods, batch_size, n_pods, log):
     """Compile the fused drain and arm the device-resident cluster context
     at the exact shapes the runner's pops will use, against the runner's OWN

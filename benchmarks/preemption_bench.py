@@ -3,10 +3,11 @@
 The reference's preemption hot path is ``DryRunPreemption``
 (``pkg/scheduler/framework/preemption/preemption.go``): per failed pod,
 simulate victim eviction on every candidate node (16 goroutines). Here the
-whole N x V victim search is one device program (ops/preemption.py) with the
-winner exactly verified host-side — this measures end-to-end
-``find_candidate_tensor`` throughput (preemptors/second) on a saturated
-cluster, vs the pure-host serial scan on a sample for the speedup ratio.
+whole WAVE of preemptors runs as one [Q,N,V+1] sequential-commit scan
+(ops/preemption.py ``_wave_scan``) with each proposal exactly verified
+host-side against a shared oracle — this measures end-to-end
+``preempt_wave`` throughput (preemptors/second) on a saturated cluster, vs
+the pure-host serial scan on a sample for the speedup ratio.
 
 Scenario: every node is full of low-priority pods; a wave of high-priority
 pods arrives, each needing victims. Each preemptor's chosen victims are
@@ -40,8 +41,7 @@ def build_saturated(n_nodes: int, pods_per_node: int = 2):
 
 def run_preemption(n_nodes: int = 5000, n_preemptors: int = 256,
                    host_sample: int = 8, log=lambda *a: None) -> dict:
-    from kubernetes_tpu.sched.preemption import (
-        find_candidate, find_candidate_tensor)
+    from kubernetes_tpu.sched.preemption import find_candidate, preempt_wave
     from kubernetes_tpu.testing.wrappers import make_pod
 
     nodes, bound = build_saturated(n_nodes)
@@ -49,25 +49,13 @@ def run_preemption(n_nodes: int = 5000, n_preemptors: int = 256,
                   .priority(100).obj() for k in range(n_preemptors)]
     log(f"  {n_nodes} nodes saturated with {len(bound)} low-priority pods")
 
-    # warmup: compile the dry-run program at this shape
-    find_candidate_tensor(nodes, bound, preemptors[0])
+    # warmup: compile the wave scan + static-mask filters at this shape
+    # (the wave mutates nothing — inputs are re-encoded per call)
+    preempt_wave(nodes, bound, preemptors)
 
-    by_uid = {p.metadata.uid: p for p in bound}
     t0 = time.time()
-    resolved = 0
-    live = list(bound)
-    for pod in preemptors:
-        res = find_candidate_tensor(nodes, live, pod)
-        if res is None:
-            continue
-        evicted = {v.metadata.uid for v in res.victims}
-        live = [p for p in live if p.metadata.uid not in evicted]
-        # the preemptor takes the freed spot (nominated-pod reservation)
-        placed = make_pod(pod.metadata.name).req(
-            {"cpu": "6", "memory": "8Gi"}).priority(100).node(
-            res.node_name).obj()
-        live.append(placed)
-        resolved += 1
+    results = preempt_wave(nodes, bound, preemptors)
+    resolved = sum(r is not None for r in results)
     dt = time.time() - t0
     tensor_rate = resolved / dt if dt > 0 else 0.0
 

@@ -662,7 +662,17 @@ class Pod:
         Reference: pkg/api/v1/resource/helpers.go (PodRequests) —
         max(sum(containers), max(initContainers)) + overhead, plus the
         implicit "pods" resource (each pod consumes 1 slot).
+
+        Memoized per instance: quantity-string parsing dominated fleet-scale
+        host paths (every encode/oracle/preemption pass re-parsed every
+        pod). Requests are spec-immutable upstream, and every mutation path
+        here builds a fresh Pod (informers, dataclasses.replace), so the
+        cache lives exactly as long as it is valid. Callers treat the
+        result as read-only.
         """
+        cached = self.__dict__.get("_requests_cache")
+        if cached is not None:
+            return cached
         total: dict[str, int] = {}
         for c in self.containers_all(init=False):
             for r, q in c.requests.items():
@@ -673,6 +683,7 @@ class Pod:
         for r, q in self.spec.overhead.items():
             total[r] = total.get(r, 0) + canonical(r, q)
         total["pods"] = 1
+        self.__dict__["_requests_cache"] = total
         return total
 
     def containers_all(self, init: bool = True) -> list[Container]:

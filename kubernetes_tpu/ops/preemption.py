@@ -92,31 +92,114 @@ def _static_mask(nodes: list[Node], pod: Pod) -> np.ndarray:
     return out
 
 
-def dry_run_candidates(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
-                       budgets: list[tuple], dra=None
-                       ) -> tuple[list[tuple[tuple, int, int]], bool]:
-    """Device-ranked preemption candidates: ``([(pickOneNode_key,
-    node_index, k_victims)] best-first, zero_evict_exists)``. The candidate
-    list is empty when no node can be made feasible by evicting
-    lower-priority pods (resource-wise); ``zero_evict_exists`` flags nodes
-    that fit WITHOUT evictions — meaning the main cycle's failure was
-    something this dry-run doesn't model (relational/ports/volumes) and the
-    caller should run the exact scan."""
+_TOPK = 4  # device-ranked candidates surfaced per preemptor for exact re-rank
+
+
+@partial(jax.jit, static_argnames=())
+def _wave_scan(allocatable, requested0, static_mask, vic_req, vic_valid,
+               vic_violating, vic_prio, need, prio):
+    """Sequential-commit preemption wave as ONE device program.
+
+    [N,R], [N,R], [Q,N], [N,V,R], [N,V], [N,V], [N,V], [Q,R], [Q] ->
+    (found [Q], zero_evict [Q], cand_nodes [Q,K], evict_sel [Q,V]).
+
+    ``lax.scan`` over the Q preemptors carries (requested, evicted): each
+    step derives its own evictable set (victims strictly lower priority,
+    not yet evicted), releases capacity via exclusive prefix sums, ranks
+    nodes by the pickOneNode key (fewest PDB violations, lowest max victim
+    priority, fewest victims, node order) packed into one int64 for
+    ``top_k``, and COMMITS the best — its victims flip to evicted and the
+    preemptor's demand is reserved on the node — so the next preemptor
+    sees the mutated cluster, exactly like the serial failure path's
+    evict-then-retry (``schedule_one.go`` nominatedNodeName handling).
+    The K-best candidate nodes (best first, -1 = none) go to the host for
+    exact post-reprieve re-ranking."""
+    N, V, R = vic_req.shape
+
+    def step(carry, inp):
+        requested, evicted = carry
+        need_q, prio_q, smask_q = inp
+        evictable = vic_valid & ~evicted & (vic_prio < prio_q)   # [N,V]
+        freed = jnp.cumsum(
+            jnp.where(evictable[..., None], vic_req, 0), axis=1)
+        freed = jnp.concatenate(
+            [jnp.zeros((N, 1, R), freed.dtype), freed], axis=1)  # [N,V+1,R]
+        fits = jnp.all(requested[:, None, :] + need_q[None, None, :] - freed
+                       <= allocatable[:, None, :], axis=-1)      # [N,V+1]
+        feasible = fits & smask_q[:, None]
+        k_min = jnp.argmax(feasible, axis=1)                     # [N]
+        any_f = jnp.any(feasible, axis=1)
+        take = lambda a: jnp.take_along_axis(a, k_min[:, None], axis=1)[:, 0]
+        nvic = take(jnp.concatenate(
+            [jnp.zeros((N, 1), jnp.int32),
+             jnp.cumsum(evictable.astype(jnp.int32), axis=1)], axis=1))
+        viol = take(jnp.concatenate(
+            [jnp.zeros((N, 1), jnp.int32),
+             jnp.cumsum((evictable & vic_violating).astype(jnp.int32),
+                        axis=1)], axis=1))
+        maxp = take(jnp.concatenate(
+            [jnp.full((N, 1), _INT_MIN, jnp.int32),
+             jax.lax.cummax(jnp.where(evictable, vic_prio, _INT_MIN),
+                            axis=1)], axis=1))
+        # a zero-eviction fit means the scheduling failure was something
+        # this resource model can't see (relational/ports/volumes): the
+        # caller must run the exact path for this preemptor — and the scan
+        # must NOT commit anything for it
+        zero_evict = jnp.any(any_f & (nvic == 0))
+        cand = any_f & (nvic > 0)
+        # pickOneNode: staged lexicographic argmin (viol, maxPrio,
+        # nVictims, node order), repeated K times with the winner masked
+        # out — int32-safe (a packed-int64 key would silently truncate
+        # under JAX's default 32-bit ints)
+        BIG = jnp.int32(np.iinfo(np.int32).max)
+
+        def pick_best(avail):
+            m = avail
+            m &= viol == jnp.min(jnp.where(m, viol, BIG))
+            m &= maxp == jnp.min(jnp.where(m, maxp, BIG))
+            m &= nvic == jnp.min(jnp.where(m, nvic, BIG))
+            return jnp.argmax(m)                                 # first idx
+
+        picks = []
+        avail = cand
+        for _ in range(min(_TOPK, N)):
+            n_k = pick_best(avail)
+            picks.append(jnp.where(jnp.any(avail), n_k, -1))
+            avail = avail & (jnp.arange(N) != n_k)
+        cand_nodes = jnp.stack(picks)                            # [K]
+        n_star = jnp.maximum(cand_nodes[0], 0)
+        found = jnp.any(cand) & ~zero_evict
+        k_star = k_min[n_star]
+        evict_sel = (evictable[n_star]
+                     & (jnp.arange(V) < k_star) & found)         # [V]
+        # commit: release victims' capacity, reserve the preemptor's demand
+        delta = need_q - freed[n_star, k_star]
+        requested = requested.at[n_star].add(
+            jnp.where(found, delta, jnp.zeros_like(delta)))
+        evicted = evicted.at[n_star].set(evicted[n_star] | evict_sel)
+        return (requested, evicted), (found, zero_evict,
+                                      cand_nodes.astype(jnp.int32),
+                                      evict_sel)
+
+    (_, _), (found, zero_evict, cand_nodes, evict_sel) = jax.lax.scan(
+        step, (requested0, jnp.zeros((N, V), bool)),
+        (need, prio, static_mask))
+    return found, zero_evict, cand_nodes, evict_sel
+
+
+def _encode_cluster_arrays(nodes, bound_pods, resources, prio_cut,
+                           budgets, dra=None):
+    """Shared host encoding for dry-run programs: per-node totals plus the
+    victim tensors in eviction order (non-violating first, priority asc —
+    SelectVictimsOnNode's two-phase removal). ``prio_cut``: only pods with
+    priority strictly below it are encoded as victims (for a wave, the max
+    preemptor priority; the device re-masks per preemptor).
+    -> (allocatable [N,R], requested [N,R], vic_req, vic_valid,
+        vic_violating, vic_prio, vic_ref [N,V] indices into bound_pods)."""
     from kubernetes_tpu.sched.preemption import _violates
-
-    # resource axes: everything the preemptor demands
-    reqs = dict(pod.resource_requests())
-    if dra is not None:
-        reqs.update(dra.pod_demands(pod))
-    if not reqs:
-        reqs = {"pods": 1}
-    reqs.setdefault("pods", 1)
-    resources = sorted(reqs)
     R = len(resources)
-    need = np.array([scale_request(r, reqs[r]) for r in resources], np.int64)
-
-    name_to_i = {n.metadata.name: i for i, n in enumerate(nodes)}
     N = len(nodes)
+    name_to_i = {n.metadata.name: i for i, n in enumerate(nodes)}
     allocatable = np.zeros((N, R), np.int64)
     for i, n in enumerate(nodes):
         alloc = n.allocatable_canonical()
@@ -139,42 +222,136 @@ def dry_run_candidates(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
         return v
 
     requested = np.zeros((N, R), np.int64)
-    per_node: dict[int, list[Pod]] = {}
-    for p in bound_pods:
+    per_node: dict[int, list[int]] = {}
+    req_cache = {}
+    for idx, p in enumerate(bound_pods):
         i = name_to_i.get(p.spec.node_name)
         if i is None:
             continue
-        requested[i] += req_vec(p)
-        if p.spec.priority < pod.spec.priority:
-            per_node.setdefault(i, []).append(p)
-    if not per_node:
-        return [], False
-
-    # eviction order per node: non-violating victims (priority asc) before
-    # violating ones, exactly like SelectVictimsOnNode's two-phase removal
-    V = next_bucket(max(len(v) for v in per_node.values()), minimum=1)
+        rv = req_vec(p)
+        req_cache[idx] = rv
+        requested[i] += rv
+        if p.spec.priority < prio_cut:
+            per_node.setdefault(i, []).append(idx)
+    V = next_bucket(max((len(v) for v in per_node.values()), default=1),
+                    minimum=1)
     vic_req = np.zeros((N, V, R), np.int64)
     vic_valid = np.zeros((N, V), bool)
     vic_violating = np.zeros((N, V), bool)
     vic_prio = np.zeros((N, V), np.int32)
-    for i, victims in per_node.items():
+    vic_ref = np.full((N, V), -1, np.int32)
+    for i, idxs in per_node.items():
         used = [[ns, sel, allowed, 0] for (ns, sel, allowed) in budgets]
-        flagged = [(p, _violates(p, used))
-                   for p in sorted(victims, key=lambda p: p.spec.priority)]
-        ordered = ([(p, v) for p, v in flagged if not v]
-                   + [(p, v) for p, v in flagged if v])
-        for k, (p, v) in enumerate(ordered):
-            vic_req[i, k] = req_vec(p)
+        flagged = [(idx, _violates(bound_pods[idx], used))
+                   for idx in sorted(
+                       idxs, key=lambda j: bound_pods[j].spec.priority)]
+        ordered = ([(j, v) for j, v in flagged if not v]
+                   + [(j, v) for j, v in flagged if v])
+        for k, (j, v) in enumerate(ordered):
+            vic_req[i, k] = req_cache[j]
             vic_valid[i, k] = True
             vic_violating[i, k] = v
-            vic_prio[i, k] = p.spec.priority
+            vic_prio[i, k] = bound_pods[j].spec.priority
+            vic_ref[i, k] = j
+    return allocatable, requested, vic_req, vic_valid, vic_violating, \
+        vic_prio, vic_ref
+
+
+def dry_run_wave(nodes: list[Node], bound_pods: list[Pod],
+                 preemptors: list[Pod], budgets: list[tuple], dra=None,
+                 static_masks: Optional[np.ndarray] = None
+                 ) -> list:
+    """Device dry-run for a WAVE of preemptors with sequential-commit
+    semantics. -> per-preemptor ``None`` (no resource-feasible eviction
+    set), ``"zero_evict"`` (fits without evicting: failure was relational,
+    run the exact path), or ``(cand_node_indices, [victim Pod, ...])`` —
+    the device's K-best candidate nodes (best first) and its committed
+    victims on the best one, to be exactly verified + re-ranked host-side.
+
+    ``static_masks`` [Q,N]: victim-independent feasibility (taints/affinity/
+    nodeName/unschedulable) per preemptor; computed via the serial host
+    helper when not supplied (callers at fleet scale should supply one from
+    the encoded cluster's filter masks — ops/filters.run_filters)."""
+    reqs_union: dict = {}
+    for pod in preemptors:
+        pr = dict(pod.resource_requests())
+        if dra is not None:
+            pr.update(dra.pod_demands(pod))
+        reqs_union.update(pr)
+    reqs_union.setdefault("pods", 1)
+    resources = sorted(reqs_union)
+    R = len(resources)
+    Q = len(preemptors)
+    need = np.zeros((Q, R), np.int64)
+    prio = np.zeros(Q, np.int32)
+    for q, pod in enumerate(preemptors):
+        pr = dict(pod.resource_requests())
+        if dra is not None:
+            pr.update(dra.pod_demands(pod))
+        pr.setdefault("pods", 1)
+        for j, r in enumerate(resources):
+            need[q, j] = scale_request(r, pr.get(r, 0)) if r != "pods" \
+                else scale_request(r, pr.get(r, 1))
+        prio[q] = pod.spec.priority
+
+    allocatable, requested, vic_req, vic_valid, vic_violating, vic_prio, \
+        vic_ref = _encode_cluster_arrays(
+            nodes, bound_pods, resources, int(prio.max(initial=0)),
+            budgets, dra=dra)
+    if static_masks is None:
+        static_masks = np.stack([_static_mask(nodes, pod)
+                                 for pod in preemptors])
+
+    found, zero_evict, cand_nodes, evict_sel = jax.device_get(_wave_scan(
+        allocatable, requested, static_masks, vic_req, vic_valid,
+        vic_violating, vic_prio, need, prio))
+    out = []
+    for q in range(Q):
+        if zero_evict[q]:
+            out.append("zero_evict")
+        elif not found[q]:
+            out.append(None)
+        else:
+            ni = int(cand_nodes[q][0])
+            victims = [bound_pods[int(vic_ref[ni, k])]
+                       for k in np.flatnonzero(evict_sel[q])]
+            out.append(([int(c) for c in cand_nodes[q] if c >= 0], victims))
+    return out
+
+
+def dry_run_candidates(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
+                       budgets: list[tuple], dra=None
+                       ) -> tuple[list[tuple[tuple, int, int]], bool]:
+    """Device-ranked preemption candidates: ``([(pickOneNode_key,
+    node_index, k_victims)] best-first, zero_evict_exists)``. The candidate
+    list is empty when no node can be made feasible by evicting
+    lower-priority pods (resource-wise); ``zero_evict_exists`` flags nodes
+    that fit WITHOUT evictions — meaning the main cycle's failure was
+    something this dry-run doesn't model (relational/ports/volumes) and the
+    caller should run the exact scan."""
+    # resource axes: everything the preemptor demands
+    reqs = dict(pod.resource_requests())
+    if dra is not None:
+        reqs.update(dra.pod_demands(pod))
+    if not reqs:
+        reqs = {"pods": 1}
+    reqs.setdefault("pods", 1)
+    resources = sorted(reqs)
+    need = np.array([scale_request(r, reqs[r]) for r in resources], np.int64)
+
+    allocatable, requested, vic_req, vic_valid, vic_violating, vic_prio, \
+        _vic_ref = _encode_cluster_arrays(
+            nodes, bound_pods, resources, pod.spec.priority, budgets,
+            dra=dra)
+    if not vic_valid.any():
+        return [], False
 
     any_f, k_min, viols, maxprio = jax.device_get(_dry_run(
         allocatable, requested, _static_mask(nodes, pod),
         vic_req, vic_valid, vic_violating, vic_prio, need))
     out = []
     zero_evict = False
-    for i in range(N):
+    for i in range(len(nodes)):
         if not any_f[i]:
             continue
         if k_min[i] == 0:

@@ -11,6 +11,13 @@ server and its pods are filtered/scored by the one-shot [1,N] device program.
 
 Cluster state: the caller either wires a clientset (nodes + bound pods are
 listed per request) or pushes state via ``set_cluster`` (tests, embedding).
+
+SUPERSEDED for new integrations by the gRPC sidecar
+(``kubernetes_tpu/sidecar/``): the extender re-ships the full node list and
+re-lists cluster state per request and has no staleness protocol, while the
+sidecar holds a generation-tokened resident snapshot kept current by
+deltas. This module remains as the compatibility path for stock schedulers
+that only speak ``extenders:`` config.
 """
 
 from __future__ import annotations

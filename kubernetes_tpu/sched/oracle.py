@@ -150,14 +150,27 @@ class OracleScheduler:
             for st in self.states:
                 for r, q in dra.node_capacity(st.node.metadata.name).items():
                     st.allocatable[r] = scale_allocatable(r, q)
+        # Count of bound pods carrying REQUIRED anti-affinity: the symmetry
+        # veto scan in _pod_ctx walks every bound pod on every call, which
+        # dominated preemption verification at fleet scale — when no bound
+        # pod has such a term (the overwhelmingly common case) the scan is
+        # skipped outright. Maintained by every mutation path.
+        self._n_anti = 0
         for p in bound_pods or []:
             i = self.node_index.get(p.spec.node_name)
             if i is not None:
                 self.states[i].add_pod(p)
                 self._fold_demands(self.states[i], p)
+                self._n_anti += self._has_required_anti(p)
         from kubernetes_tpu.sched.volumebinding import cluster_volume_state
         self._vol_rwo, self._vol_attach, self._vol_rwop = cluster_volume_state(
             [p for st in self.states for p in st.pods], volumes)
+
+    @staticmethod
+    def _has_required_anti(p: Pod) -> bool:
+        aff = p.spec.affinity
+        return bool(aff and aff.pod_anti_affinity
+                    and aff.pod_anti_affinity.required)
 
     def _fold_demands(self, st: NodeState, pod: Pod, sign: int = 1):
         """Fold a pod's DRA device demands into the node's requested map."""
@@ -283,7 +296,7 @@ class OracleScheduler:
         # pod's required anti-affinity matches this pod. The term resolves
         # against the EXISTING pod's namespace + labels (it owns the term).
         sym_veto: set[tuple[str, str]] = set()
-        for other_st in self.states:
+        for other_st in (self.states if self._n_anti else ()):
             for p in other_st.pods:
                 paff = p.spec.affinity
                 pananti = paff.pod_anti_affinity if paff else None
@@ -418,6 +431,7 @@ class OracleScheduler:
             return
         self.states[i].remove_pod(pod)
         self._fold_demands(self.states[i], pod, sign=-1)
+        self._n_anti -= self._has_required_anti(pod)
         self._refresh_volume_state()
 
     def restore_bound(self, pod: Pod) -> None:
@@ -427,6 +441,7 @@ class OracleScheduler:
             return
         self.states[i].add_pod(pod)
         self._fold_demands(self.states[i], pod)
+        self._n_anti += self._has_required_anti(pod)
         self._refresh_volume_state()
 
     def _refresh_volume_state(self) -> None:
@@ -629,6 +644,7 @@ class OracleScheduler:
         pod.spec.node_name = self.states[node_idx].node.metadata.name
         self.states[node_idx].add_pod(pod)
         self._fold_demands(self.states[node_idx], pod)
+        self._n_anti += self._has_required_anti(pod)
 
     def schedule_all(self, pods: list[Pod]):
         """Serial loop over the batch (ScheduleOne x N) in activeQ order —

@@ -20,8 +20,11 @@ Two paths:
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import Optional
+
+_LOG = logging.getLogger(__name__)
 
 from kubernetes_tpu.api.policy import _matches, compute_pdb_status
 from kubernetes_tpu.api.types import Node, Pod
@@ -68,6 +71,8 @@ def _violates(pod: Pod, budgets_used: list) -> bool:
 
 def find_candidate(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
                    pdbs: Optional[list[dict]] = None, dra=None,
+                   orc: Optional[OracleScheduler] = None,
+                   budgets: Optional[list] = None,
                    ) -> Optional[PreemptionResult]:
     """Find the best node + minimal victim set enabling ``pod`` to schedule.
 
@@ -77,12 +82,16 @@ def find_candidate(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
     victims. A budget MAY be violated as a last resort, exactly as upstream.
     Candidate selection mirrors pickOneNodeForPreemption: fewest PDB
     violations, then min highest-victim-priority, then min victim count,
-    then node order.
+    then node order. ``orc``/``budgets``: a caller-maintained simulation +
+    live budget accounting (the wave path threads one oracle through many
+    preemptors instead of rebuilding O(nodes x bound) state per call).
     """
-    budgets = _pdb_budgets(pdbs or [], bound_pods)
+    if budgets is None:
+        budgets = _pdb_budgets(pdbs or [], bound_pods)
     # one shared simulation, mutated and restored per node trial — building
     # a fresh oracle per candidate node is O(nodes x bound) each
-    orc = OracleScheduler(nodes, bound_pods, dra=dra)
+    if orc is None:
+        orc = OracleScheduler(nodes, bound_pods, dra=dra)
     best: Optional[tuple] = None
     for i, node in enumerate(nodes):
         found = _victims_on_node(nodes, bound_pods, pod, node, budgets,
@@ -120,6 +129,8 @@ def find_candidate_tensor(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
         cands, zero_evict = dry_run_candidates(nodes, bound_pods, pod,
                                                budgets, dra=dra)
     except Exception:
+        _LOG.exception("preemption dry-run device program failed; "
+                       "degrading to the exact host scan")
         return find_candidate(nodes, bound_pods, pod, pdbs=pdbs, dra=dra)
     if zero_evict:
         # some node fits without evicting anyone: the main-cycle failure was
@@ -128,18 +139,200 @@ def find_candidate_tensor(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
     if not cands:
         return None  # no node becomes resource-feasible by evicting
     orc = OracleScheduler(nodes, bound_pods, dra=dra)
+    # Exactly evaluate EVERY candidate within the verify budget and re-rank
+    # by the exact post-reprieve pickOneNode key: the device key uses
+    # pre-reprieve estimates, which can rank a different node first than
+    # the reference's pickOneNodeForPreemption would.
+    best: Optional[tuple] = None
     for _key, ni, _k in cands[:verify_limit]:
         found = _victims_on_node(nodes, bound_pods, pod, nodes[ni], budgets,
                                  dra=dra, orc=orc)
-        if found is not None:
-            victims, violations = found
-            return PreemptionResult(
-                node_name=nodes[ni].metadata.name,
-                victims=sorted(victims, key=lambda p: p.spec.priority),
-                num_pdb_violations=violations)
+        if found is None:
+            continue
+        victims, violations = found
+        key = (violations,
+               max((v.spec.priority for v in victims), default=-1),
+               len(victims), ni)
+        if best is None or key < best[0]:
+            best = (key, ni, victims, violations)
+    if best is not None:
+        _key, ni, victims, violations = best
+        return PreemptionResult(
+            node_name=nodes[ni].metadata.name,
+            victims=sorted(victims, key=lambda p: p.spec.priority),
+            num_pdb_violations=violations)
     # ranked candidates failed exact verification (relational terms the
     # dry-run doesn't model): the serial scan is the source of truth
     return find_candidate(nodes, bound_pods, pod, pdbs=pdbs, dra=dra)
+
+
+def _charge_budgets(budgets: list, victim: Pod) -> None:
+    """Evicting ``victim`` consumes one disruption from every covering PDB —
+    live accounting threaded across a wave (may go negative: a budget
+    violated as a last resort stays violated for later preemptors)."""
+    for entry in budgets:
+        ns, sel, _allowed = entry[0], entry[1], entry[2]
+        if victim.metadata.namespace == ns and _matches(
+                sel, victim.metadata.labels):
+            entry[2] -= 1
+
+
+# The victim-INDEPENDENT filter set: evicting pods can never change these
+# verdicts (ports/volumes/relational CAN change, and are settled by exact
+# host verification instead). One definition, shared by the wave's own
+# encoder path and the scheduler's resident-encoding path.
+STATIC_FILTERS = frozenset({"NodeUnschedulable", "NodeName", "NodeAffinity",
+                            "TaintToleration"})
+
+
+def tensor_static_masks(nodes, preemptors, ct=None, meta=None,
+                        bound_pods=None, encode_pods=None) -> "np.ndarray":
+    """[Q,N] victim-independent feasibility via the encoded filter masks —
+    ONE device program instead of Q x N host-side oracle probes, which
+    dominated wave setup at fleet scale. Pass an already-encoded cluster
+    (``ct``/``meta`` + an ``encode_pods(pods, meta)`` callable — e.g. the
+    scheduler cache's) to skip the fresh encode."""
+    import jax
+    import numpy as np
+    from kubernetes_tpu.ops.filters import run_filters
+    if ct is None:
+        from kubernetes_tpu.encode.snapshot import SnapshotEncoder
+        enc = SnapshotEncoder()
+        ct, meta = enc.encode_cluster(nodes, bound_pods or [])
+        encode_pods = enc.encode_pods
+    pb = encode_pods(preemptors, meta)
+    mask = np.asarray(jax.device_get(
+        run_filters(ct, pb, enabled=STATIC_FILTERS)))
+    return mask[:len(preemptors), :len(nodes)]
+
+
+def preempt_wave(nodes: list[Node], bound_pods: list[Pod],
+                 preemptors: list[Pod], pdbs: Optional[list[dict]] = None,
+                 dra=None, static_masks=None
+                 ) -> list[Optional[PreemptionResult]]:
+    """Resolve a WAVE of preemptors with sequential-commit semantics in one
+    device program + one shared host simulation.
+
+    Reference behavior being batched: the failure path runs
+    ``DryRunPreemption`` per pod, evicts, and the next failed pod sees the
+    mutated cluster. Here the [Q,N,V+1] scan (ops/preemption.py
+    ``_wave_scan``) commits each winner's victims and reservation into the
+    device-side state, and the host EXACTLY verifies each proposal in wave
+    order against ONE OracleScheduler that absorbs the committed evictions
+    and nominee reservations — so results are identical in soundness to Q
+    serial ``find_candidate_tensor`` calls, minus Q re-encodes of the
+    cluster and Q oracle rebuilds (the 0.67s/preemptor host tax VERDICT r3
+    flagged).
+
+    Returns one ``PreemptionResult | None`` per preemptor, in order."""
+    import numpy as np
+    from kubernetes_tpu.ops.preemption import dry_run_wave
+    if not preemptors:
+        return []
+    budgets = _pdb_budgets(pdbs or [], bound_pods)
+    if static_masks is None and len(preemptors) * len(nodes) > (1 << 14):
+        try:
+            static_masks = tensor_static_masks(nodes, preemptors,
+                                               bound_pods=bound_pods)
+        except Exception:
+            _LOG.exception("tensor static masks failed; using host helper")
+            static_masks = None  # host helper path inside dry_run_wave
+    try:
+        proposals = dry_run_wave(nodes, bound_pods, preemptors, budgets,
+                                 dra=dra, static_masks=static_masks)
+    except Exception:
+        # every preemptor degrades to the serial exact scan — correct but
+        # ~three orders slower; never let that happen silently
+        _LOG.exception("preemption wave device program failed; "
+                       "degrading %d preemptors to the exact host scan",
+                       len(preemptors))
+        proposals = ["zero_evict"] * len(preemptors)
+
+    import dataclasses
+    orc = OracleScheduler(nodes, bound_pods, dra=dra)
+    live = list(bound_pods)
+    budgets_live = [[ns, sel, allowed] for (ns, sel, allowed) in budgets]
+    results: list[Optional[PreemptionResult]] = []
+    # Drift accounting: a host REPRIEVE evicts fewer victims than the device
+    # committed, leaving the device state only OPTIMISTIC about capacity —
+    # a device "no" stays trustworthy. Anything that makes the device state
+    # PESSIMISTIC — a phantom commit the host rejected outright, a fallback
+    # commit the device never saw, a different node chosen by the exact
+    # re-rank, or the host evicting pods outside the device's set — flips
+    # ``drifted`` and later device "no"s are re-checked exactly.
+    drifted = False
+    for pod, prop in zip(preemptors, proposals):
+        res: Optional[PreemptionResult] = None
+        via_fallback = False
+        dev_victims = None
+        snap = [tuple(b) for b in budgets_live]
+        if prop is None and not drifted:
+            # no resource-feasible eviction set exists device-side; since
+            # evictions only ever free resources and the device state is
+            # not pessimistic, the exact path cannot succeed either
+            results.append(None)
+            continue
+        if prop == "zero_evict" or prop is None:
+            res = find_candidate(nodes, live, pod, dra=dra, orc=orc,
+                                 budgets=snap)
+            via_fallback = True
+        else:
+            cand_idxs, dev_vs = prop
+            dev_victims = {v.metadata.uid for v in dev_vs}
+            # exactly verify the device's K-best candidates and re-rank by
+            # the exact post-reprieve pickOneNode key (mirrors
+            # find_candidate_tensor's verify_limit pass)
+            best: Optional[tuple] = None
+            for ni in cand_idxs:
+                found = _victims_on_node(nodes, live, pod, nodes[ni], snap,
+                                         dra=dra, orc=orc)
+                if found is None:
+                    continue
+                victims, violations = found
+                key = (violations,
+                       max((v.spec.priority for v in victims), default=-1),
+                       len(victims), ni)
+                if best is None or key < best[0]:
+                    best = (key, ni, victims, violations)
+            if best is not None:
+                _key, ni, victims, violations = best
+                res = PreemptionResult(
+                    node_name=nodes[ni].metadata.name,
+                    victims=sorted(victims, key=lambda p: p.spec.priority),
+                    num_pdb_violations=violations)
+            else:
+                # every ranked candidate failed exact verification
+                # (relational terms, or drift from earlier commits)
+                res = find_candidate(nodes, live, pod, dra=dra, orc=orc,
+                                     budgets=snap)
+                via_fallback = True
+        # drift bookkeeping (device committed on its TOP candidate)
+        if dev_victims is not None:
+            if res is None:
+                drifted = True  # phantom device commit, host found nothing
+            else:
+                host_victims = {v.metadata.uid for v in res.victims}
+                dev_node = nodes[prop[0][0]].metadata.name
+                if (via_fallback or res.node_name != dev_node
+                        or not host_victims <= dev_victims):
+                    drifted = True
+        elif res is not None:
+            drifted = True  # fallback commit the device never saw
+        if res is not None:
+            # commit: evictions + the nominee's reservation become the
+            # state every later preemptor is verified against
+            evicted = {v.metadata.uid for v in res.victims}
+            for v in res.victims:
+                orc.remove_bound(v)
+                _charge_budgets(budgets_live, v)
+            live = [p for p in live if p.metadata.uid not in evicted]
+            nominee = dataclasses.replace(
+                pod, spec=dataclasses.replace(pod.spec,
+                                              node_name=res.node_name))
+            orc.restore_bound(nominee)
+            live.append(nominee)
+        results.append(res)
+    return results
 
 
 def _victims_on_node(nodes, bound_pods, pod, node, budgets, dra=None,

@@ -1,0 +1,17 @@
+"""TPU scheduling sidecar — the gRPC bridge a reference-world scheduler
+delegates to (SURVEY §7 phase 7, the north star's integration story).
+
+Supersedes the legacy HTTP extender protocol (``sched/extender_server.py``,
+reference ``pkg/scheduler/extender.go`` ``HTTPExtender``): where the extender
+is stateless request/response JSON with the full node list per call, the
+sidecar holds a device-resident snapshot pushed ONCE and kept current by
+deltas, and every scheduling batch is tagged with the pusher's snapshot
+generation — stale generations are rejected so an optimistic client
+(assume-before-confirm, like the reference's ``AssumePod``) can never get
+placements computed against state it has already advanced past.
+"""
+
+from kubernetes_tpu.sidecar.server import SidecarServer
+from kubernetes_tpu.sidecar.client import SidecarClient
+
+__all__ = ["SidecarServer", "SidecarClient"]

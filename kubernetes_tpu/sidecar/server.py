@@ -1,0 +1,258 @@
+"""Sidecar server — the TPU scheduling engine behind a gRPC service.
+
+Reference shape being replaced: ``pkg/scheduler/extender.go`` sends the full
+candidate node list with EVERY HTTP request and gets names back. Here the
+cluster lives device-adjacent: one PushSnapshot, then deltas, and each
+Filter/Score/Schedule batch is one device program over the resident
+encoding (encode/snapshot.py + ops/ + models/gang.py) — the same engine the
+in-process scheduler uses, exported across the process boundary the north
+star requires (Go scheduler -> Python/TPU sidecar).
+
+Generation discipline: the CLIENT owns the generation counter (its informer
+cache's delta generation — sched/cache.py delta_info is the in-process
+twin). The engine only ever answers batches tagged with exactly its applied
+generation; anything else is a STALE reject carrying the server's
+generation so the client knows which deltas to re-push.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent import futures
+from typing import Optional
+
+import numpy as np
+
+from kubernetes_tpu.sidecar import proto
+
+_LOG = logging.getLogger(__name__)
+
+
+class StaleGeneration(Exception):
+    def __init__(self, server_gen: int):
+        super().__init__(f"stale generation (server at {server_gen})")
+        self.server_gen = server_gen
+
+
+class _Engine:
+    """Snapshot + deltas -> encoded cluster; batches -> device programs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._nodes: dict[str, dict] = {}
+        self._pods: dict[str, dict] = {}
+        self._gen: Optional[int] = None
+        self._profile: dict = {}
+        self._encoder = None
+        self._encoded = None  # (gen, nodes list, ct, meta)
+
+    @staticmethod
+    def _pod_key(d: dict) -> str:
+        md = d.get("metadata") or {}
+        return f"{md.get('namespace', 'default')}/{md.get('name', '')}"
+
+    def snapshot(self, nodes: list[dict], pods: list[dict], gen: int,
+                 profile: Optional[dict] = None):
+        with self._lock:
+            self._nodes = {(n.get("metadata") or {}).get("name", ""): n
+                           for n in nodes}
+            self._pods = {self._pod_key(p): p for p in pods
+                          if (p.get("spec") or {}).get("nodeName")}
+            self._gen = gen
+            if profile is not None:
+                self._profile = dict(profile)
+            self._encoded = None
+            return self._gen
+
+    def delta(self, base_gen: int, gen: int, upserts: list[dict],
+              deletes: list[str], node_upserts: list[dict],
+              node_deletes: list[str]) -> int:
+        with self._lock:
+            if self._gen is None or base_gen != self._gen:
+                raise StaleGeneration(-1 if self._gen is None else self._gen)
+            for p in upserts:
+                k = self._pod_key(p)
+                if (p.get("spec") or {}).get("nodeName"):
+                    self._pods[k] = p
+                else:
+                    self._pods.pop(k, None)
+            for k in deletes:
+                self._pods.pop(k, None)
+            for n in node_upserts:
+                self._nodes[(n.get("metadata") or {}).get("name", "")] = n
+            for name in node_deletes:
+                self._nodes.pop(name, None)
+            self._gen = gen
+            self._encoded = None
+            return self._gen
+
+    def _require(self, gen: int):
+        if self._gen is None or gen != self._gen:
+            raise StaleGeneration(-1 if self._gen is None else self._gen)
+
+    def _encoded_cluster(self, pending: list):
+        """Encoded cluster at the current generation (cached across batches
+        at the same generation — the device-resident snapshot). A batch
+        demanding a resource outside the cached axis forces a re-encode
+        (the cache's 'widen' check, sched/cache.py _snapshot_serialized —
+        the encoder zeroes unknown resources, which would silently admit
+        the pod anywhere)."""
+        from kubernetes_tpu.api.types import Node, Pod
+        from kubernetes_tpu.encode.snapshot import SnapshotEncoder
+        if self._encoder is None:
+            self._encoder = SnapshotEncoder()
+        enc = self._encoded
+        if enc is not None and enc[0] == self._gen:
+            _, nodes, ct, meta = enc
+            known = set(meta.resources)
+            if not any(r not in known for p in pending
+                       for r in p.resource_requests()):
+                return nodes, ct, meta
+        nodes = [Node.from_dict(d) for d in self._nodes.values()]
+        bound = [Pod.from_dict(d) for d in self._pods.values()]
+        ct, meta = self._encoder.encode_cluster(nodes, bound,
+                                               pending_pods=pending)
+        self._encoded = (self._gen, nodes, ct, meta)
+        return nodes, ct, meta
+
+    def _batch(self, pod_dicts: list[dict], gen: int):
+        from kubernetes_tpu.api.types import Pod
+        self._require(gen)
+        pods = [Pod.from_dict(d) for d in pod_dicts]
+        nodes, ct, meta = self._encoded_cluster(pods)
+        pb = self._encoder.encode_pods(pods, meta)
+        return pods, nodes, ct, meta, pb
+
+    def filter(self, pod_dicts: list[dict], gen: int) -> dict:
+        import jax
+        from kubernetes_tpu.ops.filters import run_filters
+        with self._lock:
+            pods, nodes, ct, meta, pb = self._batch(pod_dicts, gen)
+            mask = np.asarray(jax.device_get(run_filters(
+                ct, pb, enabled=self._enabled())))
+            m = mask[:len(pods), :len(nodes)]
+            return {"mask": np.packbits(m, axis=None).tobytes(),
+                    "pods": len(pods), "nodes": len(nodes)}
+
+    def score(self, pod_dicts: list[dict], gen: int) -> dict:
+        import jax
+        from kubernetes_tpu.ops.filters import run_filters
+        from kubernetes_tpu.ops.scores import combined_score
+        with self._lock:
+            pods, nodes, ct, meta, pb = self._batch(pod_dicts, gen)
+            mask = run_filters(ct, pb, enabled=self._enabled())
+            scores = np.asarray(jax.device_get(combined_score(
+                ct, pb, mask, weights=self._weights(),
+                fit_strategy=self._profile.get("fit_strategy",
+                                               "LeastAllocated"))))
+            s = scores[:len(pods), :len(nodes)].astype(np.float32)
+            return {"scores": s.tobytes(), "pods": len(pods),
+                    "nodes": len(nodes)}
+
+    def schedule(self, pod_dicts: list[dict], gen: int) -> dict:
+        from kubernetes_tpu.models.gang import gang_schedule
+        with self._lock:
+            pods, nodes, ct, meta, pb = self._batch(pod_dicts, gen)
+            assignment, rounds = gang_schedule(
+                ct, pb, seed=0,
+                fit_strategy=self._profile.get("fit_strategy",
+                                               "LeastAllocated"),
+                topo_keys=meta.topo_keys,
+                weights=self._weights(),
+                enabled_filters=self._enabled())
+            out = []
+            for i in range(len(pods)):
+                a = int(assignment[i])
+                out.append(meta.node_names[a] if a >= 0 else "")
+            return {"assignments": out, "rounds": int(rounds)}
+
+    def _enabled(self):
+        ef = self._profile.get("enabled_filters")
+        return tuple(ef) if ef else None
+
+    def _weights(self):
+        w = self._profile.get("weights")
+        return dict(w) if w else None
+
+
+class SidecarServer:
+    """gRPC server exporting the engine. ``start()`` binds and serves;
+    unary methods + the ``Session`` bidi stream share one engine."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 8):
+        import grpc
+        self.engine = _Engine()
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        self._server.add_generic_rpc_handlers((self._handler(),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.address = f"{host}:{self.port}"
+
+    # ---- dispatch --------------------------------------------------------
+
+    def _dispatch(self, method: str, req: dict) -> dict:
+        eng = self.engine
+        try:
+            if method == "PushSnapshot":
+                gen = eng.snapshot(req.get("nodes", []), req.get("pods", []),
+                                   int(req["generation"]),
+                                   profile=req.get("profile"))
+                return {"generation": gen}
+            if method == "PushDelta":
+                gen = eng.delta(int(req["base_generation"]),
+                                int(req["generation"]),
+                                req.get("upserts", []),
+                                req.get("deletes", []),
+                                req.get("node_upserts", []),
+                                req.get("node_deletes", []))
+                return {"generation": gen}
+            if method == "Filter":
+                return eng.filter(req.get("pods", []),
+                                  int(req["generation"]))
+            if method == "Score":
+                return eng.score(req.get("pods", []), int(req["generation"]))
+            if method == "Schedule":
+                return eng.schedule(req.get("pods", []),
+                                    int(req["generation"]))
+            return {"error": f"unknown method {method!r}"}
+        except StaleGeneration as e:
+            return proto.stale(e.server_gen)
+        except Exception as e:  # engine errors surface as frames, not aborts
+            _LOG.exception("sidecar %s failed", method)
+            return {"error": str(e)}
+
+    def _handler(self):
+        import grpc
+        server = self
+
+        def unary(method):
+            def call(req: dict, ctx) -> dict:
+                return server._dispatch(method, req)
+            return grpc.unary_unary_rpc_method_handler(
+                call, request_deserializer=proto.unpack,
+                response_serializer=proto.pack)
+
+        def session(request_iterator, ctx):
+            for frame in request_iterator:
+                kind = frame.get("kind", "")
+                resp = server._dispatch(kind, frame)
+                resp["seq"] = frame.get("seq", 0)
+                resp["kind"] = kind
+                yield resp
+
+        handlers = {m: unary(m) for m in proto.METHODS}
+        handlers[proto.STREAM_METHOD] = grpc.stream_stream_rpc_method_handler(
+            session, request_deserializer=proto.unpack,
+            response_serializer=proto.pack)
+        return grpc.method_handlers_generic_handler(proto.SERVICE, handlers)
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> "SidecarServer":
+        self._server.start()
+        return self
+
+    def stop(self, grace: float = 1.0):
+        self._server.stop(grace).wait()

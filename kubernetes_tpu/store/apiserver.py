@@ -525,7 +525,12 @@ class APIServer:
                 else:
                     try:
                         out = json.loads(raw)
-                    except json.JSONDecodeError as e:
+                    except (json.JSONDecodeError, UnicodeDecodeError,
+                            ValueError) as e:
+                        # UnicodeDecodeError covers binary bodies reaching a
+                        # JSON-only server — the 400 text is what a msgpack
+                        # client's downgrade probe keys on, so it must be
+                        # produced, not a dead handler thread
                         raise _BadRequest(f"invalid JSON body: {e}") from None
                 if not isinstance(out, dict):
                     raise _BadRequest("body must be a JSON object")

@@ -123,3 +123,143 @@ def test_tensor_randomized_parity(seed):
     exact = find_candidate(nodes, bound, pod)
     tensor = find_candidate_tensor(nodes, bound, pod)
     assert _same(exact, tensor)
+
+
+# ---- wave batching (ops/preemption.py _wave_scan + preempt_wave) ---------
+
+def _serial_wave(nodes, bound, preemptors, pdbs=None):
+    """Ground truth: the serial failure path — one exact find_candidate per
+    preemptor, committing evictions + the nominee's reservation between
+    calls (schedule_one.go evict-then-retry semantics)."""
+    import dataclasses
+    from kubernetes_tpu.sched.preemption import find_candidate
+    live = list(bound)
+    out = []
+    for pod in preemptors:
+        res = find_candidate(nodes, live, pod, pdbs=pdbs)
+        if res is not None:
+            gone = {v.metadata.uid for v in res.victims}
+            live = [p for p in live if p.metadata.uid not in gone]
+            live.append(dataclasses.replace(
+                pod, spec=dataclasses.replace(pod.spec,
+                                              node_name=res.node_name)))
+        out.append(res)
+    return out
+
+
+def _same_wave(a, b):
+    return len(a) == len(b) and all(_same(x, y) for x, y in zip(a, b))
+
+
+def test_wave_matches_serial_basic():
+    from kubernetes_tpu.sched.preemption import preempt_wave
+    nodes = [make_node(f"n{i}").capacity({"cpu": "8", "pods": "16"}).obj()
+             for i in range(6)]
+    bound = []
+    for i in range(6):
+        for j in range(2):
+            bound.append(make_pod(f"v{i}-{j}").req({"cpu": "4"})
+                         .priority(1 + (i + j) % 3).node(f"n{i}").obj())
+    preemptors = [make_pod(f"hi{k}").req({"cpu": "6"}).priority(100).obj()
+                  for k in range(4)]
+    wave = preempt_wave(nodes, bound, preemptors)
+    serial = _serial_wave(nodes, bound, preemptors)
+    assert sum(r is not None for r in serial) == 4
+    assert _same_wave(wave, serial)
+
+
+def test_wave_sequential_commit_prevents_double_spend():
+    """Two preemptors, one node with one evictable victim: only the first
+    may win; the second must see the nominee's reservation and fail."""
+    from kubernetes_tpu.sched.preemption import preempt_wave
+    nodes = [make_node("n0").capacity({"cpu": "4"}).obj()]
+    bound = [make_pod("low").req({"cpu": "4"}).priority(1).node("n0").obj()]
+    preemptors = [make_pod("a").req({"cpu": "4"}).priority(100).obj(),
+                  make_pod("b").req({"cpu": "4"}).priority(100).obj()]
+    wave = preempt_wave(nodes, bound, preemptors)
+    assert wave[0] is not None and wave[0].node_name == "n0"
+    assert [v.metadata.name for v in wave[0].victims] == ["low"]
+    assert wave[1] is None
+
+
+def test_wave_mixed_priorities_respects_cutoff():
+    """A preemptor only evicts strictly lower priorities; the wave's
+    per-preemptor device masking must agree with serial."""
+    from kubernetes_tpu.sched.preemption import preempt_wave
+    nodes = [make_node("n0").capacity({"cpu": "4"}).obj(),
+             make_node("n1").capacity({"cpu": "4"}).obj()]
+    bound = [make_pod("p10").req({"cpu": "4"}).priority(10).node("n0").obj(),
+             make_pod("p40").req({"cpu": "4"}).priority(40).node("n1").obj()]
+    preemptors = [make_pod("mid").req({"cpu": "2"}).priority(20).obj(),
+                  make_pod("top").req({"cpu": "2"}).priority(99).obj()]
+    wave = preempt_wave(nodes, bound, preemptors)
+    serial = _serial_wave(nodes, bound, preemptors)
+    assert _same_wave(wave, serial)
+    assert wave[0] is not None and wave[0].node_name == "n0"
+
+
+def test_wave_pdb_budgets_thread_through():
+    from kubernetes_tpu.sched.preemption import preempt_wave
+    nodes = [make_node(f"n{i}").capacity({"cpu": "4"}).obj()
+             for i in range(3)]
+    bound = [make_pod(f"db{i}").req({"cpu": "4"}).priority(1)
+             .node(f"n{i}").label("app", "db").obj() for i in range(3)]
+    # minAvailable 2 of 3: only ONE disruption allowed across the wave
+    pdbs = [{"metadata": {"name": "db-pdb", "namespace": "default"},
+             "spec": {"minAvailable": 2,
+                      "selector": {"matchLabels": {"app": "db"}}}}]
+    preemptors = [make_pod(f"hi{k}").req({"cpu": "4"}).priority(100).obj()
+                  for k in range(2)]
+    wave = preempt_wave(nodes, bound, preemptors, pdbs=pdbs)
+    serial = _serial_wave(nodes, bound, preemptors, pdbs=pdbs)
+    assert _same_wave(wave, serial)
+    assert wave[0] is not None and wave[0].num_pdb_violations == 0
+    # the second preemption must register as a budget violation
+    assert wave[1] is not None and wave[1].num_pdb_violations == 1
+
+
+@pytest.mark.parametrize("seed", [3, 4, 5, 6])
+def test_wave_randomized_parity(seed):
+    from kubernetes_tpu.sched.preemption import preempt_wave
+    rng = random.Random(seed)
+    nodes = [make_node(f"n{i}").capacity(
+        {"cpu": str(rng.choice([2, 4, 8])),
+         "memory": f"{rng.choice([4, 8])}Gi"}).obj() for i in range(8)]
+    bound = []
+    for i in range(8):
+        for j in range(rng.randint(0, 4)):
+            bound.append(
+                make_pod(f"v{i}-{j}")
+                .req({"cpu": str(rng.choice([1, 2])),
+                      "memory": f"{rng.choice([1, 2])}Gi"})
+                .priority(rng.randint(0, 20)).node(f"n{i}").obj())
+    preemptors = [
+        make_pod(f"pre{k}")
+        .req({"cpu": str(rng.choice([1, 2, 3])), "memory": "2Gi"})
+        .priority(rng.randint(10, 30)).obj() for k in range(5)]
+    wave = preempt_wave(nodes, bound, preemptors)
+    serial = _serial_wave(nodes, bound, preemptors)
+    assert _same_wave(wave, serial)
+
+
+def test_wave_phantom_commit_does_not_blind_later_preemptors():
+    """Regression: preemptor A's device proposal commits victims in the
+    scan carry, but host verification rejects it (anti-affinity) and finds
+    nothing; preemptor B must NOT inherit the device's phantom eviction as
+    a trusted 'no' — serial ground truth says B can preempt."""
+    from kubernetes_tpu.sched.preemption import preempt_wave
+    nodes = [make_node("n0").capacity({"cpu": "8"}).label("zone", "z").obj()]
+    bound = [
+        make_pod("v").req({"cpu": "6"}).priority(1).node("n0").obj(),
+        make_pod("h").req({"cpu": "1"}).priority(200).node("n0")
+        .label("team", "x").obj(),
+    ]
+    a = (make_pod("a").req({"cpu": "6"}).priority(100)
+         .pod_anti_affinity("zone", {"team": "x"}).obj())
+    b = make_pod("b").req({"cpu": "6"}).priority(100).obj()
+    wave = preempt_wave(nodes, bound, [a, b])
+    serial = _serial_wave(nodes, bound, [a, b])
+    assert _same_wave(wave, serial)
+    assert wave[0] is None
+    assert wave[1] is not None and wave[1].node_name == "n0"
+    assert [v.metadata.name for v in wave[1].victims] == ["v"]

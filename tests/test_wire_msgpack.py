@@ -156,3 +156,20 @@ def test_runner_fast_confirm_via_watch(api):
         assert runner.cache.is_bound("default/fc")
     finally:
         runner.stop()
+
+
+def test_msgpack_client_downgrades_against_json_only_server(monkeypatch):
+    """A server without msgpack must answer a binary body with the 400 the
+    client's downgrade probe keys on — then the client permanently falls
+    back to the JSON wire and the request succeeds."""
+    import kubernetes_tpu.store.apiserver as apiserver_mod
+    monkeypatch.setattr(apiserver_mod, "_msgpack", None)
+    server = APIServer().start()
+    try:
+        c = HTTPClient(server.url)  # msgpack default
+        assert c._mp is not None
+        c.pods("default").create(make_pod("dg").obj().to_dict())
+        assert c._mp is None  # downgraded after the probe
+        assert c.pods("default").get("dg")["metadata"]["name"] == "dg"
+    finally:
+        server.stop()
