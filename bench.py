@@ -75,6 +75,19 @@ def main():
             log=log)
         log("[bench] " + json.dumps(preemption))
 
+    pallas = None
+    if os.environ.get("BENCH_PALLAS", "1") != "0" and not only_case:
+        # the domain-count hot op: live XLA number + the recorded round-4
+        # measurement that retired the Pallas kernel (prove-or-delete);
+        # in-process — the bench already owns the single TPU client
+        from benchmarks.pallas_bench import run_domain_count
+        log("[bench] domain-count hot-op run ...")
+        try:
+            pallas = run_domain_count()
+        except Exception as e:
+            pallas = {"error": str(e)}
+        log("[bench] " + json.dumps(pallas))
+
     connected_preemption = None
     if os.environ.get("BENCH_PREEMPTION", "1") != "0" and not only_case:
         from benchmarks.connected import run_connected_preemption
@@ -108,10 +121,13 @@ def main():
             {"case": r["case"], "workload": r["workload"],
              "pods_per_sec": r["SchedulingThroughput"],
              "p99_s": r.get("p99_schedule_latency_s"),
-             "passed": r["passed"]} for r in results],
+             "passed": r["passed"],
+             **({"churn_api_ops": r["churn_api_ops"], "connected": True}
+                if "churn_api_ops" in r else {})} for r in results],
         "connected": connected,
         "preemption": preemption,
         "connected_preemption": connected_preemption,
+        "pallas": pallas,
     }
     print(json.dumps(out))
 

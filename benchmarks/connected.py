@@ -86,6 +86,7 @@ def _churn_loop(client, stop, period_s: float = 0.1, counter=None) -> None:
 def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
                   batch_size: int = 512, drain_batches: int = 8,
                   timeout: float = 300.0, churn: bool = False,
+                  churn_period_s: float = 0.1,
                   log=lambda *a: None) -> dict:
     from kubernetes_tpu.client.clientset import HTTPClient
     from kubernetes_tpu.config.types import SchedulerConfiguration
@@ -132,7 +133,8 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
             churn_stop = threading.Event()
             threading.Thread(target=_churn_loop,
                              args=(HTTPClient(url), churn_stop),
-                             kwargs={"counter": churn_stats},
+                             kwargs={"counter": churn_stats,
+                                     "period_s": churn_period_s},
                              daemon=True).start()
 
         from kubernetes_tpu.utils.tracing import TRACER

@@ -104,6 +104,11 @@ def run_workload(case: dict, workload: dict, scale: float = 1.0,
     from kubernetes_tpu.models.gang import gang_drain, prepare_drain
 
     params = {k: max(1, int(v * scale)) for k, v in workload["params"].items()}
+    churn_op = next((op for op in case["workloadTemplate"]
+                     if op["opcode"] == "churn"), None)
+    if churn_op is not None:
+        return _run_churn_workload(case, workload, params, churn_op, log,
+                                   scale=scale, batch=batch)
     nodes, measured, warm = materialize(case, params)
     log(f"  materialized {len(nodes)} nodes, {len(measured)} measured pods")
 
@@ -160,6 +165,45 @@ def run_workload(case: dict, workload: dict, scale: float = 1.0,
         "scheduled": scheduled, "pods": len(measured), "nodes": len(nodes),
         "encode_s": round(encode_s, 2), "compile_s": round(compile_s, 2),
         "measure_s": round(dt, 2),
+        "thresholds": thresholds, "passed": passed,
+    }
+
+
+def _run_churn_workload(case: dict, workload: dict, params: dict,
+                        churn_op: dict, log, scale: float = 1.0,
+                        batch: int = 512) -> dict:
+    """The ``churn`` opcode (upstream scheduler_perf's API-churn op): churn
+    is an INTEGRATION-level behavior — nodes and unrelated pods recycling
+    through the API while the measured pods schedule — so it runs through
+    the CONNECTED harness (live apiserver + informers + the resident drain
+    context's invalidate-and-rebuild path), not the raw device drain.
+    Reference: test/integration/scheduler_perf/scheduler_perf.go
+    (churnOp, Recreate mode)."""
+    from benchmarks.connected import run_connected
+    mode = churn_op.get("mode", "recreate")
+    if mode != "recreate":
+        raise ValueError(f"churn mode {mode!r} not implemented "
+                         "(only 'recreate')")
+    res = run_connected(
+        n_pods=int(params["measurePods"]), n_nodes=int(params["initNodes"]),
+        batch_size=min(batch, 512), churn=True,
+        churn_period_s=float(churn_op.get("intervalMilliseconds", 100))
+        / 1000.0,
+        log=log)
+    thresholds = workload.get("thresholds") or {}
+    throughput = res["SchedulingThroughput"]
+    passed = (res["bound"] >= res["pods"]
+              and all(throughput >= t * scale
+                      for k, t in thresholds.items()
+                      if k == "SchedulingThroughput"))
+    return {
+        "case": case["name"], "workload": workload["name"],
+        "SchedulingThroughput": throughput,
+        "p99_schedule_latency_s": res.get("p99_attempt_latency_s"),
+        "scheduled": res["bound"], "pods": res["pods"],
+        "nodes": res["nodes"], "measure_s": res["measure_s"],
+        "churn_api_ops": res.get("churn_api_ops", 0),
+        "connected": True,
         "thresholds": thresholds, "passed": passed,
     }
 

@@ -107,13 +107,14 @@ def _wave_scan(allocatable, requested0, static_mask, vic_req, vic_valid,
     step derives its own evictable set (victims strictly lower priority,
     not yet evicted), releases capacity via exclusive prefix sums, ranks
     nodes by the pickOneNode key (fewest PDB violations, lowest max victim
-    priority, fewest victims, node order) packed into one int64 for
-    ``top_k``, and COMMITS the best — its victims flip to evicted and the
-    preemptor's demand is reserved on the node — so the next preemptor
-    sees the mutated cluster, exactly like the serial failure path's
-    evict-then-retry (``schedule_one.go`` nominatedNodeName handling).
-    The K-best candidate nodes (best first, -1 = none) go to the host for
-    exact post-reprieve re-ranking."""
+    priority, fewest victims, node order) via a staged int32 lexicographic
+    argmin repeated K times (a packed-int64 key would silently truncate
+    under JAX's default 32-bit ints), and COMMITS the best — its victims
+    flip to evicted and the preemptor's demand is reserved on the node —
+    so the next preemptor sees the mutated cluster, exactly like the
+    serial failure path's evict-then-retry (``schedule_one.go``
+    nominatedNodeName handling). The K-best candidate nodes (best first,
+    -1 = none) go to the host for exact post-reprieve re-ranking."""
     N, V, R = vic_req.shape
 
     def step(carry, inp):
@@ -124,8 +125,13 @@ def _wave_scan(allocatable, requested0, static_mask, vic_req, vic_valid,
             jnp.where(evictable[..., None], vic_req, 0), axis=1)
         freed = jnp.concatenate(
             [jnp.zeros((N, 1, R), freed.dtype), freed], axis=1)  # [N,V+1,R]
-        fits = jnp.all(requested[:, None, :] + need_q[None, None, :] - freed
-                       <= allocatable[:, None, :], axis=-1)      # [N,V+1]
+        # the resource axis is the UNION across the wave; each preemptor is
+        # constrained only on axes it actually requests (need_q > 0) —
+        # matching the serial path, where an externally-overcommitted axis
+        # the preemptor never asked for does not veto the node
+        fit_r = (requested[:, None, :] + need_q[None, None, :] - freed
+                 <= allocatable[:, None, :]) | (need_q == 0)[None, None, :]
+        fits = jnp.all(fit_r, axis=-1)                           # [N,V+1]
         feasible = fits & smask_q[:, None]
         k_min = jnp.argmax(feasible, axis=1)                     # [N]
         any_f = jnp.any(feasible, axis=1)

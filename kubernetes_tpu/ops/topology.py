@@ -95,18 +95,14 @@ def _self_ns_ok(pb: PodBatch, ns_explicit, ns_mask):
 
 def _count_pn(ct: ClusterTensors, sel, pod_ns, ns_explicit=None, ns_mask=None):
     """cnt_pn [P,T,N] f32: matching existing pods per (pod, term) per NODE
-    (before domain aggregation). Uses the fused Pallas kernel on TPU
-    (ops/pallas/domain_count.py) — the [E,P,T] match tensor never leaves
-    VMEM; falls back to the XLA match+einsum pair elsewhere."""
-    from kubernetes_tpu.ops.pallas import domain_count as _pk
+    (before domain aggregation): selector match [E,P,T] contracted against
+    the node one-hot on the MXU. XLA fuses this chain well; a hand-written
+    Pallas kernel that kept the match tensor in VMEM was measured 120x
+    SLOWER than this path on v5e (16k epods x 1k pods x 4 terms x 5k nodes:
+    14.7s vs 122ms/eval — tiny per-grid-step dots starved the MXU, and
+    MXU-sized tiles spilled ~74MiB of Mosaic VMEM stack) and was deleted in
+    round 4; benchmarks/pallas_bench.py records the comparison."""
     N = ct.node_valid.shape[0]
-    T, X = sel.key.shape[1], sel.key.shape[2]
-    E = ct.epod_valid.shape[0]
-    if _pk.enabled() and T > 0 and X > 0 and E > 0 and N > 0:
-        return _pk.match_count(
-            ct.epod_labels, ct.epod_node, ct.epod_ns, ct.epod_valid,
-            sel.key, sel.op, sel.expr_valid, sel.vals, sel.valid, pod_ns,
-            ns_explicit=ns_explicit, ns_mask=ns_mask, n_nodes=int(N))
     match_ept = _term_match_epods(ct, sel, pod_ns, ns_explicit, ns_mask)
     onehot = (ct.epod_node[:, None] == jnp.arange(N)[None, :]).astype(jnp.float32)
     return jnp.einsum("ept,en->ptn", match_ept, onehot)       # [P,T,N]

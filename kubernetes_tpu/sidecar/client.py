@@ -56,24 +56,24 @@ class SidecarClient:
     def upsert_node(self, node: dict):
         with self._lock:
             self._nodes[(node.get("metadata") or {}).get("name", "")] = node
-            self._bump({"node_upserts": [node]})
+            self._bump({"op": "node_upsert", "node": node})
 
     def delete_node(self, name: str):
         with self._lock:
             self._nodes.pop(name, None)
-            self._bump({"node_deletes": [name]})
+            self._bump({"op": "node_delete", "name": name})
 
     def observe_binding(self, pod: dict):
         """A pod bound (by us or anyone): local gen advances NOW — the
         sidecar learns of it on the next push or stale-reject round-trip."""
         with self._lock:
             self._pods[self._pod_key(pod)] = pod
-            self._bump({"upserts": [pod]})
+            self._bump({"op": "upsert", "pod": pod})
 
     def observe_delete(self, pod_key: str):
         with self._lock:
             self._pods.pop(pod_key, None)
-            self._bump({"deletes": [pod_key]})
+            self._bump({"op": "delete", "key": pod_key})
 
     def _bump(self, entry: dict):
         self._gen += 1
@@ -119,13 +119,11 @@ class SidecarClient:
             if can_delta and not pending:
                 return  # already in sync
             if can_delta:
+                # journal ORDER is preserved on the wire: a delete followed
+                # by a same-key re-add must replay in sequence
                 delta = {"base_generation": server_gen,
                          "generation": self._gen,
-                         "upserts": [], "deletes": [],
-                         "node_upserts": [], "node_deletes": []}
-                for _g, e in pending:
-                    for k, v in e.items():
-                        delta[k].extend(v)
+                         "ops": [e for _g, e in pending]}
         if delta is None:
             self.push_snapshot()
             return

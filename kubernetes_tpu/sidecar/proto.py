@@ -9,10 +9,12 @@ Service: ``ktpu.SchedSidecar``
   PushSnapshot  {nodes: [dict], pods: [dict], generation: int,
                  profile?: {fit_strategy, weights, enabled_filters}}
                 -> {generation}
-  PushDelta     {base_generation, generation, upserts: [pod dict],
-                 deletes: [pod key], node_upserts: [node dict],
-                 node_deletes: [name]}
+  PushDelta     {base_generation, generation, ops: [ORDERED entries:
+                 {op: upsert, pod} | {op: delete, key} |
+                 {op: node_upsert, node} | {op: node_delete, name}]}
                 -> {generation} | STALE
+                (order is semantic — delete-then-re-add of one key must
+                 replay in sequence, like a watch stream)
   Filter        {pods: [dict], generation}
                 -> {mask: packed bits, pods: P, nodes: N} | STALE
   Score         {pods: [dict], generation}

@@ -65,24 +65,30 @@ class _Engine:
             self._encoded = None
             return self._gen
 
-    def delta(self, base_gen: int, gen: int, upserts: list[dict],
-              deletes: list[str], node_upserts: list[dict],
-              node_deletes: list[str]) -> int:
+    def delta(self, base_gen: int, gen: int, ops: list[dict]) -> int:
+        """Apply an ORDERED op list. Order is semantic: a delete followed by
+        a re-add of the same key must leave the object live — flattened
+        per-kind lists would lose it (the watch-stream property informers
+        rely on: events apply in sequence)."""
         with self._lock:
             if self._gen is None or base_gen != self._gen:
                 raise StaleGeneration(-1 if self._gen is None else self._gen)
-            for p in upserts:
-                k = self._pod_key(p)
-                if (p.get("spec") or {}).get("nodeName"):
-                    self._pods[k] = p
-                else:
-                    self._pods.pop(k, None)
-            for k in deletes:
-                self._pods.pop(k, None)
-            for n in node_upserts:
-                self._nodes[(n.get("metadata") or {}).get("name", "")] = n
-            for name in node_deletes:
-                self._nodes.pop(name, None)
+            for entry in ops:
+                op = entry.get("op", "")
+                if op == "upsert":
+                    p = entry["pod"]
+                    k = self._pod_key(p)
+                    if (p.get("spec") or {}).get("nodeName"):
+                        self._pods[k] = p
+                    else:
+                        self._pods.pop(k, None)
+                elif op == "delete":
+                    self._pods.pop(entry["key"], None)
+                elif op == "node_upsert":
+                    n = entry["node"]
+                    self._nodes[(n.get("metadata") or {}).get("name", "")] = n
+                elif op == "node_delete":
+                    self._nodes.pop(entry["name"], None)
             self._gen = gen
             self._encoded = None
             return self._gen
@@ -203,10 +209,7 @@ class SidecarServer:
             if method == "PushDelta":
                 gen = eng.delta(int(req["base_generation"]),
                                 int(req["generation"]),
-                                req.get("upserts", []),
-                                req.get("deletes", []),
-                                req.get("node_upserts", []),
-                                req.get("node_deletes", []))
+                                req.get("ops", []))
                 return {"generation": gen}
             if method == "Filter":
                 return eng.filter(req.get("pods", []),

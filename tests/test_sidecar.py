@@ -111,9 +111,7 @@ def test_server_rejects_unknown_generation_delta(sidecar):
     client.push_snapshot()
     # a delta whose base doesn't match the server's applied generation
     out = client._call["PushDelta"]({
-        "base_generation": 999, "generation": 1000,
-        "upserts": [], "deletes": [],
-        "node_upserts": [], "node_deletes": []})
+        "base_generation": 999, "generation": 1000, "ops": []})
     assert out.get("stale") is True
     assert out["server_generation"] == client._pushed_gen
 
@@ -162,6 +160,24 @@ def test_schedule_matches_oracle(sidecar):
     # bit-parity covered by the main oracle parity suites
     assert Counter(assigned) == Counter(oracle_names)
     assert all(a for a in assigned)
+
+
+def test_delete_then_readd_survives_delta_push(sidecar):
+    """Ordered delta replay: a pod evicted and re-bound (same key) between
+    pushes must still be live in the sidecar after reconciliation — its
+    capacity charged, so a same-size pod cannot double-book the node."""
+    _, client = sidecar
+    client.upsert_node(make_node("one").capacity(
+        {"cpu": "2", "memory": "4Gi", "pods": "8"}).obj().to_dict())
+    client.push_snapshot()
+    bound = make_pod("x").req({"cpu": "2"}).node("one").obj().to_dict()
+    client.observe_binding(bound)
+    client.observe_delete("default/x")
+    client.observe_binding(bound)  # re-add with the SAME key
+    # stale reject -> ordered delta replay -> 'one' must be FULL
+    assert client.schedule(
+        [make_pod("y").req({"cpu": "2"}).obj().to_dict()]) == [""]
+    assert client.stale_retries >= 1
 
 
 def test_unknown_resource_widens_encoding(sidecar):
