@@ -291,6 +291,38 @@ class PodAffinity:
         return d
 
 
+def with_added_node_affinity(pod: "Pod", added) -> "Pod":
+    """Pod with a profile-level NodeAffinity folded in (NodeAffinityArgs.
+    addedAffinity; reference ``pkg/scheduler/framework/plugins/nodeaffinity/
+    node_affinity.go``): the pod must satisfy BOTH its own affinity and the
+    added one. Required selectors are OR-of-terms, so AND of two selectors
+    is the cross product of their term lists (each merged term carries both
+    sides' expressions); preferred terms simply append. ``added``: a
+    NodeAffinity or its wire dict. Returns a new Pod sharing every
+    untouched subtree."""
+    import dataclasses
+    add = (added if isinstance(added, NodeAffinity)
+           else NodeAffinity.from_dict(added))
+    aff = pod.spec.affinity
+    own = aff.node_affinity if aff else None
+    if own is None or not own.required:
+        req = list(add.required)
+    elif not add.required:
+        req = list(own.required)
+    else:
+        req = [NodeSelectorTerm(
+            match_expressions=a.match_expressions + b.match_expressions,
+            match_fields=a.match_fields + b.match_fields)
+            for a in own.required for b in add.required]
+    merged = NodeAffinity(
+        required=req,
+        preferred=(own.preferred if own else []) + list(add.preferred))
+    new_aff = (dataclasses.replace(aff, node_affinity=merged) if aff
+               else Affinity(node_affinity=merged))
+    return dataclasses.replace(
+        pod, spec=dataclasses.replace(pod.spec, affinity=new_aff))
+
+
 @dataclass
 class Affinity:
     node_affinity: Optional[NodeAffinity] = None

@@ -455,6 +455,9 @@ class HTTPClient(_Handles):
             "/apis/apiextensions.k8s.io/v1"
             if plural == "customresourcedefinitions" else
             "/apis/rbac.authorization.k8s.io/v1" if plural in RBAC_RESOURCES
+            else "/apis/admissionregistration.k8s.io/v1"
+            if plural in ("mutatingwebhookconfigurations",
+                          "validatingwebhookconfigurations")
             else "/api/v1")
         return self._path_for(group, plural, ns, name, sub, query)
 

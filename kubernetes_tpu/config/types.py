@@ -28,6 +28,20 @@ ALL_SCORE_PLUGINS = tuple(DEFAULT_WEIGHTS)
 FIT_STRATEGIES = ("LeastAllocated", "MostAllocated", "RequestedToCapacityRatio")
 
 
+def _plugin_args(plugin_config, name: str) -> dict:
+    """Args for one plugin from either pluginConfig wire shape: the
+    reference's list of ``{name, args}`` entries, or a plain
+    ``{PluginName: args}`` map."""
+    if isinstance(plugin_config, list):
+        for entry in plugin_config:
+            if isinstance(entry, dict) and entry.get("name") == name:
+                return entry.get("args") or {}
+        return {}
+    if isinstance(plugin_config, dict):
+        return plugin_config.get(name) or {}
+    return {}
+
+
 @dataclass
 class Profile:
     """KubeSchedulerProfile analog."""
@@ -40,6 +54,29 @@ class Profile:
     # out-of-tree plugin names enabled for this profile (sched/framework.py
     # Registry); None = every registered plugin, [] = none
     out_of_tree: Optional[list] = None
+    # NodeAffinityArgs.addedAffinity (reference: pkg/scheduler/framework/
+    # plugins/nodeaffinity/node_affinity.go): a NodeAffinity applied to
+    # EVERY pod scheduled by this profile, in ADDITION to the pod's own —
+    # required terms AND, preferred terms appended. Wire shape: the
+    # core/v1 NodeAffinity dict under pluginConfig.NodeAffinity.addedAffinity.
+    added_affinity: Optional[dict] = None
+
+    def apply_added_affinity(self, pods: list) -> list:
+        """Pods with this profile's addedAffinity folded into their node
+        affinity terms (no-op without addedAffinity). Applied scheduler-side
+        before encoding, so the tensor AND oracle paths see one merged
+        affinity and stay in parity by construction. The NodeAffinity dict
+        is parsed once per profile, not per pod (this sits on the per-cycle
+        encode path)."""
+        if not self.added_affinity:
+            return pods
+        from kubernetes_tpu.api.types import (NodeAffinity,
+                                              with_added_node_affinity)
+        parsed = self.__dict__.get("_added_parsed")
+        if parsed is None:
+            parsed = NodeAffinity.from_dict(self.added_affinity)
+            self.__dict__["_added_parsed"] = parsed
+        return [with_added_node_affinity(p, parsed) for p in pods]
 
     @property
     def enabled_filters(self) -> Optional[set]:
@@ -62,6 +99,10 @@ class Profile:
             percentage_of_nodes_to_score=int(d.get("percentageOfNodesToScore", 0)),
             out_of_tree=(list(d["outOfTree"])
                          if d.get("outOfTree") is not None else None),
+            added_affinity=(_plugin_args(d.get("pluginConfig"),
+                                         "NodeAffinity")
+                            .get("addedAffinity")
+                            or d.get("addedAffinity")),
         )
 
 

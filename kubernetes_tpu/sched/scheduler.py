@@ -188,7 +188,10 @@ class Scheduler:
             # nominees inside it are protected by the gang rank order instead
             ct = self.cache.overlay_nominated(ct, meta, entries)
         with TRACER.span("scheduler/encode_pods", pods=len(pods)):
-            pb = self.cache.encode_pods(pods, meta)
+            # placement-time view: the profile's addedAffinity folds into
+            # the encoded terms; assume/bind/requeue keep the ORIGINAL pod
+            pb = self.cache.encode_pods(
+                profile.apply_added_affinity(pods), meta)
         ext_mask = ext_scores = None
         ext_errors: set = set()
         if self._extenders:
@@ -324,8 +327,9 @@ class Scheduler:
         P = self.cfg.batch_size
         chunks = [items[i:i + P] for i in range(0, len(items), P)]
         with TRACER.span("scheduler/encode_pods", pods=len(pods)):
-            pbs = [self.cache.encode_pods([p for p, _ in c], meta, min_p=P)
-                   for c in chunks]
+            pbs = [self.cache.encode_pods(
+                profile.apply_added_affinity([p for p, _ in c]),
+                meta, min_p=P) for c in chunks]
         # pad to the fixed drain width with all-invalid batches (their pods
         # propose nothing; the scan converges them in one dead round)
         B = max(1, self.cfg.max_drain_batches)
@@ -518,7 +522,8 @@ class Scheduler:
             return False
         chunks = [sample_pods[i * P:(i + 1) * P] or sample_pods[:P]
                   for i in range(B)]
-        pbs = [self.cache.encode_pods(c, meta, min_p=P) for c in chunks]
+        pbs = [self.cache.encode_pods(profile.apply_added_affinity(c),
+                                      meta, min_p=P) for c in chunks]
         pb_stack = jax.tree_util.tree_map(
             lambda *xs: np.stack(xs), *unify_batches(pbs))
         built = build_drain_context(ct, pbs)
@@ -611,11 +616,20 @@ class Scheduler:
             if self.cache.is_bound(pod.key):  # bound event raced the requeue
                 self.queue.delete(pod)
 
+    def _preempt_view(self, pod: Pod) -> Pod:
+        """Feasibility view of the pod for preemption: the profile's
+        addedAffinity applies there too (upstream preemption re-runs the
+        NodeAffinity plugin, which carries the args)."""
+        profile = self.cfg.profile_for(pod.spec.scheduler_name)
+        if profile is None or not profile.added_affinity:
+            return pod
+        return profile.apply_added_affinity([pod])[0]
+
     def _default_preempt(self, pod: Pod) -> Optional[str]:
         nodes, _, _ = self.cache.snapshot()
         bound = self.cache.bound_pods(include_assumed=True)
         res = preemption_mod.find_candidate_tensor(
-            nodes, bound, pod, pdbs=self.pdb_lister(),
+            nodes, bound, self._preempt_view(pod), pdbs=self.pdb_lister(),
             dra=self.cache.dra_catalog)
         if res is None:
             return None
@@ -631,16 +645,17 @@ class Scheduler:
         preempt_wave would otherwise re-encode the whole cluster for them."""
         nodes, ct, meta = self.cache.snapshot()
         bound = self.cache.bound_pods(include_assumed=True)
+        views = [self._preempt_view(p) for p in pods]
         try:
             masks = preemption_mod.tensor_static_masks(
-                nodes, pods, ct=ct, meta=meta,
+                nodes, views, ct=ct, meta=meta,
                 encode_pods=self.cache.encode_pods)
         except Exception:
             _LOG.exception("static masks from resident encoding failed; "
                            "preempt_wave will re-encode")
             masks = None  # preempt_wave computes its own
         results = preemption_mod.preempt_wave(
-            nodes, bound, pods, pdbs=self.pdb_lister(),
+            nodes, bound, views, pdbs=self.pdb_lister(),
             dra=self.cache.dra_catalog, static_masks=masks)
         out: list[Optional[str]] = []
         for res in results:

@@ -273,12 +273,19 @@ class ValidatingPolicy:
 
 
 def default_chain(store: ObjectStore) -> AdmissionChain:
-    """The default plugin set, in upstream enablement order."""
+    """The default plugin set, in upstream enablement order: built-in
+    mutators, then MutatingAdmissionWebhook; ValidatingAdmissionWebhook
+    before ResourceQuota LAST (the reference's AllOrderedPlugins tail —
+    quota must only be charged for objects the webhooks already allowed,
+    or a slow/denying webhook pins phantom reservations)."""
+    from kubernetes_tpu.store.webhooks import (MutatingWebhooks,
+                                               ValidatingWebhooks)
     chain = AdmissionChain()
     chain.mutating += [
         pod_priority_resolver(store),
         default_toleration_seconds,
         limit_ranger(store),
+        MutatingWebhooks(store),
     ]
-    chain.validating += [resource_quota(store)]
+    chain.validating += [ValidatingWebhooks(store), resource_quota(store)]
     return chain

@@ -92,12 +92,18 @@ RBAC_RESOURCES = {
     "clusterroles": ("ClusterRole", False),
     "clusterrolebindings": ("ClusterRoleBinding", False),
 }
+ADMISSIONREG_RESOURCES = {
+    "mutatingwebhookconfigurations": ("MutatingWebhookConfiguration", False),
+    "validatingwebhookconfigurations": ("ValidatingWebhookConfiguration",
+                                        False),
+}
 
 ALL_RESOURCES = {**CORE_RESOURCES, **APPS_RESOURCES, **COORD_RESOURCES,
                  **STORAGE_RESOURCES, **SCHEDULING_RESOURCES,
                  **RBAC_RESOURCES, **POLICY_RESOURCES, **BATCH_RESOURCES,
                  **AUTOSCALING_RESOURCES, **DISCOVERY_RESOURCES,
-                 **DRA_RESOURCES, **APIEXT_RESOURCES}
+                 **DRA_RESOURCES, **APIEXT_RESOURCES,
+                 **ADMISSIONREG_RESOURCES}
 KIND_TO_PLURAL = {k: p for p, (k, _) in ALL_RESOURCES.items()}
 
 

@@ -1,0 +1,117 @@
+"""Per-profile NodeAffinityArgs.addedAffinity.
+
+Reference: ``pkg/scheduler/framework/plugins/nodeaffinity/node_affinity.go``
+— a profile-level NodeAffinity applied to every pod the profile schedules,
+IN ADDITION to the pod's own affinity (both must hold); preferred terms add
+to scoring. Folded scheduler-side into the encoded terms
+(Profile.apply_added_affinity) so tensor and oracle paths agree by
+construction.
+"""
+
+import pytest
+
+from kubernetes_tpu.api.types import Pod, with_added_node_affinity
+from kubernetes_tpu.config.types import Profile, SchedulerConfiguration
+from kubernetes_tpu.sched.cache import SchedulerCache
+from kubernetes_tpu.sched.queue import SchedulingQueue
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+
+ADDED = {"requiredDuringSchedulingIgnoredDuringExecution": {
+    "nodeSelectorTerms": [{"matchExpressions": [
+        {"key": ZONE, "operator": "In", "values": ["a"]}]}]}}
+
+
+def _sched(nodes, added=None):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    queue = SchedulingQueue(backoff_initial=0.05)
+    log = []
+    cfg = SchedulerConfiguration(
+        profiles=[Profile(added_affinity=added)])
+    sched = Scheduler(cfg, cache, queue,
+                      lambda pod, node: log.append(
+                          (pod.metadata.name, node)) or True)
+    return sched, queue, log
+
+
+def _zone_nodes():
+    return [make_node(f"n-{z}{i}").capacity({"cpu": "4", "pods": "10"})
+            .label(ZONE, z).obj() for z in ("a", "b") for i in range(2)]
+
+
+def test_added_affinity_restricts_placement():
+    sched, queue, log = _sched(_zone_nodes(), added=ADDED)
+    for i in range(4):
+        queue.add(make_pod(f"p{i}").req({"cpu": "1"}).obj())
+    n = sched.run_once()
+    sched.wait_for_bindings()
+    assert n == 4
+    assert all(node.startswith("n-a") for _name, node in log), log
+
+
+def test_added_affinity_ands_with_pods_own():
+    """A pod whose OWN affinity demands zone b cannot satisfy both — it
+    must go unschedulable, exactly like two selectors ANDed upstream."""
+    sched, queue, log = _sched(_zone_nodes(), added=ADDED)
+    queue.add(make_pod("own-b").req({"cpu": "1"})
+              .node_affinity_in(ZONE, ["b"]).obj())
+    queue.add(make_pod("own-a").req({"cpu": "1"})
+              .node_affinity_in(ZONE, ["a"]).obj())
+    sched.run_once()
+    sched.wait_for_bindings()
+    bound = dict(log)
+    assert "own-b" not in bound
+    assert bound.get("own-a", "").startswith("n-a")
+
+
+def test_no_added_affinity_is_noop():
+    sched, queue, log = _sched(_zone_nodes(), added=None)
+    queue.add(make_pod("p").req({"cpu": "1"}).obj())
+    assert sched.run_once() == 1
+
+
+def test_merge_cross_product():
+    """AND of two OR-term selectors = cross product of merged terms."""
+    pod = (make_pod("x")
+           .node_affinity_in("disk", ["ssd", "hdd"]).obj())
+    merged = with_added_node_affinity(pod, ADDED)
+    terms = merged.spec.affinity.node_affinity.required
+    # pod had 1 term, added has 1 -> 1x1 product with both expression sets
+    assert len(terms) == 1
+    keys = sorted(e.key for e in terms[0].match_expressions)
+    assert keys == ["disk", ZONE]
+    # original pod untouched
+    own = pod.spec.affinity.node_affinity.required
+    assert len(own[0].match_expressions) == 1
+
+
+def test_merge_preferred_appends_and_scores():
+    added = {"preferredDuringSchedulingIgnoredDuringExecution": [
+        {"weight": 100, "preference": {"matchExpressions": [
+            {"key": ZONE, "operator": "In", "values": ["b"]}]}}]}
+    sched, queue, log = _sched(_zone_nodes(), added=added)
+    for i in range(2):
+        queue.add(make_pod(f"p{i}").req({"cpu": "1"}).obj())
+    n = sched.run_once()
+    sched.wait_for_bindings()
+    assert n == 2
+    # soft preference: everything still schedules, onto the preferred zone
+    assert all(node.startswith("n-b") for _name, node in log), log
+
+
+def test_oracle_parity_with_merged_pods():
+    """Tensor placement with addedAffinity == oracle on manually-merged
+    pods (feasibility sets identical)."""
+    from kubernetes_tpu.sched.oracle import OracleScheduler
+    nodes = _zone_nodes()
+    pods = [make_pod(f"q{i}").req({"cpu": "1"}).obj() for i in range(4)]
+    merged = [with_added_node_affinity(p, ADDED) for p in pods]
+    orc = OracleScheduler(nodes, [])
+    for p in merged:
+        mask, _ = orc.feasible(p)
+        feas = {nodes[i].metadata.name for i, ok in enumerate(mask) if ok}
+        assert feas == {"n-a0", "n-a1"}

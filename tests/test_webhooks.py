@@ -1,0 +1,143 @@
+"""External admission webhooks: HTTP transport, failurePolicy, timeouts,
+JSONPatch mutation.
+
+Reference: ``staging/src/k8s.io/apiserver/pkg/admission/plugin/webhook/``
+and the admission/v1 AdmissionReview wire shape.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client.clientset import ApiError, HTTPClient
+from kubernetes_tpu.store.apiserver import APIServer
+from kubernetes_tpu.store.webhooks import WebhookTestServer, apply_json_patch
+from kubernetes_tpu.testing.wrappers import make_pod
+
+
+@pytest.fixture()
+def api():
+    server = APIServer()
+    server.enable_admission()
+    server.start()
+    yield server
+    server.stop()
+
+
+def _register(client, kind_cfg, plural, name, url, *, resources=("pods",),
+              operations=("CREATE",), failure_policy="Fail", timeout=10):
+    client.resource(plural, None).create({
+        "kind": kind_cfg, "metadata": {"name": name},
+        "webhooks": [{
+            "name": f"{name}.example.com",
+            "clientConfig": {"url": url},
+            "rules": [{"operations": list(operations),
+                       "resources": list(resources)}],
+            "failurePolicy": failure_policy,
+            "timeoutSeconds": timeout,
+        }]})
+
+
+def test_mutating_webhook_patches_object(api):
+    hook = WebhookTestServer(mutate=lambda review: [
+        {"op": "add", "path": "/metadata/labels/injected", "value": "yes"},
+        {"op": "add", "path": "/spec/priority", "value": 7},
+    ]).start()
+    try:
+        c = HTTPClient(api.url)
+        _register(c, "MutatingWebhookConfiguration",
+                  "mutatingwebhookconfigurations", "mwh", hook.url)
+        c.pods("default").create(make_pod("m").obj().to_dict())
+        got = c.pods("default").get("m")
+        assert got["metadata"]["labels"]["injected"] == "yes"
+        assert got["spec"]["priority"] == 7
+        assert hook.calls >= 1
+    finally:
+        hook.stop()
+
+
+def test_validating_webhook_denies(api):
+    def validate(review):
+        name = review["request"]["object"]["metadata"]["name"]
+        return (not name.startswith("bad"), f"{name} is forbidden")
+    hook = WebhookTestServer(validate=validate).start()
+    try:
+        c = HTTPClient(api.url)
+        _register(c, "ValidatingWebhookConfiguration",
+                  "validatingwebhookconfigurations", "vwh", hook.url)
+        c.pods("default").create(make_pod("good").obj().to_dict())
+        with pytest.raises(ApiError) as ei:
+            c.pods("default").create(make_pod("bad1").obj().to_dict())
+        assert ei.value.code == 400
+        assert "forbidden" in str(ei.value)
+        # the denied object must not exist
+        with pytest.raises(ApiError):
+            c.pods("default").get("bad1")
+    finally:
+        hook.stop()
+
+
+def test_failure_policy_fail_vs_ignore(api):
+    c = HTTPClient(api.url)
+    dead = "http://127.0.0.1:1/unreachable"
+    _register(c, "ValidatingWebhookConfiguration",
+              "validatingwebhookconfigurations", "dead-fail", dead,
+              failure_policy="Fail")
+    with pytest.raises(ApiError) as ei:
+        c.pods("default").create(make_pod("x").obj().to_dict())
+    assert "failurePolicy=Fail" in str(ei.value)
+    # flip to Ignore: the unreachable webhook is skipped
+    c.resource("validatingwebhookconfigurations", None).delete("dead-fail")
+    _register(c, "ValidatingWebhookConfiguration",
+              "validatingwebhookconfigurations", "dead-ignore", dead,
+              failure_policy="Ignore")
+    time.sleep(1.1)  # config poll window
+    c.pods("default").create(make_pod("x").obj().to_dict())
+    assert c.pods("default").get("x")["metadata"]["name"] == "x"
+
+
+def test_webhook_timeout_respected(api):
+    hook = WebhookTestServer(validate=lambda r: (True, ""),
+                             latency_s=3.0).start()
+    try:
+        c = HTTPClient(api.url, timeout=30.0)
+        _register(c, "ValidatingWebhookConfiguration",
+                  "validatingwebhookconfigurations", "slow", hook.url,
+                  failure_policy="Fail", timeout=1)
+        t0 = time.time()
+        with pytest.raises(ApiError):
+            c.pods("default").create(make_pod("t").obj().to_dict())
+        assert time.time() - t0 < 3.0  # timed out at ~1s, not 3s latency
+    finally:
+        hook.stop()
+
+
+def test_rules_scope_webhook_to_kinds(api):
+    hook = WebhookTestServer(validate=lambda r: (False, "no pods")).start()
+    try:
+        c = HTTPClient(api.url)
+        _register(c, "ValidatingWebhookConfiguration",
+                  "validatingwebhookconfigurations", "pods-only", hook.url,
+                  resources=("pods",))
+        # a configmap is outside the rules: admitted without calling out
+        c.resource("configmaps", "default").create(
+            {"kind": "ConfigMap", "metadata": {"name": "cm"}})
+        assert hook.calls == 0
+        with pytest.raises(ApiError):
+            c.pods("default").create(make_pod("p").obj().to_dict())
+    finally:
+        hook.stop()
+
+
+def test_apply_json_patch_ops():
+    obj = {"spec": {"containers": [{"name": "a"}]}, "metadata": {}}
+    out = apply_json_patch(obj, [
+        {"op": "add", "path": "/metadata/labels", "value": {"k": "v"}},
+        {"op": "add", "path": "/spec/containers/-", "value": {"name": "b"}},
+        {"op": "replace", "path": "/spec/containers/0/name", "value": "a2"},
+        {"op": "remove", "path": "/metadata/labels"},
+    ])
+    assert [c["name"] for c in out["spec"]["containers"]] == ["a2", "b"]
+    assert "labels" not in out["metadata"]
+    # original untouched
+    assert obj["spec"]["containers"][0]["name"] == "a"
