@@ -206,6 +206,17 @@ def cmd_apply(client: HTTPClient, args, out) -> int:
             md.setdefault("namespace", ns)
         res = client.resource(plural, ns)
         name = md.get("name", "")
+        if getattr(args, "server_side", False):
+            # kubectl apply --server-side: the server owns the merge via
+            # managedFields (store/apply.py); conflicts 409 unless forced
+            try:
+                res.apply(doc, field_manager=args.field_manager,
+                          force=args.force_conflicts)
+                out.write(f"{plural[:-1]}/{name} serverside-applied\n")
+            except ApiError as e:
+                out.write(f"error: {e}\n")
+                rc = 1
+            continue
         try:
             current = res.get(name)
         except ApiError as e:
@@ -359,6 +370,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     a = sub.add_parser("apply")
     a.add_argument("-f", "--filename", required=True)
+    a.add_argument("--server-side", action="store_true",
+                   help="server-side apply (managedFields field ownership)")
+    a.add_argument("--field-manager", default="ktpu")
+    a.add_argument("--force-conflicts", action="store_true")
 
     d = sub.add_parser("delete")
     d.add_argument("resource", nargs="?", default="")

@@ -149,6 +149,16 @@ class ResourceClient:
     def update_status(self, obj: dict) -> dict:
         return self._t.update(self.plural, self.kind, self.namespace, obj, "status")
 
+    def apply(self, obj: dict, field_manager: str = "ktpu",
+              force: bool = False) -> dict:
+        """Server-side apply (managedFields field ownership; reference
+        ``kubectl apply --server-side``): the server merges this applied
+        configuration with other managers' fields, removes fields this
+        manager previously applied but dropped, and 409s on conflicts
+        unless ``force``."""
+        return self._t.apply(self.plural, self.kind, self.namespace, obj,
+                             field_manager, force)
+
     def delete(self, name: str) -> dict:
         return self._t.delete(self.plural, self.kind, self.namespace, name)
 
@@ -274,6 +284,29 @@ class DirectClient(_Handles):
     def list(self, plural, kind, ns, label_selector, field_selector):
         sel = compile_list_selector(label_selector, field_selector)
         return self.store.list(kind, namespace=ns, selector=sel)
+
+    @_api_errors
+    def apply(self, plural, kind, ns, obj, field_manager, force):
+        from kubernetes_tpu.store.apply import (ApplyConflict,
+                                                server_side_apply)
+        obj = self._react("apply", plural, obj)
+        obj.setdefault("metadata", {})
+        if ns:
+            obj["metadata"].setdefault("namespace", ns)
+        obj.setdefault("kind", kind)
+        name = obj["metadata"].get("name", "")
+        try:
+            live = self.store.get(kind, ns or "", name)
+        except NotFound:
+            live = None
+        try:
+            merged = server_side_apply(live, obj, field_manager, force=force)
+        except ApplyConflict as e:
+            raise ApiError(409, str(e), "Conflict") from None
+        if live is None:
+            return self.store.create(kind, merged)
+        return self.store.update(
+            kind, merged, expect_rv=live["metadata"]["resourceVersion"])
 
     @_api_errors
     def update(self, plural, kind, ns, obj, sub):
@@ -617,6 +650,20 @@ class HTTPClient(_Handles):
     def bind(self, ns, name, node_name):
         return self._req("POST", self._path("pods", ns, name, "binding"),
                          {"target": {"kind": "Node", "name": node_name}})
+
+    def apply(self, plural, kind, ns, obj, field_manager, force):
+        import urllib.parse
+        name = (obj.get("metadata") or {}).get("name", "")
+        q = urllib.parse.urlencode(
+            {"fieldManager": field_manager,
+             **({"force": "true"} if force else {})})
+        # msgpack clients ride the negotiated binary type; JSON clients must
+        # declare the apply-patch media type (plain JSON PATCH is rejected,
+        # as upstream rejects non-SSA patches it doesn't support)
+        headers = (None if self._mp is not None
+                   else {"Content-Type": "application/apply-patch+json"})
+        return self._req("PATCH", self._path(plural, ns, name, query=q),
+                         obj, headers=headers)
 
     def bind_many(self, bindings):
         out = self._req("POST", self._path("pods", None, "-", "binding"),

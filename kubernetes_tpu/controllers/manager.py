@@ -34,7 +34,12 @@ from kubernetes_tpu.controllers.serviceaccount import (
     ServiceAccountController,
     TokenController,
 )
+from kubernetes_tpu.controllers.clusterroleaggregation import (
+    ClusterRoleAggregationController,
+)
+from kubernetes_tpu.controllers.resourcequota import ResourceQuotaController
 from kubernetes_tpu.controllers.statefulset import StatefulSetController
+from kubernetes_tpu.controllers.ttl import TTLController
 from kubernetes_tpu.controllers.ttlafterfinished import TTLAfterFinishedController
 
 DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "daemonset",
@@ -42,7 +47,8 @@ DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "daemonset",
                        "nodelifecycle", "pvbinder", "disruption", "cronjob",
                        "ttlafterfinished", "horizontalpodautoscaler",
                        "namespace", "serviceaccount", "serviceaccount-token",
-                       "resourceclaim", "replicationcontroller", "podgc")
+                       "resourceclaim", "replicationcontroller", "podgc",
+                       "resourcequota", "ttl", "clusterroleaggregation")
 
 
 class ControllerManager:
@@ -74,6 +80,9 @@ class ControllerManager:
             "serviceaccount": ServiceAccountController,
             "resourceclaim": ResourceClaimController,
             "serviceaccount-token": TokenController,
+            "resourcequota": ResourceQuotaController,
+            "ttl": TTLController,
+            "clusterroleaggregation": ClusterRoleAggregationController,
         }
         self.controllers = [ctors[n](client) for n in controllers]
         self.gc = GarbageCollector(client) if gc_enabled else None

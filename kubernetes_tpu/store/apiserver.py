@@ -106,6 +106,25 @@ ALL_RESOURCES = {**CORE_RESOURCES, **APPS_RESOURCES, **COORD_RESOURCES,
                  **ADMISSIONREG_RESOURCES}
 KIND_TO_PLURAL = {k: p for p, (k, _) in ALL_RESOURCES.items()}
 
+# API group per kind (core = ""), for GroupVersionKind-bearing payloads
+# (admission webhooks' AdmissionReview.request.kind)
+KIND_TO_GROUP = {}
+for _table, _group in ((CORE_RESOURCES, ""), (APPS_RESOURCES, "apps"),
+                       (COORD_RESOURCES, "coordination.k8s.io"),
+                       (STORAGE_RESOURCES, "storage.k8s.io"),
+                       (SCHEDULING_RESOURCES, "scheduling.k8s.io"),
+                       (RBAC_RESOURCES, "rbac.authorization.k8s.io"),
+                       (POLICY_RESOURCES, "policy"),
+                       (BATCH_RESOURCES, "batch"),
+                       (AUTOSCALING_RESOURCES, "autoscaling"),
+                       (DISCOVERY_RESOURCES, "discovery.k8s.io"),
+                       (DRA_RESOURCES, "resource.k8s.io"),
+                       (APIEXT_RESOURCES, "apiextensions.k8s.io"),
+                       (ADMISSIONREG_RESOURCES,
+                        "admissionregistration.k8s.io")):
+    for _k, _ns in _table.values():
+        KIND_TO_GROUP[_k] = _group
+
 
 class AdmissionError(Exception):
     pass
@@ -881,6 +900,100 @@ class APIServer:
                     if kind == "CustomResourceDefinition":
                         server._on_crd_change(out, deleted=False)
                     return self._send_json(200, out)
+
+            def do_PATCH(self):
+                return self._shaped("patch", self._do_PATCH)
+
+            def _do_PATCH(self):
+                """Server-side apply: PATCH with
+                ``Content-Type: application/apply-patch+yaml`` (or +json /
+                the negotiated binary format) and ``?fieldManager=...``.
+                Reference: ``apiserver/pkg/endpoints/handlers/patch.go``
+                (applyPatcher) + managedfields. Conflicts -> 409 with the
+                owning managers listed; ``force=true`` transfers ownership
+                (kubectl --force-conflicts)."""
+                from kubernetes_tpu.store.apply import (ApplyConflict,
+                                                        server_side_apply)
+                r = self._route()
+                if r is None:
+                    return self._error(404, "unknown path")
+                plural, kind, ns, name, sub = r
+                ctype = self.headers.get("Content-Type", "")
+                if "apply-patch" not in ctype and MSGPACK_CT not in ctype:
+                    return self._error(
+                        415, "only apply-patch (server-side apply) is "
+                             "supported", "UnsupportedMediaType")
+                if name is None:
+                    return self._error(405, "apply needs a resource name")
+                if sub is not None:
+                    # subresource-scoped apply (status) is not implemented;
+                    # silently merging against the whole object would let a
+                    # status request rewrite spec
+                    return self._error(
+                        405, f"apply to subresource {sub!r} unsupported")
+                qs = parse_qs(urlparse(self.path).query)
+                manager = qs.get("fieldManager", ["unknown"])[0]
+                force = qs.get("force", ["false"])[0] in ("true", "1")
+                try:
+                    body = self._read_body()
+                except _BadRequest as e:
+                    return self._error(400, str(e), "BadRequest")
+                md = body.setdefault("metadata", {})
+                md.setdefault("name", name)
+                if ns:
+                    md["namespace"] = ns
+                with server._crd_guard(kind):
+                    try:
+                        live = server.store.get(kind, ns or "", name)
+                    except NotFound:
+                        live = None
+                    try:
+                        merged = server_side_apply(live, body, manager,
+                                                   force=force)
+                    except ApplyConflict as e:
+                        return self._send_json(409, {
+                            "kind": "Status", "status": "Failure",
+                            "message": str(e), "reason": "Conflict",
+                            "code": 409,
+                            "details": {"causes": [
+                                {"field": p,
+                                 "message": f"conflict with {m!r}"}
+                                for p, m in e.conflicts]}})
+                    if kind == "CustomResourceDefinition":
+                        err = server.validate_crd(merged)
+                        if err:
+                            return self._error(400, err, "Invalid")
+                    verb = "UPDATE" if live is not None else "CREATE"
+                    try:
+                        merged = server._admit(verb, kind, merged)
+                    except AdmissionError as e:
+                        return self._error(400, str(e), "AdmissionDenied")
+                    commits = server._pop_commits(merged)
+                    try:
+                        if live is None:
+                            out = server.store.create(kind, merged,
+                                                      owned=True)
+                            code = 201
+                        else:
+                            out = server.store.update(
+                                kind, merged, owned=True,
+                                expect_rv=live["metadata"]
+                                ["resourceVersion"])
+                            code = 200
+                    except (AlreadyExists, Conflict) as e:
+                        server._commit(commits, False)
+                        return self._error(409, str(e), "Conflict")
+                    except NotFound as e:
+                        # deleted between the live read and the write
+                        server._commit(commits, False)
+                        return self._error(409, str(e), "Conflict")
+                    except Exception:
+                        server._commit(commits, False)
+                        raise
+                    server._commit(commits, True)
+                    if kind == "CustomResourceDefinition":
+                        server._on_crd_change(out, deleted=False)
+                    return self._send_json(code, out)
 
             def do_DELETE(self):
                 return self._shaped("delete", self._do_DELETE)

@@ -78,12 +78,14 @@ def _call_webhook(url: str, review: dict, timeout_s: float) -> dict:
 
 
 def _review(verb: str, kind: str, obj: dict, uid: str) -> dict:
+    from kubernetes_tpu.store.apiserver import KIND_TO_GROUP
     md = obj.get("metadata") or {}
     return {
         "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
         "request": {
             "uid": uid,
-            "kind": {"group": "", "version": "v1", "kind": kind},
+            "kind": {"group": KIND_TO_GROUP.get(kind, ""),
+                     "version": "v1", "kind": kind},
             "operation": verb,
             "name": md.get("name", ""),
             "namespace": md.get("namespace", ""),

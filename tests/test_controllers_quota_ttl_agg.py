@@ -1,0 +1,137 @@
+"""Round-4 breadth controllers: ResourceQuota status, node TTL annotation,
+ClusterRole aggregation.
+
+Reference: pkg/controller/{resourcequota,ttl,clusterroleaggregation}/.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client.clientset import DirectClient
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.controllers.clusterroleaggregation import (
+    ClusterRoleAggregationController,
+)
+from kubernetes_tpu.controllers.resourcequota import ResourceQuotaController
+from kubernetes_tpu.controllers.ttl import TTL_ANNOTATION, TTLController
+from kubernetes_tpu.store.store import ObjectStore
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def wait_until(fn, timeout=8.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+@pytest.fixture
+def client():
+    return DirectClient(ObjectStore())
+
+
+def run_controller(client, ctrl):
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    return ctrl, factory
+
+
+def stop(ctrl, factory):
+    ctrl.stop()
+    factory.stop_all()
+
+
+# -------------------------------------------------------------- resourcequota
+
+def test_quota_status_tracks_usage(client):
+    client.resource("resourcequotas", "default").create({
+        "kind": "ResourceQuota", "metadata": {"name": "rq"},
+        "spec": {"hard": {"pods": "10", "requests.cpu": "4",
+                          "count/configmaps": "5"}}})
+    ctrl, factory = run_controller(client, ResourceQuotaController(client))
+    try:
+        client.pods("default").create(
+            make_pod("a").req({"cpu": "500m"}).obj().to_dict())
+        client.pods("default").create(
+            make_pod("b").req({"cpu": "250m"}).obj().to_dict())
+        client.resource("configmaps", "default").create(
+            {"kind": "ConfigMap", "metadata": {"name": "cm"}})
+
+        def used():
+            q = client.resource("resourcequotas", "default").get("rq")
+            return (q.get("status") or {}).get("used") or {}
+        assert wait_until(lambda: used().get("pods") == "2"), used()
+        assert used()["requests.cpu"] == "750m"
+        assert used()["count/configmaps"] == "1"
+        # terminal pods stop counting
+        a = client.pods("default").get("a")
+        a.setdefault("status", {})["phase"] = "Succeeded"
+        client.pods("default").update_status(a)
+        assert wait_until(lambda: used().get("pods") == "1"), used()
+        assert used()["requests.cpu"] == "250m"
+    finally:
+        stop(ctrl, factory)
+
+
+# ------------------------------------------------------------------------ ttl
+
+def test_ttl_annotation_by_cluster_size(client):
+    ctrl, factory = run_controller(client, TTLController(client))
+    try:
+        client.nodes().create(make_node("n0").obj().to_dict())
+
+        def ttl(name):
+            n = client.nodes().get(name)
+            return ((n.get("metadata") or {}).get("annotations") or {}) \
+                .get(TTL_ANNOTATION)
+        assert wait_until(lambda: ttl("n0") == "0"), ttl("n0")
+    finally:
+        stop(ctrl, factory)
+
+
+def test_ttl_scales_with_boundaries():
+    from kubernetes_tpu.controllers.ttl import _BOUNDARIES, _MAX_TTL
+    assert _BOUNDARIES[0] == (100, 0)
+    assert _MAX_TTL == 300
+
+
+# --------------------------------------------------------- role aggregation
+
+def test_clusterrole_aggregation(client):
+    roles = client.resource("clusterroles", None)
+    roles.create({"kind": "ClusterRole", "metadata": {"name": "admin"},
+                  "aggregationRule": {"clusterRoleSelectors": [
+                      {"matchLabels": {"aggregate-to-admin": "true"}}]},
+                  "rules": []})
+    ctrl, factory = run_controller(
+        client, ClusterRoleAggregationController(client))
+    try:
+        roles.create({"kind": "ClusterRole",
+                      "metadata": {"name": "crd-edit",
+                                   "labels": {"aggregate-to-admin": "true"}},
+                      "rules": [{"apiGroups": ["example.com"],
+                                 "resources": ["widgets"],
+                                 "verbs": ["*"]}]})
+
+        def rules():
+            return roles.get("admin").get("rules") or []
+        assert wait_until(lambda: len(rules()) == 1), rules()
+        assert rules()[0]["resources"] == ["widgets"]
+        # removing the labeled role empties the aggregate again
+        roles.delete("crd-edit")
+        assert wait_until(lambda: rules() == []), rules()
+        # unlabeled roles never aggregate
+        roles.create({"kind": "ClusterRole",
+                      "metadata": {"name": "loner"},
+                      "rules": [{"apiGroups": [""], "resources": ["pods"],
+                                 "verbs": ["get"]}]})
+        time.sleep(0.3)
+        assert rules() == []
+    finally:
+        stop(ctrl, factory)

@@ -1,0 +1,177 @@
+"""Server-side apply: field ownership, reconcile-by-absence, conflicts.
+
+Reference: ``staging/src/k8s.io/apimachinery/pkg/util/managedfields`` +
+structured-merge-diff semantics behind ``kubectl apply --server-side``.
+Lists are atomic here (documented simplification, store/apply.py).
+"""
+
+import pytest
+
+from kubernetes_tpu.client.clientset import ApiError, DirectClient, HTTPClient
+from kubernetes_tpu.store.apiserver import APIServer
+from kubernetes_tpu.store.apply import (field_set, from_fields_v1,
+                                        server_side_apply, to_fields_v1)
+from kubernetes_tpu.store.store import ObjectStore
+
+
+@pytest.fixture()
+def api():
+    server = APIServer().start()
+    yield server
+    server.stop()
+
+
+def _cm(name, data):
+    return {"kind": "ConfigMap", "metadata": {"name": name}, "data": data}
+
+
+def test_fields_v1_roundtrip():
+    paths = {"data.a", "data.b.c", "metadata.labels.x"}
+    assert from_fields_v1(to_fields_v1(paths)) == paths
+
+
+def test_apply_creates_with_ownership():
+    out = server_side_apply(None, _cm("c", {"k": "v"}), "mgr-a")
+    mf = out["metadata"]["managedFields"]
+    assert len(mf) == 1
+    assert mf[0]["manager"] == "mgr-a"
+    assert mf[0]["operation"] == "Apply"
+    assert "f:data" in mf[0]["fieldsV1"]
+
+
+def test_reconcile_by_absence():
+    """A field this manager applied before and dropped now is REMOVED —
+    the property client-side apply cannot provide."""
+    v1 = server_side_apply(None, _cm("c", {"keep": "1", "drop": "2"}), "a")
+    v2 = server_side_apply(v1, _cm("c", {"keep": "1"}), "a")
+    assert v2["data"] == {"keep": "1"}
+
+
+def test_disjoint_managers_coexist():
+    v1 = server_side_apply(None, _cm("c", {"a": "1"}), "mgr-a")
+    v2 = server_side_apply(
+        v1, {"kind": "ConfigMap", "metadata": {"name": "c",
+                                               "labels": {"who": "b"}}},
+        "mgr-b")
+    # mgr-b owns labels; mgr-a's data survives untouched
+    assert v2["data"] == {"a": "1"}
+    assert v2["metadata"]["labels"] == {"who": "b"}
+    managers = {e["manager"] for e in v2["metadata"]["managedFields"]}
+    assert managers == {"mgr-a", "mgr-b"}
+    # dropping mgr-b's label later removes it without touching data
+    v3 = server_side_apply(v2, {"kind": "ConfigMap",
+                                "metadata": {"name": "c"}}, "mgr-b")
+    assert "labels" not in v3["metadata"]
+    assert v3["data"] == {"a": "1"}
+
+
+def test_conflict_and_force():
+    from kubernetes_tpu.store.apply import ApplyConflict
+    v1 = server_side_apply(None, _cm("c", {"k": "a-version"}), "mgr-a")
+    with pytest.raises(ApplyConflict) as ei:
+        server_side_apply(v1, _cm("c", {"k": "b-version"}), "mgr-b")
+    assert ei.value.conflicts == [("data.k", "mgr-a")]
+    forced = server_side_apply(v1, _cm("c", {"k": "b-version"}), "mgr-b",
+                               force=True)
+    assert forced["data"]["k"] == "b-version"
+    # ownership transferred: mgr-a's entry no longer claims data.k
+    owners = {e["manager"]: from_fields_v1(e["fieldsV1"])
+              for e in forced["metadata"]["managedFields"]}
+    assert "data.k" in owners["mgr-b"]
+    assert "data.k" not in owners.get("mgr-a", set())
+
+
+def test_same_value_is_not_a_conflict():
+    v1 = server_side_apply(None, _cm("c", {"k": "same"}), "mgr-a")
+    v2 = server_side_apply(v1, _cm("c", {"k": "same"}), "mgr-b")
+    owners = {e["manager"]: from_fields_v1(e["fieldsV1"])
+              for e in v2["metadata"]["managedFields"]}
+    # co-ownership: both managers hold the path
+    assert "data.k" in owners["mgr-a"] and "data.k" in owners["mgr-b"]
+    # a co-owner dropping the field does NOT remove it (other owner remains)
+    v3 = server_side_apply(v2, {"kind": "ConfigMap",
+                                "metadata": {"name": "c"}}, "mgr-b")
+    assert v3["data"]["k"] == "same"
+
+
+@pytest.mark.parametrize("transport", ["http", "direct"])
+def test_apply_via_transports(api, transport):
+    if transport == "http":
+        c = HTTPClient(api.url)
+    else:
+        c = DirectClient(ObjectStore())
+    res = c.resource("configmaps", "default")
+    res.apply(_cm("t", {"x": "1", "y": "2"}), field_manager="one")
+    got = res.get("t")
+    assert got["data"] == {"x": "1", "y": "2"}
+    assert got["metadata"]["managedFields"][0]["manager"] == "one"
+    # second manager conflicts without force
+    with pytest.raises(ApiError) as ei:
+        res.apply(_cm("t", {"x": "other"}), field_manager="two")
+    assert ei.value.code == 409
+    res.apply(_cm("t", {"x": "other"}), field_manager="two", force=True)
+    assert res.get("t")["data"]["x"] == "other"
+    # reconcile-by-absence over the wire
+    res.apply(_cm("t", {"y": "2"}), field_manager="one")
+    assert "x" in res.get("t")["data"]  # two's field survives
+    left = res.get("t")["data"]
+    assert left == {"x": "other", "y": "2"}
+
+
+def test_cli_server_side_apply(api, tmp_path):
+    import io
+    from kubernetes_tpu.cli.ktpu import main
+    f = tmp_path / "cm.yaml"
+    f.write_text("kind: ConfigMap\nmetadata:\n  name: cli\ndata:\n  a: '1'\n")
+    out = io.StringIO()
+    rc = main(["--server", api.url, "apply", "-f", str(f), "--server-side",
+               "--field-manager", "cli-mgr"], out=out)
+    assert rc == 0, out.getvalue()
+    got = HTTPClient(api.url).resource("configmaps", "default").get("cli")
+    assert got["data"] == {"a": "1"}
+    assert got["metadata"]["managedFields"][0]["manager"] == "cli-mgr"
+
+
+def test_apply_crd_registers_routes(api):
+    """SSA of a CRD must run the same validate + serving-table rebuild as
+    POST/PUT — the custom kind is routable immediately after."""
+    c = HTTPClient(api.url)
+    c.resource("customresourcedefinitions", None).apply({
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "widgets.example.com"},
+        "spec": {"group": "example.com",
+                 "names": {"plural": "widgets", "kind": "Widget"}}},
+        field_manager="ssa")
+    c.discover_custom()
+    c.resource("widgets", "default").create(
+        {"kind": "Widget", "metadata": {"name": "w1"}})
+    assert c.resource("widgets", "default").get("w1")["metadata"]["name"] \
+        == "w1"
+    # an invalid CRD is rejected, exactly like POST
+    with pytest.raises(ApiError) as ei:
+        c.resource("customresourcedefinitions", None).apply({
+            "kind": "CustomResourceDefinition",
+            "metadata": {"name": "bad"},
+            "spec": {"names": {"plural": "pods", "kind": "Pod"}}},
+            field_manager="ssa")
+    assert ei.value.code == 400
+
+
+def test_apply_field_manager_url_encoding(api):
+    c = HTTPClient(api.url)
+    res = c.resource("configmaps", "default")
+    res.apply(_cm("enc", {"k": "v"}),
+              field_manager="kubectl client-side & friends")
+    mf = res.get("enc")["metadata"]["managedFields"]
+    assert mf[0]["manager"] == "kubectl client-side & friends"
+
+
+def test_apply_to_subresource_rejected(api):
+    c = HTTPClient(api.url)
+    c.pods("default").create({"kind": "Pod", "metadata": {"name": "s"},
+                              "spec": {"containers": []}})
+    with pytest.raises(ApiError) as ei:
+        c._req("PATCH", c._path("pods", "default", "s", "status",
+                                query="fieldManager=x"),
+               {"status": {"phase": "Running"}})
+    assert ei.value.code == 405
