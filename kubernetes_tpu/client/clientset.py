@@ -561,8 +561,13 @@ class HTTPClient(_Handles):
                         self._mp = mp = None
                         data = (json.dumps(body).encode()
                                 if body is not None else None)
+                        # PATCH is server-side apply here; its JSON media
+                        # type is apply-patch+json (plain JSON is 415'd)
+                        ctype_dg = ("application/apply-patch+json"
+                                    if method == "PATCH"
+                                    else "application/json")
                         all_headers = {**all_headers,
-                                       "Content-Type": "application/json",
+                                       "Content-Type": ctype_dg,
                                        "Accept": "application/json"}
                         continue
                     raise ApiError(resp.status, msg,

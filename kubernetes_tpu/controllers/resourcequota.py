@@ -38,7 +38,9 @@ def _fmt(resource: str, amount: int) -> str:
 class ResourceQuotaController(Controller):
     name = "resourcequota"
     workers = 1
-    tick_interval = 5.0  # upstream full resync: every 5m; scaled for tests
+    # upstream's full resync is every 5m; event-driven enqueues (quota
+    # changes, pod churn) carry the steady state — tests override this
+    tick_interval = 300.0
 
     def register(self, factory: InformerFactory) -> None:
         self.quota_informer = factory.informer("resourcequotas", None)

@@ -27,12 +27,14 @@ class TTLController(Controller):
         self.node_informer.add_event_handler(self._on_node)
 
     def _on_node(self, type_, obj, old) -> None:
-        if type_ == "DELETED":
-            # shrinking below a boundary changes every node's desired ttl
+        if type_ in ("ADDED", "DELETED"):
+            # crossing a size boundary in EITHER direction changes every
+            # node's desired ttl (ttl_controller enqueues the fleet on
+            # cluster-size transitions)
             for n in self.node_informer.store.list():
                 self.enqueue(n)
-            return
-        self.enqueue(obj)
+        if type_ != "DELETED":
+            self.enqueue(obj)
 
     def _desired_ttl(self) -> int:
         n = len(self.node_informer.store.list())

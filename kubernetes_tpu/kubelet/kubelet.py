@@ -63,6 +63,20 @@ class Kubelet:
         from kubernetes_tpu.api.resource import canonical
         self.cpu_manager = CPUManager(max(1, canonical(
             "cpu", str(self.allocatable.get("cpu", "1"))) // 1000))
+        # cm/ managers beyond cpu: device plugins, NUMA memory, topology
+        # alignment (pkg/kubelet/cm/{devicemanager,memorymanager,
+        # topologymanager}); single-NUMA default mirrors small nodes,
+        # tests reconfigure via the attributes
+        from kubernetes_tpu.kubelet.managers import (DeviceManager,
+                                                     MemoryManager,
+                                                     TopologyManager)
+        mem_mib = canonical(
+            "memory", str(self.allocatable.get("memory", "1Gi"))) >> 20
+        self.device_manager = DeviceManager()
+        self.memory_manager = MemoryManager([int(mem_mib)])
+        self.topology_manager = TopologyManager(num_numa=1)
+        self.topology_manager.add_provider(self.device_manager)
+        self.topology_manager.add_provider(self.memory_manager)
         self._informer: Optional[SharedInformer] = None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -217,11 +231,22 @@ class Kubelet:
             return
         if uid not in self._admitted:
             ok, reason = self.admitter.admit(pod)
+            affinity = None
+            if ok:
+                # topology gate BEFORE allocation (TopologyAffinityError)
+                ok, reason, affinity = self.topology_manager.admit(pod)
+                if not ok:
+                    self.admitter.release(uid)
             if ok:
                 try:
                     self.cpu_manager.allocate(pod)
+                    self.device_manager.allocate(pod, affinity=affinity)
+                    self.memory_manager.allocate(pod, affinity=affinity)
                 except RuntimeError:
                     self.admitter.release(uid)
+                    self.cpu_manager.release(uid)
+                    self.device_manager.release(uid)
+                    self.memory_manager.release(uid)
                     ok, reason = False, "UnexpectedAdmissionError"
             if not ok:
                 self._rejected[uid] = reason
@@ -268,6 +293,8 @@ class Kubelet:
                 self.volumes.remove_pod(admitted)
             self.admitter.release(uid)
             self.cpu_manager.release(uid)
+            self.device_manager.release(uid)
+            self.memory_manager.release(uid)
 
     def _fail_pod(self, pod: dict, reason: str) -> None:
         self.recorder.event(pod, "Warning", reason,

@@ -939,7 +939,10 @@ class APIServer:
                 except _BadRequest as e:
                     return self._error(400, str(e), "BadRequest")
                 md = body.setdefault("metadata", {})
-                md.setdefault("name", name)
+                if md.setdefault("name", name) != name:
+                    return self._error(
+                        400, f"metadata.name {md['name']!r} does not match "
+                             f"the request URL name {name!r}", "BadRequest")
                 if ns:
                     md["namespace"] = ns
                 with server._crd_guard(kind):

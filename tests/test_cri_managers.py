@@ -1,0 +1,214 @@
+"""CRI gRPC process boundary + device/memory/topology managers.
+
+Reference: ``staging/src/k8s.io/cri-api/.../api.proto`` (the kubelet <->
+containerd seam) and ``pkg/kubelet/cm/{devicemanager,memorymanager,
+topologymanager}``.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.kubelet.cri import CRIServer, RemoteRuntime
+from kubernetes_tpu.kubelet.managers import (
+    Device,
+    DeviceManager,
+    MemoryManager,
+    POLICY_BEST_EFFORT,
+    POLICY_SINGLE_NUMA,
+    TopologyManager,
+)
+from kubernetes_tpu.kubelet.runtime import EXITED, RUNNING, FakeRuntime
+
+
+@pytest.fixture()
+def cri():
+    backend = FakeRuntime()
+    server = CRIServer(backend).start()
+    remote = RemoteRuntime(server.address)
+    yield backend, server, remote
+    remote.close()
+    server.stop()
+
+
+def _gpod(uid, name="p", cpu="1", memory="512Mi", extra=None):
+    req = {"cpu": cpu, "memory": memory, **(extra or {})}
+    return {"kind": "Pod", "metadata": {"uid": uid, "name": name,
+                                        "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "resources": {
+                "requests": dict(req), "limits": dict(req)}}]}}
+
+
+# ------------------------------------------------------------------ CRI seam
+
+def test_cri_container_lifecycle_over_grpc(cri):
+    backend, server, remote = cri
+    sb = remote.run_pod_sandbox("u1", "p1", "default")
+    assert sb.pod_uid == "u1" and sb.ip
+    remote.create_container("u1", "c0", image="nginx:1")
+    remote.start_container("u1", "c0")
+    got = remote.get_sandbox("u1")
+    assert got.containers["c0"].state == RUNNING
+    # the image service recorded the kubelet's pull
+    assert "nginx:1" in server.images
+    remote.stop_container("u1", "c0", exit_code=3)
+    assert remote.get_sandbox("u1").containers["c0"].state == EXITED
+    assert remote.get_sandbox("u1").containers["c0"].exit_code == 3
+    # state lives in the BACKEND process object, not the client
+    assert backend.get_sandbox("u1").containers["c0"].exit_code == 3
+    remote.stop_pod_sandbox("u1")
+    assert remote.get_sandbox("u1") is None
+
+
+def test_cri_probe_via_exec_sync(cri):
+    backend, _server, remote = cri
+    remote.run_pod_sandbox("u2", "p2", "default")
+    remote.create_container("u2", "c", image="x")
+    remote.start_container("u2", "c")
+    assert remote.probe("u2", "c") is True
+    backend.set_health("u2", "c", False)
+    assert remote.probe("u2", "c") is False
+
+
+def test_kubelet_runs_over_remote_runtime(cri):
+    """The full kubelet sync loop drives containers across the gRPC seam."""
+    from kubernetes_tpu.client.clientset import DirectClient
+    from kubernetes_tpu.kubelet.kubelet import Kubelet
+    from kubernetes_tpu.store.store import ObjectStore
+    _backend, _server, remote = cri
+    client = DirectClient(ObjectStore())
+    kl = Kubelet(client, "node-cri", runtime=remote)
+    kl.start()
+    try:
+        client.pods("default").create(
+            {"kind": "Pod", "metadata": {"name": "remote-pod"},
+             "spec": {"nodeName": "node-cri",
+                      "containers": [{"name": "app", "image": "img:1"}]}})
+        deadline = time.time() + 15.0
+        phase = None
+        while time.time() < deadline:
+            p = client.pods("default").get("remote-pod")
+            phase = (p.get("status") or {}).get("phase")
+            if phase == "Running":
+                break
+            time.sleep(0.05)
+        assert phase == "Running", phase
+    finally:
+        kl.stop()
+
+
+# ------------------------------------------------------------ device manager
+
+def test_device_manager_allocates_and_releases():
+    dm = DeviceManager()
+    dm.register_plugin("example.com/fpga",
+                       [Device("f0", 0), Device("f1", 0), Device("f2", 1)])
+    assert dm.capacity() == {"example.com/fpga": 3}
+    pod = _gpod("u1", extra={"example.com/fpga": "2"})
+    got = dm.allocate(pod)
+    assert len(got["example.com/fpga"]) == 2
+    with pytest.raises(RuntimeError):
+        dm.allocate(_gpod("u2", extra={"example.com/fpga": "2"}))
+    dm.release("u1")
+    assert len(dm.allocate(_gpod("u2", extra={"example.com/fpga": "2"}))
+               ["example.com/fpga"]) == 2
+
+
+def test_device_hints_prefer_single_numa():
+    dm = DeviceManager()
+    dm.register_plugin("example.com/fpga",
+                       [Device("a", 0), Device("b", 1), Device("c", 1)])
+    h = dm.hints(_gpod("u", extra={"example.com/fpga": "2"}))
+    assert h.numa_affinity == frozenset({1}) and h.preferred
+
+
+# ------------------------------------------------------------ memory manager
+
+def test_memory_manager_guaranteed_reservation():
+    mm = MemoryManager([1024, 1024])
+    plan = mm.allocate(_gpod("u1", memory="768Mi"))
+    assert sum(plan.values()) == 768
+    assert len(plan) == 1  # fits one NUMA node
+    # second big pod must span nodes
+    plan2 = mm.allocate(_gpod("u2", memory="1Gi"))
+    assert sum(plan2.values()) == 1024
+    with pytest.raises(RuntimeError):
+        mm.allocate(_gpod("u3", memory="512Mi"))
+    mm.release("u1")
+    assert mm.allocate(_gpod("u3", memory="512Mi"))
+
+
+def test_memory_manager_ignores_non_guaranteed():
+    mm = MemoryManager([256])
+    pod = _gpod("u", memory="10Gi")
+    del pod["spec"]["containers"][0]["resources"]["limits"]  # burstable
+    assert mm.allocate(pod) is None
+
+
+# ---------------------------------------------------------- topology manager
+
+def _managers(policy):
+    dm = DeviceManager()
+    dm.register_plugin("example.com/fpga",
+                       [Device("a", 0), Device("b", 1)])
+    mm = MemoryManager([512, 512])
+    tm = TopologyManager(policy=policy, num_numa=2)
+    tm.add_provider(dm)
+    tm.add_provider(mm)
+    return dm, mm, tm
+
+
+def test_topology_single_numa_rejects_cross_node():
+    dm, mm, tm = _managers(POLICY_SINGLE_NUMA)
+    # demands 2 fpgas which live on DIFFERENT numa nodes: no single-NUMA fit
+    ok, reason, _ = tm.admit(_gpod("u", memory="128Mi",
+                                   extra={"example.com/fpga": "2"}))
+    assert not ok and "TopologyAffinityError" in reason
+    # one fpga + small memory aligns on one node
+    ok, _, aff = tm.admit(_gpod("u2", memory="128Mi",
+                                extra={"example.com/fpga": "1"}))
+    assert ok and len(aff) == 1
+
+
+def test_topology_best_effort_admits_cross_node():
+    _dm, _mm, tm = _managers(POLICY_BEST_EFFORT)
+    ok, _, _ = tm.admit(_gpod("u", memory="128Mi",
+                              extra={"example.com/fpga": "2"}))
+    assert ok
+
+
+def test_topology_no_hints_always_admits():
+    """A pod no provider has an opinion about carries no topology
+    constraint — even single-numa-node must admit it (upstream admits
+    hint-less pods)."""
+    _dm, _mm, tm = _managers(POLICY_SINGLE_NUMA)
+    pod = _gpod("u", memory="64Mi")
+    del pod["spec"]["containers"][0]["resources"]["limits"]  # not Guaranteed
+    ok, reason, _ = tm.admit(pod)
+    assert ok, reason
+
+
+def test_topology_rejection_releases_allocatable():
+    """A topology rejection must not leak the admitter reservation."""
+    from kubernetes_tpu.client.clientset import DirectClient
+    from kubernetes_tpu.kubelet.kubelet import Kubelet
+    from kubernetes_tpu.kubelet.managers import Device, POLICY_SINGLE_NUMA
+    from kubernetes_tpu.store.store import ObjectStore
+    client = DirectClient(ObjectStore())
+    kl = Kubelet(client, "n-topo", allocatable={"cpu": "4", "memory": "4Gi",
+                                                "pods": "10"})
+    kl.topology_manager.policy = POLICY_SINGLE_NUMA
+    kl.topology_manager.num_numa = 2
+    kl.device_manager.register_plugin(
+        "example.com/fpga", [Device("a", 0), Device("b", 1)])
+    # spanning demand -> rejected; reservation must be released
+    pod = _gpod("u-rej", cpu="1", memory="128Mi",
+                extra={"example.com/fpga": "2"})
+    pod["metadata"]["name"] = "rej"
+    kl._sync_pod("u-rej", pod)
+    assert "u-rej" in kl._rejected
+    assert kl.admitter._used.get("u-rej") is None \
+        or not kl.admitter._used.get("u-rej")
+    # a normal pod still fits afterwards
+    ok, _ = kl.admitter.admit(_gpod("u-ok", cpu="3", memory="3Gi"))
+    assert ok
