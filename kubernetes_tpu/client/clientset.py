@@ -491,6 +491,8 @@ class HTTPClient(_Handles):
             else "/apis/admissionregistration.k8s.io/v1"
             if plural in ("mutatingwebhookconfigurations",
                           "validatingwebhookconfigurations")
+            else "/apis/apiregistration.k8s.io/v1"
+            if plural == "apiservices"
             else "/api/v1")
         return self._path_for(group, plural, ns, name, sub, query)
 

@@ -97,13 +97,14 @@ ADMISSIONREG_RESOURCES = {
     "validatingwebhookconfigurations": ("ValidatingWebhookConfiguration",
                                         False),
 }
+APIREG_RESOURCES = {"apiservices": ("APIService", False)}
 
 ALL_RESOURCES = {**CORE_RESOURCES, **APPS_RESOURCES, **COORD_RESOURCES,
                  **STORAGE_RESOURCES, **SCHEDULING_RESOURCES,
                  **RBAC_RESOURCES, **POLICY_RESOURCES, **BATCH_RESOURCES,
                  **AUTOSCALING_RESOURCES, **DISCOVERY_RESOURCES,
                  **DRA_RESOURCES, **APIEXT_RESOURCES,
-                 **ADMISSIONREG_RESOURCES}
+                 **ADMISSIONREG_RESOURCES, **APIREG_RESOURCES}
 KIND_TO_PLURAL = {k: p for p, (k, _) in ALL_RESOURCES.items()}
 
 # API group per kind (core = ""), for GroupVersionKind-bearing payloads
@@ -121,7 +122,8 @@ for _table, _group in ((CORE_RESOURCES, ""), (APPS_RESOURCES, "apps"),
                        (DRA_RESOURCES, "resource.k8s.io"),
                        (APIEXT_RESOURCES, "apiextensions.k8s.io"),
                        (ADMISSIONREG_RESOURCES,
-                        "admissionregistration.k8s.io")):
+                        "admissionregistration.k8s.io"),
+                       (APIREG_RESOURCES, "apiregistration.k8s.io")):
     for _k, _ns in _table.values():
         KIND_TO_GROUP[_k] = _group
 
@@ -178,6 +180,10 @@ class APIServer:
         """``data_dir``: durable mode — the store journals every write and
         restores state on construction (store.py WAL + snapshot)."""
         self.store = store or ObjectStore(data_dir=data_dir)
+        from kubernetes_tpu.api.scheme import default_scheme
+        # multi-version serving: (kind, served version) -> conversion pair
+        # (runtime.Scheme analog, api/scheme.py); storage stays at the hub
+        self.scheme = default_scheme()
         self.admission: list[Callable] = []
         self.flow = None  # FlowController when APF is enabled
         self.authenticator = None  # set by enable_auth
@@ -512,6 +518,18 @@ class APIServer:
                 return (_msgpack is not None
                         and MSGPACK_CT in self.headers.get("Accept", ""))
 
+            def _conv_in(self, kind: str, body: dict) -> dict:
+                """Spoke-version request body -> the stored hub shape."""
+                conv = server.scheme.converter(
+                    kind, getattr(self, "_req_version", "v1"))
+                return conv[0](body) if conv else body
+
+            def _conv_out(self, kind: str, obj: dict) -> dict:
+                """Stored hub shape -> the requested spoke version."""
+                conv = server.scheme.converter(
+                    kind, getattr(self, "_req_version", "v1"))
+                return conv[1](obj) if conv else obj
+
             def _send_json(self, code: int, obj):
                 """Respond in the NEGOTIATED format (the name is historic):
                 msgpack when the client's Accept asks for it, JSON otherwise —
@@ -564,10 +582,12 @@ class APIServer:
             def _route(self):
                 """-> (plural, kind, namespace|None, name|None, subresource|None)"""
                 parts = [p for p in urlparse(self.path).path.split("/") if p]
-                # /api/v1/... or /apis/<group>/v1/...
+                # /api/v1/... or /apis/<group>/<version>/...
+                self._req_version = "v1"
                 if parts[:2] == ["api", "v1"]:
                     rest = parts[2:]
                 elif len(parts) >= 3 and parts[0] == "apis":
+                    self._req_version = parts[2]
                     rest = parts[3:]
                 else:
                     return None
@@ -621,11 +641,13 @@ class APIServer:
                         obj = server.store.get(kind, ns or "", name)
                     except NotFound as e:
                         return self._error(404, str(e), "NotFound")
-                    return self._send_json(200, obj)
+                    return self._send_json(200, self._conv_out(kind, obj))
                 if qs.get("watch", ["false"])[0] in ("true", "1"):
                     return self._watch(kind, ns, qs)
                 sel = _field_label_selector(qs)
                 items, rv = server.store.list(kind, namespace=ns, selector=sel)
+                if server.scheme.converter(kind, self._req_version):
+                    items = [self._conv_out(kind, o) for o in items]
                 return self._send_json(200, {
                     "kind": f"{kind}List", "apiVersion": "v1",
                     "metadata": {"resourceVersion": str(rv)}, "items": items})
@@ -654,6 +676,18 @@ class APIServer:
                     payload = Event.wire
                     heartbeat = b"1\r\n\n\r\n"
                     ctype = "application/json"
+                conv = server.scheme.converter(kind, self._req_version)
+                if conv is not None:
+                    # spoke-version watch: per-watcher serialization (the
+                    # zero-copy shared wire bytes carry the hub shape)
+                    from_hub = conv[1]
+                    if use_mp:
+                        payload = lambda e: _msgpack.packb(
+                            {"type": e.type, "object": from_hub(e.object)})
+                    else:
+                        payload = lambda e: json.dumps(
+                            {"type": e.type,
+                             "object": from_hub(e.object)}).encode() + b"\n"
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Transfer-Encoding", "chunked")
@@ -717,6 +751,8 @@ class APIServer:
                     body = self._read_body()
                 except _BadRequest as e:
                     return self._error(400, str(e), "BadRequest")
+                if sub is None:
+                    body = self._conv_in(kind, body)
                 if sub == "binding" and kind == "Pod" and name == "-":
                     # Bulk binding: one POST applies many bindings in a single
                     # store lock pass (the scheduler's gang step binds a whole
@@ -855,7 +891,7 @@ class APIServer:
                     server._commit(commits, True)
                     if kind == "CustomResourceDefinition":
                         server._on_crd_change(out, deleted=False)
-                    return self._send_json(201, out)
+                    return self._send_json(201, self._conv_out(kind, out))
 
             def do_PUT(self):
                 return self._shaped("put", self._do_PUT)
@@ -869,6 +905,10 @@ class APIServer:
                     body = self._read_body()
                 except _BadRequest as e:
                     return self._error(400, str(e), "BadRequest")
+                if sub in (None, "status"):
+                    # status fragments convert too (a v1 controller PUTs
+                    # v1-shaped status; the store must only hold hub shape)
+                    body = self._conv_in(kind, body)
                 with server._crd_guard(kind):
                     if kind == "CustomResourceDefinition" and sub != "status":
                         err = server.validate_crd(body)
@@ -899,7 +939,7 @@ class APIServer:
                     server._commit(commits, True)
                     if kind == "CustomResourceDefinition":
                         server._on_crd_change(out, deleted=False)
-                    return self._send_json(200, out)
+                    return self._send_json(200, self._conv_out(kind, out))
 
             def do_PATCH(self):
                 return self._shaped("patch", self._do_PATCH)
@@ -938,6 +978,7 @@ class APIServer:
                     body = self._read_body()
                 except _BadRequest as e:
                     return self._error(400, str(e), "BadRequest")
+                body = self._conv_in(kind, body)
                 md = body.setdefault("metadata", {})
                 if md.setdefault("name", name) != name:
                     return self._error(
@@ -996,7 +1037,7 @@ class APIServer:
                     server._commit(commits, True)
                     if kind == "CustomResourceDefinition":
                         server._on_crd_change(out, deleted=False)
-                    return self._send_json(code, out)
+                    return self._send_json(code, self._conv_out(kind, out))
 
             def do_DELETE(self):
                 return self._shaped("delete", self._do_DELETE)
@@ -1015,7 +1056,7 @@ class APIServer:
                         return self._error(404, str(e), "NotFound")
                     if kind == "CustomResourceDefinition":
                         server._on_crd_change(out, deleted=True)
-                    return self._send_json(200, out)
+                    return self._send_json(200, self._conv_out(kind, out))
 
         return Handler
 
