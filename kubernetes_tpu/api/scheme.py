@@ -43,19 +43,43 @@ class Scheme:
 
 # --------------------------------------------- autoscaling/v1 <-> v2 (hub)
 
+# non-cpu metrics ride this annotation through the lossy v1 view, exactly
+# like upstream's conversion (pkg/apis/autoscaling/v1/conversion.go) — a
+# v1 read-modify-write must not silently delete a v2 memory metric
+METRICS_ANNOTATION = "autoscaling.alpha.kubernetes.io/metrics"
+
+
 def _hpa_v1_to_v2(obj: dict) -> dict:
     """autoscaling/v1 wire shape -> the stored v2 shape: the single
-    targetCPUUtilizationPercentage becomes a cpu Utilization metric."""
+    targetCPUUtilizationPercentage becomes a cpu Utilization metric, and
+    metrics preserved through the round-trip annotation are restored."""
+    import json as _json
     out = dict(obj)
     spec = dict(out.get("spec") or {})
     pct = spec.pop("targetCPUUtilizationPercentage", None)
-    if pct is not None and not spec.get("metrics"):
-        spec["metrics"] = [{
+    metrics = list(spec.get("metrics") or [])
+    md = dict(out.get("metadata") or {})
+    ann = dict(md.get("annotations") or {})
+    stashed = ann.pop(METRICS_ANNOTATION, None)
+    if stashed:
+        try:
+            metrics += [m for m in _json.loads(stashed)
+                        if isinstance(m, dict)]
+        except ValueError:
+            pass
+        md["annotations"] = ann
+        if not ann:
+            md.pop("annotations", None)
+        out["metadata"] = md
+    if pct is not None:
+        metrics.insert(0, {
             "type": "Resource",
             "resource": {"name": "cpu",
                          "target": {"type": "Utilization",
                                     "averageUtilization": pct}},
-        }]
+        })
+    if metrics:
+        spec["metrics"] = metrics
     out["spec"] = spec
     out["apiVersion"] = "autoscaling/v2"
     if "status" in out:
@@ -74,18 +98,31 @@ def _hpa_v1_to_v2(obj: dict) -> dict:
 
 
 def _hpa_v2_to_v1(obj: dict) -> dict:
-    """Stored v2 -> the v1 wire shape; non-cpu metrics are dropped from the
-    v1 view exactly as upstream's v1 conversion lossily narrows."""
+    """Stored v2 -> the v1 wire shape; the cpu Utilization metric narrows
+    to targetCPUUtilizationPercentage, every OTHER metric is stashed in the
+    round-trip annotation so a v1 PUT of this object can't destroy it."""
+    import json as _json
     out = dict(obj)
     spec = dict(out.get("spec") or {})
     metrics = spec.pop("metrics", None) or []
+    others = []
+    cpu_seen = False
     for m in metrics:
         res = m.get("resource") or {}
-        if m.get("type") == "Resource" and res.get("name") == "cpu":
+        if (not cpu_seen and m.get("type") == "Resource"
+                and res.get("name") == "cpu"):
+            cpu_seen = True
             pct = (res.get("target") or {}).get("averageUtilization")
             if pct is not None:
                 spec["targetCPUUtilizationPercentage"] = pct
-            break
+                continue
+        others.append(m)
+    if others:
+        md = dict(out.get("metadata") or {})
+        ann = dict(md.get("annotations") or {})
+        ann[METRICS_ANNOTATION] = _json.dumps(others)
+        md["annotations"] = ann
+        out["metadata"] = md
     out["spec"] = spec
     out["apiVersion"] = "autoscaling/v1"
     if "status" in out:

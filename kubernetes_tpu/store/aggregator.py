@@ -190,5 +190,11 @@ class AggregatedAPIServer:
 
     def stop(self):
         self._httpd.shutdown()
+        # sever pooled keep-alive sockets (shutdown only stops the accept
+        # loop; handler threads would keep mutating the store), and close
+        # the never-started core server's bound listener too
+        self._httpd.close_all_connections()
         self._httpd.server_close()
+        self.core._httpd.close_all_connections()
+        self.core._httpd.server_close()
         self.core.store.close()

@@ -192,3 +192,36 @@ def test_hpa_ssa_and_status_convert(api):
     assert "currentCPUUtilizationPercentage" not in stored["status"]
     assert stored["status"]["currentMetrics"][0]["resource"]["current"][
         "averageUtilization"] == 42
+
+
+def test_hpa_v1_roundtrip_preserves_non_cpu_metrics(api):
+    """A v1 GET-then-PUT must not destroy v2-only metrics (upstream stashes
+    them in the autoscaling.alpha.kubernetes.io/metrics annotation)."""
+    c = HTTPClient(api.url)
+    c.resource("horizontalpodautoscalers", "default").create({
+        "kind": "HorizontalPodAutoscaler", "metadata": {"name": "rt"},
+        "spec": {"maxReplicas": 4, "metrics": [
+            {"type": "Resource", "resource": {
+                "name": "cpu", "target": {"type": "Utilization",
+                                          "averageUtilization": 50}}},
+            {"type": "Resource", "resource": {
+                "name": "memory", "target": {"type": "Utilization",
+                                             "averageUtilization": 70}}}]}})
+    v1 = c._req("GET", _v1_url(c, "rt"))
+    assert v1["spec"]["targetCPUUtilizationPercentage"] == 50
+    ann = v1["metadata"]["annotations"][
+        "autoscaling.alpha.kubernetes.io/metrics"]
+    assert "memory" in ann
+    # the classic v1 read-modify-write
+    v1["spec"]["targetCPUUtilizationPercentage"] = 55
+    c._req("PUT", _v1_url(c, "rt"), v1,
+           headers={"If-Match": v1["metadata"]["resourceVersion"]})
+    stored = api.store.get("HorizontalPodAutoscaler", "default", "rt")
+    names = {m["resource"]["name"] for m in stored["spec"]["metrics"]}
+    assert names == {"cpu", "memory"}
+    cpu = next(m for m in stored["spec"]["metrics"]
+               if m["resource"]["name"] == "cpu")
+    assert cpu["resource"]["target"]["averageUtilization"] == 55
+    # the stash annotation does not leak into storage
+    assert "autoscaling.alpha.kubernetes.io/metrics" not in (
+        stored["metadata"].get("annotations") or {})

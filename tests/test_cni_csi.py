@@ -1,0 +1,108 @@
+"""CNI exec seam + CSI gRPC seam.
+
+Reference: the CNI spec's exec/JSON protocol (env verbs, stdin conf,
+stdout result) and the CSI Node service the kubelet mounts through
+(``pkg/volume/csi``).
+"""
+
+import pytest
+
+from kubernetes_tpu.kubelet.cni import CNI
+from kubernetes_tpu.kubelet.csi import CSIDriverServer, CSIVolumePlugin
+from kubernetes_tpu.kubelet.volumemanager import VolumeManager
+
+
+# ------------------------------------------------------------------- CNI
+
+def test_cni_add_del_via_exec(tmp_path):
+    cni = CNI(data_dir=str(tmp_path))
+    ip1 = cni.add("ctr-1")
+    ip2 = cni.add("ctr-2")
+    assert ip1 != ip2 and ip1.startswith("10.88.")
+    # ADD is idempotent per container id (state lives in the PLUGIN's dir)
+    assert cni.add("ctr-1") == ip1
+    cni.delete("ctr-1")
+    # released id gets a FRESH ip (sequential allocator)
+    assert cni.add("ctr-1") not in (ip1,)
+
+
+def test_cni_backs_sandbox_ips(tmp_path):
+    from kubernetes_tpu.kubelet.runtime import FakeRuntime
+    cni = CNI(data_dir=str(tmp_path))
+    rt = FakeRuntime(ip_alloc=cni.ip_allocator())
+    a = rt.run_pod_sandbox("u1", "a", "default")
+    b = rt.run_pod_sandbox("u2", "b", "default")
+    assert a.ip != b.ip and a.ip.startswith("10.88.")
+
+
+# ------------------------------------------------------------------- CSI
+
+@pytest.fixture()
+def csi():
+    driver = CSIDriverServer().start()
+    plugin = CSIVolumePlugin(driver.address, node_name="n0")
+    yield driver, plugin
+    plugin.close()
+    driver.stop()
+
+
+def test_csi_stage_publish_lifecycle(csi):
+    driver, plugin = csi
+    assert plugin.plugin_info()["name"] == "hollow.csi.ktpu"
+    plugin.mount("vol-1", "pod-a")
+    plugin.mount("vol-1", "pod-b")  # second pod: publish only, one stage
+    assert "vol-1" in driver.staged
+    assert set(driver.published) == {"vol-1/pod-a", "vol-1/pod-b"}
+    plugin.unmount("vol-1", "pod-a", last_pod=False)
+    assert "vol-1" in driver.staged
+    plugin.unmount("vol-1", "pod-b", last_pod=True)
+    assert "vol-1" not in driver.staged
+    assert not driver.published
+
+
+def test_csi_publish_requires_stage(csi):
+    driver, plugin = csi
+    with pytest.raises(RuntimeError):
+        plugin._req("NodePublishVolume", volume_id="ghost", pod_uid="p",
+                    target_path="/t")
+
+
+def test_volume_manager_drives_csi(csi):
+    driver, plugin = csi
+    vm = VolumeManager(csi_plugin=plugin)
+    pod = {"metadata": {"uid": "u-csi"},
+           "spec": {"volumes": [{"name": "data",
+                                 "csi": {"driver": "hollow.csi.ktpu",
+                                         "volumeHandle": "vol-9"}}]}}
+    vm.add_pod(pod)
+    vm.reconcile_once()
+    assert "vol-9" in driver.staged
+    assert "vol-9/u-csi" in driver.published
+    assert vm.wait_for_attach_and_mount(pod, timeout=1.0)
+    vm.remove_pod(pod)
+    vm.reconcile_once()
+    assert not driver.published
+    assert "vol-9" not in driver.staged  # last pod gone -> unstaged
+
+
+def test_shared_csi_volume_gates_per_pod(csi):
+    """A second pod sharing a csi volume must wait for ITS OWN publish."""
+    driver, plugin = csi
+    vm = VolumeManager(csi_plugin=plugin)
+    vol = {"name": "d", "csi": {"driver": "x", "volumeHandle": "shared"}}
+    pod_a = {"metadata": {"uid": "pa"}, "spec": {"volumes": [vol]}}
+    pod_b = {"metadata": {"uid": "pb"}, "spec": {"volumes": [vol]}}
+    vm.add_pod(pod_a)
+    vm.reconcile_once()
+    assert vm.wait_for_attach_and_mount(pod_a, timeout=1.0)
+    vm.add_pod(pod_b)
+    # B not yet published: the gate must NOT open on A's mount
+    assert not vm.wait_for_attach_and_mount(pod_b, timeout=0.2)
+    vm.reconcile_once()
+    assert vm.wait_for_attach_and_mount(pod_b, timeout=1.0)
+    # both pods removed in ONE reconcile: unstage must come last (the
+    # hollow driver would error a publish-after-unstage; survive = ordered)
+    vm.remove_pod(pod_a)
+    vm.remove_pod(pod_b)
+    vm.reconcile_once()
+    assert not driver.published and "shared" not in driver.staged
