@@ -384,6 +384,10 @@ def build_parser() -> argparse.ArgumentParser:
     de.add_argument("resource")
     de.add_argument("name")
 
+    cert = sub.add_parser("certificate")
+    cert.add_argument("action", choices=["approve", "deny"])
+    cert.add_argument("name")
+
     sc = sub.add_parser("scale")
     sc.add_argument("resource")
     sc.add_argument("name")
@@ -408,6 +412,14 @@ def main(argv=None, out=None) -> int:
             return cmd_delete(client, args, out)
         if args.cmd == "describe":
             return cmd_describe(client, args, out)
+        if args.cmd == "certificate":
+            from kubernetes_tpu.controllers.certificates import (approve_csr,
+                                                                 deny_csr)
+            fn = approve_csr if args.action == "approve" else deny_csr
+            fn(client, args.name)
+            verb = "approved" if args.action == "approve" else "denied"
+            out.write(f"certificatesigningrequest/{args.name} {verb}\n")
+            return 0
         if args.cmd == "scale":
             return cmd_scale(client, args, out)
         if args.cmd == "cordon":

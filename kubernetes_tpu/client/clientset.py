@@ -493,6 +493,8 @@ class HTTPClient(_Handles):
                           "validatingwebhookconfigurations")
             else "/apis/apiregistration.k8s.io/v1"
             if plural == "apiservices"
+            else "/apis/certificates.k8s.io/v1"
+            if plural == "certificatesigningrequests"
             else "/api/v1")
         return self._path_for(group, plural, ns, name, sub, query)
 

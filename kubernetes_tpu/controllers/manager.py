@@ -34,6 +34,7 @@ from kubernetes_tpu.controllers.serviceaccount import (
     ServiceAccountController,
     TokenController,
 )
+from kubernetes_tpu.controllers.certificates import CSRSigningController
 from kubernetes_tpu.controllers.clusterroleaggregation import (
     ClusterRoleAggregationController,
 )
@@ -48,7 +49,8 @@ DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "daemonset",
                        "ttlafterfinished", "horizontalpodautoscaler",
                        "namespace", "serviceaccount", "serviceaccount-token",
                        "resourceclaim", "replicationcontroller", "podgc",
-                       "resourcequota", "ttl", "clusterroleaggregation")
+                       "resourcequota", "ttl", "clusterroleaggregation",
+                       "csrsigning")
 
 
 class ControllerManager:
@@ -83,6 +85,7 @@ class ControllerManager:
             "resourcequota": ResourceQuotaController,
             "ttl": TTLController,
             "clusterroleaggregation": ClusterRoleAggregationController,
+            "csrsigning": CSRSigningController,
         }
         self.controllers = [ctors[n](client) for n in controllers]
         self.gc = GarbageCollector(client) if gc_enabled else None

@@ -98,13 +98,16 @@ ADMISSIONREG_RESOURCES = {
                                         False),
 }
 APIREG_RESOURCES = {"apiservices": ("APIService", False)}
+CERT_RESOURCES = {
+    "certificatesigningrequests": ("CertificateSigningRequest", False)}
 
 ALL_RESOURCES = {**CORE_RESOURCES, **APPS_RESOURCES, **COORD_RESOURCES,
                  **STORAGE_RESOURCES, **SCHEDULING_RESOURCES,
                  **RBAC_RESOURCES, **POLICY_RESOURCES, **BATCH_RESOURCES,
                  **AUTOSCALING_RESOURCES, **DISCOVERY_RESOURCES,
                  **DRA_RESOURCES, **APIEXT_RESOURCES,
-                 **ADMISSIONREG_RESOURCES, **APIREG_RESOURCES}
+                 **ADMISSIONREG_RESOURCES, **APIREG_RESOURCES,
+                 **CERT_RESOURCES}
 KIND_TO_PLURAL = {k: p for p, (k, _) in ALL_RESOURCES.items()}
 
 # API group per kind (core = ""), for GroupVersionKind-bearing payloads
@@ -123,7 +126,8 @@ for _table, _group in ((CORE_RESOURCES, ""), (APPS_RESOURCES, "apps"),
                        (APIEXT_RESOURCES, "apiextensions.k8s.io"),
                        (ADMISSIONREG_RESOURCES,
                         "admissionregistration.k8s.io"),
-                       (APIREG_RESOURCES, "apiregistration.k8s.io")):
+                       (APIREG_RESOURCES, "apiregistration.k8s.io"),
+                       (CERT_RESOURCES, "certificates.k8s.io")):
     for _k, _ns in _table.values():
         KIND_TO_GROUP[_k] = _group
 

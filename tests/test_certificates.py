@@ -1,0 +1,158 @@
+"""CSR flow: create -> approve -> signer controller issues a real X.509
+certificate chained to the cluster CA.
+
+Reference: ``pkg/controller/certificates/signer/signer.go`` +
+``kubectl certificate approve``.
+"""
+
+import base64
+import time
+
+import pytest
+
+from kubernetes_tpu.client.clientset import DirectClient, HTTPClient
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.controllers.certificates import (
+    CSRSigningController,
+    approve_csr,
+    deny_csr,
+    make_csr_pem,
+)
+from kubernetes_tpu.store.apiserver import APIServer
+from kubernetes_tpu.store.store import ObjectStore
+
+
+def wait_until(fn, timeout=8.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+def _run(client):
+    ctrl = CSRSigningController(client)
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    return ctrl, factory
+
+
+def _csr_obj(name, pem):
+    return {"kind": "CertificateSigningRequest",
+            "metadata": {"name": name},
+            "spec": {"request": base64.b64encode(pem).decode(),
+                     "signerName": "kubernetes.io/kube-apiserver-client",
+                     "usages": ["digital signature", "key encipherment"]}}
+
+
+def test_csr_signed_after_approval():
+    from cryptography import x509
+    client = DirectClient(ObjectStore())
+    ctrl, factory = _run(client)
+    try:
+        pem, _key = make_csr_pem("alice", organizations=("dev",))
+        res = client.resource("certificatesigningrequests", None)
+        res.create(_csr_obj("alice-csr", pem))
+        time.sleep(0.3)
+        # pending CSRs are NOT signed
+        assert "certificate" not in (res.get("alice-csr").get("status") or {})
+        approve_csr(client, "alice-csr")
+        assert wait_until(lambda: (res.get("alice-csr").get("status") or {})
+                          .get("certificate"))
+        cert_pem = base64.b64decode(res.get("alice-csr")["status"]
+                                    ["certificate"])
+        cert = x509.load_pem_x509_certificate(cert_pem)
+        # subject preserved, issued by the cluster CA
+        cn = cert.subject.get_attributes_for_oid(
+            x509.oid.NameOID.COMMON_NAME)[0].value
+        assert cn == "alice"
+        assert cert.issuer == ctrl.ca_cert.subject
+        # real chain: the CA's key verifies the signature
+        cert.verify_directly_issued_by(ctrl.ca_cert)
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+def test_denied_csr_never_signed():
+    client = DirectClient(ObjectStore())
+    ctrl, factory = _run(client)
+    try:
+        pem, _ = make_csr_pem("mallory")
+        res = client.resource("certificatesigningrequests", None)
+        res.create(_csr_obj("bad-csr", pem))
+        deny_csr(client, "bad-csr")
+        approve_csr(client, "bad-csr")  # denied wins even if later approved
+        time.sleep(0.5)
+        assert "certificate" not in (res.get("bad-csr").get("status") or {})
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+def test_foreign_signer_left_alone():
+    client = DirectClient(ObjectStore())
+    ctrl, factory = _run(client)
+    try:
+        pem, _ = make_csr_pem("other")
+        obj = _csr_obj("other-csr", pem)
+        obj["spec"]["signerName"] = "example.com/custom-signer"
+        res = client.resource("certificatesigningrequests", None)
+        res.create(obj)
+        approve_csr(client, "other-csr")
+        time.sleep(0.5)
+        assert "certificate" not in (res.get("other-csr").get("status") or {})
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+def test_cli_certificate_approve():
+    import io
+    from kubernetes_tpu.cli.ktpu import main
+    server = APIServer().start()
+    try:
+        pem, _ = make_csr_pem("cli-user")
+        HTTPClient(server.url).resource(
+            "certificatesigningrequests", None).create(
+            _csr_obj("cli-csr", pem))
+        out = io.StringIO()
+        rc = main(["--server", server.url, "certificate", "approve",
+                   "cli-csr"], out=out)
+        assert rc == 0, out.getvalue()
+        got = HTTPClient(server.url).resource(
+            "certificatesigningrequests", None).get("cli-csr")
+        assert any(c["type"] == "Approved"
+                   for c in got["status"]["conditions"])
+    finally:
+        server.stop()
+
+
+def test_unsignable_csr_fails_once_not_forever():
+    """A malformed request records ONE terminal Failed condition — no
+    hot loop of growing conditions."""
+    client = DirectClient(ObjectStore())
+    ctrl, factory = _run(client)
+    try:
+        res = client.resource("certificatesigningrequests", None)
+        res.create({"kind": "CertificateSigningRequest",
+                    "metadata": {"name": "mangled"},
+                    "spec": {"request": "bm90LWEtY3Ny",  # not a CSR
+                             "signerName":
+                                 "kubernetes.io/kube-apiserver-client"}})
+        approve_csr(client, "mangled")
+        assert wait_until(lambda: any(
+            c["type"] == "Failed"
+            for c in (res.get("mangled").get("status") or {})
+            .get("conditions") or []))
+        time.sleep(0.6)  # would accumulate dozens of conditions if looping
+        conds = [c for c in res.get("mangled")["status"]["conditions"]
+                 if c["type"] == "Failed"]
+        assert len(conds) == 1, conds
+    finally:
+        ctrl.stop()
+        factory.stop_all()
