@@ -101,7 +101,10 @@ class Histogram(_Metric):
         return _Timer(self, labels)
 
     def percentile(self, q: float, labels: Optional[dict] = None) -> float:
-        """Approximate quantile from bucket boundaries (upper bound)."""
+        """Approximate quantile from bucket boundaries (upper bound). A
+        quantile landing in the +Inf bucket clamps to the largest finite
+        boundary (Prometheus histogram_quantile does the same) — inf is
+        not valid JSON and tells a reader nothing a max bucket doesn't."""
         k = _label_key(labels)
         with self._lock:
             total = self._totals.get(k, 0)
@@ -111,7 +114,22 @@ class Histogram(_Metric):
             for b, c in zip(self.buckets, self._counts.get(k, [])):
                 if c >= target:
                     return b
-            return float("inf")
+            return self.buckets[-1] if self.buckets else 0.0
+
+    def reset(self, labels: Optional[dict] = None) -> None:
+        """Drop observations (all label sets when ``labels`` is None) — a
+        benchmark measuring a fresh window must not inherit a previous
+        phase's tail (the registry is process-global)."""
+        with self._lock:
+            if labels is None:
+                self._counts.clear()
+                self._totals.clear()
+                self._sums.clear()
+                return
+            k = _label_key(labels)
+            self._counts.pop(k, None)
+            self._totals.pop(k, None)
+            self._sums.pop(k, None)
 
     def expose(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]

@@ -97,7 +97,10 @@ def test_proxier_rules_render(client):
     try:
         rules = p.rules()
         vip = svc["spec"]["clusterIP"]
-        assert any(f"-d {vip}/32" in r and "KUBE-SVC-default/web" in r for r in rules)
+        # VIP dispatch jumps to an upstream-shaped hashed chain, with the
+        # readable service name carried in the -m comment
+        assert any(f"-d {vip}/32" in r and "KUBE-SVC-" in r
+                   and "default/web" in r for r in rules)
         assert any("DNAT --to-destination 10.1.0.1:8080" in r for r in rules)
         # probability ladder on the first of two endpoints
         assert any("--probability 0.50000" in r for r in rules)
@@ -117,5 +120,86 @@ def test_headless_service_has_no_rules(client):
     p = Proxier(client).start()
     try:
         assert all("hl" not in r for r in p.rules())
+    finally:
+        p.stop()
+
+
+# ---------------------------------------------- iptables-restore rendering
+
+def _mk_proxier_with(services, endpoints):
+    from kubernetes_tpu.client.clientset import DirectClient
+    from kubernetes_tpu.store.store import ObjectStore
+    client = DirectClient(ObjectStore())
+    for s in services:
+        client.resource("services", s["metadata"].get("namespace",
+                                                      "default")).create(s)
+    for e in endpoints:
+        client.resource("endpoints", e["metadata"].get("namespace",
+                                                       "default")).create(e)
+    p = Proxier(client).start()
+    return p
+
+
+def test_restore_payload_structure_and_roundtrip():
+    from kubernetes_tpu.proxy.proxier import RestoredRules
+    p = _mk_proxier_with(
+        [{"kind": "Service", "metadata": {"name": "web"},
+          "spec": {"clusterIP": "10.96.0.10", "sessionAffinity": "None",
+                   "ports": [{"port": 80, "protocol": "TCP",
+                              "nodePort": 30080}]}},
+         {"kind": "Service", "metadata": {"name": "empty"},
+          "spec": {"clusterIP": "10.96.0.11",
+                   "ports": [{"port": 443, "protocol": "TCP"}]}}],
+        [{"kind": "Endpoints", "metadata": {"name": "web"},
+          "subsets": [{"addresses": [{"ip": "10.88.0.5"},
+                                     {"ip": "10.88.0.6"}],
+                       "ports": [{"port": 8080}]}]}])
+    try:
+        text = p.sync_proxy_rules_text()
+        # structural essentials of a syncProxyRules payload
+        assert text.startswith("*nat")
+        assert text.rstrip().endswith("COMMIT")
+        assert ":KUBE-SERVICES - [0:0]" in text
+        assert "-j MASQUERADE" in text and "0x4000/0x4000" in text
+        assert "--mode random --probability 0.5" in text
+        assert "-j REJECT" in text  # endpoint-less service
+        # chain names are upstream-shaped hashes, not readable strings
+        import re
+        assert re.search(r"KUBE-SVC-[A-Z2-7]{16}", text)
+        assert re.search(r"KUBE-SEP-[A-Z2-7]{16}", text)
+        # ROUND TRIP: parsing the text yields the same DNAT decisions
+        rr = RestoredRules(text)
+        assert sorted(rr.backends("10.96.0.10", 80)) == \
+            ["10.88.0.5:8080", "10.88.0.6:8080"]
+        assert rr.backends("10.96.0.11", 443) == []  # REJECT
+        # nodePort dispatch reaches the same chain
+        assert sorted(rr.backends("203.0.113.1", 30080)) == \
+            ["10.88.0.5:8080", "10.88.0.6:8080"]
+        # and the live resolve() agrees with the parsed rules
+        got = {p.resolve("10.96.0.10", 80) for _ in range(50)}
+        assert got == set(rr.backends("10.96.0.10", 80))
+    finally:
+        p.stop()
+
+
+def test_rejected_vip_does_not_fall_through_to_nodeport():
+    """REJECT precedes the nodePort dispatch: a rejected clusterIP whose
+    port collides with another service's nodePort must stay rejected."""
+    from kubernetes_tpu.proxy.proxier import RestoredRules
+    p = _mk_proxier_with(
+        [{"kind": "Service", "metadata": {"name": "np"},
+          "spec": {"clusterIP": "10.96.0.20",
+                   "ports": [{"port": 80, "protocol": "TCP",
+                              "nodePort": 30080}]}},
+         {"kind": "Service", "metadata": {"name": "dead"},
+          "spec": {"clusterIP": "10.96.0.21",
+                   "ports": [{"port": 30080, "protocol": "TCP"}]}}],
+        [{"kind": "Endpoints", "metadata": {"name": "np"},
+          "subsets": [{"addresses": [{"ip": "10.88.0.9"}],
+                       "ports": [{"port": 8080}]}]}])
+    try:
+        rr = RestoredRules(p.sync_proxy_rules_text())
+        assert rr.backends("10.96.0.21", 30080) == []   # REJECT wins
+        assert rr.backends("203.0.113.9", 30080) == ["10.88.0.9:8080"]
     finally:
         p.stop()
