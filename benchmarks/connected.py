@@ -18,6 +18,22 @@ import multiprocessing as mp
 import time
 
 
+def _trace_window() -> None:
+    """Arm the process-global tracer for a measured window."""
+    from kubernetes_tpu.utils.tracing import TRACER
+    TRACER.max_spans = 200_000  # keep long/timed-out windows untruncated
+    TRACER.reset()
+
+
+def _span_totals() -> dict:
+    """Span name -> total ms since _trace_window()."""
+    from kubernetes_tpu.utils.tracing import TRACER
+    out: dict = {}
+    for s in TRACER.spans():
+        out[s.name] = round(out.get(s.name, 0.0) + s.duration_ms, 1)
+    return out
+
+
 def _serve(conn) -> None:
     """Server process: in-memory store + HTTP apiserver until told to stop."""
     from kubernetes_tpu.store.apiserver import APIServer
@@ -137,9 +153,7 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
                                      "period_s": churn_period_s},
                              daemon=True).start()
 
-        from kubernetes_tpu.utils.tracing import TRACER
-        TRACER.max_spans = 200_000  # keep long/timed-out windows untruncated
-        TRACER.reset()  # spans from here on belong to the measured window
+        _trace_window()  # spans from here on belong to the measured window
         # the registry is process-global: an earlier bench phase's attempts
         # (e.g. the churn workload) must not pollute this window's p99
         ATTEMPT_DURATION.reset()
@@ -161,12 +175,8 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
             # seed_client is thread-safe: connections live in
             # threading.local, so each pool thread gets its own socket
             seed_client.pods(ns).create_many(objs)
-        if len(jobs) > 1:
-            with ThreadPoolExecutor(max_workers=min(4, len(jobs))) as pool:
-                list(pool.map(create, jobs))
-        else:
-            for job in jobs:
-                create(job)
+        with ThreadPoolExecutor(max_workers=min(4, len(jobs))) as pool:
+            list(pool.map(create, jobs))
         t_created = time.time()
         runner.start_loop()
         deadline = t_start + timeout
@@ -210,10 +220,7 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         p50 = ATTEMPT_DURATION.percentile(0.50, {"result": "scheduled"})
         # where the window went: scheduler-side span totals (ms) + the bind
         # progress curve, so a BENCH file diagnoses its own bottleneck
-        span_ms: dict = {}
-        for s in TRACER.spans():
-            span_ms[s.name] = round(span_ms.get(s.name, 0.0)
-                                    + s.duration_ms, 1)
+        span_ms = _span_totals()
         out = {
             "case": "ConnectedChurn" if churn else "ConnectedScheduler",
             "workload": f"{n_pods}x{n_nodes}",
@@ -288,6 +295,7 @@ def run_connected_preemption(n_nodes: int = 5000, n_high: int = 128,
         runner.start(wait_sync=60.0, start_loop=False)
         warmed = _warm_preempt(runner, n_high, log)
 
+        _trace_window()
         high = [make_pod(f"hi-{k}", "preempt")
                 .req({"cpu": "6", "memory": "8Gi"}).priority(100).obj()
                 for k in range(n_high)]
@@ -325,6 +333,7 @@ def run_connected_preemption(n_nodes: int = 5000, n_high: int = 128,
                         if p["spec"].get("nodeName"))
         log(f"  {bound}/{n_high} preemptors bound at +{dt:.1f}s")
         runner.stop()
+        span_ms = _span_totals()
         remaining = len(seed_client.pods("default").list())
         return {
             "case": "ConnectedPreemption",
@@ -334,6 +343,7 @@ def run_connected_preemption(n_nodes: int = 5000, n_high: int = 128,
             "measure_s": round(dt, 2),
             "victims_evicted": len(low) - remaining,
             "watch_degraded": watch_dead.is_set(),
+            "span_ms": span_ms,
             # False = compilation happened INSIDE the measured window; the
             # throughput is then not comparable run to run
             "jit_warmed": warmed,
@@ -374,10 +384,14 @@ def _warm_preempt(runner, n_high: int, log) -> bool:
                       fit_strategy=profile.fit_strategy,
                       topo_keys=meta.topo_keys, weights=profile.weights(),
                       enabled_filters=profile.enabled_filters)
-        masks = pmod.tensor_static_masks(nodes, warm, ct=ct, meta=meta,
-                                         encode_pods=cache.encode_pods)
+        # same bucket pinning as the scheduler's wave path, so every wave
+        # of the storm hits the programs compiled here
+        masks = pmod.tensor_static_masks(
+            nodes, warm, ct=ct, meta=meta, encode_pods=cache.encode_pods,
+            min_p=pmod.WAVE_BUCKET)
         from kubernetes_tpu.ops.preemption import dry_run_wave
-        dry_run_wave(nodes, bound, warm, [], static_masks=masks)
+        dry_run_wave(nodes, bound, warm, [], static_masks=masks,
+                     min_q=pmod.WAVE_BUCKET)
     except Exception:
         import traceback
         traceback.print_exc()

@@ -265,8 +265,8 @@ def _encode_cluster_arrays(nodes, bound_pods, resources, prio_cut,
 
 def dry_run_wave(nodes: list[Node], bound_pods: list[Pod],
                  preemptors: list[Pod], budgets: list[tuple], dra=None,
-                 static_masks: Optional[np.ndarray] = None
-                 ) -> list:
+                 static_masks: Optional[np.ndarray] = None,
+                 min_q: int = 1) -> list:
     """Device dry-run for a WAVE of preemptors with sequential-commit
     semantics. -> per-preemptor ``None`` (no resource-feasible eviction
     set), ``"zero_evict"`` (fits without evicting: failure was relational,
@@ -288,8 +288,14 @@ def dry_run_wave(nodes: list[Node], bound_pods: list[Pod],
     resources = sorted(reqs_union)
     R = len(resources)
     Q = len(preemptors)
-    need = np.zeros((Q, R), np.int64)
-    prio = np.zeros(Q, np.int32)
+    # Bucket the wave length: Q is the scan length (STRUCTURAL — every
+    # distinct Q is a fresh XLA compile, and a storm's waves vary in size).
+    # Pad rows are inert: INT_MIN priority evicts nothing and an all-False
+    # static mask admits nothing, so the pad scans as found=False without
+    # touching the carry.
+    Qb = next_bucket(max(Q, min_q), minimum=1)
+    need = np.zeros((Qb, R), np.int64)
+    prio = np.full(Qb, _INT_MIN, np.int32)
     for q, pod in enumerate(preemptors):
         pr = dict(pod.resource_requests())
         if dra is not None:
@@ -307,9 +313,14 @@ def dry_run_wave(nodes: list[Node], bound_pods: list[Pod],
     if static_masks is None:
         static_masks = np.stack([_static_mask(nodes, pod)
                                  for pod in preemptors])
+    if static_masks.shape[0] < Qb:
+        static_masks = np.concatenate(
+            [static_masks,
+             np.zeros((Qb - static_masks.shape[0], static_masks.shape[1]),
+                      bool)])
 
     found, zero_evict, cand_nodes, evict_sel = jax.device_get(_wave_scan(
-        allocatable, requested, static_masks, vic_req, vic_valid,
+        allocatable, requested, static_masks[:Qb], vic_req, vic_valid,
         vic_violating, vic_prio, need, prio))
     out = []
     for q in range(Q):

@@ -643,28 +643,36 @@ class Scheduler:
         order, mirroring Q serial _default_preempt calls. The cache's
         already-encoded cluster supplies the [Q,N] static filter masks —
         preempt_wave would otherwise re-encode the whole cluster for them."""
-        nodes, ct, meta = self.cache.snapshot()
-        bound = self.cache.bound_pods(include_assumed=True)
+        from kubernetes_tpu.utils.tracing import TRACER
+        with TRACER.span("preempt/snapshot"):
+            nodes, ct, meta = self.cache.snapshot()
+            bound = self.cache.bound_pods(include_assumed=True)
         views = [self._preempt_view(p) for p in pods]
         try:
-            masks = preemption_mod.tensor_static_masks(
-                nodes, views, ct=ct, meta=meta,
-                encode_pods=self.cache.encode_pods)
+            with TRACER.span("preempt/masks", pods=len(pods)):
+                masks = preemption_mod.tensor_static_masks(
+                    nodes, views, ct=ct, meta=meta,
+                    encode_pods=self.cache.encode_pods,
+                    min_p=preemption_mod.WAVE_BUCKET)
         except Exception:
             _LOG.exception("static masks from resident encoding failed; "
                            "preempt_wave will re-encode")
             masks = None  # preempt_wave computes its own
-        results = preemption_mod.preempt_wave(
-            nodes, bound, views, pdbs=self.pdb_lister(),
-            dra=self.cache.dra_catalog, static_masks=masks)
+        with TRACER.span("preempt/wave", pods=len(pods),
+                         nodes=len(nodes)):
+            results = preemption_mod.preempt_wave(
+                nodes, bound, views, pdbs=self.pdb_lister(),
+                dra=self.cache.dra_catalog, static_masks=masks,
+                min_q=preemption_mod.WAVE_BUCKET)
         out: list[Optional[str]] = []
-        for res in results:
-            if res is None:
-                out.append(None)
-                continue
-            for v in res.victims:
-                self._evict(v)
-            out.append(res.node_name)
+        with TRACER.span("preempt/evict"):
+            for res in results:
+                if res is None:
+                    out.append(None)
+                    continue
+                for v in res.victims:
+                    self._evict(v)
+                out.append(res.node_name)
         return out
 
     def _evict(self, victim: Pod):
