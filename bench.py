@@ -89,7 +89,7 @@ def main():
         log("[bench] " + json.dumps(pallas))
 
     connected_preemption = None
-    if os.environ.get("BENCH_PREEMPTION", "1") != "0" and not only_case:
+    if os.environ.get("BENCH_CPREEMPT", "1") != "0" and not only_case:
         from benchmarks.connected import run_connected_preemption
         log("[bench] connected preemption run ...")
         connected_preemption = run_connected_preemption(

@@ -63,6 +63,10 @@ class ThreadSafeStore:
         with self._lock:
             return list(self._items.values())
 
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
     def keys(self) -> list[str]:
         with self._lock:
             return list(self._items.keys())

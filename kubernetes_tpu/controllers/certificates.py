@@ -12,8 +12,8 @@ from __future__ import annotations
 
 import base64
 import datetime
-import time
 
+from kubernetes_tpu.utils.clock import rfc3339_now
 from kubernetes_tpu.client.clientset import ApiError
 from kubernetes_tpu.client.informer import InformerFactory
 from kubernetes_tpu.controllers.base import Controller
@@ -186,7 +186,7 @@ def approve_csr(client, name: str, message: str = "approved") -> dict:
     if not _is_approved(csr):
         conds.append({"type": "Approved", "status": "True",
                       "reason": "ManualApproval", "message": message,
-                      "lastUpdateTime": time.time()})
+                      "lastUpdateTime": rfc3339_now()})
     return res.update_status(csr)
 
 
@@ -197,5 +197,5 @@ def deny_csr(client, name: str, message: str = "denied") -> dict:
     if not _is_denied(csr):  # idempotent, like approve_csr
         status.setdefault("conditions", []).append(
             {"type": "Denied", "status": "True", "reason": "ManualDenial",
-             "message": message, "lastUpdateTime": time.time()})
+             "message": message, "lastUpdateTime": rfc3339_now()})
     return res.update_status(csr)

@@ -24,20 +24,26 @@ class TTLController(Controller):
 
     def register(self, factory: InformerFactory) -> None:
         self.node_informer = factory.informer("nodes", None)
+        self._last_ttl: int | None = None
         self.node_informer.add_event_handler(self._on_node)
 
     def _on_node(self, type_, obj, old) -> None:
         if type_ in ("ADDED", "DELETED"):
-            # crossing a size boundary in EITHER direction changes every
-            # node's desired ttl (ttl_controller enqueues the fleet on
-            # cluster-size transitions)
-            for n in self.node_informer.store.list():
-                self.enqueue(n)
+            # the fleet is re-enqueued only when the cluster-size TIER
+            # changes (ttl_controller enqueues everything on boundary
+            # crossings, not on every membership event — at fleet scale
+            # per-event fan-out is O(N^2))
+            ttl = self._desired_ttl()
+            if ttl != self._last_ttl:
+                self._last_ttl = ttl
+                for n in self.node_informer.store.list():
+                    self.enqueue(n)
+                return
         if type_ != "DELETED":
             self.enqueue(obj)
 
     def _desired_ttl(self) -> int:
-        n = len(self.node_informer.store.list())
+        n = len(self.node_informer.store)
         for bound, ttl in _BOUNDARIES:
             if n <= bound:
                 return ttl

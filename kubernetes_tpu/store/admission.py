@@ -38,21 +38,29 @@ _AUTO_TOLERATIONS = ("node.kubernetes.io/not-ready",
 class AdmissionChain:
     """Ordered mutating plugins then validating plugins, as one callable."""
 
+    wants_subresource = True  # threads the subresource to webhook dispatch
+
     def __init__(self):
         self.mutating: list[Callable] = []
         self.validating: list[Callable] = []
 
-    def __call__(self, verb: str, kind: str, obj: dict) -> dict:
+    @staticmethod
+    def _invoke(fn, verb, kind, obj, sub):
+        if getattr(fn, "wants_subresource", False):
+            return fn(verb, kind, obj, sub)
+        return fn(verb, kind, obj)
+
+    def __call__(self, verb: str, kind: str, obj: dict, sub=None) -> dict:
         hooks = []
         try:
             for fn in self.mutating:
-                r = fn(verb, kind, obj)
+                r = self._invoke(fn, verb, kind, obj, sub)
                 if callable(r):
                     hooks.append(r)
                 elif r:
                     obj = r
             for fn in self.validating:
-                out = fn(verb, kind, obj)
+                out = self._invoke(fn, verb, kind, obj, sub)
                 if callable(out):  # two-phase plugin: commit hook (see _admit)
                     hooks.append(out)
                 elif out is not None and out is not obj:

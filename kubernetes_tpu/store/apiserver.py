@@ -336,7 +336,8 @@ class APIServer:
 
     # ---- request handling ------------------------------------------------
 
-    def _admit(self, verb: str, kind: str, obj: dict) -> dict:
+    def _admit(self, verb: str, kind: str, obj: dict,
+               sub: Optional[str] = None) -> dict:
         """Run the admission chain. A plugin may return a mutated object, or a
         callable commit hook ``hook(ok: bool)`` invoked after the storage
         operation completes (two-phase: lets e.g. quota release its in-flight
@@ -344,10 +345,15 @@ class APIServer:
         guessing by name — generateName objects have none at admission time).
         Collected hooks are stashed on the returned object under a private
         key the storage path pops before persisting."""
+        from kubernetes_tpu.store.admission import AdmissionChain
         hooks = []
         try:
             for fn in self.admission:
-                r = fn(verb, kind, obj)
+                # webhook dispatchers match rules against the subresource
+                # (a hook registered for "pods" must NOT fire on every
+                # status heartbeat; "pods/status" opts in) — built-in
+                # plugins keep the 3-arg shape
+                r = AdmissionChain._invoke(fn, verb, kind, obj, sub)
                 if callable(r):
                     hooks.append(r)
                 elif r:
@@ -919,7 +925,7 @@ class APIServer:
                         if err:
                             return self._error(400, err, "Invalid")
                     try:
-                        body = server._admit("UPDATE", kind, body)
+                        body = server._admit("UPDATE", kind, body, sub)
                     except AdmissionError as e:
                         return self._error(400, str(e), "AdmissionDenied")
                     commits = server._pop_commits(body)
@@ -958,6 +964,8 @@ class APIServer:
                 (kubectl --force-conflicts)."""
                 from kubernetes_tpu.store.apply import (ApplyConflict,
                                                         server_side_apply)
+                from kubernetes_tpu.store.apply import \
+                    path_str as apply_path_str
                 r = self._route()
                 if r is None:
                     return self._error(404, "unknown path")
@@ -1004,7 +1012,7 @@ class APIServer:
                             "message": str(e), "reason": "Conflict",
                             "code": 409,
                             "details": {"causes": [
-                                {"field": p,
+                                {"field": apply_path_str(p),
                                  "message": f"conflict with {m!r}"}
                                 for p, m in e.conflicts]}})
                     if kind == "CustomResourceDefinition":

@@ -125,12 +125,18 @@ class _Dispatcher:
         return hooks
 
     @staticmethod
-    def _matches(wh: dict, verb: str, kind: str) -> bool:
+    def _matches(wh: dict, verb: str, kind: str,
+                 sub: Optional[str] = None) -> bool:
+        """Rule matching with upstream's resource/subresource split
+        (``plugin/webhook/rules/rules.go`` Matcher.resource): ``pods``
+        matches only the main resource, ``pods/status`` that subresource,
+        ``pods/*`` any, ``*`` all resources but NO subresources."""
         from kubernetes_tpu.store.apiserver import KIND_TO_PLURAL
         rules = wh.get("rules")
         if not rules:
             return False
         plural = KIND_TO_PLURAL.get(kind, kind.lower() + "s")
+        req_sub = sub or ""
         for rule in rules:
             ops = rule.get("operations") or ["*"]
             # upstream validation requires non-empty resources; a rule
@@ -138,9 +144,14 @@ class _Dispatcher:
             kinds = rule.get("resources") or rule.get("kinds")
             if not kinds:
                 continue
-            if ("*" in ops or verb in ops) and (
-                    "*" in kinds or kind in kinds or plural in kinds):
-                return True
+            if "*" not in ops and verb not in ops:
+                continue
+            for entry in kinds:
+                res, _, rsub = str(entry).partition("/")
+                res_ok = res == "*" or res == plural or res == kind
+                sub_ok = rsub == "*" or rsub == req_sub
+                if res_ok and sub_ok:
+                    return True
         return False
 
     def _call(self, wh: dict, verb: str, kind: str, obj: dict
@@ -180,12 +191,14 @@ class MutatingWebhooks(_Dispatcher):
     JSONPatch in webhook order."""
 
     CONFIG_KIND = "MutatingWebhookConfiguration"
+    wants_subresource = True
 
-    def __call__(self, verb: str, kind: str, obj: dict):
+    def __call__(self, verb: str, kind: str, obj: dict,
+                 sub: Optional[str] = None):
         if kind == self.CONFIG_KIND or kind == "ValidatingWebhookConfiguration":
             return None  # the configs themselves bypass the webhooks
         for wh in self._webhooks():
-            if not self._matches(wh, verb, kind):
+            if not self._matches(wh, verb, kind, sub):
                 continue
             resp = self._call(wh, verb, kind, obj)
             if resp is None:
@@ -210,12 +223,14 @@ class ValidatingWebhooks(_Dispatcher):
     cannot mutate."""
 
     CONFIG_KIND = "ValidatingWebhookConfiguration"
+    wants_subresource = True
 
-    def __call__(self, verb: str, kind: str, obj: dict):
+    def __call__(self, verb: str, kind: str, obj: dict,
+                 sub: Optional[str] = None):
         if kind in ("MutatingWebhookConfiguration", self.CONFIG_KIND):
             return None
         for wh in self._webhooks():
-            if self._matches(wh, verb, kind):
+            if self._matches(wh, verb, kind, sub):
                 self._call(wh, verb, kind, obj)
         return None
 

@@ -1,0 +1,32 @@
+"""Wire-format timestamps.
+
+Reference shape: metav1.Time serializes as RFC3339 with second precision
+(``apimachinery/pkg/apis/meta/v1/time.go``, MarshalJSON). Every condition
+``lastTransitionTime``, managedFields ``time``, event timestamp etc. is a
+string of this shape on the wire; kubectl-shaped consumers parse it.
+"""
+
+from __future__ import annotations
+
+import datetime
+
+
+def rfc3339_now() -> str:
+    """Current UTC time as an RFC3339 string, e.g. '2026-07-30T12:34:56Z'."""
+    return rfc3339(datetime.datetime.now(datetime.timezone.utc))
+
+
+def rfc3339(dt: datetime.datetime) -> str:
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return dt.astimezone(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def rfc3339_from_epoch(ts: float) -> str:
+    return rfc3339(datetime.datetime.fromtimestamp(ts, datetime.timezone.utc))
+
+
+def parse_rfc3339(s: str) -> float:
+    """RFC3339 string -> epoch seconds (tolerates fractional seconds)."""
+    return datetime.datetime.fromisoformat(
+        str(s).replace("Z", "+00:00")).timestamp()

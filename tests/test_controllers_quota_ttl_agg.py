@@ -135,3 +135,53 @@ def test_clusterrole_aggregation(client):
         assert rules() == []
     finally:
         stop(ctrl, factory)
+
+
+def test_ttl_fleet_enqueue_only_on_tier_change():
+    """ADVICE r4: per-event fleet fan-out is O(N^2) at scale — the fleet is
+    re-enqueued only when the cluster-size TIER changes (upstream enqueues
+    everything on ttlBoundaries crossings, not every membership event)."""
+    ctrl = TTLController.__new__(TTLController)
+    enqueued = []
+    ctrl.enqueue = lambda obj: enqueued.append(
+        (obj.get("metadata") or {}).get("name"))
+
+    class _Store:
+        def __init__(self):
+            self.nodes = []
+
+        def list(self):
+            return self.nodes
+
+        def __len__(self):
+            return len(self.nodes)
+
+    class _Informer:
+        def __init__(self):
+            self.store = _Store()
+
+        def add_event_handler(self, fn):
+            pass
+
+    class _Factory:
+        def informer(self, *a):
+            return _Informer()
+
+    ctrl.register(_Factory())
+    store = ctrl.node_informer.store
+    # first ADDED establishes the tier -> one fleet pass of size 1
+    store.nodes = [{"metadata": {"name": "n0"}}]
+    ctrl._on_node("ADDED", store.nodes[0], None)
+    n_after_first = len(enqueued)
+    # 50 more ADDs inside the same tier (<100 -> ttl 0): one enqueue each,
+    # no fleet fan-out
+    for i in range(1, 51):
+        store.nodes = [{"metadata": {"name": f"n{j}"}}
+                       for j in range(i + 1)]
+        ctrl._on_node("ADDED", store.nodes[-1], None)
+    assert len(enqueued) == n_after_first + 50
+    # crossing the 100-node boundary re-enqueues the fleet once
+    store.nodes = [{"metadata": {"name": f"n{j}"}} for j in range(101)]
+    before = len(enqueued)
+    ctrl._on_node("ADDED", store.nodes[-1], None)
+    assert len(enqueued) == before + 101  # whole fleet, tier changed

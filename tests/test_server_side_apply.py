@@ -26,8 +26,19 @@ def _cm(name, data):
 
 
 def test_fields_v1_roundtrip():
-    paths = {"data.a", "data.b.c", "metadata.labels.x"}
+    paths = {("data", "a"), ("data", "b", "c"), ("metadata", "labels", "x")}
     assert from_fields_v1(to_fields_v1(paths)) == paths
+
+
+def test_fields_v1_roundtrip_dotted_segments():
+    """Map keys containing '.' (ConfigMap data file names, label keys like
+    topology.kubernetes.io/zone) are single fieldsV1 segments — they must
+    NOT split into nested path components."""
+    paths = {("data", "config.yaml"),
+             ("metadata", "labels", "topology.kubernetes.io/zone")}
+    assert from_fields_v1(to_fields_v1(paths)) == paths
+    trie = to_fields_v1(paths)
+    assert "f:config.yaml" in trie["f:data"]
 
 
 def test_apply_creates_with_ownership():
@@ -70,15 +81,15 @@ def test_conflict_and_force():
     v1 = server_side_apply(None, _cm("c", {"k": "a-version"}), "mgr-a")
     with pytest.raises(ApplyConflict) as ei:
         server_side_apply(v1, _cm("c", {"k": "b-version"}), "mgr-b")
-    assert ei.value.conflicts == [("data.k", "mgr-a")]
+    assert ei.value.conflicts == [(("data", "k"), "mgr-a")]
     forced = server_side_apply(v1, _cm("c", {"k": "b-version"}), "mgr-b",
                                force=True)
     assert forced["data"]["k"] == "b-version"
     # ownership transferred: mgr-a's entry no longer claims data.k
     owners = {e["manager"]: from_fields_v1(e["fieldsV1"])
               for e in forced["metadata"]["managedFields"]}
-    assert "data.k" in owners["mgr-b"]
-    assert "data.k" not in owners.get("mgr-a", set())
+    assert ("data", "k") in owners["mgr-b"]
+    assert ("data", "k") not in owners.get("mgr-a", set())
 
 
 def test_same_value_is_not_a_conflict():
@@ -87,7 +98,8 @@ def test_same_value_is_not_a_conflict():
     owners = {e["manager"]: from_fields_v1(e["fieldsV1"])
               for e in v2["metadata"]["managedFields"]}
     # co-ownership: both managers hold the path
-    assert "data.k" in owners["mgr-a"] and "data.k" in owners["mgr-b"]
+    assert ("data", "k") in owners["mgr-a"]
+    assert ("data", "k") in owners["mgr-b"]
     # a co-owner dropping the field does NOT remove it (other owner remains)
     v3 = server_side_apply(v2, {"kind": "ConfigMap",
                                 "metadata": {"name": "c"}}, "mgr-b")
@@ -164,6 +176,34 @@ def test_apply_field_manager_url_encoding(api):
               field_manager="kubectl client-side & friends")
     mf = res.get("enc")["metadata"]["managedFields"]
     assert mf[0]["manager"] == "kubectl client-side & friends"
+
+
+def test_apply_with_dotted_data_keys():
+    """Regression: applying {'data': {'config.yaml': ...}} over a live
+    object must replace the value (not silently keep the old one) and must
+    not inject junk nested keys like {'config': {'yaml': None}}."""
+    v1 = server_side_apply(None, _cm("c", {"config.yaml": "a: 1"}), "m")
+    v2 = server_side_apply(v1, _cm("c", {"config.yaml": "a: 2"}), "m")
+    assert v2["data"] == {"config.yaml": "a: 2"}
+    assert "config" not in v2["data"]
+    # reconcile-by-absence with a dotted sibling
+    v3 = server_side_apply(
+        v2, _cm("c", {"config.yaml": "a: 2", "extra.toml": "x"}), "m")
+    v4 = server_side_apply(v3, _cm("c", {"config.yaml": "a: 2"}), "m")
+    assert v4["data"] == {"config.yaml": "a: 2"}
+
+
+def test_apply_with_dotted_label_keys():
+    v1 = server_side_apply(None, {
+        "kind": "Node", "metadata": {
+            "name": "n",
+            "labels": {"topology.kubernetes.io/zone": "us-east1-a"}}}, "m")
+    v2 = server_side_apply(v1, {
+        "kind": "Node", "metadata": {
+            "name": "n",
+            "labels": {"topology.kubernetes.io/zone": "us-east1-b"}}}, "m")
+    assert v2["metadata"]["labels"] == {
+        "topology.kubernetes.io/zone": "us-east1-b"}
 
 
 def test_apply_to_subresource_rejected(api):

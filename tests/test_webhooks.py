@@ -141,3 +141,36 @@ def test_apply_json_patch_ops():
     assert "labels" not in out["metadata"]
     # original untouched
     assert obj["spec"]["containers"][0]["name"] == "a"
+
+
+def test_webhook_subresource_scoping(api):
+    """A rule for 'pods' must NOT fire on status PUTs; 'pods/status' opts
+    in; 'pods/*' matches both (plugin/webhook/rules/rules.go Matcher)."""
+    hook = WebhookTestServer(validate=lambda r: (True, "")).start()
+    try:
+        c = HTTPClient(api.url)
+        _register(c, "ValidatingWebhookConfiguration",
+                  "validatingwebhookconfigurations", "main-only", hook.url,
+                  resources=("pods",), operations=("UPDATE",))
+        pods = c.pods("default")
+        pods.create(make_pod("w").obj().to_dict())
+        pod = pods.get("w")
+        pod.setdefault("status", {})["phase"] = "Running"
+        pods.update_status(pod)  # status heartbeat: rule must not fire
+        assert hook.calls == 0
+        pod = pods.get("w")
+        pod["metadata"].setdefault("labels", {})["x"] = "1"
+        pods.update(pod)  # main-resource UPDATE: fires
+        assert hook.calls == 1
+
+        # a pods/status rule fires ONLY on the status fragment
+        _register(c, "ValidatingWebhookConfiguration",
+                  "validatingwebhookconfigurations", "status-only", hook.url,
+                  resources=("pods/status",), operations=("UPDATE",))
+        time.sleep(1.1)  # config poll window
+        pod = pods.get("w")
+        pod["status"]["phase"] = "Succeeded"
+        pods.update_status(pod)
+        assert hook.calls == 2  # status-only fired, main-only did not
+    finally:
+        hook.stop()
