@@ -239,6 +239,7 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         }
         if churn:
             out["churn_api_ops"] = churn_stats.get("ops", 0)
+        out["ctx_stats"] = dict(runner.scheduler.ctx_stats)
         return out
     finally:
         try:

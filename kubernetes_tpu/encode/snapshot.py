@@ -243,10 +243,7 @@ class _PatchState:
     resources: list[str]
     res_index: dict[str, int]
     node_index: dict[str, int]
-    # bucket sizes bounding what a patch may add. Value ids have no bucket
-    # here on purpose: patched pods' label VALUES are only ever compared by
-    # interned id (label_value_num[V] is indexed by node labels alone, and
-    # node changes force a full re-encode).
+    # bucket sizes bounding what a patch may add
     K: int
     ET: int
     EAX: int
@@ -259,6 +256,21 @@ class _PatchState:
     # pods whose encode contributed node port/volume state — removing or
     # replacing one requires a full re-encode
     unpatchable: set = dc_field(default_factory=set)
+    # ---- node-side patch bookkeeping (drain-context churn patches:
+    # encode/patch.py). Bucket widths of the node-axis arrays plus the free
+    # node rows the N bucket left (node_valid False), so node ADD/REMOVE can
+    # patch the encoding instead of forcing a full rebuild under churn.
+    N: int = 0
+    V: int = 0
+    T: int = 0
+    I: int = 0
+    IMG: int = 0  # filled prefix of image_sizes: a NEW image id needs its
+    #               size shipped, which patches don't do -> rebuild
+    PRT: int = 0
+    VN: int = 0
+    E: int = 0
+    node_free: list[int] = dc_field(default_factory=list)  # ascending rows
+    row_pods: dict[int, int] = dc_field(default_factory=dict)  # row -> #pods
 
 
 @dataclass
@@ -316,6 +328,13 @@ class SnapshotEncoder:
         self._rwop_in_use: set = set()
         self._patch: Optional[_PatchState] = None
         self.generation = 0
+        # bucket headroom so CHURN patches fit without re-encoding: free
+        # node rows for node ADDs, spare label-value ids for the new values
+        # they intern (every node interns its own name). 0 = tight buckets
+        # (kernels/parity tests); the scheduler cache raises them.
+        self.node_headroom = 0
+        self.value_headroom = 0
+        self.ns_headroom = 0
 
     def set_volumes(self, catalog) -> None:
         """Attach the PVC/PV/StorageClass catalog consulted by the next
@@ -383,7 +402,7 @@ class SnapshotEncoder:
                 if DRA_PREFIX + cname not in resources:
                     resources.append(DRA_PREFIX + cname)
         R = len(resources)
-        N = next_bucket(len(nodes), minimum=1)
+        N = next_bucket(len(nodes) + self.node_headroom, minimum=1)
 
         node_index = {n.metadata.name: i for i, n in enumerate(nodes)}
         # Pre-intern all labels so the key bucket covers everything.
@@ -432,7 +451,7 @@ class SnapshotEncoder:
         K = next_bucket(len(self.keys), minimum=1)
         # namespace-mask width: covers every id interned so far (epods, pend
         # pods, and all resolved term sets), so patches stay in-bucket
-        NSB = next_bucket(len(self.namespaces), minimum=1)
+        NSB = next_bucket(len(self.namespaces) + self.ns_headroom, minimum=1)
 
         allocatable = np.zeros((N, R), np.int32)
         requested = np.zeros((N, R), np.int32)
@@ -545,7 +564,7 @@ class SnapshotEncoder:
                 used_rwo[i, v_idx] = self.pv_names.intern(pv)
                 used_rwo_valid[i, v_idx] = True
 
-        V = next_bucket(len(self.values), minimum=1)
+        V = next_bucket(len(self.values) + self.value_headroom, minimum=1)
         label_value_num = np.full(V, np.nan, np.float32)
         nums = self.values.numeric_values()
         label_value_num[:len(nums)] = np.asarray(nums, np.float32)
@@ -561,6 +580,10 @@ class SnapshotEncoder:
             topo_keys=tuple(sorted(self._cluster_topo_keys)),
             generation=self.generation,
         )
+        row_pods: dict[int, int] = {}
+        for p in epods:
+            ni = node_index[p.spec.node_name]
+            row_pods[ni] = row_pods.get(ni, 0) + 1
         self._patch = _PatchState(
             generation=self.generation, resources=resources,
             res_index={r: i for i, r in enumerate(resources)},
@@ -571,6 +594,10 @@ class SnapshotEncoder:
             slot_req={p.key: self._request_vector(p, resources) for p in epods},
             unpatchable={p.key for p in epods
                          if p.spec.volumes or p.host_ports()},
+            N=N, V=V, T=T, I=I, IMG=len(self._image_sizes),
+            PRT=PRT, VN=VN, E=E,
+            node_free=list(range(len(nodes), N)),
+            row_pods=row_pods,
         )
         ct = ClusterTensors(
             allocatable=allocatable, requested=requested, node_valid=node_valid,
@@ -933,7 +960,7 @@ class SnapshotEncoder:
         AV = max(AV, _bucket(lambda c: max((len(v) for t in c["spreads"]
                                             for (_, _, v, _) in t[2]), default=0)))
         # namespace-mask width: all term ns sets are already interned above
-        NSB = next_bucket(len(self.namespaces), minimum=1)
+        NSB = next_bucket(len(self.namespaces) + self.ns_headroom, minimum=1)
 
         def _new_termset(T):
             return dict(

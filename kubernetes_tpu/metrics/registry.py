@@ -15,7 +15,8 @@ from typing import Optional
 
 DEFAULT_BUCKETS = (0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.15, 0.2,
                    0.25, 0.3, 0.4, 0.5, 0.6, 0.75, 0.9, 1.0, 1.25, 1.5,
-                   2.0, 3.0, 5.0, 10.0)
+                   2.0, 3.0, 5.0, 7.5, 10.0, 15.0, 20.0, 30.0, 45.0, 60.0,
+                   120.0)
 
 
 def _label_key(labels: Optional[dict]) -> tuple:

@@ -647,21 +647,107 @@ def batch_shapes(pb_stack: PodBatch) -> list[tuple]:
             for l in jax.tree_util.tree_leaves(pb_stack)]
 
 
-def build_drain_context(ct: ClusterTensors, pbs: list[PodBatch]):
+def build_drain_context(ct: ClusterTensors, pbs: list[PodBatch],
+                        nom_bucket: int = 0):
     """Host-side one-time prep for the device-resident drain: unify the batch
     buckets, chain extension slots (content is placeholder — drain_step
     refills it), stage everything into HBM. Returns
     ``(ct_all_device, e0, fill0)`` or None when base epod slots aren't packed
     (fold targets assume [0,fill) occupied, [fill,e0) free — true after any
-    full encode; host-side patches with deletes can leave holes)."""
+    full encode; host-side patches with deletes can leave holes).
+
+    ``nom_bucket``: size of the RESIDENT nominee-reservation tensors. The
+    base encode carries zero nominees; giving the context a fixed M lets
+    preemption storms patch reservations device-side (apply_ctx_patch)
+    instead of dropping to the per-batch overlay path."""
     pbs_u = unify_batches(pbs)
     ct_all, e0 = extend_cluster_drain(ct, pbs_u)
     valid = np.asarray(ct_all.epod_valid)[:e0]
     fill0 = int(valid.sum())
     if fill0 and not valid[:fill0].all():
         return None  # holes: device fold would overwrite occupied slots
+    if nom_bucket:
+        R = int(np.asarray(ct_all.requested).shape[1])
+        ct_all = ct_all.replace(
+            nom_node=np.full(nom_bucket, -1, np.int32),
+            nom_prio=np.zeros(nom_bucket, np.int32),
+            nom_req=np.zeros((nom_bucket, R), np.int32),
+            nom_valid=np.zeros(nom_bucket, bool))
     ct_dev = _stage(ct_all)
     return ct_dev, e0, fill0
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def apply_ctx_patch(ct_all: ClusterTensors, patch: dict) -> ClusterTensors:
+    """Scatter a compiled churn patch (encode/patch.py compile_patch) into
+    the device-resident drain encoding: pod slot rewrites/clears, node row
+    rewrites/retires, nominee reservation diffs, and the dense
+    requested[N,R] delta — one fused program, donated buffers, ~KB of
+    host->device traffic. Pad entries carry index -1 and are dropped.
+
+    Reference shape: the incremental half of ``Cache.UpdateSnapshot``
+    (pkg/scheduler/internal/cache/cache.go) — churn moves only what changed."""
+    BIG = jnp.int32(1 << 30)  # out-of-range: scatter mode="drop" ignores
+
+    def idx(a):
+        return jnp.where(a < 0, BIG, a)
+
+    ps = idx(patch["pod_slot"])
+    ns_ = idx(patch["node_row"])
+    ms = idx(patch["nom_slot"])
+    N = ct_all.node_valid.shape[0]
+
+    # node rows being reset (fresh assignment of a freed/new row) clear the
+    # pod-contributed state patches cannot reconstruct (ports/volumes are
+    # guarded unpatchable, so a resettable row never has live entries)
+    reset = jnp.zeros(N, bool).at[ns_].set(patch["n_reset"], mode="drop")
+    requested = jnp.where(reset[:, None], 0, ct_all.requested) \
+        + patch["req_delta"]
+
+    def sc(base, i, vals):
+        return base.at[i].set(vals, mode="drop")
+
+    return ct_all.replace(
+        requested=requested,
+        label_value_num=patch["label_value_num"],
+        # ---- pod slots
+        epod_node=sc(ct_all.epod_node, ps, patch["pod_node"]),
+        epod_ns=sc(ct_all.epod_ns, ps, patch["pod_ns"]),
+        epod_labels=sc(ct_all.epod_labels, ps, patch["pod_labels"]),
+        epod_valid=sc(ct_all.epod_valid, ps, patch["pod_valid"]),
+        ea_sel=SelectorSet(
+            key=sc(ct_all.ea_sel.key, ps, patch["ea_sel_key"]),
+            op=sc(ct_all.ea_sel.op, ps, patch["ea_sel_op"]),
+            vals=sc(ct_all.ea_sel.vals, ps, patch["ea_sel_vals"]),
+            expr_valid=sc(ct_all.ea_sel.expr_valid, ps,
+                          patch["ea_sel_expr_valid"]),
+            valid=sc(ct_all.ea_sel.valid, ps, patch["ea_sel_valid"])),
+        ea_topo=sc(ct_all.ea_topo, ps, patch["ea_topo"]),
+        ea_valid=sc(ct_all.ea_valid, ps, patch["ea_valid"]),
+        ea_ns_explicit=sc(ct_all.ea_ns_explicit, ps,
+                          patch["ea_ns_explicit"]),
+        ea_ns_mask=sc(ct_all.ea_ns_mask, ps, patch["ea_ns_mask"]),
+        # ---- node rows
+        allocatable=sc(ct_all.allocatable, ns_, patch["n_alloc"]),
+        node_valid=sc(ct_all.node_valid, ns_, patch["n_valid"]),
+        unschedulable=sc(ct_all.unschedulable, ns_, patch["n_unsched"]),
+        node_labels=sc(ct_all.node_labels, ns_, patch["n_labels"]),
+        taint_key=sc(ct_all.taint_key, ns_, patch["n_taint_key"]),
+        taint_val=sc(ct_all.taint_val, ns_, patch["n_taint_val"]),
+        taint_effect=sc(ct_all.taint_effect, ns_, patch["n_taint_effect"]),
+        taint_valid=sc(ct_all.taint_valid, ns_, patch["n_taint_valid"]),
+        node_images=sc(ct_all.node_images, ns_, patch["n_images"]),
+        attach_limit=sc(ct_all.attach_limit, ns_, patch["n_attach_limit"]),
+        attach_used=jnp.where(reset, 0, ct_all.attach_used),
+        port_valid=jnp.where(reset[:, None], False, ct_all.port_valid),
+        used_rwo_valid=jnp.where(reset[:, None], False,
+                                 ct_all.used_rwo_valid),
+        # ---- nominee reservations
+        nom_node=sc(ct_all.nom_node, ms, patch["nom_node"]),
+        nom_prio=sc(ct_all.nom_prio, ms, patch["nom_prio"]),
+        nom_req=sc(ct_all.nom_req, ms, patch["nom_req"]),
+        nom_valid=sc(ct_all.nom_valid, ms, patch["nom_valid"]),
+    )
 
 
 def prepare_drain(ct: ClusterTensors, pbs: list[PodBatch], stage: bool = True):

@@ -33,6 +33,19 @@ class SchedulerCache:
         self._assumed: dict[str, tuple[Pod, float]] = {}  # key -> (pod, deadline)
         self._generation = 0
         self._encoder = SnapshotEncoder()
+        # churn headroom: free node rows absorb node ADDs as device patches,
+        # spare label-value ids absorb the new values they intern (every
+        # node interns its own name) — without these any node event would
+        # overflow its bucket and force a rebuild
+        import os
+        self._encoder.node_headroom = int(
+            os.environ.get("KTPU_NODE_HEADROOM", "64"))
+        self._encoder.value_headroom = int(
+            os.environ.get("KTPU_VALUE_HEADROOM", "256"))
+        # fresh namespaces (e.g. churn traffic) must not widen the NSB
+        # bucket mid-stream: that recompiles the drain inside the window
+        self._encoder.ns_headroom = int(
+            os.environ.get("KTPU_NS_HEADROOM", "16"))
         self._cached: Optional[tuple[int, ClusterTensors, SnapshotMeta]] = None
         self.assume_ttl = assume_ttl
         self._volumes = None  # VolumeCatalog once any PVC/PV/SC appears
@@ -44,9 +57,47 @@ class SchedulerCache:
         self._delta_upserts: dict[str, Pod] = {}
         self._delta_deletes: set[str] = set()
         self._needs_full = True
+        # ---- ordered delta LOG for the device-resident drain context ----
+        # Every encoding-relevant mutation appends (seq, op, payload); the
+        # drain context replays entries since its last-consumed seq as
+        # device-side patches (encode/patch.py) instead of dying on any
+        # foreign change. Bounded; a consumer older than the window rebuilds.
+        self._dlog: list[tuple] = []
+        self._dlog_start = 0   # seq of _dlog[0]
+        self._dlog_seq = 0     # seq of the NEXT entry
+        self._snap_seq = 0     # log seq captured with the last snapshot
+        self._dlog_max = 100_000
         # encode-relevant node fingerprints: heartbeats that only touch
         # status/conditions must not invalidate the encoding at all
         self._node_fps: dict[str, tuple] = {}
+
+    # ---- delta log (drain-context patch feed) ----------------------------
+
+    def _log_locked(self, op: str, payload):
+        self._dlog.append((self._dlog_seq, op, payload))
+        self._dlog_seq += 1
+        if len(self._dlog) > self._dlog_max:
+            drop = len(self._dlog) // 2
+            del self._dlog[:drop]
+            self._dlog_start += drop
+
+    def deltas_since(self, seq: int):
+        """Log entries with sequence >= ``seq`` in order, or None when the
+        window no longer reaches back that far (consumer must rebuild)."""
+        with self._lock:
+            if seq < self._dlog_start:
+                return None
+            return self._dlog[seq - self._dlog_start:]
+
+    def log_seq(self) -> int:
+        with self._lock:
+            return self._dlog_seq
+
+    def last_snapshot_seq(self) -> int:
+        """The log seq captured atomically with the last snapshot's state:
+        a context built from that snapshot starts consuming here."""
+        with self._lock:
+            return self._snap_seq
 
     # ---- volume catalog (PVC/PV/StorageClass informers feed this) --------
 
@@ -73,6 +124,7 @@ class SchedulerCache:
             self._encoder.set_volumes(self._volumes)
             self._generation += 1
             self._needs_full = True
+            self._log_locked("full", None)
 
     @property
     def volume_catalog(self):
@@ -119,6 +171,7 @@ class SchedulerCache:
                 return  # status-only change: encoding-neutral
             self._generation += 1
             self._needs_full = True
+            self._log_locked("full", None)
 
     @property
     def dra_catalog(self):
@@ -148,6 +201,7 @@ class SchedulerCache:
             # actually resolved a namespaceSelector against the old labels.
             if self._encoder.cluster_depends_on_namespace_labels:
                 self._needs_full = True
+                self._log_locked("full", None)
 
     # ---- node events -----------------------------------------------------
 
@@ -174,6 +228,7 @@ class SchedulerCache:
             self._node_fps[node.metadata.name] = fp
             self._generation += 1
             self._needs_full = True
+            self._log_locked("node", node)
 
     def update_node(self, node: Node):
         self.add_node(node)
@@ -184,6 +239,7 @@ class SchedulerCache:
                 self._node_fps.pop(name, None)
                 self._generation += 1
                 self._needs_full = True
+                self._log_locked("nodedel", name)
 
     # ---- pod events ------------------------------------------------------
 
@@ -211,6 +267,7 @@ class SchedulerCache:
             self._generation += 1
             self._delta_upserts[pod.key] = pod
             self._delta_deletes.discard(pod.key)
+            self._log_locked("pod", pod)
 
     def update_pod(self, pod: Pod):
         self.add_pod(pod)
@@ -259,6 +316,7 @@ class SchedulerCache:
                 self._generation += 1
                 self._delta_upserts.pop(pod_key, None)
                 self._delta_deletes.add(pod_key)
+                self._log_locked("poddel", pod_key)
 
     # ---- optimistic binding ---------------------------------------------
 
@@ -279,6 +337,7 @@ class SchedulerCache:
             self._generation += 1
             self._delta_upserts[p.key] = p
             self._delta_deletes.discard(p.key)
+            self._log_locked("assume", (p.key, node_name, p))
 
     def assume_many(self, pairs: list) -> None:
         """assume() for a whole drain's winners in ONE lock pass — the gang
@@ -297,6 +356,7 @@ class SchedulerCache:
                 self._assumed[p.key] = (p, deadline)
                 self._delta_upserts[p.key] = p
                 self._delta_deletes.discard(p.key)
+                self._log_locked("assume", (p.key, node_name, p))
             self._generation += len(pairs)
 
     def finish_binding(self, pod_key: str):
@@ -309,6 +369,7 @@ class SchedulerCache:
                 self._generation += 1
                 self._delta_upserts.pop(pod_key, None)
                 self._delta_deletes.add(pod_key)
+                self._log_locked("poddel", pod_key)
 
     def _expire_assumed_locked(self):
         now = time.time()
@@ -317,6 +378,7 @@ class SchedulerCache:
             del self._assumed[k]
             self._delta_upserts.pop(k, None)
             self._delta_deletes.add(k)
+            self._log_locked("poddel", k)
         if expired:
             self._generation += 1
 
@@ -353,6 +415,7 @@ class SchedulerCache:
     def _snapshot_serialized(self, pending_pods, slot_headroom):
         with self._lock:
             self._expire_assumed_locked()
+            self._snap_seq = self._dlog_seq
             nodes = list(self._nodes.values())
             gen = self._generation
             cached = self._cached
@@ -401,6 +464,22 @@ class SchedulerCache:
             if self._generation == gen:
                 self._needs_full = False
         return nodes, ct, meta
+
+    def patch_state_fork(self):
+        """CtxPatchState forked from the encoder's post-encode bookkeeping
+        (encode/patch.py) — the drain context's private slot/row maps."""
+        from kubernetes_tpu.encode.patch import fork_patch_state
+        with self._encode_lock:
+            return fork_patch_state(self._encoder._patch)
+
+    def compile_ctx_patch(self, meta, cs, entries, nom_target: dict,
+                          nom_bucket: int):
+        """compile_patch under the encode lock (interning is shared with
+        snapshot/encode_pods and must not interleave)."""
+        from kubernetes_tpu.encode.patch import compile_patch
+        with self._encode_lock:
+            return compile_patch(self._encoder, meta, cs, entries,
+                                 nom_target, nom_bucket)
 
     def encode_pods(self, pods: list[Pod], meta: SnapshotMeta,
                     min_p: int = 1):

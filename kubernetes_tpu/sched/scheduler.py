@@ -43,6 +43,12 @@ _LOG = logging.getLogger(__name__)
 # POST pods/<p>/binding; tests pass a lambda.
 Binder = Callable[[Pod, str], bool]
 
+# Resident nominee-reservation bucket in the drain context (encode/patch.py):
+# preemption storms patch reservations device-side instead of dropping the
+# context. Static — part of the compiled drain shapes.
+import os as _os
+DRAIN_NOM_BUCKET = int(_os.environ.get("KTPU_DRAIN_NOM_BUCKET", "128"))
+
 
 class Scheduler:
     def __init__(self, cfg: SchedulerConfiguration, cache: SchedulerCache,
@@ -75,6 +81,10 @@ class Scheduler:
         # of the cluster encoding, valid while the only pending cache deltas
         # are assumes this loop folded on device
         self._drain_ctx = None
+        # context lifecycle counters (benchmarks report these: a healthy
+        # churn run shows patches >> rebuilds)
+        self.ctx_stats = {"patches": 0, "rebuilds": 0, "unfit": 0,
+                          "reasons": {}}
         # one-deep software pipeline: the in-flight drain awaiting resolution
         self._pending_drain = None
         # preemption nominees awaiting re-schedule: key -> (node, prio, pod, ts).
@@ -119,6 +129,7 @@ class Scheduler:
         single-batch program."""
         # land the in-flight drain's bindings as soon as the device is done
         # (don't let finished results sit behind a blocking pop)
+        n_early = 0
         pend = self._pending_drain
         if pend is not None:
             try:
@@ -126,12 +137,12 @@ class Scheduler:
             except Exception:
                 ready = True
             if ready:
-                self._resolve_pending()
+                n_early = self._resolve_pending()
         batch = self.queue.pop_batch(
             self.cfg.batch_size * max(1, self.cfg.max_drain_batches),
             wait=0.05 if self._pending_drain is not None else wait)
         if not batch:
-            return self._resolve_pending()
+            return n_early + self._resolve_pending()
         stats = self.queue.stats()
         for q, v in stats.items():
             QUEUE_DEPTH.set(v, {"queue": q})
@@ -162,7 +173,7 @@ class Scheduler:
                 for i in range(0, len(items), self.cfg.batch_size):
                     n_bound += self._schedule_group(
                         profile, items[i:i + self.cfg.batch_size], headroom)
-        return n_bound
+        return n_early + n_bound
 
     def _schedule_group(self, profile, items, slot_headroom: int = 0) -> int:
         from kubernetes_tpu.utils.tracing import TRACER
@@ -271,16 +282,18 @@ class Scheduler:
         connected path, so the steady state here is: cluster tensors live in
         HBM (``_drain_ctx``), each drain ships only the new pod batches,
         and ``drain_step`` folds what it commits into free existing-pod
-        slots on device (models/gang.py). The context is provably current:
-        it is used only while every pending cache delta is an assume this
-        loop already folded (cache.delta_info); anything foreign — node
-        events, deletes, forgets, preemption nominees — falls back to a
-        host snapshot and a fresh upload."""
+        slots on device (models/gang.py). Foreign changes — node churn, pod
+        deletes, rebinds, preemption nominees — are replayed from the
+        cache's delta log as DEVICE-SIDE PATCHES (encode/patch.py +
+        apply_ctx_patch) before the next dispatch; the context rebuilds
+        from a host snapshot only when a delta doesn't fit the resident
+        buckets (new resource kind / topology key, bucket overflow,
+        port/volume-owning pods)."""
         import numpy as np
         import jax
         from kubernetes_tpu.models.gang import (
-            batch_shapes, build_drain_context, drain_step, drain_widths_fit,
-            pad_batch_to, unify_batches)
+            apply_ctx_patch, batch_shapes, build_drain_context, drain_step,
+            drain_widths_fit, pad_batch_to, unify_batches)
         from kubernetes_tpu.utils.tracing import TRACER
         t0 = time.time()
         pods = [p for p, _ in items]
@@ -289,35 +302,77 @@ class Scheduler:
         self._nominated = {
             k: e for k, e in self._nominated.items()
             if now - e[3] < self._nominated_ttl and not self.cache.is_bound(k)}
-        entries = [(n, prio, p) for k, (n, prio, p, _ts)
-                   in self._nominated.items() if k not in batch_keys]
-        if entries:
-            # nominee reservations need the overlay path; keep semantics,
-            # drop the resident context for this cycle
-            n_prev = self._resolve_pending()
-            self._drain_ctx = None
-            return n_prev + sum(self._schedule_group(
-                profile, items[i:i + self.cfg.batch_size], slot_headroom)
-                for i in range(0, len(items), self.cfg.batch_size))
+        # desired resident reservation set: nominees NOT in this pop (a
+        # nominee scheduling itself must not be blocked by its own hold)
+        nom_target = {k: (n, prio, p) for k, (n, prio, p, _ts)
+                      in self._nominated.items() if k not in batch_keys}
 
         ctx = self._drain_ctx
         use_ctx = False
         n_prev = 0
         if ctx is not None and ctx["profile"] == profile.scheduler_name:
+            cs = ctx["cs"]
             known = set(ctx["meta"].resources)
-            use_ctx = (self._ctx_current(ctx, ctx["gen"])
-                       and ctx["fill_bound"] + len(pods) <= ctx["e0"]
-                       and not any(r not in known for p in pods
-                                   for r in p.resource_requests()))
+            fits = (not cs.tainted
+                    and ctx["fill_bound"] + len(pods) <= cs.top
+                    and not any(r not in known for p in pods
+                                for r in p.resource_requests()))
+            if not fits:
+                self._ctx_reason("tainted" if cs.tainted else "capacity")
+            else:
+                entries = self.cache.deltas_since(ctx["seq"])
+                nom_dirty = (set(nom_target) != set(cs.nom_applied)
+                             or any(cs.nom_applied[k][1:] != (n, prio)
+                                    for k, (n, prio, _p)
+                                    in nom_target.items()
+                                    if k in cs.nom_applied))
+                if entries is None:
+                    self._ctx_reason("log_window")
+                elif not entries and not nom_dirty:
+                    use_ctx = True
+                else:
+                    # the in-flight drain must resolve FIRST so the patch
+                    # state knows which slots its folds took (and its
+                    # assume log entries land before the re-read)
+                    n_prev += self._resolve_pending()
+                    entries = self.cache.deltas_since(ctx["seq"])
+                    if entries is not None:
+                        new_seq = (entries[-1][0] + 1 if entries
+                                   else ctx["seq"])
+                        with TRACER.span("scheduler/ctx_patch_compile",
+                                         deltas=len(entries)):
+                            patch = self.cache.compile_ctx_patch(
+                                ctx["meta"], cs, entries, nom_target,
+                                DRAIN_NOM_BUCKET)
+                        # the patch may have moved the slot cursor: the
+                        # fold region this dispatch will write must still
+                        # clear every patched slot (re-check AFTER compile;
+                        # on failure the context — and the mutated patch
+                        # state with it — is discarded and rebuilt)
+                        if (patch is not None
+                                and ctx["fill_bound"] + len(pods)
+                                <= cs.top):
+                            with TRACER.span("scheduler/ctx_patch_apply"):
+                                ctx["ct"] = apply_ctx_patch(ctx["ct"], patch)
+                            ctx["seq"] = new_seq
+                            use_ctx = True
+                            self.ctx_stats["patches"] += 1
+                        elif patch is None:
+                            self.ctx_stats["unfit"] += 1
+                            self._ctx_reason("patch_unfit")
+                        else:
+                            self._ctx_reason("capacity")
         if use_ctx:
             nodes, meta = ctx["nodes"], ctx["meta"]
         else:
             # the in-flight drain's placements must land in the cache before
             # a host snapshot, or the re-encode double-books their capacity
-            n_prev = self._resolve_pending()
+            n_prev += self._resolve_pending()
+            self._drain_ctx = None
             with TRACER.span("scheduler/snapshot", pods=len(pods)):
                 nodes, ct, meta = self.cache.snapshot(
                     pending_pods=pods, slot_headroom=slot_headroom)
+            seq0 = self.cache.last_snapshot_seq()
             if not nodes:
                 for pod, attempts in items:
                     self.queue.add_unschedulable(pod, attempts + 1)
@@ -341,8 +396,11 @@ class Scheduler:
             lambda *xs: np.stack(xs), *unify_batches(pbs))
 
         if not use_ctx:
-            built = build_drain_context(ct, pbs)
-            if built is None:
+            from kubernetes_tpu.encode.patch import fork_meta
+            built = build_drain_context(ct, pbs,
+                                        nom_bucket=DRAIN_NOM_BUCKET)
+            cs = self.cache.patch_state_fork()
+            if built is None or cs is None:
                 # base slots not packed (host patches left holes): run the
                 # host per-batch path this cycle
                 self._drain_ctx = None
@@ -350,12 +408,23 @@ class Scheduler:
                     self._schedule_group(profile, c, slot_headroom)
                     for c in chunks)
             ct_dev, e0, fill = built
+            self.ctx_stats["rebuilds"] += 1
             ctx = {"ct": ct_dev, "e0": e0, "fill_dev": fill,
-                   "fill_bound": fill, "meta": meta,
-                   "nodes": nodes, "folded": set(),
-                   "gen": self.cache.delta_info()[0],
+                   "fill_bound": fill, "meta": fork_meta(meta),
+                   "nodes": nodes, "cs": cs, "seq": seq0,
                    "pb_shape": batch_shapes(pb_stack),
                    "profile": profile.scheduler_name}
+            meta = ctx["meta"]
+            if nom_target:
+                patch = self.cache.compile_ctx_patch(
+                    meta, cs, [], nom_target, DRAIN_NOM_BUCKET)
+                if patch is None:
+                    # reservation set exceeds the resident bucket: keep
+                    # semantics via the per-batch overlay path this cycle
+                    return n_prev + sum(
+                        self._schedule_group(profile, c, slot_headroom)
+                        for c in chunks)
+                ctx["ct"] = apply_ctx_patch(ctx["ct"], patch)
             self._drain_ctx = ctx
         else:
             # pin the batch to the context's compiled shapes: pop-dependent
@@ -363,6 +432,7 @@ class Scheduler:
             padded = pad_batch_to(pb_stack, ctx["pb_shape"])
             if padded is None or not drain_widths_fit(ctx["ct"], padded):
                 # wider than anything compiled so far: rebuild the context
+                self._ctx_reason("batch_shape")
                 n_prev += self._resolve_pending()
                 self._drain_ctx = None
                 return n_prev + self._schedule_drain(profile, items,
@@ -400,21 +470,17 @@ class Scheduler:
         }
         return n_prev
 
-    def _ctx_current(self, ctx, gen_expected: int) -> bool:
-        """True when the HBM-resident drain context provably reflects the
-        cache at ``gen_expected``: the generation matches and every pending
-        delta is an upsert this loop already folded device-side (no deletes,
-        no structural invalidation). The single predicate shared by the
-        dispatch-side use_ctx check and both resolve-side currency checks —
-        the gen term is the load-bearing one (see _resolve_pending)."""
-        gen, up_keys, has_dels, needs_full = self.cache.delta_info()
-        return (gen == gen_expected and not has_dels and not needs_full
-                and up_keys <= ctx["folded"])
+    def _ctx_reason(self, why: str):
+        r = self.ctx_stats["reasons"]
+        r[why] = r.get(why, 0) + 1
 
     def _resolve_pending(self) -> int:
         """Block on the in-flight drain's results and apply them host-side:
-        assume + bulk-bind the placements, requeue the failures, re-sync the
-        context generation. Returns pods bound."""
+        assume + bulk-bind the placements, requeue the failures, and record
+        the device folds in the context's patch state (the fold packs
+        committed pods into base slots [fill, fill+n) in flattened batch
+        order — mirrored here so later churn patches can address them).
+        Returns pods bound."""
         pend = self._pending_drain
         if pend is None:
             return 0
@@ -430,20 +496,10 @@ class Scheduler:
                 (pend["assignments"], pend["rounds"]))
         ctx, meta, profile = pend["ctx"], pend["meta"], pend["profile"]
         active = self._drain_ctx is ctx
-        if active:
-            pend_count = sum(len(c) for c in pend["chunks"])
-            # Context-currency precondition, captured BEFORE this resolve's
-            # assumes land: every pending delta must already be a fold this
-            # loop performed device-side. Anything foreign (a pod bound or
-            # removed by another party since dispatch) means the resident
-            # encoding never saw it — the context must be dropped, not
-            # re-synced, or a snapshot consumed mid-resolve (e.g. by the
-            # preemptor) would absorb the foreign change into a gen bump the
-            # encoding doesn't reflect.
-            gen0 = ctx["gen"]
-            ctx_clean = self._ctx_current(ctx, gen0)
+        pend_count = sum(len(c) for c in pend["chunks"])
         GANG_ROUNDS.observe(int(np.sum(rounds)))
         to_bind: list[tuple[Pod, str]] = []
+        bound_rows: list[int] = []  # node index per to_bind entry
         failures: list[tuple[Pod, int]] = []
         with TRACER.span("scheduler/apply"):
             for b, chunk in enumerate(pend["chunks"]):
@@ -458,38 +514,45 @@ class Scheduler:
                                               assignment[:len(chunk)]):
                     if a >= 0:
                         to_bind.append((pod, node_names[int(a)]))
+                        bound_rows.append(int(a))
                     else:
                         failures.append((pod, attempts))
             if to_bind:
                 # one lock pass for the whole drain's winners; failures are
                 # handled AFTER so their preemption dry-runs see every winner
                 self.cache.assume_many(to_bind)
-                folded = ctx["folded"]
                 nominated = self._nominated
+                if active:
+                    # mirror the device fold: winners occupy base slots
+                    # [fill_host, fill_host+n) in this exact order. slot_req
+                    # stores the Pod itself — the request vector is computed
+                    # lazily only if the pod is later deleted/rebound.
+                    cs = ctx["cs"]
+                    fill = cs.fill_host
+                    for (pod, node), row in zip(to_bind, bound_rows):
+                        cs.slot_of[pod.key] = fill
+                        cs.slot_node[pod.key] = row
+                        cs.slot_req[pod.key] = pod
+                        cs.row_pods[row] = cs.row_pods.get(row, 0) + 1
+                        cs.folded[pod.key] = node
+                        fill += 1
+                        if pod.spec.volumes or pod.host_ports():
+                            # the fold cannot reproduce this pod's node-side
+                            # port/volume state: the resident encoding is
+                            # now approximate — rebuild at next dispatch
+                            cs.tainted = True
+                    cs.fill_host = fill
                 for pod, _node in to_bind:
-                    folded.add(pod.key)
                     if nominated:
                         nominated.pop(pod.key, None)
         n_bound = len(to_bind)
         n_unsched = len(failures)
         self._handle_failures(failures)
-        # Re-sync the context: it survives only when it was provably current
-        # before this resolve AND the generation moved by EXACTLY our
-        # assumes since. The gen arithmetic is what makes this air-tight: a
-        # foreign upsert whose key collides with an already-folded pod (a
-        # competing binder re-binding it elsewhere) passes the subset test,
-        # and a snapshot consumed mid-resolve (the preemptor's) empties the
-        # pending sets — but neither can undo the extra gen bump, since
-        # snapshot() never advances _generation. fill_bound is ADJUSTED,
-        # never overwritten: drains dispatched after this one already
-        # reserved their own += len(pods) on top, so only this drain's
-        # unused reservation (pend_count - n_bound) is released.
+        # fill_bound is ADJUSTED, never overwritten: drains dispatched after
+        # this one already reserved their own += len(pods) on top, so only
+        # this drain's unused reservation (pend_count - n_bound) is released
         if active and self._drain_ctx is ctx:
-            if ctx_clean and self._ctx_current(ctx, gen0 + n_bound):
-                ctx["gen"] = gen0 + n_bound
-                ctx["fill_bound"] -= (pend_count - n_bound)
-            else:
-                self._drain_ctx = None
+            ctx["fill_bound"] -= (pend_count - n_bound)
         self._bind_async_batch(to_bind, profile)
         dt = time.time() - pend["t0"]
         for result, n in (("scheduled", n_bound),
@@ -508,6 +571,7 @@ class Scheduler:
         armed."""
         import jax
         import numpy as np
+        from kubernetes_tpu.encode.patch import fork_meta
         from kubernetes_tpu.models.gang import (
             batch_shapes, build_drain_context, drain_step, unify_batches)
         if not sample_pods:
@@ -526,7 +590,7 @@ class Scheduler:
                                       meta, min_p=P) for c in chunks]
         pb_stack = jax.tree_util.tree_map(
             lambda *xs: np.stack(xs), *unify_batches(pbs))
-        built = build_drain_context(ct, pbs)
+        built = build_drain_context(ct, pbs, nom_bucket=DRAIN_NOM_BUCKET)
         if built is None:
             return False
         ct_dev, e0, fill = built
@@ -547,15 +611,32 @@ class Scheduler:
         _, _, ct_dev2, fill2 = drain_step(ct_dev, pb_stack, fill, **kw)
         # second call matches the steady-state variant exactly: donated-
         # buffer layouts AND a device-resident fill scalar
-        drain_step(ct_dev2, pb_stack, fill2, **kw)
-        built = build_drain_context(ct, pbs)
-        if built is None:
+        _, _, ct_dev3, fill3 = drain_step(ct_dev2, pb_stack, fill2, **kw)
+        # rehearse the real churn alternation — drain -> patch -> drain —
+        # so BOTH programs compile at each other's output layouts (a layout
+        # mismatch recompiles drain_step for seconds inside the measured
+        # window) at the standard patch write buckets
+        try:
+            from kubernetes_tpu.models.gang import apply_ctx_patch
+            cs_warm = self.cache.patch_state_fork()
+            if cs_warm is not None:
+                warm_patch = self.cache.compile_ctx_patch(
+                    fork_meta(meta), cs_warm, [], {}, DRAIN_NOM_BUCKET)
+                if warm_patch is not None:
+                    ct_dev4 = apply_ctx_patch(ct_dev3, warm_patch)
+                    drain_step(ct_dev4, pb_stack, fill3, **kw)
+        except Exception:
+            _LOG.exception("patch-program warmup failed (non-fatal)")
+        built = build_drain_context(ct, pbs, nom_bucket=DRAIN_NOM_BUCKET)
+        cs = self.cache.patch_state_fork()
+        if built is None or cs is None:
             return False
         ct_dev, e0, fill = built
         self._drain_ctx = {"ct": ct_dev, "e0": e0, "fill_dev": fill,
                            "fill_bound": fill,
-                           "meta": meta, "nodes": nodes, "folded": set(),
-                           "gen": self.cache.delta_info()[0],
+                           "meta": fork_meta(meta), "nodes": nodes,
+                           "cs": cs,
+                           "seq": self.cache.last_snapshot_seq(),
                            "pb_shape": batch_shapes(pb_stack),
                            "profile": profile.scheduler_name}
         return True

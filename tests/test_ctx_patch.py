@@ -1,0 +1,223 @@
+"""Device-resident drain context under churn: delta-log patches.
+
+Reference: the incremental half of ``Cache.UpdateSnapshot``
+(``pkg/scheduler/internal/cache/cache.go`` — per-node generations so churn
+moves only what changed) and scheduler_perf's churn op
+(``test/integration/scheduler_perf/scheduler_perf.go`` churnOp): upstream
+sustains its thresholds while nodes and pods recycle through the API.
+
+Here the analog is sharper: the fused drain keeps the cluster encoding in
+HBM, and node ADD/REMOVE, foreign pod deletes/rebinds, and preemption
+nominee reservations must be applied as DEVICE-SIDE PATCHES
+(encode/patch.py -> models/gang.apply_ctx_patch) without dropping the
+context — the round-4 failure mode was context death on every foreign
+delta, collapsing to a full re-encode + re-upload per pop.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.config.types import Profile, SchedulerConfiguration
+from kubernetes_tpu.sched.cache import SchedulerCache
+from kubernetes_tpu.sched.queue import SchedulingQueue
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _sched(nodes, batch_size=4, drain_batches=2):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    queue = SchedulingQueue(backoff_initial=0.05)
+    log = []
+    cfg = SchedulerConfiguration(batch_size=batch_size,
+                                 max_drain_batches=drain_batches)
+    sched = Scheduler(cfg, cache, queue,
+                      lambda pod, node: log.append(
+                          (pod.metadata.name, node)) or True)
+    return sched, cache, queue, log
+
+
+def _nodes(n, cpu="4", prefix="n"):
+    return [make_node(f"{prefix}{i}")
+            .capacity({"cpu": cpu, "memory": "8Gi", "pods": "32"})
+            .obj() for i in range(n)]
+
+
+def _drain(sched, queue, pods, rounds=6):
+    """Push pods, run until the pipeline fully resolves."""
+    for p in pods:
+        queue.add(p)
+    bound = 0
+    for _ in range(rounds):
+        bound += sched.run_once(wait=0.01)
+        if sched._pending_drain is None and not queue.stats()["active"]:
+            break
+    bound += sched._resolve_pending()
+    sched.wait_for_bindings()  # binder log assertions must not race
+    return bound
+
+
+def _arm(sched, slot_headroom=64):
+    """Arm the drain context the way the product does (warm_drain): compile
+    the fused drain + patch program and stage the resident encoding with
+    enough slot headroom for the test's pods."""
+    warm = [make_pod(f"__warm{i}").req({"cpu": "100m"}).obj()
+            for i in range(sched.cfg.batch_size)]
+    assert sched.warm_drain(warm, slot_headroom=slot_headroom), \
+        "drain context failed to arm"
+    return sched._drain_ctx
+
+
+def test_ctx_survives_pod_delete():
+    """A foreign pod delete (e.g. churn teardown) patches the resident
+    encoding — the context object survives and the freed capacity is
+    immediately schedulable."""
+    sched, cache, queue, log = _sched(_nodes(2, cpu="1"))
+    ctx = _arm(sched)
+    # fill the cluster: one 600m pod per 1-cpu node
+    fillers = [make_pod(f"f{i}").req({"cpu": "600m"}).obj() for i in range(2)]
+    assert _drain(sched, queue, fillers) == 2
+    assert sched._drain_ctx is ctx
+    # cluster full: one more 600m pod cannot fit
+    assert _drain(sched, queue,
+                  [make_pod("nofit").req({"cpu": "600m"}).obj()]) == 0
+    # a foreign delete frees one filler's capacity
+    cache.remove_pod("default/f0")
+    got = _drain(sched, queue,
+                 [make_pod("refit").req({"cpu": "600m"}).obj()])
+    assert got == 1, "freed capacity not visible after device patch"
+    assert sched._drain_ctx is ctx, "context died on a patchable delete"
+
+
+def test_ctx_survives_node_add():
+    """A node ADD patches into a free node row: pods land on the new node
+    without a context rebuild."""
+    sched, cache, queue, log = _sched(_nodes(2, cpu="1"))
+    ctx = _arm(sched)
+    # saturate the two original nodes
+    assert _drain(sched, queue, [make_pod(f"s{i}").req({"cpu": "700m"}).obj()
+                                 for i in range(2)]) == 2
+    assert _drain(sched, queue,
+                  [make_pod("wait").req({"cpu": "900m"}).obj()]) == 0
+    # new node arrives (churn): only place with room
+    cache.add_node(make_node("fresh")
+                   .capacity({"cpu": "4", "memory": "8Gi", "pods": "32"})
+                   .obj())
+    got = _drain(sched, queue,
+                 [make_pod("landed").req({"cpu": "900m"}).obj()])
+    assert got == 1
+    assert ("landed", "fresh") in log, log
+    assert sched._drain_ctx is ctx, "context died on a node add"
+
+
+def test_ctx_survives_node_delete():
+    """A node REMOVE invalidates its row: nothing schedules there anymore,
+    context intact."""
+    sched, cache, queue, log = _sched(_nodes(3, cpu="2"))
+    ctx = _arm(sched)
+    cache.remove_node("n1")
+    got = _drain(sched, queue, [make_pod(f"p{i}").req({"cpu": "100m"}).obj()
+                                for i in range(6)])
+    assert got == 6
+    assert not any(node == "n1" for (_name, node) in log[-6:]), log[-6:]
+    assert sched._drain_ctx is ctx, "context died on a node delete"
+
+
+def test_ctx_survives_recreate_churn_cycle():
+    """The scheduler_perf churn shape: node+pod create/delete every cycle.
+    The context must survive the whole storm (zero rebuilds) and every
+    measured pod still binds."""
+    sched, cache, queue, log = _sched(_nodes(4))
+    ctx = _arm(sched)
+    for i in range(6):
+        cache.add_node(make_node(f"churn-n{i}")
+                       .capacity({"cpu": "2", "memory": "4Gi", "pods": "8"})
+                       .obj())
+        if i >= 2:
+            cache.remove_node(f"churn-n{i-2}")
+            cache.remove_pod(f"default/m{i-2}")
+        got = _drain(sched, queue,
+                     [make_pod(f"m{i}").req({"cpu": "100m"}).obj()])
+        assert got == 1, f"cycle {i} lost its pod"
+        assert sched._drain_ctx is ctx, f"context rebuilt at cycle {i}"
+
+
+def test_ctx_patch_parity_with_rebuild():
+    """After a patch storm, placements must equal a fresh scheduler built
+    from the same cache state (the patched encoding is not an
+    approximation)."""
+    spec = [("a", "1"), ("b", "2"), ("c", "1")]
+    sched, cache, queue, log = _sched(_nodes(4, cpu="4"))
+    _arm(sched)
+    # place some pods, then churn: drop one node, add three, delete a pod
+    seed = [make_pod(f"s{i}").req({"cpu": "1"}).obj() for i in range(4)]
+    assert _drain(sched, queue, seed) == 4
+    cache.remove_node("n2")
+    for name, cpu in spec:
+        cache.add_node(make_node(f"x-{name}")
+                       .capacity({"cpu": cpu, "memory": "4Gi", "pods": "8"})
+                       .obj())
+    cache.remove_pod("default/s1")
+    # surviving bound pods BEFORE the probe, for the fresh reconstruction
+    survivors = [(p, p.spec.node_name)
+                 for p, _dl in list(cache._assumed.values())]
+    probe = [make_pod(f"q{i}").req({"cpu": "1"}).obj() for i in range(5)]
+    got = _drain(sched, queue, probe)
+
+    # fresh scheduler over the identical surviving state
+    nodes2 = [make_node(f"n{i}")
+              .capacity({"cpu": "4", "memory": "8Gi", "pods": "32"}).obj()
+              for i in (0, 1, 3)]
+    nodes2 += [make_node(f"x-{name}")
+               .capacity({"cpu": cpu, "memory": "4Gi", "pods": "8"}).obj()
+               for name, cpu in spec]
+    sched2, cache2, queue2, log2 = _sched(nodes2)
+    for p, node in survivors:
+        cache2.assume(p, node)
+    probe2 = [make_pod(f"q{i}").req({"cpu": "1"}).obj() for i in range(5)]
+    got2 = _drain(sched2, queue2, probe2)
+    assert got == got2 == 5
+    # score-equivalence, not placement-equality (SURVEY §7: tie-breaks hash
+    # the node ROW index, which renumbers across a rebuild): the multiset of
+    # chosen nodes — i.e. the resulting load distribution — must match.
+    assert sorted(n for _p, n in log[-got:]) \
+        == sorted(n for _p, n in log2[-got2:]), (log[-got:], log2[-got2:])
+
+
+def test_ctx_resident_nominee_reservation():
+    """Nominee reservations patch into the resident nom tensors: a LOWER
+    priority pod cannot take the reserved capacity, a higher one can, and
+    the context survives the whole exchange (round-4 weak #3: any live
+    nominee dropped the drain context)."""
+    import time
+    sched, cache, queue, log = _sched(_nodes(1, cpu="2"))
+    ctx = _arm(sched)
+    # reserve 1.5 cpu for a priority-50 nominee on n0
+    nominee = make_pod("nom").req({"cpu": "1500m"}).priority(50).obj()
+    sched._nominated["preempt/nom"] = ("n0", 50, nominee, time.time())
+    # lower-priority pod wanting 1 cpu: only ~1.6 free minus 1.5 reserved
+    low = make_pod("low").req({"cpu": "1"}).priority(1).obj()
+    assert _drain(sched, queue, [low]) == 0, \
+        "low-priority pod stole a nominee's reservation"
+    assert sched._drain_ctx is ctx, "context died on nominee overlay"
+    # higher-priority pod ignores the reservation
+    high = make_pod("high").req({"cpu": "1"}).priority(100).obj()
+    assert _drain(sched, queue, [high]) == 1
+    assert sched._drain_ctx is ctx
+
+
+def test_ctx_rebuilds_on_unpatchable_delta():
+    """A structural delta (new volume catalog state) must still fall back
+    to a rebuild — patches are an optimization, not a semantics fork."""
+    sched, cache, queue, log = _sched(_nodes(2))
+    ctx = _arm(sched)
+    cache.update_volume_object(
+        "StorageClass", {"kind": "StorageClass",
+                         "metadata": {"name": "fast"},
+                         "provisioner": "x",
+                         "volumeBindingMode": "WaitForFirstConsumer"})
+    got = _drain(sched, queue, [make_pod("after").req({"cpu": "100m"}).obj()])
+    assert got == 1
+    assert sched._drain_ctx is not ctx, \
+        "context survived a structural (full) delta it cannot patch"
