@@ -100,7 +100,7 @@ def _churn_loop(client, stop, period_s: float = 0.1, counter=None) -> None:
 
 
 def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
-                  batch_size: int = 512, drain_batches: int = 8,
+                  batch_size: int = 512, drain_batches: int = 2,
                   timeout: float = 300.0, churn: bool = False,
                   churn_period_s: float = 0.1,
                   log=lambda *a: None) -> dict:
@@ -110,6 +110,11 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
     from kubernetes_tpu.sched.runner import SchedulerRunner
     from benchmarks.workloads import mixed_heterogeneous
 
+    import sys as _sys
+    # the box is single-core: the tunnel client's Python layer competes for
+    # the GIL with informer bursts; a finer switch interval shortens the
+    # stalls a device_get suffers mid-burst
+    _sys.setswitchinterval(0.0005)
     ctx = mp.get_context("spawn")  # never fork a live TPU client
     parent, child = ctx.Pipe()
     server = ctx.Process(target=_serve, args=(child,), daemon=True)
@@ -240,6 +245,9 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         if churn:
             out["churn_api_ops"] = churn_stats.get("ops", 0)
         out["ctx_stats"] = dict(runner.scheduler.ctx_stats)
+        out["attempt_buckets"] = [
+            (b, c) for b, c in ATTEMPT_DURATION.bucket_counts(
+                {"result": "scheduled"}) if c]
         return out
     finally:
         try:
@@ -423,6 +431,6 @@ if __name__ == "__main__":
         n_pods=int(os.environ.get("BENCH_CONNECTED_PODS", "2000")),
         n_nodes=int(os.environ.get("BENCH_CONNECTED_NODES", "1000")),
         batch_size=int(os.environ.get("BENCH_CONNECTED_BATCH", "512")),
-        drain_batches=int(os.environ.get("BENCH_CONNECTED_DRAIN", "8")),
+        drain_batches=int(os.environ.get("BENCH_CONNECTED_DRAIN", "2")),
         log=lambda *a: print(*a, file=sys.stderr))
     print(json.dumps(res))

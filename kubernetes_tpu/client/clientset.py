@@ -423,11 +423,14 @@ class HTTPClient(_Handles):
     def __init__(self, base_url: str, timeout: float = 10.0,
                  token: Optional[str] = None,
                  impersonate: Optional[str] = None,
-                 wire: str = "msgpack"):
+                 wire: str = "msgpack", user_agent: str = ""):
         self.base = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token
         self.impersonate = impersonate
+        # identifies the component to the server (upstream clients always
+        # send one); APF flow schemas match on it for unauthenticated flows
+        self.user_agent = user_agent
         # Wire format: msgpack by default (the protobuf-negotiation analog;
         # ~4x cheaper encode / ~2x decode than JSON on pod-sized objects —
         # the connected path moves every object several times, so the
@@ -467,6 +470,8 @@ class HTTPClient(_Handles):
             h["Authorization"] = f"Bearer {self.token}"
         if self.impersonate:
             h["Impersonate-User"] = self.impersonate
+        if self.user_agent:
+            h["User-Agent"] = self.user_agent
         return h
 
     def _path(self, plural, ns, name=None, sub=None, query=""):

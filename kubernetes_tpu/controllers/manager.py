@@ -40,6 +40,8 @@ from kubernetes_tpu.controllers.clusterroleaggregation import (
 )
 from kubernetes_tpu.controllers.resourcequota import ResourceQuotaController
 from kubernetes_tpu.controllers.statefulset import StatefulSetController
+from kubernetes_tpu.controllers.attachdetach import AttachDetachController
+from kubernetes_tpu.controllers.nodeipam import NodeIpamController
 from kubernetes_tpu.controllers.ttl import TTLController
 from kubernetes_tpu.controllers.ttlafterfinished import TTLAfterFinishedController
 
@@ -86,6 +88,8 @@ class ControllerManager:
             "ttl": TTLController,
             "clusterroleaggregation": ClusterRoleAggregationController,
             "csrsigning": CSRSigningController,
+            "attachdetach": AttachDetachController,
+            "nodeipam": NodeIpamController,
         }
         self.controllers = [ctors[n](client) for n in controllers]
         self.gc = GarbageCollector(client) if gc_enabled else None

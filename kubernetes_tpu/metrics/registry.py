@@ -14,9 +14,9 @@ from bisect import bisect_right
 from typing import Optional
 
 DEFAULT_BUCKETS = (0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.15, 0.2,
-                   0.25, 0.3, 0.4, 0.5, 0.6, 0.75, 0.9, 1.0, 1.25, 1.5,
-                   2.0, 3.0, 5.0, 7.5, 10.0, 15.0, 20.0, 30.0, 45.0, 60.0,
-                   120.0)
+                   0.25, 0.3, 0.4, 0.5, 0.6, 0.7, 0.75, 0.8, 0.85, 0.9,
+                   0.95, 1.0, 1.25, 1.5, 2.0, 3.0, 5.0, 7.5, 10.0, 15.0,
+                   20.0, 30.0, 45.0, 60.0, 120.0)
 
 
 def _label_key(labels: Optional[dict]) -> tuple:
@@ -116,6 +116,12 @@ class Histogram(_Metric):
                 if c >= target:
                     return b
             return self.buckets[-1] if self.buckets else 0.0
+
+    def bucket_counts(self, labels: Optional[dict] = None):
+        """[(upper_bound, cumulative_count)] snapshot for diagnostics."""
+        k = _label_key(labels)
+        with self._lock:
+            return list(zip(self.buckets, self._counts.get(k, [])))
 
     def reset(self, labels: Optional[dict] = None) -> None:
         """Drop observations (all label sets when ``labels`` is None) — a

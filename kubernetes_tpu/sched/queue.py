@@ -70,13 +70,17 @@ class SchedulingQueue:
 
     # ---- producers -------------------------------------------------------
 
-    def add(self, pod: Pod):
-        """New pod (or update making it schedulable): into activeQ."""
+    def add(self, pod: Pod, attempts: int = 0):
+        """New pod (or update making it schedulable): into activeQ.
+        ``attempts`` carries prior attempt history through re-adds (e.g.
+        scheduler restarts re-queueing parked pods) so backoff does not
+        reset."""
         with self._lock:
             k = self._key(pod)
             if k in self._keys_queued:
                 return
-            item = _QueuedPod(self._sort_key(pod), pod, timestamp=time.time())
+            item = _QueuedPod(self._sort_key(pod), pod, attempts=attempts,
+                              timestamp=time.time())
             self._entries[k] = item
             self._keys_queued.add(k)
             if pod.spec.scheduling_gates:

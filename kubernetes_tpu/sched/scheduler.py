@@ -85,8 +85,16 @@ class Scheduler:
         # churn run shows patches >> rebuilds)
         self.ctx_stats = {"patches": 0, "rebuilds": 0, "unfit": 0,
                           "reasons": {}}
+        # per-drain-cycle debug trail (pop size, t_pop, t_dispatch,
+        # t_resolve) when KTPU_CYCLE_LOG=1
+        self.cycle_log: list = [] if _os.environ.get(
+            "KTPU_CYCLE_LOG") else None
         # one-deep software pipeline: the in-flight drain awaiting resolution
         self._pending_drain = None
+        # fragment pops parked while the device is busy (see run_once)
+        self._staged: list = []
+        self._staged_once = False   # a parked fragment merges at most once
+        self._last_pop_full = False  # burst heuristic: arrivals are hot
         # preemption nominees awaiting re-schedule: key -> (node, prio, pod, ts).
         # Their freed capacity is reserved against lower-priority pods until
         # they bind (schedule_one.go nominatedNodeName handling). The TTL
@@ -138,11 +146,31 @@ class Scheduler:
                 ready = True
             if ready:
                 n_early = self._resolve_pending()
+        cap = self.cfg.batch_size * max(1, self.cfg.max_drain_batches)
         batch = self.queue.pop_batch(
-            self.cfg.batch_size * max(1, self.cfg.max_drain_batches),
+            max(1, cap - len(self._staged)),
             wait=0.05 if self._pending_drain is not None else wait)
+        if self._staged:
+            batch = self._staged + batch
+            self._staged = []
         if not batch:
             return n_early + self._resolve_pending()
+        if (len(batch) < self.cfg.batch_size and not self._staged_once
+                and (self._pending_drain is not None
+                     or self._last_pop_full)):
+            # A fragment pop while the device is busy or right after a
+            # full-size pop — typically the middle of a creation burst,
+            # when the informer thread is decoding thousands of watch
+            # events and any host work crawls (single-core GIL). Park it
+            # once, settle the in-flight drain (device-bound anyway), and
+            # let the fragment merge with the arrivals that land
+            # meanwhile: tiny mid-burst drains were the connected p99
+            # tail.
+            self._staged = batch
+            self._staged_once = True
+            return n_early + self._resolve_pending()
+        self._staged_once = False
+        self._last_pop_full = len(batch) >= cap
         stats = self.queue.stats()
         for q, v in stats.items():
             QUEUE_DEPTH.set(v, {"queue": q})
@@ -296,6 +324,7 @@ class Scheduler:
             drain_widths_fit, pad_batch_to, unify_batches)
         from kubernetes_tpu.utils.tracing import TRACER
         t0 = time.time()
+        self._cyc_marks = []  # fresh debug trail per cycle (KTPU_CYCLE_LOG)
         pods = [p for p, _ in items]
         batch_keys = {p.key for p in pods}
         now = time.time()
@@ -334,7 +363,13 @@ class Scheduler:
                     # the in-flight drain must resolve FIRST so the patch
                     # state knows which slots its folds took (and its
                     # assume log entries land before the re-read)
+                    if self.cycle_log is not None:
+                        self._cyc_marks.append(("resolve_prev_start",
+                                                round(time.time() - t0, 3)))
                     n_prev += self._resolve_pending()
+                    if self.cycle_log is not None:
+                        self._cyc_marks.append(
+                            ("resolve_prev_end", round(time.time() - t0, 3)))
                     entries = self.cache.deltas_since(ctx["seq"])
                     if entries is not None:
                         new_seq = (entries[-1][0] + 1 if entries
@@ -380,6 +415,9 @@ class Scheduler:
                 return n_prev
 
         P = self.cfg.batch_size
+        if self.cycle_log is not None:
+            self._cyc_marks.append(("encode_start",
+                                    round(time.time() - t0, 3)))
         chunks = [items[i:i + P] for i in range(0, len(items), P)]
         with TRACER.span("scheduler/encode_pods", pods=len(pods)):
             pbs = [self.cache.encode_pods(
@@ -446,6 +484,9 @@ class Scheduler:
         # host resolves the PREVIOUS one — assume/bind/requeue and the next
         # pop's decode all overlap device execution (software pipelining;
         # jax dispatch is asynchronous, only device_get blocks)
+        if self.cycle_log is not None:
+            self._cyc_marks.append(("dispatch_start",
+                                    round(time.time() - t0, 3)))
         with TRACER.span("scheduler/gang_dispatch",
                          pods=len(pods), nodes=len(nodes)):
             assignments, rounds, new_ct, new_fill = drain_step(
@@ -468,6 +509,10 @@ class Scheduler:
             "meta": meta, "n_nodes": len(nodes), "profile": profile,
             "t0": t0,
         }
+        if self.cycle_log is not None:
+            marks = dict(self._cyc_marks)
+            marks["done"] = round(time.time() - t0, 3)
+            self._pending_drain["cyc"] = (len(pods), t0, marks)
         return n_prev
 
     def _ctx_reason(self, why: str):
@@ -485,6 +530,10 @@ class Scheduler:
         if pend is None:
             return 0
         self._pending_drain = None
+        if self.cycle_log is not None and "cyc" in pend:
+            n, tp, marks = pend["cyc"]
+            marks["resolve_at"] = round(time.time() - tp, 3)
+            self.cycle_log.append((n, round(tp, 3), marks))
         import jax
         import numpy as np
         from kubernetes_tpu.utils.tracing import TRACER
@@ -632,6 +681,11 @@ class Scheduler:
         if built is None or cs is None:
             return False
         ct_dev, e0, fill = built
+        # the context upload streams asynchronously over the (remote) device
+        # link; returning before it lands makes the FIRST real drain eat the
+        # remaining transfer (~seconds at 10k-scale encodings) inside the
+        # measured window
+        jax.block_until_ready(ct_dev)
         self._drain_ctx = {"ct": ct_dev, "e0": e0, "fill_dev": fill,
                            "fill_bound": fill,
                            "meta": fork_meta(meta), "nodes": nodes,
@@ -857,6 +911,12 @@ class Scheduler:
             self._resolve_pending()  # land the in-flight drain's bindings
         except Exception:
             _LOG.exception("resolving in-flight drain at close")
+        if self._staged:
+            # parked fragments go back to the queue, not the void — with
+            # their attempt history, so backoff does not reset
+            for pod, attempts in self._staged:
+                self.queue.add(pod, attempts=attempts)
+            self._staged = []
         with self._bind_cv:
             workers = list(self._bind_workers)
             self._bind_workers = []

@@ -63,7 +63,8 @@ CORE_RESOURCES = {
     "replicationcontrollers": ("ReplicationController", True),
     "serviceaccounts": ("ServiceAccount", True),
 }
-STORAGE_RESOURCES = {"storageclasses": ("StorageClass", False)}
+STORAGE_RESOURCES = {"storageclasses": ("StorageClass", False),
+                     "volumeattachments": ("VolumeAttachment", False)}
 SCHEDULING_RESOURCES = {"priorityclasses": ("PriorityClass", False)}
 APPS_RESOURCES = {
     "deployments": ("Deployment", True),
@@ -438,11 +439,16 @@ class APIServer:
                         self._user = UserInfo(imp, groups)
                 if server.flow is None or "watch=true" in self.path:
                     return self._run_authorized(verb, fn)
+                agent = self.headers.get("User-Agent", "")
                 level = server.flow.classify(
-                    verb, urlparse(self.path).path,
-                    self.headers.get("User-Agent", ""))
+                    verb, urlparse(self.path).path, agent)
                 try:
-                    server.flow.acquire(level)
+                    # flow distinguisher: the authenticated user, falling
+                    # back to the client agent (upstream: FlowSchema's
+                    # distinguisherMethod over user/namespace)
+                    server.flow.acquire(
+                        level,
+                        flow=(self._user.name if self._user else agent))
                 except RejectedError as e:
                     self._drain_body()
                     body = json.dumps({"kind": "Status", "status": "Failure",

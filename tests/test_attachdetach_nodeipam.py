@@ -1,0 +1,202 @@
+"""Attach/detach + node IPAM controllers.
+
+Reference: ``pkg/controller/volume/attachdetach/attach_detach_controller.go``
+(VolumeAttachment reconciliation from pods' volumes) and
+``pkg/controller/nodeipam/ipam/range_allocator.go`` (per-node podCIDR
+carving of the cluster CIDR).
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client.clientset import DirectClient
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.controllers.attachdetach import (AttachDetachController,
+                                                     attachment_name)
+from kubernetes_tpu.controllers.nodeipam import CidrSet, NodeIpamController
+from kubernetes_tpu.store.store import ObjectStore
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def wait_until(fn, timeout=8.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+@pytest.fixture
+def client():
+    return DirectClient(ObjectStore())
+
+
+def run_controller(client, ctrl):
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    return ctrl, factory
+
+
+def stop(ctrl, factory):
+    ctrl.stop()
+    factory.stop_all()
+
+
+def _seed_csi_volume(client, pv="pv-1", pvc="data", ns="default"):
+    client.resource("persistentvolumes", None).create({
+        "kind": "PersistentVolume", "metadata": {"name": pv},
+        "spec": {"capacity": {"storage": "10Gi"},
+                 "csi": {"driver": "ebs.csi.example.com",
+                         "volumeHandle": "vol-123"}}})
+    client.resource("persistentvolumeclaims", ns).create({
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": pvc, "namespace": ns},
+        "spec": {"volumeName": pv,
+                 "resources": {"requests": {"storage": "10Gi"}}}})
+
+
+# ------------------------------------------------------------- attachdetach
+
+def test_attach_created_for_scheduled_pod_and_detached_on_delete(client):
+    client.nodes().create(make_node("n1").obj().to_dict())
+    _seed_csi_volume(client)
+    ctrl, factory = run_controller(client, AttachDetachController(client))
+    try:
+        pod = make_pod("app").obj().to_dict()
+        pod["spec"]["nodeName"] = "n1"
+        pod["spec"]["volumes"] = [
+            {"name": "data", "persistentVolumeClaim": {"claimName": "data"}}]
+        client.pods("default").create(pod)
+        vas = client.resource("volumeattachments", None)
+
+        def attached():
+            items = vas.list()
+            return [v for v in items
+                    if (v.get("status") or {}).get("attached")]
+        assert wait_until(lambda: len(attached()) == 1), vas.list()
+        va = attached()[0]
+        assert va["spec"]["nodeName"] == "n1"
+        assert va["spec"]["attacher"] == "ebs.csi.example.com"
+        assert va["spec"]["source"]["persistentVolumeName"] == "pv-1"
+        assert va["metadata"]["name"] == attachment_name("pv-1", "n1")
+        # node status mirrors the attachment
+        assert wait_until(lambda: (client.nodes().get("n1").get("status") or
+                                   {}).get("volumesAttached"))
+        # pod deleted -> attachment detached
+        client.pods("default").delete("app")
+        assert wait_until(lambda: not attached()), vas.list()
+    finally:
+        stop(ctrl, factory)
+
+
+def test_no_attachment_for_non_csi_pv(client):
+    client.nodes().create(make_node("n1").obj().to_dict())
+    client.resource("persistentvolumes", None).create({
+        "kind": "PersistentVolume", "metadata": {"name": "local-pv"},
+        "spec": {"capacity": {"storage": "10Gi"},
+                 "hostPath": {"path": "/data"}}})
+    client.resource("persistentvolumeclaims", "default").create({
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "data", "namespace": "default"},
+        "spec": {"volumeName": "local-pv",
+                 "resources": {"requests": {"storage": "10Gi"}}}})
+    ctrl, factory = run_controller(client, AttachDetachController(client))
+    try:
+        pod = make_pod("app").obj().to_dict()
+        pod["spec"]["nodeName"] = "n1"
+        pod["spec"]["volumes"] = [
+            {"name": "data", "persistentVolumeClaim": {"claimName": "data"}}]
+        client.pods("default").create(pod)
+        time.sleep(0.3)
+        assert client.resource("volumeattachments", None).list() == []
+    finally:
+        stop(ctrl, factory)
+
+
+# ----------------------------------------------------------------- nodeipam
+
+def test_cidrset_carves_and_recycles():
+    cs = CidrSet("10.244.0.0/16", 24)
+    assert cs.max == 256
+    a = cs.allocate()
+    b = cs.allocate()
+    assert a == "10.244.0.0/24" and b == "10.244.1.0/24"
+    cs.release(a)
+    cs.occupy("10.244.5.0/24")
+    # drain the whole space: the released subnet must come back, the
+    # occupied one must not, and nothing is handed out twice
+    got = [cs.allocate() for _ in range(cs.max - 2)]
+    assert len(set(got)) == len(got)
+    assert "10.244.5.0/24" not in got
+    assert "10.244.0.0/24" in got  # released subnet recycled
+    with pytest.raises(RuntimeError):
+        cs.allocate()  # exhausted
+
+
+def test_nodeipam_assigns_unique_cidrs(client):
+    for i in range(3):
+        client.nodes().create(make_node(f"n{i}").obj().to_dict())
+    ctrl, factory = run_controller(
+        client, NodeIpamController(client, cluster_cidr="10.244.0.0/16"))
+    try:
+        def cidrs():
+            return [(n.get("spec") or {}).get("podCIDR")
+                    for n in client.nodes().list()]
+        assert wait_until(lambda: all(cidrs())), cidrs()
+        got = cidrs()
+        assert len(set(got)) == 3
+        assert all(c.endswith("/24") and c.startswith("10.244.")
+                   for c in got)
+        # a late node gets a CIDR too, never a duplicate
+        client.nodes().create(make_node("late").obj().to_dict())
+        assert wait_until(
+            lambda: (client.nodes().get("late").get("spec") or {})
+            .get("podCIDR")), client.nodes().get("late")
+        assert len(set(cidrs())) == 4
+    finally:
+        stop(ctrl, factory)
+
+
+def test_nodeipam_reserves_existing_cidrs_on_restart(client):
+    n = make_node("seeded").obj().to_dict()
+    n["spec"]["podCIDR"] = "10.244.0.0/24"
+    client.nodes().create(n)
+    client.nodes().create(make_node("fresh").obj().to_dict())
+    ctrl, factory = run_controller(
+        client, NodeIpamController(client, cluster_cidr="10.244.0.0/16"))
+    try:
+        assert wait_until(
+            lambda: (client.nodes().get("fresh").get("spec") or {})
+            .get("podCIDR"))
+        fresh = client.nodes().get("fresh")["spec"]["podCIDR"]
+        assert fresh != "10.244.0.0/24"  # seeded subnet stayed reserved
+        # seeded node keeps its original allocation
+        assert client.nodes().get("seeded")["spec"]["podCIDR"] \
+            == "10.244.0.0/24"
+    finally:
+        stop(ctrl, factory)
+
+
+def test_nodeipam_releases_on_node_delete(client):
+    cs_ctrl = NodeIpamController(client, cluster_cidr="10.0.0.0/30",
+                                 node_mask_size=31)  # only 2 subnets
+    client.nodes().create(make_node("a").obj().to_dict())
+    client.nodes().create(make_node("b").obj().to_dict())
+    ctrl, factory = run_controller(client, cs_ctrl)
+    try:
+        assert wait_until(lambda: all(
+            (n.get("spec") or {}).get("podCIDR")
+            for n in client.nodes().list()))
+        client.nodes().delete("a")
+        time.sleep(0.2)
+        client.nodes().create(make_node("c").obj().to_dict())
+        assert wait_until(
+            lambda: (client.nodes().get("c").get("spec") or {})
+            .get("podCIDR")), "released subnet was not reusable"
+    finally:
+        stop(ctrl, factory)
