@@ -110,11 +110,6 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
     from kubernetes_tpu.sched.runner import SchedulerRunner
     from benchmarks.workloads import mixed_heterogeneous
 
-    import sys as _sys
-    # the box is single-core: the tunnel client's Python layer competes for
-    # the GIL with informer bursts; a finer switch interval shortens the
-    # stalls a device_get suffers mid-burst
-    _sys.setswitchinterval(0.0005)
     ctx = mp.get_context("spawn")  # never fork a live TPU client
     parent, child = ctx.Pipe()
     server = ctx.Process(target=_serve, args=(child,), daemon=True)

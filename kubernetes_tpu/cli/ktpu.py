@@ -402,7 +402,8 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None, out=None) -> int:
     out = out or sys.stdout
     args = build_parser().parse_args(argv)
-    client = HTTPClient(args.server, token=args.token)
+    client = HTTPClient(args.server, token=args.token,
+                        user_agent="ktpu")
     try:
         if args.cmd == "get":
             return cmd_get(client, args, out)

@@ -86,9 +86,10 @@ class NodeIpamController(Controller):
 
     def register(self, factory: InformerFactory) -> None:
         self.node_informer = factory.informer("nodes", None)
-        # re-reserve CIDRs already on nodes BEFORE allocating (restart path)
-        for n in self.node_informer.store.list():
-            self._reserve_existing(n)
+        # Restart safety: the informer replays every existing node as an
+        # ADDED event during cache sync (before any worker runs), and
+        # _on_node occupies its podCIDR before enqueueing — so seeded
+        # subnets are reserved before the first allocation.
         self.node_informer.add_event_handler(self._on_node)
 
     def _reserve_existing(self, node: dict) -> None:

@@ -1,0 +1,133 @@
+"""Kubemark — hundreds of hollow kubelets in one process.
+
+Reference: ``pkg/kubemark/hollow_kubelet.go`` + ``cmd/kubemark``: real
+kubelet code over a mocked CRI so a handful of machines can drive
+thousand-node control-plane tests. The packing trick here is SHARED
+PLUMBING: one pod watch stream fans events out to every hollow kubelet by
+``spec.nodeName`` (500 per-node watch connections would melt a single-core
+box before the control plane breaks a sweat), node registration is one
+bulk create, and heartbeats ride a small driver pool instead of a timer
+thread per node. Each node still runs the REAL Kubelet sync machinery —
+admission (allocatable/cpu/device/topology), FakeRuntime sandbox +
+container lifecycle, status writes — via its own PodWorkers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from kubernetes_tpu.client.clientset import ApiError
+from kubernetes_tpu.client.informer import SharedInformer
+from kubernetes_tpu.kubelet.kubelet import HollowNode
+from kubernetes_tpu.utils.events import NullRecorder
+
+
+class HollowCluster:
+    def __init__(self, client, n: int, prefix: str = "hollow",
+                 heartbeat_period: float = 10.0, drivers: int = 4,
+                 allocatable: dict | None = None,
+                 exit_after: float | None = None):
+        self.client = client
+        # identify this component's flows to APF (classify matches the agent
+        # for unauthenticated traffic)
+        if getattr(client, "user_agent", None) == "":
+            client.user_agent = "kubelet/hollow"
+        self.heartbeat_period = heartbeat_period
+        self.drivers = max(1, drivers)
+        self.nodes: list[HollowNode] = []
+        for i in range(n):
+            hn = HollowNode(client, f"{prefix}-{i}", exit_after=exit_after,
+                            allocatable=dict(allocatable or {
+                                "cpu": "8", "memory": "16Gi",
+                                "pods": "110"}),
+                            heartbeat_period=heartbeat_period,
+                            register_node=False)
+            # at fleet scale the per-pod event POSTs are pure hot-path load
+            # on the apiserver; kubemark silences them the same way
+            hn.kubelet.recorder = NullRecorder()
+            self.nodes.append(hn)
+        self._by_name = {hn.kubelet.node_name: hn.kubelet
+                         for hn in self.nodes}
+        self._informer: SharedInformer | None = None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self, wait_sync: float = 30.0) -> "HollowCluster":
+        # one bulk registration for the whole fleet
+        self.client.nodes().create_many(
+            [hn.kubelet._node_object() for hn in self.nodes])
+        # one shared watch stream; dispatch by spec.nodeName
+        self._informer = SharedInformer(self.client.resource("pods", None))
+        self._informer.add_event_handler(self._on_pod_event)
+        self._informer.start()
+        self._informer.wait_for_cache_sync(wait_sync)
+        shards = [self.nodes[i::self.drivers] for i in range(self.drivers)]
+        for shard in shards:
+            t = threading.Thread(target=self._driver_loop, args=(shard,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._informer is not None:
+            self._informer.stop()
+        for hn in self.nodes:
+            hn.kubelet.workers.stop()
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    # ---- shared event fan-out -------------------------------------------
+
+    def _on_pod_event(self, type_, obj, old):
+        node = (obj.get("spec") or {}).get("nodeName", "")
+        kubelet = self._by_name.get(node)
+        if kubelet is not None:
+            kubelet._on_pod_event(type_, obj, old)
+        elif old is not None:
+            # MODIFIED that moved the pod off one of our nodes
+            prev = self._by_name.get((old.get("spec") or {})
+                                     .get("nodeName", ""))
+            if prev is not None:
+                prev._on_pod_event("DELETED", old, None)
+
+    # ---- driver pool: heartbeats without a thread per node ---------------
+
+    def _heartbeat_once(self, kubelet) -> None:
+        try:
+            node = self.client.nodes().get(kubelet.node_name)
+            conds = [c for c in (node.get("status") or {})
+                     .get("conditions") or [] if c.get("type") != "Ready"]
+            node.setdefault("status", {})["conditions"] = \
+                conds + [kubelet._ready_condition()]
+            self.client.nodes().update_status(node)
+        except ApiError:
+            try:
+                kubelet._register()
+            except ApiError:
+                pass
+
+    def _driver_loop(self, shard):
+        # spread the shard's heartbeats across the period so the apiserver
+        # sees a steady trickle, not a thundering herd every period
+        while not self._stop.is_set():
+            t0 = time.time()
+            for kubelet in shard:
+                if self._stop.is_set():
+                    return
+                self._heartbeat_once(kubelet.kubelet)
+                budget = self.heartbeat_period / max(1, len(shard))
+                self._stop.wait(max(0.0, budget - 0.001))
+            leftover = self.heartbeat_period - (time.time() - t0)
+            if leftover > 0:
+                self._stop.wait(leftover)
+
+    # ---- observability ---------------------------------------------------
+
+    def running_pods(self) -> int:
+        return sum(len(hn.kubelet.runtime.list_sandboxes())
+                   for hn in self.nodes)

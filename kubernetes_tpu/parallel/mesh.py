@@ -67,3 +67,25 @@ def shard_cluster(mesh: Mesh, ct: ClusterTensors) -> ClusterTensors:
 
 def shard_batch(mesh: Mesh, pb: PodBatch) -> PodBatch:
     return jax.device_put(pb, batch_shardings(mesh, pb))
+
+
+def stack_shardings(mesh: Mesh, pb_stack: PodBatch) -> PodBatch:
+    """Sharding pytree for a STACKED drain batch [B,P,...]: the pod axis
+    (axis 1) splits over "pods"; the scan axis B stays replicated (the
+    drain scans batches sequentially — capacity carries batch to batch)."""
+    def spec(leaf):
+        return NamedSharding(mesh, P(None, "pods", *([None] * (leaf.ndim - 2))))
+    return jax.tree_util.tree_map(spec, pb_stack)
+
+
+def shard_drain(mesh: Mesh, ct_all: ClusterTensors, pb_stack: PodBatch):
+    """Stage a fused-drain problem onto the mesh: cluster tensors split on
+    "nodes" (the SURVEY §2.6 core replacement for parallelize.Until's
+    node-axis goroutine fan-out), stacked batches split on "pods",
+    epod/relational side-tables replicated — drain_step then runs with
+    GSPMD collectives over ICI for every cross-node reduction
+    (normalize max, selectHost argmax, domain-count matmuls, fold
+    scatters)."""
+    ct_s = jax.device_put(ct_all, cluster_shardings(mesh, ct_all))
+    pb_s = jax.device_put(pb_stack, stack_shardings(mesh, pb_stack))
+    return ct_s, pb_s

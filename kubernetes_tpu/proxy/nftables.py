@@ -106,6 +106,8 @@ class NftablesProxier(Proxier):
             "\t}",
             "\tchain services {",
             "\t\ttype nat hook prerouting priority dnat; policy accept;",
+            "\t\tip daddr . meta l4proto . th dport vmap "
+            "@no-endpoint-services",
             "\t\tip daddr . meta l4proto . th dport vmap @service-ips",
             "\t\tfib daddr type local meta l4proto . th dport vmap "
             "@service-nodeports",
@@ -157,7 +159,9 @@ class RestoredNftRules:
                         continue
                     key, _, verdict = elem.partition(" : ")
                     fields = [f.strip() for f in key.split(" . ")]
-                    target = verdict.replace("goto", "").strip()
+                    # removeprefix, not replace: chain names embed the
+                    # user-controlled ns/name, which may contain "goto"
+                    target = verdict.strip().removeprefix("goto").strip()
                     if mode == "service-ips":
                         vip, proto, port = fields
                         self.dispatch[(vip, int(port), proto)] = target
@@ -181,7 +185,8 @@ class RestoredNftRules:
                 continue
             arms = rule[rule.index("{") + 1:rule.rindex("}")]
             for arm in arms.split(","):
-                target = arm.split(":", 1)[1].replace("goto", "").strip()
+                target = arm.split(":", 1)[1].strip() \
+                    .removeprefix("goto").strip()
                 for ep_rule in self.chains.get(target, []):
                     if "dnat to" in ep_rule:
                         out.append(ep_rule.rsplit("dnat to", 1)[1].strip())

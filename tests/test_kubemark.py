@@ -1,0 +1,73 @@
+"""Kubemark e2e: hollow kubelet fleet + connected scheduler + apiserver.
+
+Reference: ``pkg/kubemark/hollow_kubelet.go`` — real kubelet machinery over
+a mocked CRI at node counts no test cluster provides. The full 500-node run
+records its numbers in BENCH (benchmarks/kubemark.py); the slow-marked test
+here runs the same loop at a CI-survivable fleet size.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client.clientset import HTTPClient
+from kubernetes_tpu.kubelet.kubemark import HollowCluster
+from kubernetes_tpu.store.apiserver import APIServer
+from kubernetes_tpu.testing.wrappers import make_pod
+
+
+def test_hollow_cluster_registers_heartbeats_and_runs_pods():
+    """Smoke: a small fleet registers in bulk, heartbeats via the driver
+    pool, and drives scheduled pods to Running through the real kubelet
+    sync machinery."""
+    server = APIServer().start()
+    cluster = None
+    try:
+        client = HTTPClient(server.url)
+        cluster = HollowCluster(client, 12, prefix="hx",
+                                heartbeat_period=0.5).start()
+        assert len(client.nodes().list()) == 12
+        # heartbeats: every node turns Ready
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            ready = sum(
+                1 for n in client.nodes().list()
+                if any(c.get("type") == "Ready"
+                       and c.get("status") == "True"
+                       for c in (n.get("status") or {})
+                       .get("conditions") or []))
+            if ready == 12:
+                break
+            time.sleep(0.1)
+        assert ready == 12
+        # a pod bound to a hollow node reaches Running via the shared watch
+        pod = make_pod("hp").req({"cpu": "100m"}).obj().to_dict()
+        pod["spec"]["nodeName"] = "hx-3"
+        client.pods("default").create(pod)
+        deadline = time.time() + 10
+        phase = None
+        while time.time() < deadline:
+            phase = (client.pods("default").get("hp").get("status")
+                     or {}).get("phase")
+            if phase == "Running":
+                break
+            time.sleep(0.1)
+        assert phase == "Running"
+        assert cluster.running_pods() == 1
+    finally:
+        if cluster is not None:
+            cluster.stop()
+        server.stop()
+
+
+@pytest.mark.slow
+def test_kubemark_fleet_with_connected_scheduler():
+    """The kubemark loop end to end at fleet scale: 150 hollow nodes, the
+    connected scheduler binding 400 pods, kubelets driving them Running
+    (the 500-node configuration runs in BENCH via benchmarks/kubemark.py)."""
+    from benchmarks.kubemark import run_kubemark
+    res = run_kubemark(n_hollow=150, n_pods=400, heartbeat_period=5.0,
+                       timeout=180.0)
+    assert res["bound"] == 400, res
+    assert res["running"] == 400, res
+    assert res["nodes_ready"] == 150, res
