@@ -350,6 +350,143 @@ def cmd_drain(client: HTTPClient, args, out) -> int:
     return 0
 
 
+def cmd_logs(client: HTTPClient, args, out) -> int:
+    """kubectl logs analog: apiserver -> kubelet containerLogs proxy."""
+    out.write(client.pod_logs(args.namespace, args.name,
+                              container=args.container or ""))
+    return 0
+
+
+def cmd_exec(client: HTTPClient, args, out) -> int:
+    """kubectl exec analog (ExecSync shape: command in, output + code)."""
+    res = client.pod_exec(args.namespace, args.name, args.command,
+                          container=args.container or "")
+    out.write(res.get("output", ""))
+    return int(res.get("exit_code", 1))
+
+
+def cmd_port_forward(client: HTTPClient, args, out) -> int:
+    """kubectl port-forward analog: local listener -> apiserver
+    portforward subresource -> kubelet -> container app, raw TCP spliced
+    end to end. Serves until interrupted (or ``--one-shot`` for one
+    connection, which tests use)."""
+    import socket as _socket
+    import threading
+    from urllib.parse import urlsplit
+    local = int(args.ports.split(":")[0])
+    parts = urlsplit(args.server)
+    api = (parts.hostname, parts.port or 80)
+    path = (f"/api/v1/namespaces/{args.namespace}/pods/"
+            f"{args.name}/portforward")
+
+    auth = (f"Authorization: Bearer {args.token}\r\n"
+            if getattr(args, "token", None) else "")
+
+    def handle(conn):
+        with conn:
+            try:
+                up = _socket.create_connection(api, timeout=10.0)
+                up.sendall((f"POST {path} HTTP/1.1\r\n"
+                            f"Host: {parts.hostname}\r\n"
+                            f"{auth}"
+                            "Upgrade: tcp\r\nConnection: Upgrade\r\n"
+                            "Content-Length: 0\r\n\r\n").encode())
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    c = up.recv(1024)
+                    if not c:
+                        return
+                    buf += c
+                if b" 101 " not in buf.split(b"\r\n", 1)[0]:
+                    return
+                rest = buf.split(b"\r\n\r\n", 1)[1]
+                if rest:
+                    conn.sendall(rest)
+                from kubernetes_tpu.kubelet.server import _splice_sockets
+                _splice_sockets(conn, up)
+            except OSError:
+                pass
+
+    srv = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    srv.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", local))
+    srv.listen(4)
+    bound = srv.getsockname()[1]
+    out.write(f"Forwarding from 127.0.0.1:{bound} -> pod {args.name}\n")
+    try:
+        while True:
+            conn, _ = srv.accept()
+            if args.one_shot:
+                handle(conn)
+                return 0
+            threading.Thread(target=handle, args=(conn,),
+                             daemon=True).start()
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        srv.close()
+
+
+REVISION_ANNOTATION = "deployment.kubernetes.io/revision"
+
+
+def cmd_rollout(client: HTTPClient, args, out) -> int:
+    """kubectl rollout status|history|undo|restart for Deployments
+    (kubectl/pkg/cmd/rollout; revisions ride the ReplicaSet revision
+    annotation exactly like upstream)."""
+    deps = client.resource("deployments", args.namespace)
+    dep = deps.get(args.name)
+    spec = dep.get("spec") or {}
+    status = dep.get("status") or {}
+    if args.action == "status":
+        want = int(spec.get("replicas", 1))
+        updated = int(status.get("updatedReplicas", 0) or 0)
+        avail = int(status.get("availableReplicas",
+                               status.get("readyReplicas", 0)) or 0)
+        if updated >= want and avail >= want:
+            out.write(f'deployment "{args.name}" successfully rolled out\n')
+            return 0
+        out.write(f"Waiting for deployment \"{args.name}\" rollout: "
+                  f"{updated} of {want} updated, {avail} available\n")
+        return 1
+    rss = [rs for rs in client.resource("replicasets",
+                                        args.namespace).list()
+           if any(ref.get("kind") == "Deployment"
+                  and ref.get("name") == args.name
+                  for ref in (rs.get("metadata") or {})
+                  .get("ownerReferences") or [])]
+    rss.sort(key=lambda rs: int(((rs.get("metadata") or {})
+                                 .get("annotations") or {})
+                                .get(REVISION_ANNOTATION, "0") or 0))
+    if args.action == "history":
+        out.write(f"deployment.apps/{args.name}\nREVISION\n")
+        for rs in rss:
+            rev = ((rs.get("metadata") or {}).get("annotations") or {}) \
+                .get(REVISION_ANNOTATION, "?")
+            out.write(f"{rev}\n")
+        return 0
+    if args.action == "undo":
+        if len(rss) < 2:
+            out.write("error: no rollout history found\n")
+            return 1
+        prev = rss[-2]  # previous revision's template
+        dep["spec"]["template"] = (prev.get("spec") or {}).get("template")
+        deps.update(dep)
+        out.write(f"deployment.apps/{args.name} rolled back\n")
+        return 0
+    if args.action == "restart":
+        import datetime
+        tmpl = dep["spec"].setdefault("template", {})
+        md = tmpl.setdefault("metadata", {})
+        md.setdefault("annotations", {})[
+            "kubectl.kubernetes.io/restartedAt"] = \
+            datetime.datetime.now(datetime.timezone.utc).isoformat()
+        deps.update(dep)
+        out.write(f"deployment.apps/{args.name} restarted\n")
+        return 0
+    return 2
+
+
 # ------------------------------------------------------------------- main
 
 def build_parser() -> argparse.ArgumentParser:
@@ -396,6 +533,27 @@ def build_parser() -> argparse.ArgumentParser:
     for nm in ("cordon", "uncordon", "drain"):
         c = sub.add_parser(nm)
         c.add_argument("name")
+
+    lg = sub.add_parser("logs")
+    lg.add_argument("name")
+    lg.add_argument("-c", "--container", default=None)
+
+    ex = sub.add_parser("exec")
+    ex.add_argument("name")
+    ex.add_argument("-c", "--container", default=None)
+    ex.add_argument("command", nargs=argparse.REMAINDER,
+                    help="-- cmd args...")
+
+    pf = sub.add_parser("port-forward")
+    pf.add_argument("name")
+    pf.add_argument("ports", help="local[:remote]")
+    pf.add_argument("--one-shot", action="store_true",
+                    help="serve a single connection then exit")
+
+    ro = sub.add_parser("rollout")
+    ro.add_argument("action",
+                    choices=["status", "history", "undo", "restart"])
+    ro.add_argument("kind_name", help="deployment/<name>")
     return ap
 
 
@@ -429,6 +587,17 @@ def main(argv=None, out=None) -> int:
             return _set_unschedulable(client, args.name, False, out)
         if args.cmd == "drain":
             return cmd_drain(client, args, out)
+        if args.cmd == "logs":
+            return cmd_logs(client, args, out)
+        if args.cmd == "exec":
+            args.command = [c for c in args.command if c != "--"]
+            return cmd_exec(client, args, out)
+        if args.cmd == "port-forward":
+            args.server = client.base
+            return cmd_port_forward(client, args, out)
+        if args.cmd == "rollout":
+            args.name = args.kind_name.split("/", 1)[-1]
+            return cmd_rollout(client, args, out)
     except ApiError as e:
         out.write(f"Error from server ({e.reason or e.code}): {e}\n")
         return 1

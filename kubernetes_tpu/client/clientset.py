@@ -444,6 +444,12 @@ class HTTPClient(_Handles):
         # of the connected scheduling path's bind/status chatter
         self._local = threading.local()
 
+    def default_user_agent(self, name: str) -> None:
+        """Set the agent unless the caller already chose one — components
+        call this so their flows classify under the right APF schema."""
+        if not self.user_agent:
+            self.user_agent = name
+
     def _conn(self):
         conn = getattr(self._local, "conn", None)
         if conn is None:
@@ -693,6 +699,28 @@ class HTTPClient(_Handles):
 
     def watch(self, plural, kind, ns, since_rv):
         return _HTTPWatch(self, plural, ns, since_rv)
+
+    # ---- kubelet-proxied pod subresources (kubectl logs / exec) ----------
+
+    def pod_logs(self, ns: str, name: str, container: str = "") -> str:
+        """GET pods/<p>/log — the apiserver proxies to the pod's kubelet."""
+        q = f"container={container}" if container else ""
+        url = self._path("pods", ns, name, "log", query=q)
+        req = urllib.request.Request(url, headers=self._auth_headers())
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.read().decode()
+
+    def pod_exec(self, ns: str, name: str, command: list,
+                 container: str = "") -> dict:
+        """POST pods/<p>/exec -> {exit_code, output} via the kubelet."""
+        q = f"container={container}" if container else ""
+        url = self._path("pods", ns, name, "exec", query=q)
+        req = urllib.request.Request(
+            url, data=json.dumps({"command": command}).encode(),
+            headers={"Content-Type": "application/json",
+                     **self._auth_headers()}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read())
 
 
 class _HTTPWatch:

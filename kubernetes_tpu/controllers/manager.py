@@ -62,10 +62,8 @@ class ControllerManager:
                  resync_period: float = 10.0,
                  gc_enabled: bool = True):
         self.client = client
-        # identify this component's flows to APF (classify matches the agent
-        # for unauthenticated traffic)
-        if getattr(client, "user_agent", None) == "":
-            client.user_agent = "kube-controller-manager"
+        if hasattr(client, "default_user_agent"):
+            client.default_user_agent("kube-controller-manager")
         self.factory = InformerFactory(client)
         self.resync_period = resync_period
         ctors = {

@@ -86,10 +86,14 @@ class NodeIpamController(Controller):
 
     def register(self, factory: InformerFactory) -> None:
         self.node_informer = factory.informer("nodes", None)
-        # Restart safety: the informer replays every existing node as an
-        # ADDED event during cache sync (before any worker runs), and
-        # _on_node occupies its podCIDR before enqueueing — so seeded
-        # subnets are reserved before the first allocation.
+        # Restart safety, both wiring orders: in the normal flow (register
+        # before factory.start_all) the informer replays every existing
+        # node as an ADDED event during cache sync, and _on_node occupies
+        # its podCIDR before any worker allocates. If this controller is
+        # ever registered against an ALREADY-synced shared informer (no
+        # replay), the store scan below provides the same guarantee.
+        for n in self.node_informer.store.list():
+            self._reserve_existing(n)
         self.node_informer.add_event_handler(self._on_node)
 
     def _reserve_existing(self, node: dict) -> None:

@@ -86,6 +86,7 @@ class Kubelet:
         self._rejected: dict[str, str] = {}   # uid -> rejection reason
         from kubernetes_tpu.utils.events import EventRecorder
         self.recorder = EventRecorder(client, f"kubelet/{node_name}")
+        self.server = None  # KubeletServer once start(serve=True) runs
 
     def _next_pod_ip(self) -> str:
         n = next(self._pod_ip_seq)
@@ -94,16 +95,34 @@ class Kubelet:
     # ---- node registration + heartbeat ----------------------------------
 
     def _node_object(self) -> dict:
+        status = {
+            "allocatable": dict(self.allocatable),
+            "capacity": dict(self.allocatable),
+            "conditions": [self._ready_condition()],
+        }
+        if self.server is not None:
+            # the apiserver proxies log/exec/portforward subresources here
+            # (node.status.daemonEndpoints.kubeletEndpoint upstream)
+            status["addresses"] = [{"type": "InternalIP",
+                                    "address": "127.0.0.1"}]
+            status["daemonEndpoints"] = {
+                "kubeletEndpoint": {"Port": self.server.port}}
         return {
             "apiVersion": "v1", "kind": "Node",
             "metadata": {"name": self.node_name, "labels": dict(self.labels)},
             "spec": {},
-            "status": {
-                "allocatable": dict(self.allocatable),
-                "capacity": dict(self.allocatable),
-                "conditions": [self._ready_condition()],
-            },
+            "status": status,
         }
+
+    def _uid_of(self, ns: str, name: str):
+        """pod-manager name lookup for the kubelet API server."""
+        with self._pods_lock:
+            for uid, p in self._pods.items():
+                md = p.get("metadata") or {}
+                if (md.get("namespace", "default") == ns
+                        and md.get("name", "") == name):
+                    return uid
+        return None
 
     def _ready_condition(self) -> dict:
         return {"type": "Ready", "status": "True",
@@ -117,25 +136,45 @@ class Kubelet:
             if e.code != 409:
                 raise  # exists: adopt + heartbeat
 
+    def heartbeat_once(self):
+        """One heartbeat: refresh the Ready condition AND re-assert the
+        kubelet endpoint (a restarted kubelet binds a fresh port; the old
+        daemonEndpoints on the adopted Node would 502 every logs/exec proxy
+        until corrected). Re-registers if the Node vanished. Shared by the
+        per-kubelet loop and the kubemark driver pool."""
+        try:
+            node = self.client.nodes().get(self.node_name)
+            st = node.setdefault("status", {})
+            conds = [c for c in st.get("conditions") or []
+                     if c.get("type") != "Ready"]
+            st["conditions"] = conds + [self._ready_condition()]
+            if self.server is not None:
+                st["addresses"] = [{"type": "InternalIP",
+                                    "address": "127.0.0.1"}]
+                st["daemonEndpoints"] = {
+                    "kubeletEndpoint": {"Port": self.server.port}}
+            self.client.nodes().update_status(node)
+        except ApiError:
+            # node vanished (or update raced a delete): re-create it —
+            # even register_node=False kubelets (fleet-registered, e.g.
+            # kubemark) heal their own Node here, as the old per-fleet
+            # heartbeat did
+            try:
+                self._register()
+            except ApiError:
+                pass
+
     def _heartbeat_loop(self):
         while not self._stop.wait(self.heartbeat_period):
-            try:
-                node = self.client.nodes().get(self.node_name)
-                conds = [c for c in (node.get("status") or {}).get("conditions") or []
-                         if c.get("type") != "Ready"]
-                node.setdefault("status", {})["conditions"] = \
-                    conds + [self._ready_condition()]
-                self.client.nodes().update_status(node)
-            except ApiError:
-                if self.register_node:
-                    try:
-                        self._register()
-                    except ApiError:
-                        pass
+            self.heartbeat_once()
 
     # ---- syncLoop --------------------------------------------------------
 
-    def start(self, wait_sync: float = 10.0):
+    def start(self, wait_sync: float = 10.0, serve: bool = True):
+        if serve:
+            from kubernetes_tpu.kubelet.server import KubeletServer
+            self.server = KubeletServer(self.runtime, self._uid_of,
+                                        self.node_name)
         if self.register_node:
             self._register()
         # managers first: informer handlers fire during cache sync and
@@ -157,6 +196,8 @@ class Kubelet:
 
     def stop(self):
         self._stop.set()
+        if self.server is not None:
+            self.server.stop()
         self.pleg.stop()
         self.workers.stop()
         self.prober.stop()

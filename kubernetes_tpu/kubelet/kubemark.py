@@ -17,7 +17,6 @@ from __future__ import annotations
 import threading
 import time
 
-from kubernetes_tpu.client.clientset import ApiError
 from kubernetes_tpu.client.informer import SharedInformer
 from kubernetes_tpu.kubelet.kubelet import HollowNode
 from kubernetes_tpu.utils.events import NullRecorder
@@ -29,10 +28,8 @@ class HollowCluster:
                  allocatable: dict | None = None,
                  exit_after: float | None = None):
         self.client = client
-        # identify this component's flows to APF (classify matches the agent
-        # for unauthenticated traffic)
-        if getattr(client, "user_agent", None) == "":
-            client.user_agent = "kubelet/hollow"
+        if hasattr(client, "default_user_agent"):
+            client.default_user_agent("kubelet/hollow")
         self.heartbeat_period = heartbeat_period
         self.drivers = max(1, drivers)
         self.nodes: list[HollowNode] = []
@@ -97,20 +94,6 @@ class HollowCluster:
 
     # ---- driver pool: heartbeats without a thread per node ---------------
 
-    def _heartbeat_once(self, kubelet) -> None:
-        try:
-            node = self.client.nodes().get(kubelet.node_name)
-            conds = [c for c in (node.get("status") or {})
-                     .get("conditions") or [] if c.get("type") != "Ready"]
-            node.setdefault("status", {})["conditions"] = \
-                conds + [kubelet._ready_condition()]
-            self.client.nodes().update_status(node)
-        except ApiError:
-            try:
-                kubelet._register()
-            except ApiError:
-                pass
-
     def _driver_loop(self, shard):
         # spread the shard's heartbeats across the period so the apiserver
         # sees a steady trickle, not a thundering herd every period
@@ -119,7 +102,7 @@ class HollowCluster:
             for kubelet in shard:
                 if self._stop.is_set():
                     return
-                self._heartbeat_once(kubelet.kubelet)
+                kubelet.kubelet.heartbeat_once()
                 budget = self.heartbeat_period / max(1, len(shard))
                 self._stop.wait(max(0.0, budget - 0.001))
             leftover = self.heartbeat_period - (time.time() - t0)

@@ -92,6 +92,7 @@ class FakeRuntime(ContainerRuntime):
         self._ip_alloc = ip_alloc or (lambda: "10.88.0.1")
         self._lock = threading.Lock()
         self._sandboxes: dict[str, PodSandboxStatus] = {}
+        self._logs: dict[tuple, list[str]] = {}
 
     def run_pod_sandbox(self, pod_uid, name, namespace):
         if self.start_latency:
@@ -103,6 +104,8 @@ class FakeRuntime(ContainerRuntime):
                 self._sandboxes[pod_uid] = sb
             return sb
 
+    MAX_LOG_LINES = 200  # per container; restart loops must not grow RAM
+
     def stop_pod_sandbox(self, pod_uid):
         with self._lock:
             sb = self._sandboxes.pop(pod_uid, None)
@@ -112,6 +115,11 @@ class FakeRuntime(ContainerRuntime):
                         c.state = EXITED
                         c.exit_code = 137  # SIGKILL
                         c.finished_at = time.time()
+                # the sandbox is gone: its log files go with it (a hollow
+                # fleet under pod churn would otherwise leak every uid ever
+                # run)
+                for k in [k for k in self._logs if k[0] == pod_uid]:
+                    del self._logs[k]
 
     def create_container(self, pod_uid, name, image):
         if self.start_latency:
@@ -127,6 +135,44 @@ class FakeRuntime(ContainerRuntime):
             c = self._sandboxes[pod_uid].containers[name]
             c.state = RUNNING
             c.started_at = time.time()
+            lines = self._logs.setdefault((pod_uid, name), [])
+            lines.append(
+                f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} "
+                f"container {name} started (restart {c.restart_count})")
+            del lines[:-self.MAX_LOG_LINES]
+
+    # ---- kubelet API surface (logs / exec) -------------------------------
+
+    def logs(self, pod_uid, name) -> list[str]:
+        """Container log lines (CRI ReopenContainerLog/log-file analog:
+        the hollow runtime records lifecycle lines; tests and ktpu logs
+        read them through the kubelet server)."""
+        with self._lock:
+            return list(self._logs.get((pod_uid, name), []))
+
+    def append_log(self, pod_uid, name, line: str) -> None:
+        with self._lock:
+            lines = self._logs.setdefault((pod_uid, name), [])
+            lines.append(line)
+            del lines[:-self.MAX_LOG_LINES]
+
+    def exec(self, pod_uid, name, command: list[str]) -> tuple[int, str]:
+        """Synchronous exec (CRI ExecSync analog): the hollow container
+        answers a tiny shell — enough for kubectl-exec-shaped round trips."""
+        with self._lock:
+            sb = self._sandboxes.get(pod_uid)
+            c = sb.containers.get(name) if sb else None
+            if c is None or c.state != RUNNING:
+                return 1, "container not running"
+        if not command:
+            return 1, "no command"
+        if command[0] == "echo":
+            return 0, " ".join(command[1:]) + "\n"
+        if command[0] == "hostname":
+            return 0, f"{pod_uid[:8]}\n"
+        if command[0] == "true":
+            return 0, ""
+        return 127, f"{command[0]}: command not found\n"
 
     def stop_container(self, pod_uid, name, exit_code: int = 137):
         with self._lock:

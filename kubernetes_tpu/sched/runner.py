@@ -37,10 +37,8 @@ class SchedulerRunner:
     def __init__(self, client, cfg: Optional[SchedulerConfiguration] = None,
                  identity: str = "kubernetes-tpu-scheduler", registry=None):
         self.client = client
-        # identify the component's flows to APF (classify matches on the
-        # agent for unauthenticated traffic)
-        if getattr(client, "user_agent", None) == "":
-            client.user_agent = "kube-scheduler"
+        if hasattr(client, "default_user_agent"):
+            client.default_user_agent("kube-scheduler")
 
         self.cfg = cfg or SchedulerConfiguration()
         self.cache = SchedulerCache(assume_ttl=self.cfg.assume_ttl_s)

@@ -639,6 +639,21 @@ class APIServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                if path == "/debug/traces":
+                    # OTLP/JSON export of the process tracer's spans
+                    from kubernetes_tpu.utils.tracing import (TRACER,
+                                                              export_otlp_json)
+                    return self._send_json(200, export_otlp_json(TRACER))
+                if path == "/debug/stacks":
+                    # /debug/pprof goroutine-dump analog
+                    from kubernetes_tpu.utils.tracing import dump_stacks
+                    body = dump_stacks().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if path == "/metrics":
                     body = REGISTRY.expose_text().encode()
                     self.send_response(200)
@@ -650,8 +665,16 @@ class APIServer:
                 r = self._route()
                 if r is None:
                     return self._error(404, f"unknown path {path}")
-                plural, kind, ns, name, _ = r
+                plural, kind, ns, name, sub = r
                 qs = parse_qs(urlparse(self.path).query)
+                if sub == "log" and kind == "Pod" and name:
+                    # kubectl logs: proxy to the pod's kubelet
+                    # (kubelet server /containerLogs, reached via
+                    # node.status.daemonEndpoints — upstream's pod log
+                    # subresource does exactly this hop)
+                    return self._proxy_kubelet_get(
+                        ns or "default", name,
+                        qs.get("container", [""])[0])
                 if name:
                     try:
                         obj = server.store.get(kind, ns or "", name)
@@ -667,6 +690,105 @@ class APIServer:
                 return self._send_json(200, {
                     "kind": f"{kind}List", "apiVersion": "v1",
                     "metadata": {"resourceVersion": str(rv)}, "items": items})
+
+            def _proxy_portforward(self, ns: str, pod_name: str):
+                """Upgrade the client connection and splice it through to
+                the pod's kubelet /portForward stream — the apiserver leg of
+                kubectl port-forward (upstream: SPDY through the same two
+                hops)."""
+                ep = self._kubelet_endpoint(ns, pod_name)
+                if ep is None:
+                    return None
+                base, _pod = ep
+                import socket as _socket
+                from urllib.parse import urlsplit
+                parts = urlsplit(base)
+                try:
+                    upstream = _socket.create_connection(
+                        (parts.hostname, parts.port), timeout=5.0)
+                    req_text = (f"POST /portForward/{ns}/{pod_name} "
+                                "HTTP/1.1\r\n"
+                                f"Host: {parts.hostname}\r\n"
+                                "Upgrade: tcp\r\nConnection: Upgrade\r\n"
+                                "Content-Length: 0\r\n\r\n")
+                    upstream.sendall(req_text.encode())
+                    # consume the kubelet's 101 header block
+                    buf = b""
+                    while b"\r\n\r\n" not in buf:
+                        chunk = upstream.recv(1024)
+                        if not chunk:
+                            raise OSError("kubelet closed during upgrade")
+                        buf += chunk
+                    if b" 101 " not in buf.split(b"\r\n", 1)[0]:
+                        raise OSError("kubelet refused upgrade")
+                except OSError as e:
+                    return self._error(502, f"kubelet proxy: {e}",
+                                       "BadGateway")
+                self.send_response(101)
+                self.send_header("Upgrade", "tcp")
+                self.send_header("Connection", "Upgrade")
+                self.end_headers()
+                self.wfile.flush()
+                from kubernetes_tpu.kubelet.server import _splice_sockets
+                leftover = buf.split(b"\r\n\r\n", 1)[1]
+                if leftover:
+                    self.connection.sendall(leftover)
+                _splice_sockets(self.connection, upstream)
+                self.close_connection = True
+                return None
+
+            def _kubelet_endpoint(self, ns: str, pod_name: str):
+                """-> (base_url, pod) or an error response already sent."""
+                try:
+                    pod = server.store.get("Pod", ns, pod_name)
+                except NotFound as e:
+                    self._error(404, str(e), "NotFound")
+                    return None
+                node_name = (pod.get("spec") or {}).get("nodeName", "")
+                if not node_name:
+                    self._error(400, "pod is not scheduled", "BadRequest")
+                    return None
+                try:
+                    node = server.store.get("Node", "", node_name)
+                except NotFound:
+                    self._error(502, f"node {node_name!r} not found",
+                                "BadGateway")
+                    return None
+                st = node.get("status") or {}
+                ep = ((st.get("daemonEndpoints") or {})
+                      .get("kubeletEndpoint") or {})
+                port = ep.get("Port")
+                addr = next((a.get("address") for a in
+                             st.get("addresses") or []
+                             if a.get("type") == "InternalIP"), "127.0.0.1")
+                if not port:
+                    self._error(502, "kubelet endpoint not registered",
+                                "BadGateway")
+                    return None
+                return f"http://{addr}:{port}", pod
+
+            def _proxy_kubelet_get(self, ns, pod_name, container):
+                ep = self._kubelet_endpoint(ns, pod_name)
+                if ep is None:
+                    return None
+                base, pod = ep
+                if not container:
+                    ctrs = (pod.get("spec") or {}).get("containers") or []
+                    container = (ctrs[0].get("name", "") if ctrs else "")
+                import urllib.request as _rq
+                try:
+                    with _rq.urlopen(
+                            f"{base}/containerLogs/{ns}/{pod_name}/"
+                            f"{container}", timeout=10.0) as resp:
+                        body = resp.read()
+                except Exception as e:
+                    return self._error(502, f"kubelet proxy: {e}",
+                                       "BadGateway")
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def _watch(self, kind: str, ns, qs):
                 # Namespace filtering happens here (matching DirectClient's
@@ -769,6 +891,37 @@ class APIServer:
                     return self._error(400, str(e), "BadRequest")
                 if sub is None:
                     body = self._conv_in(kind, body)
+                if sub == "exec" and kind == "Pod" and name:
+                    ep = self._kubelet_endpoint(ns or "default", name)
+                    if ep is None:
+                        return None
+                    base, _pod = ep
+                    qs2 = parse_qs(urlparse(self.path).query)
+                    container = qs2.get("container", [""])[0]
+                    if not container:
+                        ctrs = (_pod.get("spec") or {}).get("containers") or []
+                        container = (ctrs[0].get("name", "") if ctrs else "")
+                    import urllib.request as _rq
+                    try:
+                        req2 = _rq.Request(
+                            f"{base}/exec/{ns or 'default'}/{name}/"
+                            f"{container}",
+                            data=json.dumps(body).encode(),
+                            headers={"Content-Type": "application/json"},
+                            method="POST")
+                        with _rq.urlopen(req2, timeout=15.0) as resp:
+                            out_body = resp.read()
+                    except Exception as e:
+                        return self._error(502, f"kubelet proxy: {e}",
+                                           "BadGateway")
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(out_body)))
+                    self.end_headers()
+                    self.wfile.write(out_body)
+                    return None
+                if sub == "portforward" and kind == "Pod" and name:
+                    return self._proxy_portforward(ns or "default", name)
                 if sub == "binding" and kind == "Pod" and name == "-":
                     # Bulk binding: one POST applies many bindings in a single
                     # store lock pass (the scheduler's gang step binds a whole

@@ -77,3 +77,62 @@ class Tracer:
 
 # process-global default tracer (TracerProvider analog)
 TRACER = Tracer()
+
+
+def export_otlp_json(tracer: "Tracer", service_name: str = "kubernetes-tpu"
+                     ) -> dict:
+    """Finished spans in the OTLP/JSON resourceSpans wire shape
+    (opentelemetry-proto trace/v1, JSON mapping) — what an OTLP/HTTP
+    collector ingests at /v1/traces. component-base/tracing emits the same
+    protocol; exporting on demand (vs a background OTLP pusher) fits the
+    bench-and-test deployment here."""
+    import hashlib
+
+    def _id(name: str, n: int) -> str:
+        return hashlib.sha256(name.encode()).hexdigest()[:n]
+
+    trace_id = _id("kubernetes-tpu-export", 32)
+    spans = []
+    last_id_by_name: dict[str, str] = {}
+    for i, sp in enumerate(tracer.spans()):
+        span_id = _id(f"{sp.name}-{i}", 16)
+        # parent linkage: the tracer records the parent's NAME; the most
+        # recently exported span of that name is the enclosing one (spans
+        # finish child-before-parent within a thread, and the exporter
+        # preserves completion order)
+        parent_id = last_id_by_name.get(sp.parent, "") if sp.parent else ""
+        last_id_by_name[sp.name] = span_id
+        spans.append({
+            "traceId": trace_id,
+            "spanId": span_id,
+            "parentSpanId": parent_id,
+            "name": sp.name,
+            "kind": "SPAN_KIND_INTERNAL",
+            "startTimeUnixNano": str(int(sp.start * 1e9)),
+            "endTimeUnixNano": str(int(sp.end * 1e9)),
+            "attributes": [
+                {"key": k, "value": {"stringValue": str(v)}}
+                for k, v in sp.attributes.items()],
+        })
+    return {"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name",
+             "value": {"stringValue": service_name}}]},
+        "scopeSpans": [{
+            "scope": {"name": "kubernetes_tpu.utils.tracing"},
+            "spans": spans}],
+    }]}
+
+
+def dump_stacks() -> str:
+    """Every live thread's stack — the /debug/pprof goroutine-dump analog
+    (component-base healthz mux exposes the Go equivalent on every
+    binary)."""
+    import sys
+    import traceback
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"thread {tid}:")
+        out.extend("  " + ln.rstrip()
+                   for ln in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"

@@ -1,0 +1,448 @@
+/* Native (C) client for the ktpu scheduling sidecar.
+ *
+ * Proves the process boundary SURVEY §7 phase 7 requires: a NON-Python
+ * consumer speaking the sidecar's wire protocol — gRPC (HTTP/2, 5-byte
+ * length-prefixed frames) carrying msgpack maps — the shape of the Go
+ * scheduler shim that replaces pkg/scheduler/extender.go's HTTPExtender.
+ *
+ * No generated code and no grpc library: a ~100-line msgpack codec plus
+ * libcurl's HTTP/2 support (dlopen'd — the image ships the shared object
+ * without dev headers) is the whole client, exactly the "three-line codec"
+ * promise the protocol makes (sidecar/proto.py).
+ *
+ * Exercises, against a live sidecar/server.py:
+ *   1. PushSnapshot   N nodes, generation 1
+ *   2. Schedule       P pods -> every pod placed on a real node
+ *   3. PushDelta      bind the placements (ordered upsert ops) -> gen 2
+ *   4. Schedule       STALE generation -> {stale: true, server_generation}
+ *   5. Schedule       wave 2 at gen 2 -> placements reflect wave 1's usage
+ *   6. PushDelta      node_delete + delete ops replay in ORDER -> gen 3
+ *
+ * Usage: sidecar_client <host:port> [nodes] [pods]
+ * Exit 0 = every check passed.
+ */
+
+#include <dlfcn.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* ------------------------------------------------------------ msgpack out */
+
+typedef struct {
+    uint8_t *buf;
+    size_t len, cap;
+} mp_out;
+
+static void mp_reserve(mp_out *o, size_t extra) {
+    if (o->len + extra <= o->cap) return;
+    o->cap = (o->cap ? o->cap * 2 : 4096);
+    while (o->cap < o->len + extra) o->cap *= 2;
+    o->buf = realloc(o->buf, o->cap);
+}
+
+static void mp_byte(mp_out *o, uint8_t b) { mp_reserve(o, 1); o->buf[o->len++] = b; }
+static void mp_raw(mp_out *o, const void *p, size_t n) {
+    mp_reserve(o, n); memcpy(o->buf + o->len, p, n); o->len += n;
+}
+
+static void mp_uint(mp_out *o, uint64_t v) {
+    if (v < 0x80) { mp_byte(o, (uint8_t)v); }
+    else if (v <= 0xff) { mp_byte(o, 0xcc); mp_byte(o, (uint8_t)v); }
+    else if (v <= 0xffff) { mp_byte(o, 0xcd); mp_byte(o, v >> 8); mp_byte(o, v); }
+    else if (v <= 0xffffffffu) {
+        mp_byte(o, 0xce);
+        for (int i = 3; i >= 0; i--) mp_byte(o, (uint8_t)(v >> (8 * i)));
+    } else {
+        mp_byte(o, 0xcf);
+        for (int i = 7; i >= 0; i--) mp_byte(o, (uint8_t)(v >> (8 * i)));
+    }
+}
+
+static void mp_str(mp_out *o, const char *s) {
+    size_t n = strlen(s);
+    if (n < 32) mp_byte(o, 0xa0 | (uint8_t)n);
+    else if (n <= 0xff) { mp_byte(o, 0xd9); mp_byte(o, (uint8_t)n); }
+    else { mp_byte(o, 0xda); mp_byte(o, n >> 8); mp_byte(o, n); }
+    mp_raw(o, s, n);
+}
+
+static void mp_map(mp_out *o, uint32_t n) {
+    if (n < 16) mp_byte(o, 0x80 | (uint8_t)n);
+    else { mp_byte(o, 0xde); mp_byte(o, n >> 8); mp_byte(o, n); }
+}
+
+static void mp_arr(mp_out *o, uint32_t n) {
+    if (n < 16) mp_byte(o, 0x90 | (uint8_t)n);
+    else { mp_byte(o, 0xdc); mp_byte(o, n >> 8); mp_byte(o, n); }
+}
+
+/* ------------------------------------------------------------- msgpack in */
+
+typedef struct {
+    const uint8_t *p, *end;
+    int err;
+} mp_in;
+
+static uint64_t mp_be(mp_in *in, int n) {
+    uint64_t v = 0;
+    if (in->end - in->p < n) { in->err = 1; return 0; }
+    for (int i = 0; i < n; i++) v = (v << 8) | *in->p++;
+    return v;
+}
+
+/* skip one value of any type */
+static void mp_skip(mp_in *in);
+
+/* returns type tag class: 'i' int, 's' str (fills sp/sn), 'a' array (*n),
+ * 'm' map (*n), 'b' bool (*n = 0/1), 'n' nil, '?' other (skipped) */
+static char mp_next(mp_in *in, const char **sp, uint32_t *n) {
+    if (in->p >= in->end) { in->err = 1; return '?'; }
+    uint8_t t = *in->p++;
+    if (t < 0x80 || t >= 0xe0) { if (n) *n = (uint32_t)(int8_t)t; return 'i'; }
+    if ((t & 0xf0) == 0x80) { if (n) *n = t & 0x0f; return 'm'; }
+    if ((t & 0xf0) == 0x90) { if (n) *n = t & 0x0f; return 'a'; }
+    if ((t & 0xe0) == 0xa0) {
+        uint32_t ln = t & 0x1f;
+        if (in->end - in->p < ln) { in->err = 1; return '?'; }
+        if (sp) *sp = (const char *)in->p;
+        if (n) *n = ln;
+        in->p += ln;
+        return 's';
+    }
+    switch (t) {
+    case 0xc0: return 'n';
+    case 0xc2: if (n) *n = 0; return 'b';
+    case 0xc3: if (n) *n = 1; return 'b';
+    case 0xcc: if (n) *n = (uint32_t)mp_be(in, 1); return 'i';
+    case 0xcd: if (n) *n = (uint32_t)mp_be(in, 2); return 'i';
+    case 0xce: if (n) *n = (uint32_t)mp_be(in, 4); return 'i';
+    case 0xcf: if (n) *n = (uint32_t)mp_be(in, 8); return 'i';
+    case 0xd0: if (n) *n = (uint32_t)(int8_t)mp_be(in, 1); return 'i';
+    case 0xd1: if (n) *n = (uint32_t)(int16_t)mp_be(in, 2); return 'i';
+    case 0xd2: if (n) *n = (uint32_t)(int32_t)mp_be(in, 4); return 'i';
+    case 0xd3: if (n) *n = (uint32_t)mp_be(in, 8); return 'i';
+    case 0xd9: case 0xda: case 0xdb: {
+        uint32_t ln = (uint32_t)mp_be(in, t == 0xd9 ? 1 : t == 0xda ? 2 : 4);
+        if (in->end - in->p < ln) { in->err = 1; return '?'; }
+        if (sp) *sp = (const char *)in->p;
+        if (n) *n = ln;
+        in->p += ln;
+        return 's';
+    }
+    case 0xc4: case 0xc5: case 0xc6: {  /* bin: treat as str */
+        uint32_t ln = (uint32_t)mp_be(in, t == 0xc4 ? 1 : t == 0xc5 ? 2 : 4);
+        if (in->end - in->p < ln) { in->err = 1; return '?'; }
+        if (sp) *sp = (const char *)in->p;
+        if (n) *n = ln;
+        in->p += ln;
+        return 's';
+    }
+    case 0xca: mp_be(in, 4); if (n) *n = 0; return 'i';  /* f32: not needed */
+    case 0xcb: mp_be(in, 8); if (n) *n = 0; return 'i';  /* f64 */
+    case 0xdc: if (n) *n = (uint32_t)mp_be(in, 2); return 'a';
+    case 0xdd: if (n) *n = (uint32_t)mp_be(in, 4); return 'a';
+    case 0xde: if (n) *n = (uint32_t)mp_be(in, 2); return 'm';
+    case 0xdf: if (n) *n = (uint32_t)mp_be(in, 4); return 'm';
+    default: in->err = 1; return '?';
+    }
+}
+
+static void mp_skip(mp_in *in) {
+    uint32_t n = 0;
+    switch (mp_next(in, NULL, &n)) {
+    case 'm': for (uint32_t i = 0; i < 2 * n && !in->err; i++) mp_skip(in); break;
+    case 'a': for (uint32_t i = 0; i < n && !in->err; i++) mp_skip(in); break;
+    default: break;
+    }
+}
+
+/* --------------------------------------------------------- libcurl dlopen */
+
+typedef void CURL;
+struct curl_slist;
+
+static struct {
+    CURL *(*easy_init)(void);
+    int (*easy_setopt)(CURL *, int, ...);
+    int (*easy_perform)(CURL *);
+    void (*easy_cleanup)(CURL *);
+    long (*easy_getinfo)(CURL *, int, ...);
+    struct curl_slist *(*slist_append)(struct curl_slist *, const char *);
+    void (*slist_free_all)(struct curl_slist *);
+} cu;
+
+/* option codes from curl.h (stable ABI) */
+#define CURLOPT_URL 10002
+#define CURLOPT_POSTFIELDS 10015
+#define CURLOPT_POSTFIELDSIZE 60
+#define CURLOPT_HTTPHEADER 10023
+#define CURLOPT_WRITEFUNCTION 20011
+#define CURLOPT_WRITEDATA 10001
+#define CURLOPT_POST 47
+#define CURLOPT_HTTP_VERSION 84
+#define CURL_HTTP_VERSION_2_PRIOR_KNOWLEDGE 5
+#define CURLINFO_RESPONSE_CODE 0x200002
+
+static int cu_load(void) {
+    void *h = dlopen("libcurl.so.4", RTLD_NOW);
+    if (!h) h = dlopen("libcurl-gnutls.so.4", RTLD_NOW);
+    if (!h) { fprintf(stderr, "FAIL: no libcurl\n"); return -1; }
+    cu.easy_init = dlsym(h, "curl_easy_init");
+    cu.easy_setopt = dlsym(h, "curl_easy_setopt");
+    cu.easy_perform = dlsym(h, "curl_easy_perform");
+    cu.easy_cleanup = dlsym(h, "curl_easy_cleanup");
+    cu.easy_getinfo = dlsym(h, "curl_easy_getinfo");
+    cu.slist_append = dlsym(h, "curl_slist_append");
+    cu.slist_free_all = dlsym(h, "curl_slist_free_all");
+    return (cu.easy_init && cu.easy_setopt && cu.easy_perform &&
+            cu.slist_append) ? 0 : -1;
+}
+
+typedef struct { uint8_t *buf; size_t len, cap; } blob;
+
+static size_t on_body(char *ptr, size_t sz, size_t nm, void *ud) {
+    blob *b = ud;
+    size_t n = sz * nm;
+    if (b->len + n > b->cap) {
+        b->cap = (b->cap ? b->cap * 2 : 8192);
+        while (b->cap < b->len + n) b->cap *= 2;
+        b->buf = realloc(b->buf, b->cap);
+    }
+    memcpy(b->buf + b->len, ptr, n);
+    b->len += n;
+    return n;
+}
+
+/* one gRPC unary call: msgpack payload in, msgpack payload out */
+static int grpc_call(const char *base, const char *method,
+                     const mp_out *req, blob *resp) {
+    char url[512];
+    snprintf(url, sizeof url, "http://%s/ktpu.SchedSidecar/%s", base, method);
+    /* 5-byte gRPC frame: flags=0 + big-endian length */
+    size_t flen = 5 + req->len;
+    uint8_t *frame = malloc(flen);
+    frame[0] = 0;
+    for (int i = 0; i < 4; i++)
+        frame[1 + i] = (uint8_t)(req->len >> (8 * (3 - i)));
+    memcpy(frame + 5, req->buf, req->len);
+
+    CURL *h = cu.easy_init();
+    struct curl_slist *hdr = NULL;
+    hdr = cu.slist_append(hdr, "Content-Type: application/grpc");
+    hdr = cu.slist_append(hdr, "TE: trailers");
+    hdr = cu.slist_append(hdr, "Expect:");
+    cu.easy_setopt(h, CURLOPT_URL, url);
+    cu.easy_setopt(h, CURLOPT_HTTP_VERSION,
+                   (long)CURL_HTTP_VERSION_2_PRIOR_KNOWLEDGE);
+    cu.easy_setopt(h, CURLOPT_POST, 1L);
+    cu.easy_setopt(h, CURLOPT_POSTFIELDS, frame);
+    cu.easy_setopt(h, CURLOPT_POSTFIELDSIZE, (long)flen);
+    cu.easy_setopt(h, CURLOPT_HTTPHEADER, hdr);
+    cu.easy_setopt(h, CURLOPT_WRITEFUNCTION, on_body);
+    cu.easy_setopt(h, CURLOPT_WRITEDATA, resp);
+    int rc = cu.easy_perform(h);
+    long code = 0;
+    if (cu.easy_getinfo) cu.easy_getinfo(h, CURLINFO_RESPONSE_CODE, &code);
+    cu.slist_free_all(hdr);
+    cu.easy_cleanup(h);
+    free(frame);
+    if (rc != 0 || code != 200) {
+        fprintf(stderr, "FAIL: %s transport rc=%d http=%ld\n", method, rc, code);
+        return -1;
+    }
+    if (resp->len < 5) {
+        fprintf(stderr, "FAIL: %s short gRPC frame (%zu)\n", method, resp->len);
+        return -1;
+    }
+    /* strip the response's 5-byte frame header in place */
+    memmove(resp->buf, resp->buf + 5, resp->len - 5);
+    resp->len -= 5;
+    return 0;
+}
+
+/* ----------------------------------------------------------- domain logic */
+
+static void enc_node(mp_out *o, int i) {
+    char name[32], cpu[16];
+    snprintf(name, sizeof name, "cn-%d", i);
+    snprintf(cpu, sizeof cpu, "%d", 4);
+    mp_map(o, 3);
+    mp_str(o, "kind"); mp_str(o, "Node");
+    mp_str(o, "metadata"); mp_map(o, 1); mp_str(o, "name"); mp_str(o, name);
+    mp_str(o, "status"); mp_map(o, 1);
+    mp_str(o, "allocatable"); mp_map(o, 3);
+    mp_str(o, "cpu"); mp_str(o, cpu);
+    mp_str(o, "memory"); mp_str(o, "8Gi");
+    mp_str(o, "pods"); mp_str(o, "16");
+}
+
+static void enc_pod(mp_out *o, const char *name, const char *node) {
+    mp_map(o, 3);
+    mp_str(o, "kind"); mp_str(o, "Pod");
+    mp_str(o, "metadata"); mp_map(o, 2);
+    mp_str(o, "name"); mp_str(o, name);
+    mp_str(o, "namespace"); mp_str(o, "default");
+    mp_str(o, "spec");
+    mp_map(o, node ? 2 : 1);
+    mp_str(o, "containers"); mp_arr(o, 1);
+    mp_map(o, 2);
+    mp_str(o, "name"); mp_str(o, "c");
+    mp_str(o, "resources"); mp_map(o, 1);
+    mp_str(o, "requests"); mp_map(o, 2);
+    mp_str(o, "cpu"); mp_str(o, "500m");
+    mp_str(o, "memory"); mp_str(o, "256Mi");
+    if (node) { mp_str(o, "nodeName"); mp_str(o, node); }
+}
+
+/* find a top-level key in a response map; returns type via mp_next contract */
+static char find_key(blob *resp, const char *key, const char **sp,
+                     uint32_t *n, mp_in *save) {
+    mp_in in = { resp->buf, resp->buf + resp->len, 0 };
+    uint32_t pairs = 0;
+    if (mp_next(&in, NULL, &pairs) != 'm') return '?';
+    for (uint32_t i = 0; i < pairs && !in.err; i++) {
+        const char *kp; uint32_t kn = 0;
+        if (mp_next(&in, &kp, &kn) != 's') return '?';
+        if (kn == strlen(key) && !memcmp(kp, key, kn)) {
+            char t = mp_next(&in, sp, n);
+            if (save) *save = in;
+            return t;
+        }
+        mp_skip(&in);
+    }
+    return 0;
+}
+
+static int expect_gen(blob *resp, const char *what, long want) {
+    uint32_t v = 0;
+    if (find_key(resp, "generation", NULL, &v, NULL) != 'i' ||
+        (long)v != want) {
+        fprintf(stderr, "FAIL: %s generation != %ld\n", what, want);
+        return -1;
+    }
+    printf("OK %s -> generation %ld\n", what, want);
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 2) { fprintf(stderr, "usage: %s host:port [N] [P]\n", argv[0]); return 2; }
+    const char *base = argv[1];
+    int N = argc > 2 ? atoi(argv[2]) : 100;
+    int P = argc > 3 ? atoi(argv[3]) : 100;
+    if (cu_load()) return 1;
+
+    /* 1. PushSnapshot: N nodes, generation 1 */
+    mp_out req = {0};
+    mp_map(&req, 4);
+    mp_str(&req, "nodes"); mp_arr(&req, (uint32_t)N);
+    for (int i = 0; i < N; i++) enc_node(&req, i);
+    mp_str(&req, "pods"); mp_arr(&req, 0);
+    mp_str(&req, "generation"); mp_uint(&req, 1);
+    mp_str(&req, "profile"); mp_map(&req, 1);
+    mp_str(&req, "fit_strategy"); mp_str(&req, "LeastAllocated");
+    blob resp = {0};
+    if (grpc_call(base, "PushSnapshot", &req, &resp)) return 1;
+    if (expect_gen(&resp, "PushSnapshot", 1)) return 1;
+
+    /* 2. Schedule wave 1 */
+    char (*placed)[64] = calloc((size_t)P, 64);
+    req.len = 0; resp.len = 0;
+    mp_map(&req, 2);
+    mp_str(&req, "pods"); mp_arr(&req, (uint32_t)P);
+    for (int i = 0; i < P; i++) {
+        char name[32]; snprintf(name, sizeof name, "w1-%d", i);
+        enc_pod(&req, name, NULL);
+    }
+    mp_str(&req, "generation"); mp_uint(&req, 1);
+    if (grpc_call(base, "Schedule", &req, &resp)) return 1;
+    {
+        const char *sp; uint32_t n = 0; mp_in in;
+        if (find_key(&resp, "assignments", &sp, &n, &in) != 'a' || n != (uint32_t)P) {
+            fprintf(stderr, "FAIL: Schedule wave1 assignments\n"); return 1;
+        }
+        for (uint32_t i = 0; i < n; i++) {
+            uint32_t sn = 0;
+            if (mp_next(&in, &sp, &sn) != 's' || sn == 0 || sn >= 64) {
+                fprintf(stderr, "FAIL: pod %u unplaced\n", i); return 1;
+            }
+            memcpy(placed[i], sp, sn);
+        }
+        printf("OK Schedule wave1 -> %d/%d pods placed\n", P, P);
+    }
+
+    /* 3. PushDelta: bind wave 1 (ordered upserts), generation 2 */
+    req.len = 0; resp.len = 0;
+    mp_map(&req, 3);
+    mp_str(&req, "base_generation"); mp_uint(&req, 1);
+    mp_str(&req, "generation"); mp_uint(&req, 2);
+    mp_str(&req, "ops"); mp_arr(&req, (uint32_t)P);
+    for (int i = 0; i < P; i++) {
+        char name[32]; snprintf(name, sizeof name, "w1-%d", i);
+        mp_map(&req, 2);
+        mp_str(&req, "op"); mp_str(&req, "upsert");
+        mp_str(&req, "pod"); enc_pod(&req, name, placed[i]);
+    }
+    if (grpc_call(base, "PushDelta", &req, &resp)) return 1;
+    if (expect_gen(&resp, "PushDelta(bind wave1)", 2)) return 1;
+
+    /* 4. STALE: schedule against the superseded generation */
+    req.len = 0; resp.len = 0;
+    mp_map(&req, 2);
+    mp_str(&req, "pods"); mp_arr(&req, 1); enc_pod(&req, "stale-probe", NULL);
+    mp_str(&req, "generation"); mp_uint(&req, 1);
+    if (grpc_call(base, "Schedule", &req, &resp)) return 1;
+    {
+        uint32_t b = 0, sg = 0;
+        if (find_key(&resp, "stale", NULL, &b, NULL) != 'b' || !b) {
+            fprintf(stderr, "FAIL: stale generation not rejected\n"); return 1;
+        }
+        find_key(&resp, "server_generation", NULL, &sg, NULL);
+        printf("OK Schedule(gen=1) -> STALE (server at %u)\n", sg);
+    }
+
+    /* 5. wave 2 at the current generation sees wave 1's usage */
+    req.len = 0; resp.len = 0;
+    mp_map(&req, 2);
+    mp_str(&req, "pods"); mp_arr(&req, (uint32_t)P);
+    for (int i = 0; i < P; i++) {
+        char name[32]; snprintf(name, sizeof name, "w2-%d", i);
+        enc_pod(&req, name, NULL);
+    }
+    mp_str(&req, "generation"); mp_uint(&req, 2);
+    if (grpc_call(base, "Schedule", &req, &resp)) return 1;
+    {
+        const char *sp; uint32_t n = 0; mp_in in;
+        if (find_key(&resp, "assignments", &sp, &n, &in) != 'a' || n != (uint32_t)P) {
+            fprintf(stderr, "FAIL: Schedule wave2 shape\n"); return 1;
+        }
+        int placed2 = 0;
+        for (uint32_t i = 0; i < n; i++) {
+            uint32_t sn = 0;
+            if (mp_next(&in, &sp, &sn) != 's') { fprintf(stderr, "FAIL w2\n"); return 1; }
+            if (sn) placed2++;
+        }
+        printf("OK Schedule wave2 -> %d/%d placed at gen 2\n", placed2, P);
+        if (placed2 == 0) { fprintf(stderr, "FAIL: wave2 empty\n"); return 1; }
+    }
+
+    /* 6. ordered ops: delete a node + delete a pod, generation 3 */
+    req.len = 0; resp.len = 0;
+    mp_map(&req, 3);
+    mp_str(&req, "base_generation"); mp_uint(&req, 2);
+    mp_str(&req, "generation"); mp_uint(&req, 3);
+    mp_str(&req, "ops"); mp_arr(&req, 2);
+    mp_map(&req, 2);
+    mp_str(&req, "op"); mp_str(&req, "node_delete");
+    mp_str(&req, "name"); mp_str(&req, "cn-0");
+    mp_map(&req, 2);
+    mp_str(&req, "op"); mp_str(&req, "delete");
+    mp_str(&req, "key"); mp_str(&req, "default/w1-0");
+    if (grpc_call(base, "PushDelta", &req, &resp)) return 1;
+    if (expect_gen(&resp, "PushDelta(node_delete+delete)", 3)) return 1;
+
+    printf("NATIVE SIDECAR CLIENT: ALL CHECKS PASSED\n");
+    return 0;
+}

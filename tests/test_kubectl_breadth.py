@@ -1,0 +1,169 @@
+"""kubectl breadth: logs / exec / port-forward / rollout + debug surface.
+
+Reference: ``staging/src/k8s.io/kubectl/pkg/cmd/{logs,exec,portforward,
+rollout}`` and the two-hop proxy path behind them — apiserver pod
+subresource -> node.status.daemonEndpoints -> kubelet server
+(``pkg/kubelet/server/server.go``). Port-forward is real TCP splicing end
+to end (local socket -> apiserver upgrade -> kubelet -> container app).
+"""
+
+import io
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.cli.ktpu import main
+from kubernetes_tpu.client.clientset import HTTPClient
+from kubernetes_tpu.kubelet.kubelet import HollowNode
+from kubernetes_tpu.store.apiserver import APIServer
+from kubernetes_tpu.testing.wrappers import make_pod
+
+
+@pytest.fixture()
+def cluster():
+    server = APIServer().start()
+    client = HTTPClient(server.url)
+    node = HollowNode(client, "kn-1").start()
+    pod = make_pod("app").req({"cpu": "100m"}).obj().to_dict()
+    pod["spec"]["nodeName"] = "kn-1"
+    client.pods("default").create(pod)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        phase = (client.pods("default").get("app").get("status")
+                 or {}).get("phase")
+        if phase == "Running":
+            break
+        time.sleep(0.1)
+    assert phase == "Running"
+    yield server, client
+    node.stop()
+    server.stop()
+
+
+def test_logs_via_apiserver_proxy(cluster):
+    server, client = cluster
+    text = client.pod_logs("default", "app")
+    assert "container c0 started" in text or "started" in text, text
+    out = io.StringIO()
+    rc = main(["--server", server.url, "logs", "app"], out=out)
+    assert rc == 0
+    assert "started" in out.getvalue()
+
+
+def test_exec_via_apiserver_proxy(cluster):
+    server, client = cluster
+    res = client.pod_exec("default", "app", ["echo", "hello", "world"])
+    assert res["exit_code"] == 0
+    assert res["output"] == "hello world\n"
+    out = io.StringIO()
+    rc = main(["--server", server.url, "exec", "app", "--",
+               "echo", "hi"], out=out)
+    assert rc == 0 and out.getvalue() == "hi\n"
+    # a failing command's exit code propagates, like kubectl exec
+    rc = main(["--server", server.url, "exec", "app", "--", "bogus"],
+              out=io.StringIO())
+    assert rc == 127
+
+
+def test_port_forward_end_to_end(cluster):
+    """Local socket -> CLI forwarder -> apiserver upgrade -> kubelet ->
+    container app: bytes round-trip through all four legs."""
+    server, client = cluster
+    out = io.StringIO()
+    done = threading.Event()
+
+    def forwarder():
+        main(["--server", server.url, "port-forward", "app", "0",
+              "--one-shot"], out=out)
+        done.set()
+
+    t = threading.Thread(target=forwarder, daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    port = None
+    while time.time() < deadline:
+        m = out.getvalue()
+        if "Forwarding from 127.0.0.1:" in m:
+            port = int(m.split("127.0.0.1:")[1].split(" ")[0])
+            break
+        time.sleep(0.05)
+    assert port, out.getvalue()
+    with socket.create_connection(("127.0.0.1", port), timeout=10.0) as c:
+        banner = c.recv(1024)
+        assert banner.startswith(b"pod "), banner
+        c.sendall(b"ping")
+        assert c.recv(1024) == b"echo: ping"
+    assert done.wait(10.0)
+
+
+def _mk_rs(name, dep_name, dep_uid, revision, image):
+    return {"kind": "ReplicaSet",
+            "metadata": {"name": name,
+                         "annotations": {
+                             "deployment.kubernetes.io/revision":
+                             str(revision)},
+                         "ownerReferences": [{
+                             "kind": "Deployment", "name": dep_name,
+                             "uid": dep_uid, "controller": True}]},
+            "spec": {"replicas": 1,
+                     "template": {"spec": {"containers": [
+                         {"name": "c", "image": image}]}}}}
+
+
+def test_rollout_status_history_undo(cluster):
+    server, client = cluster
+    deps = client.resource("deployments", "default")
+    dep = deps.create({
+        "kind": "Deployment", "metadata": {"name": "web"},
+        "spec": {"replicas": 2,
+                 "template": {"spec": {"containers": [
+                     {"name": "c", "image": "img:v2"}]}}},
+        "status": {"updatedReplicas": 2, "availableReplicas": 2}})
+    uid = dep["metadata"].get("uid", "")
+    rs = client.resource("replicasets", "default")
+    rs.create(_mk_rs("web-1", "web", uid, 1, "img:v1"))
+    rs.create(_mk_rs("web-2", "web", uid, 2, "img:v2"))
+
+    out = io.StringIO()
+    assert main(["--server", server.url, "rollout", "status",
+                 "deployment/web"], out=out) == 0
+    assert "successfully rolled out" in out.getvalue()
+
+    out = io.StringIO()
+    assert main(["--server", server.url, "rollout", "history",
+                 "deployment/web"], out=out) == 0
+    assert "1\n" in out.getvalue() and "2\n" in out.getvalue()
+
+    out = io.StringIO()
+    assert main(["--server", server.url, "rollout", "undo",
+                 "deployment/web"], out=out) == 0
+    got = deps.get("web")
+    assert got["spec"]["template"]["spec"]["containers"][0]["image"] \
+        == "img:v1"
+
+    out = io.StringIO()
+    assert main(["--server", server.url, "rollout", "restart",
+                 "deployment/web"], out=out) == 0
+    ann = deps.get("web")["spec"]["template"]["metadata"]["annotations"]
+    assert "kubectl.kubernetes.io/restartedAt" in ann
+
+
+def test_debug_traces_and_stacks(cluster):
+    server, _client = cluster
+    from kubernetes_tpu.utils.tracing import TRACER
+    with TRACER.span("test/export", n=1):
+        pass
+    with urllib.request.urlopen(server.url + "/debug/traces") as r:
+        doc = json.loads(r.read())
+    spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert any(s["name"] == "test/export" for s in spans)
+    attrs = doc["resourceSpans"][0]["resource"]["attributes"]
+    assert any(a["key"] == "service.name" for a in attrs)
+    with urllib.request.urlopen(server.url + "/debug/stacks") as r:
+        text = r.read().decode()
+    assert "thread " in text
+    assert "dump_stacks" in text  # the serving frame itself is in the dump
