@@ -383,29 +383,9 @@ def cmd_port_forward(client: HTTPClient, args, out) -> int:
             if getattr(args, "token", None) else "")
 
     def handle(conn):
+        from kubernetes_tpu.kubelet.server import upgrade_and_splice
         with conn:
-            try:
-                up = _socket.create_connection(api, timeout=10.0)
-                up.sendall((f"POST {path} HTTP/1.1\r\n"
-                            f"Host: {parts.hostname}\r\n"
-                            f"{auth}"
-                            "Upgrade: tcp\r\nConnection: Upgrade\r\n"
-                            "Content-Length: 0\r\n\r\n").encode())
-                buf = b""
-                while b"\r\n\r\n" not in buf:
-                    c = up.recv(1024)
-                    if not c:
-                        return
-                    buf += c
-                if b" 101 " not in buf.split(b"\r\n", 1)[0]:
-                    return
-                rest = buf.split(b"\r\n\r\n", 1)[1]
-                if rest:
-                    conn.sendall(rest)
-                from kubernetes_tpu.kubelet.server import _splice_sockets
-                _splice_sockets(conn, up)
-            except OSError:
-                pass
+            upgrade_and_splice(conn, api, path, extra_headers=auth)
 
     srv = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
     srv.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)

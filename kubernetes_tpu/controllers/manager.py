@@ -41,7 +41,10 @@ from kubernetes_tpu.controllers.clusterroleaggregation import (
 from kubernetes_tpu.controllers.resourcequota import ResourceQuotaController
 from kubernetes_tpu.controllers.statefulset import StatefulSetController
 from kubernetes_tpu.controllers.attachdetach import AttachDetachController
+from kubernetes_tpu.controllers.ephemeral import EphemeralVolumeController
 from kubernetes_tpu.controllers.nodeipam import NodeIpamController
+from kubernetes_tpu.controllers.route import RouteController
+from kubernetes_tpu.controllers.servicelb import ServiceLBController
 from kubernetes_tpu.controllers.ttl import TTLController
 from kubernetes_tpu.controllers.ttlafterfinished import TTLAfterFinishedController
 
@@ -92,6 +95,9 @@ class ControllerManager:
             "csrsigning": CSRSigningController,
             "attachdetach": AttachDetachController,
             "nodeipam": NodeIpamController,
+            "ephemeral": EphemeralVolumeController,
+            "service-lb": ServiceLBController,
+            "route": RouteController,
         }
         self.controllers = [ctors[n](client) for n in controllers]
         self.gc = GarbageCollector(client) if gc_enabled else None

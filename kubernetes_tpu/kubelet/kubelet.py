@@ -100,13 +100,7 @@ class Kubelet:
             "capacity": dict(self.allocatable),
             "conditions": [self._ready_condition()],
         }
-        if self.server is not None:
-            # the apiserver proxies log/exec/portforward subresources here
-            # (node.status.daemonEndpoints.kubeletEndpoint upstream)
-            status["addresses"] = [{"type": "InternalIP",
-                                    "address": "127.0.0.1"}]
-            status["daemonEndpoints"] = {
-                "kubeletEndpoint": {"Port": self.server.port}}
+        self._apply_endpoint_status(status)
         return {
             "apiVersion": "v1", "kind": "Node",
             "metadata": {"name": self.node_name, "labels": dict(self.labels)},
@@ -123,6 +117,17 @@ class Kubelet:
                         and md.get("name", "") == name):
                     return uid
         return None
+
+    def _apply_endpoint_status(self, status: dict) -> None:
+        """The apiserver proxies log/exec/portforward subresources here
+        (node.status.daemonEndpoints.kubeletEndpoint upstream). Shared by
+        registration and the heartbeat so a restarted kubelet's fresh port
+        always reaches the Node."""
+        if self.server is not None:
+            status["addresses"] = [{"type": "InternalIP",
+                                    "address": "127.0.0.1"}]
+            status["daemonEndpoints"] = {
+                "kubeletEndpoint": {"Port": self.server.port}}
 
     def _ready_condition(self) -> dict:
         return {"type": "Ready", "status": "True",
@@ -148,11 +153,7 @@ class Kubelet:
             conds = [c for c in st.get("conditions") or []
                      if c.get("type") != "Ready"]
             st["conditions"] = conds + [self._ready_condition()]
-            if self.server is not None:
-                st["addresses"] = [{"type": "InternalIP",
-                                    "address": "127.0.0.1"}]
-                st["daemonEndpoints"] = {
-                    "kubeletEndpoint": {"Port": self.server.port}}
+            self._apply_endpoint_status(st)
             self.client.nodes().update_status(node)
         except ApiError:
             # node vanished (or update raced a delete): re-create it —

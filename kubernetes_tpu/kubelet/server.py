@@ -163,6 +163,41 @@ class KubeletServer:
             self._apps.clear()
 
 
+def upgrade_and_splice(client_sock: socket.socket, addr: tuple, path: str,
+                       extra_headers: str = "") -> bool:
+    """Client leg of the port-forward chain: connect to ``addr``, send the
+    Upgrade: tcp POST for ``path``, consume the 101 header block, forward
+    any leftover bytes, then splice. Shared by the apiserver proxy and the
+    ktpu CLI so the handshake lives in exactly one place. Returns False
+    when the upgrade is refused (caller reports; sockets are closed)."""
+    try:
+        upstream = socket.create_connection(addr, timeout=10.0)
+        upstream.sendall((f"POST {path} HTTP/1.1\r\n"
+                          f"Host: {addr[0]}\r\n"
+                          f"{extra_headers}"
+                          "Upgrade: tcp\r\nConnection: Upgrade\r\n"
+                          "Content-Length: 0\r\n\r\n").encode())
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = upstream.recv(1024)
+            if not chunk:
+                raise OSError("peer closed during upgrade")
+            buf += chunk
+        if b" 101 " not in buf.split(b"\r\n", 1)[0]:
+            raise OSError("upgrade refused")
+    except OSError:
+        try:
+            client_sock.close()
+        except OSError:
+            pass
+        return False
+    leftover = buf.split(b"\r\n\r\n", 1)[1]
+    if leftover:
+        client_sock.sendall(leftover)
+    _splice_sockets(client_sock, upstream)
+    return True
+
+
 def _splice(client_sock: socket.socket, target: tuple) -> None:
     """Connect to the container app, then pump (see _splice_sockets)."""
     try:

@@ -700,40 +700,17 @@ class APIServer:
                 if ep is None:
                     return None
                 base, _pod = ep
-                import socket as _socket
                 from urllib.parse import urlsplit
+                from kubernetes_tpu.kubelet.server import upgrade_and_splice
                 parts = urlsplit(base)
-                try:
-                    upstream = _socket.create_connection(
-                        (parts.hostname, parts.port), timeout=5.0)
-                    req_text = (f"POST /portForward/{ns}/{pod_name} "
-                                "HTTP/1.1\r\n"
-                                f"Host: {parts.hostname}\r\n"
-                                "Upgrade: tcp\r\nConnection: Upgrade\r\n"
-                                "Content-Length: 0\r\n\r\n")
-                    upstream.sendall(req_text.encode())
-                    # consume the kubelet's 101 header block
-                    buf = b""
-                    while b"\r\n\r\n" not in buf:
-                        chunk = upstream.recv(1024)
-                        if not chunk:
-                            raise OSError("kubelet closed during upgrade")
-                        buf += chunk
-                    if b" 101 " not in buf.split(b"\r\n", 1)[0]:
-                        raise OSError("kubelet refused upgrade")
-                except OSError as e:
-                    return self._error(502, f"kubelet proxy: {e}",
-                                       "BadGateway")
                 self.send_response(101)
                 self.send_header("Upgrade", "tcp")
                 self.send_header("Connection", "Upgrade")
                 self.end_headers()
                 self.wfile.flush()
-                from kubernetes_tpu.kubelet.server import _splice_sockets
-                leftover = buf.split(b"\r\n\r\n", 1)[1]
-                if leftover:
-                    self.connection.sendall(leftover)
-                _splice_sockets(self.connection, upstream)
+                upgrade_and_splice(self.connection,
+                                   (parts.hostname, parts.port),
+                                   f"/portForward/{ns}/{pod_name}")
                 self.close_connection = True
                 return None
 

@@ -92,16 +92,24 @@ def export_otlp_json(tracer: "Tracer", service_name: str = "kubernetes-tpu"
         return hashlib.sha256(name.encode()).hexdigest()[:n]
 
     trace_id = _id("kubernetes-tpu-export", 32)
+    finished = tracer.spans()
+    span_ids = [_id(f"{sp.name}-{i}", 16) for i, sp in enumerate(finished)]
+    # Parent linkage: the tracer records the parent's NAME, and spans are
+    # collected in COMPLETION order — a child finishes BEFORE its enclosing
+    # parent, so the parent is the NEAREST LATER span of that name. Resolve
+    # in a reverse pass (map holds the nearest later occurrence of each
+    # name as we walk backward).
+    parent_ids = [""] * len(finished)
+    nearest_later: dict[str, str] = {}
+    for i in range(len(finished) - 1, -1, -1):
+        sp = finished[i]
+        if sp.parent:
+            parent_ids[i] = nearest_later.get(sp.parent, "")
+        nearest_later[sp.name] = span_ids[i]
     spans = []
-    last_id_by_name: dict[str, str] = {}
-    for i, sp in enumerate(tracer.spans()):
-        span_id = _id(f"{sp.name}-{i}", 16)
-        # parent linkage: the tracer records the parent's NAME; the most
-        # recently exported span of that name is the enclosing one (spans
-        # finish child-before-parent within a thread, and the exporter
-        # preserves completion order)
-        parent_id = last_id_by_name.get(sp.parent, "") if sp.parent else ""
-        last_id_by_name[sp.name] = span_id
+    for i, sp in enumerate(finished):
+        span_id = span_ids[i]
+        parent_id = parent_ids[i]
         spans.append({
             "traceId": trace_id,
             "spanId": span_id,

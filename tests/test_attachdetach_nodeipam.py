@@ -200,3 +200,103 @@ def test_nodeipam_releases_on_node_delete(client):
             .get("podCIDR")), "released subnet was not reusable"
     finally:
         stop(ctrl, factory)
+
+
+# ----------------------------------------- ephemeral / service-lb / route
+
+def test_ephemeral_volume_creates_owned_pvc(client):
+    from kubernetes_tpu.controllers.ephemeral import EphemeralVolumeController
+    ctrl, factory = run_controller(client, EphemeralVolumeController(client))
+    try:
+        pod = make_pod("db").obj().to_dict()
+        pod["spec"]["volumes"] = [{
+            "name": "scratch",
+            "ephemeral": {"volumeClaimTemplate": {
+                "metadata": {"labels": {"app": "db"}},
+                "spec": {"resources": {"requests": {"storage": "1Gi"}},
+                         "storageClassName": "fast"}}}}]
+        created = client.pods("default").create(pod)
+        pvcs = client.resource("persistentvolumeclaims", "default")
+        assert wait_until(lambda: any(
+            (p.get("metadata") or {}).get("name") == "db-scratch"
+            for p in pvcs.list()))
+        claim = pvcs.get("db-scratch")
+        refs = claim["metadata"]["ownerReferences"]
+        assert refs[0]["kind"] == "Pod"
+        assert refs[0]["uid"] == created["metadata"]["uid"]
+        assert claim["spec"]["storageClassName"] == "fast"
+        assert claim["metadata"]["labels"] == {"app": "db"}
+    finally:
+        stop(ctrl, factory)
+
+
+def test_ephemeral_volume_refuses_foreign_claim(client):
+    from kubernetes_tpu.controllers.ephemeral import EphemeralVolumeController
+    pvcs = client.resource("persistentvolumeclaims", "default")
+    pvcs.create({"kind": "PersistentVolumeClaim",
+                 "metadata": {"name": "db-scratch"},
+                 "spec": {"resources": {"requests": {"storage": "1Gi"}}}})
+    ctrl, factory = run_controller(client, EphemeralVolumeController(client))
+    try:
+        pod = make_pod("db").obj().to_dict()
+        pod["spec"]["volumes"] = [{
+            "name": "scratch",
+            "ephemeral": {"volumeClaimTemplate": {
+                "spec": {"resources": {"requests": {"storage": "1Gi"}}}}}}]
+        client.pods("default").create(pod)
+        time.sleep(0.3)
+        # the foreign claim is NOT adopted: no ownerReferences grafted
+        assert "ownerReferences" not in pvcs.get("db-scratch")["metadata"]
+    finally:
+        stop(ctrl, factory)
+
+
+def test_service_lb_assigns_and_releases_ingress(client):
+    from kubernetes_tpu.controllers.servicelb import ServiceLBController
+    ctrl, factory = run_controller(client, ServiceLBController(client))
+    try:
+        svcs = client.resource("services", "default")
+        svcs.create({"kind": "Service", "metadata": {"name": "edge"},
+                     "spec": {"type": "LoadBalancer",
+                              "ports": [{"port": 443}]}})
+        def ingress():
+            return ((svcs.get("edge").get("status") or {})
+                    .get("loadBalancer") or {}).get("ingress")
+        assert wait_until(lambda: ingress()), svcs.get("edge")
+        ip = ingress()[0]["ip"]
+        assert ip.startswith("203.0.113.")
+        # a second LB service gets a DIFFERENT address
+        svcs.create({"kind": "Service", "metadata": {"name": "edge2"},
+                     "spec": {"type": "LoadBalancer",
+                              "ports": [{"port": 80}]}})
+        assert wait_until(lambda: ((svcs.get("edge2").get("status") or {})
+                                   .get("loadBalancer") or {}).get("ingress"))
+        ip2 = svcs.get("edge2")["status"]["loadBalancer"]["ingress"][0]["ip"]
+        assert ip2 != ip
+        # type change away tears the LB down
+        svc = svcs.get("edge")
+        svc["spec"]["type"] = "ClusterIP"
+        svcs.update(svc)
+        assert wait_until(lambda: not ingress()), svcs.get("edge")
+    finally:
+        stop(ctrl, factory)
+
+
+def test_route_controller_clears_network_unavailable(client):
+    from kubernetes_tpu.controllers.route import RouteController
+    n = make_node("rn-1").obj().to_dict()
+    n["spec"]["podCIDR"] = "10.244.7.0/24"
+    client.nodes().create(n)
+    ctrl, factory = run_controller(client, RouteController(client))
+    try:
+        def net_ok():
+            conds = (client.nodes().get("rn-1").get("status") or {}) \
+                .get("conditions") or []
+            return any(c.get("type") == "NetworkUnavailable"
+                       and c.get("status") == "False" for c in conds)
+        assert wait_until(net_ok)
+        assert ctrl.routes == {"rn-1": "10.244.7.0/24"}
+        client.nodes().delete("rn-1")
+        assert wait_until(lambda: ctrl.routes == {})
+    finally:
+        stop(ctrl, factory)

@@ -292,3 +292,88 @@ def test_nftables_and_iptables_backends_agree():
     finally:
         ipt.stop()
         nft.stop()
+
+
+# ------------------------------------------------------ ipvs backend render
+
+def _mk_ipvs_proxier_with(services, endpoints):
+    from kubernetes_tpu.client.clientset import DirectClient
+    from kubernetes_tpu.proxy.ipvs import IpvsProxier
+    from kubernetes_tpu.store.store import ObjectStore
+    client = DirectClient(ObjectStore())
+    for s in services:
+        client.resource("services", s["metadata"].get("namespace",
+                                                      "default")).create(s)
+    for e in endpoints:
+        client.resource("endpoints", e["metadata"].get("namespace",
+                                                       "default")).create(e)
+    return IpvsProxier(client).start()
+
+
+def test_ipvs_payload_structure_and_roundtrip():
+    from kubernetes_tpu.proxy.ipvs import RestoredIpvsRules
+    p = _mk_ipvs_proxier_with(
+        [{"kind": "Service", "metadata": {"name": "web"},
+          "spec": {"clusterIP": "10.96.0.10",
+                   "sessionAffinity": "ClientIP",
+                   "ports": [{"port": 80, "protocol": "TCP",
+                              "nodePort": 30080}]}},
+         {"kind": "Service", "metadata": {"name": "empty"},
+          "spec": {"clusterIP": "10.96.0.11",
+                   "ports": [{"port": 443, "protocol": "TCP"}]}}],
+        [{"kind": "Endpoints", "metadata": {"name": "web"},
+          "subsets": [{"addresses": [{"ip": "10.88.0.5"},
+                                     {"ip": "10.88.0.6"}],
+                       "ports": [{"port": 8080}]}]}])
+    try:
+        text = p.sync_ipvs_text()
+        # VIPs on the dummy interface; virtual + real servers; source-hash
+        # scheduler with persistence for ClientIP affinity
+        assert "ip addr add 10.96.0.10/32 dev kube-ipvs0" in text
+        assert "-A -t 10.96.0.10:80 -s sh -p 10800" in text
+        assert "-a -t 10.96.0.10:80 -r 10.88.0.5:8080 -m -w 1" in text
+        assert "-A -t 0.0.0.0:30080" in text          # nodePort vserver
+        assert "-A -t 10.96.0.11:443" in text          # empty: vserver only
+        assert "-a -t 10.96.0.11:443" not in text      # ...no real servers
+        rr = RestoredIpvsRules(text)
+        assert sorted(rr.backends("10.96.0.10", 80)) == \
+            ["10.88.0.5:8080", "10.88.0.6:8080"]
+        assert rr.backends("10.96.0.11", 443) == []
+        assert sorted(rr.backends("203.0.113.1", 30080)) == \
+            ["10.88.0.5:8080", "10.88.0.6:8080"]
+        got = {p.resolve("10.96.0.10", 80, client_ip="1.2.3.4")}
+        assert got <= set(rr.backends("10.96.0.10", 80))
+    finally:
+        p.stop()
+
+
+def test_all_three_backends_agree():
+    """iptables, nftables, and ipvs renderers must encode the SAME decision
+    table for identical cluster state."""
+    from kubernetes_tpu.proxy.ipvs import RestoredIpvsRules
+    from kubernetes_tpu.proxy.nftables import RestoredNftRules
+    from kubernetes_tpu.proxy.proxier import RestoredRules
+    svcs = [{"kind": "Service", "metadata": {"name": f"s{i}"},
+             "spec": {"clusterIP": f"10.96.2.{i}",
+                      "ports": [{"port": 80 + i, "protocol": "TCP"}]}}
+            for i in range(3)]
+    eps = [{"kind": "Endpoints", "metadata": {"name": f"s{i}"},
+            "subsets": [{"addresses": [{"ip": f"10.88.2.{10*i + j}"}
+                                       for j in range(i)],
+                         "ports": [{"port": 9000 + i}]}]}
+           for i in range(3)]
+    ipt = _mk_proxier_with(svcs, eps)
+    nft = _mk_nft_proxier_with(svcs, eps)
+    ipv = _mk_ipvs_proxier_with(svcs, eps)
+    try:
+        a = RestoredRules(ipt.sync_proxy_rules_text())
+        b = RestoredNftRules(nft.sync_nft_text())
+        c = RestoredIpvsRules(ipv.sync_ipvs_text())
+        for i in range(3):
+            key = (f"10.96.2.{i}", 80 + i)
+            assert sorted(a.backends(*key)) == sorted(b.backends(*key)) \
+                == sorted(c.backends(*key)), key
+    finally:
+        ipt.stop()
+        nft.stop()
+        ipv.stop()
