@@ -75,7 +75,14 @@ class LocalCluster:
             self.client = HTTPClient(self.server.url, token=token)
             self.runner = SchedulerRunner(self.client, cfg=self._scheduler_cfg,
                                           registry=self._registry)
-            self.manager = ControllerManager(self.client)
+            from kubernetes_tpu.controllers.manager import (
+                CLOUD_CONTROLLERS, DEFAULT_CONTROLLERS)
+            # cluster-up runs the cloud loops too: this IS the cloud here
+            # (nodeipam carves podCIDRs, route flips NetworkUnavailable,
+            # service-lb hands out ingress IPs)
+            self.manager = ControllerManager(
+                self.client,
+                controllers=DEFAULT_CONTROLLERS + CLOUD_CONTROLLERS)
             self.runner.start()
             self.manager.start()
             for i in range(self._cfg["nodes"]):

@@ -19,6 +19,11 @@ class EphemeralVolumeController(Controller):
     name = "ephemeral"
     workers = 1
 
+    def __init__(self, client):
+        super().__init__(client)
+        from kubernetes_tpu.utils.events import EventRecorder
+        self.recorder = EventRecorder(client, "ephemeral-volume-controller")
+
     def register(self, factory: InformerFactory) -> None:
         self.pod_informer = factory.informer("pods", None)
         self.pod_informer.add_event_handler(self.handler())
@@ -77,8 +82,6 @@ class EphemeralVolumeController(Controller):
                    .get("ownerReferences") or [])
 
     def recorder_event(self, pod: dict, claim_name: str) -> None:
-        rec = getattr(self, "recorder", None)
-        if rec is not None:
-            rec.event(pod, "Warning", "ConflictingPVC",
-                      f"PVC {claim_name!r} exists and is not owned by the "
-                      "pod")
+        self.recorder.event(pod, "Warning", "ConflictingPVC",
+                            f"PVC {claim_name!r} exists and is not owned "
+                            "by the pod")

@@ -55,7 +55,11 @@ DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "daemonset",
                        "namespace", "serviceaccount", "serviceaccount-token",
                        "resourceclaim", "replicationcontroller", "podgc",
                        "resourcequota", "ttl", "clusterroleaggregation",
-                       "csrsigning")
+                       "csrsigning", "ephemeral", "attachdetach")
+# Cloud-provider loops (upstream: cloud-controller-manager / kcm flags):
+# opt-in by name — "nodeipam" needs --cluster-cidr semantics, "route" and
+# "service-lb" a cloud. cli/cluster.py enables them for cluster-up.
+CLOUD_CONTROLLERS = ("nodeipam", "route", "service-lb")
 
 
 class ControllerManager:

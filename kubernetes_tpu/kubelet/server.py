@@ -163,15 +163,13 @@ class KubeletServer:
             self._apps.clear()
 
 
-def upgrade_and_splice(client_sock: socket.socket, addr: tuple, path: str,
-                       extra_headers: str = "") -> bool:
-    """Client leg of the port-forward chain: connect to ``addr``, send the
-    Upgrade: tcp POST for ``path``, consume the 101 header block, forward
-    any leftover bytes, then splice. Shared by the apiserver proxy and the
-    ktpu CLI so the handshake lives in exactly one place. Returns False
-    when the upgrade is refused (caller reports; sockets are closed)."""
+def connect_upgrade(addr: tuple, path: str, extra_headers: str = ""):
+    """Dial ``addr``, send the Upgrade: tcp POST for ``path``, consume the
+    101 header block. Returns ``(socket, leftover_bytes)``; raises OSError
+    (with the socket closed) when the peer is unreachable or refuses — so
+    callers can report BEFORE committing their own side of the upgrade."""
+    upstream = socket.create_connection(addr, timeout=10.0)
     try:
-        upstream = socket.create_connection(addr, timeout=10.0)
         upstream.sendall((f"POST {path} HTTP/1.1\r\n"
                           f"Host: {addr[0]}\r\n"
                           f"{extra_headers}"
@@ -187,14 +185,37 @@ def upgrade_and_splice(client_sock: socket.socket, addr: tuple, path: str,
             raise OSError("upgrade refused")
     except OSError:
         try:
+            upstream.close()
+        except OSError:
+            pass
+        raise
+    return upstream, buf.split(b"\r\n\r\n", 1)[1]
+
+
+def upgrade_and_splice(client_sock: socket.socket, addr: tuple, path: str,
+                       extra_headers: str = "") -> bool:
+    """connect_upgrade + bidirectional splice, closing both sockets on any
+    failure. Shared by the apiserver proxy and the ktpu CLI so the
+    handshake lives in exactly one place."""
+    try:
+        upstream, leftover = connect_upgrade(addr, path, extra_headers)
+    except OSError:
+        try:
             client_sock.close()
         except OSError:
             pass
         return False
-    leftover = buf.split(b"\r\n\r\n", 1)[1]
-    if leftover:
-        client_sock.sendall(leftover)
-    _splice_sockets(client_sock, upstream)
+    try:
+        if leftover:
+            client_sock.sendall(leftover)
+        _splice_sockets(client_sock, upstream)
+    except OSError:
+        for sk in (client_sock, upstream):
+            try:
+                sk.close()
+            except OSError:
+                pass
+        return False
     return True
 
 

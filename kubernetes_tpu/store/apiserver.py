@@ -701,16 +701,33 @@ class APIServer:
                     return None
                 base, _pod = ep
                 from urllib.parse import urlsplit
-                from kubernetes_tpu.kubelet.server import upgrade_and_splice
+                from kubernetes_tpu.kubelet.server import (_splice_sockets,
+                                                           connect_upgrade)
                 parts = urlsplit(base)
+                try:
+                    # dial the kubelet FIRST: an unreachable/stale endpoint
+                    # must surface as 502, not a silent post-101 close
+                    upstream, leftover = connect_upgrade(
+                        (parts.hostname, parts.port),
+                        f"/portForward/{ns}/{pod_name}")
+                except OSError as e:
+                    return self._error(502, f"kubelet proxy: {e}",
+                                       "BadGateway")
                 self.send_response(101)
                 self.send_header("Upgrade", "tcp")
                 self.send_header("Connection", "Upgrade")
                 self.end_headers()
                 self.wfile.flush()
-                upgrade_and_splice(self.connection,
-                                   (parts.hostname, parts.port),
-                                   f"/portForward/{ns}/{pod_name}")
+                try:
+                    if leftover:
+                        self.connection.sendall(leftover)
+                    _splice_sockets(self.connection, upstream)
+                except OSError:
+                    for sk in (self.connection, upstream):
+                        try:
+                            sk.close()
+                        except OSError:
+                            pass
                 self.close_connection = True
                 return None
 

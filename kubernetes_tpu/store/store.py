@@ -158,6 +158,7 @@ class ObjectStore:
         self._floor_rv = 0
         self._watchers: dict[str, list[queue.Queue]] = {}
         self._data_dir = data_dir
+        self._journal_subs: list = []  # replication taps (under the lock)
         self._wal_compact_every = wal_compact_every
         self._fsync = fsync
         self._wal = None
@@ -208,6 +209,8 @@ class ObjectStore:
     # ---- durability ------------------------------------------------------
 
     def _journal_locked(self, entry: dict):
+        for fn in self._journal_subs:
+            fn(entry)  # replication taps the journal (store/replication.py)
         if self._wal is None:
             return
         self._wal.write(json.dumps(entry) + "\n")
@@ -263,8 +266,14 @@ class ObjectStore:
                         space.pop((e["ns"], e["name"]), None)
                     self._rv = max(self._rv, rv)
         self._floor_rv = self._rv
-        # re-seed the ClusterIP allocator past every restored Service
-        seq = 0
+        self._reseed_service_ips_locked()
+
+    def _reseed_service_ips_locked(self):
+        """Advance the ClusterIP allocator past every Service present —
+        restores, snapshot installs, and replicated applies must never
+        re-issue a VIP an existing Service holds (a promoted follower
+        would otherwise hand out duplicates)."""
+        seq = getattr(self, "_svc_ip_seq", 0)
         for (_ns, _n), svc in self._data.get("Service", {}).items():
             ip = (svc.get("spec") or {}).get("clusterIP") or ""
             parts = ip.split(".")
@@ -272,6 +281,70 @@ class ObjectStore:
                 seq = max(seq, int(parts[2]) * 250 + int(parts[3]) - 1)
         if seq:
             self._svc_ip_seq = seq
+
+    # ---- replication hooks (store/replication.py) ------------------------
+
+    def snapshot_rv(self) -> int:
+        """Current rv (method form for replication call sites)."""
+        with self._lock:
+            return self._rv
+
+    def subscribe_journal(self, fn) -> None:
+        """``fn(entry)`` fires under the store lock for every journaled
+        mutation — keep it O(1) (append to a buffer; never do I/O)."""
+        with self._lock:
+            self._journal_subs.append(fn)
+
+    def apply_replicated(self, entry: dict) -> None:
+        """Apply a replicated journal entry at ITS rv (follower side): the
+        twin of the WAL replay in _restore_locked, but live — watchers see
+        the event, so informers on a follower stay current."""
+        kind = entry["kind"]
+        rv = int(entry["rv"])
+        with self._lock:
+            if rv <= self._rv:
+                return  # duplicate delivery
+            space = self._data.setdefault(kind, {})
+            key = (entry["ns"], entry["name"])
+            if entry["op"] == "set":
+                existed = key in space
+                space[key] = entry["obj"]
+                self._rv = rv
+                if kind == "Service":
+                    self._reseed_service_ips_locked()
+                self._emit_locked(kind, Event(
+                    MODIFIED if existed else ADDED, entry["obj"], rv))
+            else:
+                old = space.pop(key, None)
+                self._rv = rv
+                if old is not None:
+                    self._emit_locked(kind, Event(DELETED, old, rv))
+
+    def snapshot_blob(self) -> dict:
+        with self._lock:
+            return {"rv": self._rv,
+                    "data": {kind: list(space.values())
+                             for kind, space in self._data.items()}}
+
+    def load_snapshot_blob(self, blob: dict) -> None:
+        """Full-state resync (a follower too far behind the leader's
+        replication window, or a rejoining ex-leader with a divergent
+        uncommitted suffix). Watch histories reset AND live watch streams
+        are invalidated (ERROR event -> informers relist) — exactly the
+        load() contract: a stream that silently missed the snapshot delta
+        would retain phantoms forever."""
+        with self._lock:
+            self._data = {kind: {tuple(obj_key(o)): o for o in objs}
+                          for kind, objs in blob["data"].items()}
+            self._rv = int(blob["rv"])
+            self._history.clear()
+            self._compacted = {}
+            self._floor_rv = self._rv
+            for qs in self._watchers.values():
+                for q in qs:
+                    q.put(Event(ERROR, {}, self._rv))
+            self._watchers = {}
+            self._reseed_service_ips_locked()
 
     # ---- CRUD ------------------------------------------------------------
 

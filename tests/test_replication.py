@@ -1,0 +1,187 @@
+"""Replicated store: quorum writes, elections, failover, resync.
+
+Reference role: etcd's raft quorum behind the apiserver
+(``apiserver/pkg/storage/etcd3``). These tests drive a 3-node group over
+the real HTTP peer transport: writes commit only with a quorum, a dead
+leader is replaced by the most up-to-date follower, minority partitions
+cannot commit, and lagging/diverged replicas resync from the leader.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.store.replication import (NotLeader, QuorumLost,
+                                              RaftNode, ReplicatedStore)
+from kubernetes_tpu.store.store import ObjectStore
+
+
+def wait_until(fn, timeout=10.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+def _cluster(n=3):
+    """n RaftNodes wired into one group (ports chosen first)."""
+    import socket
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    nodes = []
+    for i in range(n):
+        peers = {f"n{j}": f"http://127.0.0.1:{ports[j]}"
+                 for j in range(n) if j != i}
+        nodes.append(RaftNode(f"n{i}", ObjectStore(), peers,
+                              port=ports[i]))
+    return nodes
+
+
+def _leader(nodes, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leaders = [nd for nd in nodes
+                   if not nd._stop.is_set() and nd.is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.05)
+    raise AssertionError("no single leader elected: "
+                         + str([nd.status() for nd in nodes]))
+
+
+def _cm(name, v="1"):
+    return {"kind": "ConfigMap", "metadata": {"name": name},
+            "data": {"v": v}}
+
+
+def test_quorum_write_replicates_to_followers():
+    nodes = _cluster(3)
+    try:
+        leader = _leader(nodes)
+        rs = ReplicatedStore(leader)
+        rs.create("ConfigMap", _cm("a"))
+        followers = [nd for nd in nodes if nd is not leader]
+        for f in followers:
+            assert wait_until(lambda f=f: any(
+                (o.get("metadata") or {}).get("name") == "a"
+                for o, in [(x,) for x in
+                           f.store.list("ConfigMap")[0]])), f.status()
+        # follower watchers observed the replicated event
+        w = followers[0].store.watch("ConfigMap", since_rv=0)
+        ev = w.get(timeout=2.0)
+        assert ev is not None and ev.object["metadata"]["name"] == "a"
+        w.stop()
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_mutation_on_follower_rejected():
+    nodes = _cluster(3)
+    try:
+        leader = _leader(nodes)
+        follower = next(nd for nd in nodes if nd is not leader)
+        with pytest.raises(NotLeader):
+            ReplicatedStore(follower).create("ConfigMap", _cm("x"))
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_leader_failover_and_continued_writes():
+    nodes = _cluster(3)
+    try:
+        leader = _leader(nodes)
+        ReplicatedStore(leader).create("ConfigMap", _cm("pre"))
+        leader.stop()
+        survivors = [nd for nd in nodes if nd is not leader]
+        new_leader = _leader(survivors)
+        assert new_leader is not leader
+        # the committed pre-failover write survived the election
+        objs, _ = new_leader.store.list("ConfigMap")
+        assert any(o["metadata"]["name"] == "pre" for o in objs)
+        # and the group still commits (2/3 alive = quorum)
+        ReplicatedStore(new_leader).create("ConfigMap", _cm("post"))
+        other = next(nd for nd in survivors if nd is not new_leader)
+        assert wait_until(lambda: any(
+            o["metadata"]["name"] == "post"
+            for o in other.store.list("ConfigMap")[0]))
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_minority_cannot_commit():
+    nodes = _cluster(3)
+    try:
+        leader = _leader(nodes)
+        followers = [nd for nd in nodes if nd is not leader]
+        for f in followers:
+            f.stop()  # majority gone
+        rs = ReplicatedStore(leader, commit_timeout=1.0)
+        with pytest.raises((QuorumLost, NotLeader)):
+            rs.create("ConfigMap", _cm("lost"))
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_downed_replica_rejoins_via_snapshot():
+    """A replica that died and lost its state rejoins empty on the same
+    port: the leader detects the gap and installs a snapshot (raft's
+    InstallSnapshot shape)."""
+    nodes = _cluster(3)
+    try:
+        leader = _leader(nodes)
+        lagger = next(nd for nd in nodes if nd is not leader)
+        port, node_id, peers = lagger.port, lagger.node_id, lagger.peers
+        lagger.stop()
+        rs = ReplicatedStore(leader)
+        for i in range(5):
+            rs.create("ConfigMap", _cm(f"c{i}"))
+        reborn = RaftNode(node_id, ObjectStore(), peers, port=port)
+        nodes.append(reborn)
+        assert wait_until(lambda: len(
+            reborn.store.list("ConfigMap")[0]) == 5, timeout=15.0), \
+            reborn.status()
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_apiserver_over_replicated_store():
+    """The control plane on top: an APIServer backed by the leader's
+    ReplicatedStore — HTTP writes quorum-commit and appear on followers."""
+    from kubernetes_tpu.client.clientset import HTTPClient
+    from kubernetes_tpu.store.apiserver import APIServer
+    nodes = _cluster(3)
+    api = None
+    try:
+        leader = _leader(nodes)
+        api = APIServer(store=ReplicatedStore(leader)).start()
+        c = HTTPClient(api.url)
+        c.resource("configmaps", "default").create(_cm("via-http"))
+        follower = next(nd for nd in nodes if nd is not leader)
+        assert wait_until(lambda: any(
+            (o.get("metadata") or {}).get("name") == "via-http"
+            for o in follower.store.list("ConfigMap")[0]))
+    finally:
+        if api is not None:
+            try:
+                api._server_close_keep_store = True  # don't close raft store
+            except Exception:
+                pass
+            try:
+                api.stop()
+            except Exception:
+                pass
+        for nd in nodes:
+            nd.stop()
