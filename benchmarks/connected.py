@@ -381,10 +381,21 @@ def _warm_preempt(runner, n_high: int, log) -> bool:
             for k in range(n_high)]
     ok = True
     try:
+        from kubernetes_tpu.sched.scheduler import DRAIN_NOM_BUCKET
         nodes, ct, meta = cache.snapshot(pending_pods=warm)
         bound = cache.bound_pods()
-        pb = cache.encode_pods(warm, meta)
+        # the runtime group path pins batch width to cfg.batch_size and the
+        # nominee overlay to DRAIN_NOM_BUCKET — compile exactly those
+        # shapes, with and without reservations (first cycle has none)
+        pb = cache.encode_pods(warm, meta, min_p=runner.cfg.batch_size)
         gang_schedule(ct, pb, seed=runner.cfg.seed,
+                      fit_strategy=profile.fit_strategy,
+                      topo_keys=meta.topo_keys, weights=profile.weights(),
+                      enabled_filters=profile.enabled_filters)
+        nom = [(meta.node_names[0], 100, warm[0])]
+        ct_nom = cache.overlay_nominated(ct, meta, nom,
+                                         min_m=DRAIN_NOM_BUCKET)
+        gang_schedule(ct_nom, pb, seed=runner.cfg.seed,
                       fit_strategy=profile.fit_strategy,
                       topo_keys=meta.topo_keys, weights=profile.weights(),
                       enabled_filters=profile.enabled_filters)

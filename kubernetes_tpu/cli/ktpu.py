@@ -407,6 +407,57 @@ def cmd_port_forward(client: HTTPClient, args, out) -> int:
         srv.close()
 
 
+def cmd_top(client: HTTPClient, args, out) -> int:
+    """kubectl top analog from the scheduler's resource view: per-node
+    requested/allocatable (nodes) or per-pod requests (pods). Upstream
+    reads metrics-server usage; the hollow runtime has no real usage, so
+    requests — the quantity every scheduling decision is made on — are
+    the faithful figure here."""
+    from kubernetes_tpu.api.resource import canonical
+    pods = client.resource("pods", None).list()
+    if args.resource == "pods":
+        out.write(f"{'NAMESPACE':<16}{'NAME':<32}{'CPU':>10}{'MEMORY':>12}\n")
+        for p in pods:
+            md = p.get("metadata") or {}
+            if args.namespace not in ("", md.get("namespace", "default")) \
+                    and not args.all_namespaces:
+                continue
+            cpu = mem = 0
+            for c in (p.get("spec") or {}).get("containers") or []:
+                req = (c.get("resources") or {}).get("requests") or {}
+                cpu += canonical("cpu", str(req.get("cpu", "0")))
+                mem += canonical("memory", str(req.get("memory", "0")))
+            out.write(f"{md.get('namespace', 'default'):<16}"
+                      f"{md.get('name', ''):<32}"
+                      f"{cpu}m{'':>4}{mem >> 20}Mi\n")
+        return 0
+    nodes = client.nodes().list()
+    by_node: dict = {}
+    for p in pods:
+        nn = (p.get("spec") or {}).get("nodeName", "")
+        if not nn:
+            continue
+        cpu = mem = 0
+        for c in (p.get("spec") or {}).get("containers") or []:
+            req = (c.get("resources") or {}).get("requests") or {}
+            cpu += canonical("cpu", str(req.get("cpu", "0")))
+            mem += canonical("memory", str(req.get("memory", "0")))
+        acc = by_node.setdefault(nn, [0, 0])
+        acc[0] += cpu
+        acc[1] += mem
+    out.write(f"{'NAME':<24}{'CPU(req)':>12}{'CPU%':>7}"
+              f"{'MEM(req)':>12}{'MEM%':>7}\n")
+    for n in nodes:
+        name = (n.get("metadata") or {}).get("name", "")
+        alloc = (n.get("status") or {}).get("allocatable") or {}
+        acpu = canonical("cpu", str(alloc.get("cpu", "0"))) or 1
+        amem = canonical("memory", str(alloc.get("memory", "0"))) or 1
+        cpu, mem = by_node.get(name, [0, 0])
+        out.write(f"{name:<24}{cpu}m{'':>6}{100 * cpu // acpu:>5}%"
+                  f"{mem >> 20}Mi{'':>6}{100 * mem // amem:>5}%\n")
+    return 0
+
+
 REVISION_ANNOTATION = "deployment.kubernetes.io/revision"
 
 
@@ -530,6 +581,10 @@ def build_parser() -> argparse.ArgumentParser:
     pf.add_argument("--one-shot", action="store_true",
                     help="serve a single connection then exit")
 
+    tp = sub.add_parser("top")
+    tp.add_argument("resource", choices=["nodes", "pods"])
+    tp.add_argument("-A", "--all-namespaces", action="store_true")
+
     ro = sub.add_parser("rollout")
     ro.add_argument("action",
                     choices=["status", "history", "undo", "restart"])
@@ -575,6 +630,8 @@ def main(argv=None, out=None) -> int:
         if args.cmd == "port-forward":
             args.server = client.base
             return cmd_port_forward(client, args, out)
+        if args.cmd == "top":
+            return cmd_top(client, args, out)
         if args.cmd == "rollout":
             args.name = args.kind_name.split("/", 1)[-1]
             return cmd_rollout(client, args, out)

@@ -43,6 +43,7 @@ from kubernetes_tpu.controllers.statefulset import StatefulSetController
 from kubernetes_tpu.controllers.attachdetach import AttachDetachController
 from kubernetes_tpu.controllers.ephemeral import EphemeralVolumeController
 from kubernetes_tpu.controllers.nodeipam import NodeIpamController
+from kubernetes_tpu.controllers.rootca import RootCAPublisher
 from kubernetes_tpu.controllers.route import RouteController
 from kubernetes_tpu.controllers.servicelb import ServiceLBController
 from kubernetes_tpu.controllers.ttl import TTLController
@@ -55,7 +56,8 @@ DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "daemonset",
                        "namespace", "serviceaccount", "serviceaccount-token",
                        "resourceclaim", "replicationcontroller", "podgc",
                        "resourcequota", "ttl", "clusterroleaggregation",
-                       "csrsigning", "ephemeral", "attachdetach")
+                       "csrsigning", "ephemeral", "attachdetach",
+                       "root-ca-cert-publisher")
 # Cloud-provider loops (upstream: cloud-controller-manager / kcm flags):
 # opt-in by name — "nodeipam" needs --cluster-cidr semantics, "route" and
 # "service-lb" a cloud. cli/cluster.py enables them for cluster-up.
@@ -100,6 +102,7 @@ class ControllerManager:
             "attachdetach": AttachDetachController,
             "nodeipam": NodeIpamController,
             "ephemeral": EphemeralVolumeController,
+            "root-ca-cert-publisher": RootCAPublisher,
             "service-lb": ServiceLBController,
             "route": RouteController,
         }

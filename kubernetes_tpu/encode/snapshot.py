@@ -620,16 +620,19 @@ class SnapshotEncoder:
         return ct, meta
 
     def with_nominated(self, ct: ClusterTensors, meta: "SnapshotMeta",
-                       nominated: list) -> ClusterTensors:
+                       nominated: list, min_m: int = 0) -> ClusterTensors:
         """Overlay nominated-pod reservations onto an encoded snapshot.
         ``nominated``: [(node_name, priority, Pod)]. Cheap (tiny M-bucketed
         arrays), so it applies on every scheduling cycle without touching the
-        incremental-patch bookkeeping."""
+        incremental-patch bookkeeping. ``min_m`` pins the bucket: a
+        preemption storm's nominee count varies per cycle, and every new M
+        is a fresh gang program compile mid-window."""
         R = ct.nom_req.shape[1]
         entries = [(meta.node_index[n], prio,
                     self._request_vector(p, meta.resources))
                    for (n, prio, p) in nominated if n in meta.node_index]
-        M = next_bucket(len(entries), minimum=1) if entries else 0
+        M = next_bucket(max(len(entries), min_m), minimum=1) \
+            if entries or min_m else 0
         nom_node = np.full(M, -1, np.int32)
         nom_prio = np.zeros(M, np.int32)
         nom_req = np.zeros((M, R), np.int32)

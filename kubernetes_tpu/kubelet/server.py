@@ -95,6 +95,12 @@ class KubeletServer:
                 parts = [p for p in self.path.split("?")[0].split("/") if p]
                 if parts[:1] == ["healthz"]:
                     return self._send(200, b"ok")
+                if parts[:1] == ["metrics"]:
+                    # the kubelet's Prometheus endpoint (upstream serves
+                    # cadvisor + kubelet metrics here); the process-global
+                    # registry carries this node's counters
+                    from kubernetes_tpu.metrics.registry import REGISTRY
+                    return self._send(200, REGISTRY.expose_text().encode())
                 if parts[:1] == ["containerLogs"] and len(parts) == 4:
                     _, ns, pod, ctr = parts
                     uid = outer.uid_of(ns, pod)
@@ -192,19 +198,12 @@ def connect_upgrade(addr: tuple, path: str, extra_headers: str = ""):
     return upstream, buf.split(b"\r\n\r\n", 1)[1]
 
 
-def upgrade_and_splice(client_sock: socket.socket, addr: tuple, path: str,
-                       extra_headers: str = "") -> bool:
-    """connect_upgrade + bidirectional splice, closing both sockets on any
-    failure. Shared by the apiserver proxy and the ktpu CLI so the
-    handshake lives in exactly one place."""
-    try:
-        upstream, leftover = connect_upgrade(addr, path, extra_headers)
-    except OSError:
-        try:
-            client_sock.close()
-        except OSError:
-            pass
-        return False
+def splice_upgraded(client_sock: socket.socket, upstream: socket.socket,
+                    leftover: bytes) -> bool:
+    """Forward any post-handshake bytes, then splice; both sockets are
+    closed on any failure. The second half shared by upgrade_and_splice
+    and the apiserver's proxy (which dials via connect_upgrade first so
+    unreachable kubelets surface as 502)."""
     try:
         if leftover:
             client_sock.sendall(leftover)
@@ -217,6 +216,21 @@ def upgrade_and_splice(client_sock: socket.socket, addr: tuple, path: str,
                 pass
         return False
     return True
+
+
+def upgrade_and_splice(client_sock: socket.socket, addr: tuple, path: str,
+                       extra_headers: str = "") -> bool:
+    """connect_upgrade + splice_upgraded: the whole client leg in one call
+    (the ktpu CLI's path)."""
+    try:
+        upstream, leftover = connect_upgrade(addr, path, extra_headers)
+    except OSError:
+        try:
+            client_sock.close()
+        except OSError:
+            pass
+        return False
+    return splice_upgraded(client_sock, upstream, leftover)
 
 
 def _splice(client_sock: socket.socket, target: tuple) -> None:

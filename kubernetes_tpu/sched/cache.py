@@ -486,11 +486,12 @@ class SchedulerCache:
         with self._encode_lock:
             return self._encoder.encode_pods(pods, meta, min_p=min_p)
 
-    def overlay_nominated(self, ct, meta, entries):
+    def overlay_nominated(self, ct, meta, entries, min_m: int = 0):
         """ct with nominated-pod reservations applied (encoder.with_nominated);
         entries: [(node_name, priority, Pod)]."""
         with self._encode_lock:
-            return self._encoder.with_nominated(ct, meta, entries)
+            return self._encoder.with_nominated(ct, meta, entries,
+                                                min_m=min_m)
 
     def get_node(self, name: str) -> Optional[Node]:
         """Cheap single-node lookup (binder-side volume labels); avoids a

@@ -225,12 +225,19 @@ class Scheduler:
         if entries:
             # nominees OUTSIDE this batch hold their reservation tensor-side;
             # nominees inside it are protected by the gang rank order instead
-            ct = self.cache.overlay_nominated(ct, meta, entries)
+            # pin the reservation bucket: nominee counts vary per cycle
+            # and every new M is a fresh gang compile mid-storm
+            ct = self.cache.overlay_nominated(ct, meta, entries,
+                                              min_m=DRAIN_NOM_BUCKET)
         with TRACER.span("scheduler/encode_pods", pods=len(pods)):
             # placement-time view: the profile's addedAffinity folds into
-            # the encoded terms; assume/bind/requeue keep the ORIGINAL pod
+            # the encoded terms; assume/bind/requeue keep the ORIGINAL pod.
+            # min_p pins the batch bucket to ONE compiled width: failure
+            # re-pops arrive in ragged sizes (1..batch) and per-size
+            # buckets each recompile the gang program
             pb = self.cache.encode_pods(
-                profile.apply_added_affinity(pods), meta)
+                profile.apply_added_affinity(pods), meta,
+                min_p=self.cfg.batch_size)
         ext_mask = ext_scores = None
         ext_errors: set = set()
         if self._extenders:

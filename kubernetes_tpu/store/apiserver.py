@@ -701,8 +701,8 @@ class APIServer:
                     return None
                 base, _pod = ep
                 from urllib.parse import urlsplit
-                from kubernetes_tpu.kubelet.server import (_splice_sockets,
-                                                           connect_upgrade)
+                from kubernetes_tpu.kubelet.server import (connect_upgrade,
+                                                           splice_upgraded)
                 parts = urlsplit(base)
                 try:
                     # dial the kubelet FIRST: an unreachable/stale endpoint
@@ -718,16 +718,7 @@ class APIServer:
                 self.send_header("Connection", "Upgrade")
                 self.end_headers()
                 self.wfile.flush()
-                try:
-                    if leftover:
-                        self.connection.sendall(leftover)
-                    _splice_sockets(self.connection, upstream)
-                except OSError:
-                    for sk in (self.connection, upstream):
-                        try:
-                            sk.close()
-                        except OSError:
-                            pass
+                splice_upgraded(self.connection, upstream, leftover)
                 self.close_connection = True
                 return None
 

@@ -220,8 +220,10 @@ class RaftNode:
             base = self._log_base
             entries = [e for t, e in self._log
                        if int(e["rv"]) > match]
-            if match and match < base:
-                entries = None  # fell out of the window: snapshot them
+            if match < base:
+                # behind the log window (including a fresh empty follower
+                # against a log whose base predates it): snapshot
+                entries = None
             prev = match
         if entries is None:
             self._send_snapshot(peer_id)

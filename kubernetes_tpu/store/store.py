@@ -312,11 +312,17 @@ class ObjectStore:
                 self._rv = rv
                 if kind == "Service":
                     self._reseed_service_ips_locked()
+                # journal like a local write: quorum-acked entries must be
+                # WAL-durable on FOLLOWERS too, and the journal tap is how
+                # a follower's raft log stays populated (a promoted leader
+                # with an empty log would force a snapshot storm)
+                self._journal_locked(entry)
                 self._emit_locked(kind, Event(
                     MODIFIED if existed else ADDED, entry["obj"], rv))
             else:
                 old = space.pop(key, None)
                 self._rv = rv
+                self._journal_locked(entry)
                 if old is not None:
                     self._emit_locked(kind, Event(DELETED, old, rv))
 
@@ -329,22 +335,14 @@ class ObjectStore:
     def load_snapshot_blob(self, blob: dict) -> None:
         """Full-state resync (a follower too far behind the leader's
         replication window, or a rejoining ex-leader with a divergent
-        uncommitted suffix). Watch histories reset AND live watch streams
-        are invalidated (ERROR event -> informers relist) — exactly the
-        load() contract: a stream that silently missed the snapshot delta
-        would retain phantoms forever."""
+        uncommitted suffix) — the load() contract: live watch streams are
+        invalidated (ERROR event -> informers relist), since a stream that
+        silently missed the snapshot delta would retain phantoms forever."""
         with self._lock:
-            self._data = {kind: {tuple(obj_key(o)): o for o in objs}
-                          for kind, objs in blob["data"].items()}
-            self._rv = int(blob["rv"])
-            self._history.clear()
-            self._compacted = {}
-            self._floor_rv = self._rv
-            for qs in self._watchers.values():
-                for q in qs:
-                    q.put(Event(ERROR, {}, self._rv))
-            self._watchers = {}
-            self._reseed_service_ips_locked()
+            self._install_state_locked(
+                int(blob["rv"]),
+                {kind: {tuple(obj_key(o)): o for o in objs}
+                 for kind, objs in blob["data"].items()})
 
     # ---- CRUD ------------------------------------------------------------
 
@@ -559,27 +557,33 @@ class ObjectStore:
         with open(path) as f:
             data = json.load(f)
         with self._lock:
-            self._rv = data["rv"]
-            self._data = {kind: {obj_key(o): o for o in objs}
-                          for kind, objs in data["data"].items()}
-            self._history.clear()
-            # No replay history survives a checkpoint restore: every kind —
-            # including kinds absent from the blob — is compacted up to the
-            # restored rv, so stale watchers get TooOld and relist instead of
-            # silently missing pre-restore events.
-            self._compacted = {}
-            self._floor_rv = self._rv
-            # Live watch streams are invalidated too: they'd otherwise keep
-            # receiving post-restore events while missing the restore delta
-            # (e.g. an object absent from the blob never emits DELETED, so a
-            # connected informer would retain it as a phantom forever).
-            for qs in self._watchers.values():
-                for q in qs:
-                    q.put(Event(ERROR, {}, self._rv))
-            self._watchers = {}
-            if self._wal is not None:
-                # re-sync durable state with the explicitly loaded blob
-                self._compact_wal_locked()
+            self._install_state_locked(
+                data["rv"], {kind: {obj_key(o): o for o in objs}
+                             for kind, objs in data["data"].items()})
+
+    def _install_state_locked(self, rv: int, data: dict) -> None:
+        """Replace the whole store state (checkpoint restore / replication
+        snapshot install). No replay history survives: every kind —
+        including kinds absent from the blob — is compacted up to the
+        installed rv, so stale watchers get TooOld and relist instead of
+        silently missing pre-install events. Live watch streams are
+        invalidated too (an object absent from the blob never emits
+        DELETED; a connected informer would retain it as a phantom
+        forever), the ClusterIP allocator re-seeds past installed
+        Services, and durable stores fold the new state into the
+        snapshot file."""
+        self._rv = rv
+        self._data = data
+        self._history.clear()
+        self._compacted = {}
+        self._floor_rv = self._rv
+        for qs in self._watchers.values():
+            for q in qs:
+                q.put(Event(ERROR, {}, self._rv))
+        self._watchers = {}
+        self._reseed_service_ips_locked()
+        if self._wal is not None:
+            self._compact_wal_locked()
 
     def close(self):
         with self._lock:

@@ -439,3 +439,35 @@ def test_endpointslice_created_and_sliced(client):
             .get("kubernetes.io/service-name") == "web"])
     finally:
         stop(ctrl, factory)
+
+
+# ------------------------------------------------------- root CA publisher
+
+def test_root_ca_published_to_every_namespace(client):
+    from kubernetes_tpu.controllers.rootca import (CONFIGMAP_NAME,
+                                                   RootCAPublisher)
+    nss = client.resource("namespaces", None)
+    nss.create({"kind": "Namespace", "metadata": {"name": "team-a"}})
+    ctrl, factory = run_controller(
+        client, RootCAPublisher(client, ca_pem="PEM-BUNDLE"))
+    try:
+        def published(ns):
+            try:
+                cm = client.resource("configmaps", ns).get(CONFIGMAP_NAME)
+            except ApiError:
+                return False
+            return cm.get("data", {}).get("ca.crt") == "PEM-BUNDLE"
+        assert wait_until(lambda: published("team-a"))
+        # a later namespace gets the bundle too
+        nss.create({"kind": "Namespace", "metadata": {"name": "team-b"}})
+        assert wait_until(lambda: published("team-b"))
+        # drift heals
+        cm = client.resource("configmaps", "team-a").get(CONFIGMAP_NAME)
+        cm["data"] = {"ca.crt": "tampered"}
+        client.resource("configmaps", "team-a").update(cm)
+        assert wait_until(lambda: published("team-a"))
+        # deletion heals
+        client.resource("configmaps", "team-b").delete(CONFIGMAP_NAME)
+        assert wait_until(lambda: published("team-b"))
+    finally:
+        stop(ctrl, factory)

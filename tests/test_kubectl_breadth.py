@@ -167,3 +167,23 @@ def test_debug_traces_and_stacks(cluster):
         text = r.read().decode()
     assert "thread " in text
     assert "dump_stacks" in text  # the serving frame itself is in the dump
+
+
+def test_top_nodes_and_pods(cluster):
+    server, client = cluster
+    out = io.StringIO()
+    assert main(["--server", server.url, "top", "nodes"], out=out) == 0
+    text = out.getvalue()
+    assert "kn-1" in text and "CPU(req)" in text
+    out = io.StringIO()
+    assert main(["--server", server.url, "top", "pods", "-A"], out=out) == 0
+    assert "app" in out.getvalue()
+
+
+def test_kubelet_metrics_endpoint(cluster):
+    server, client = cluster
+    node = client.nodes().get("kn-1")
+    port = node["status"]["daemonEndpoints"]["kubeletEndpoint"]["Port"]
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+        text = r.read().decode()
+    assert "# TYPE" in text  # Prometheus exposition

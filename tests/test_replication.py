@@ -176,12 +176,48 @@ def test_apiserver_over_replicated_store():
     finally:
         if api is not None:
             try:
-                api._server_close_keep_store = True  # don't close raft store
-            except Exception:
-                pass
-            try:
                 api.stop()
             except Exception:
                 pass
+        for nd in nodes:
+            nd.stop()
+
+
+def test_replicated_writes_are_wal_durable_on_followers(tmp_path):
+    """Quorum-acked entries must survive a follower restart from ITS OWN
+    disk — replication without follower durability would lose acknowledged
+    writes if the leader's disk died (the etcd contract is quorum of
+    DISKS, not of memories)."""
+    import socket as _socket
+    socks, ports = [], []
+    for _ in range(3):
+        sk = _socket.socket()
+        sk.bind(("127.0.0.1", 0))
+        ports.append(sk.getsockname()[1])
+        socks.append(sk)
+    for sk in socks:
+        sk.close()
+    nodes = []
+    dirs = [str(tmp_path / f"d{i}") for i in range(3)]
+    for i in range(3):
+        peers = {f"n{j}": f"http://127.0.0.1:{ports[j]}"
+                 for j in range(3) if j != i}
+        nodes.append(RaftNode(f"n{i}", ObjectStore(data_dir=dirs[i]),
+                              peers, port=ports[i]))
+    try:
+        leader = _leader(nodes)
+        ReplicatedStore(leader).create("ConfigMap", _cm("durable"))
+        follower = next(nd for nd in nodes if nd is not leader)
+        f_idx = nodes.index(follower)
+        assert wait_until(lambda: any(
+            o["metadata"]["name"] == "durable"
+            for o in follower.store.list("ConfigMap")[0]))
+        follower.store.close()
+        # a fresh process restoring the follower's disk sees the write
+        restored = ObjectStore(data_dir=dirs[f_idx])
+        objs, _ = restored.list("ConfigMap")
+        assert any(o["metadata"]["name"] == "durable" for o in objs), objs
+        restored.close()
+    finally:
         for nd in nodes:
             nd.stop()
