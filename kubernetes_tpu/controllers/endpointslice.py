@@ -94,10 +94,17 @@ class EndpointSliceController(Controller):
         ns, name = split_key(key)
         svc = self.svc_informer.store.get(key)
         handle = self.client.resource("endpointslices", ns)
-        existing = [s for s in self.slice_informer.store.list()
-                    if (s.get("metadata") or {}).get("namespace", "") == ns
-                    and ((s.get("metadata") or {}).get("labels") or {})
-                    .get(SERVICE_NAME_LABEL) == name]
+        existing = [
+            s for s in self.slice_informer.store.list()
+            if (s.get("metadata") or {}).get("namespace", "") == ns
+            and ((s.get("metadata") or {}).get("labels") or {})
+            .get(SERVICE_NAME_LABEL) == name
+            # slices another manager owns (the mirroring controller's) are
+            # not this controller's to reconcile or delete
+            and ((s.get("metadata") or {}).get("labels") or {})
+            .get("endpointslice.kubernetes.io/managed-by",
+                 "endpointslice-controller.k8s.io")
+            == "endpointslice-controller.k8s.io"]
         if svc is None or not (svc.get("spec") or {}).get("selector"):
             for s in existing:
                 try:

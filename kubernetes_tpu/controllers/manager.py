@@ -18,6 +18,8 @@ from kubernetes_tpu.controllers.deployment import DeploymentController
 from kubernetes_tpu.controllers.disruption import DisruptionController
 from kubernetes_tpu.controllers.endpoints import EndpointsController
 from kubernetes_tpu.controllers.endpointslice import EndpointSliceController
+from kubernetes_tpu.controllers.endpointslicemirroring import (
+    EndpointSliceMirroringController)
 from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
 from kubernetes_tpu.controllers.hpa import HorizontalPodAutoscalerController
 from kubernetes_tpu.controllers.job import JobController
@@ -57,7 +59,7 @@ DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "daemonset",
                        "resourceclaim", "replicationcontroller", "podgc",
                        "resourcequota", "ttl", "clusterroleaggregation",
                        "csrsigning", "ephemeral", "attachdetach",
-                       "root-ca-cert-publisher")
+                       "root-ca-cert-publisher", "endpointslicemirroring")
 # Cloud-provider loops (upstream: cloud-controller-manager / kcm flags):
 # opt-in by name — "nodeipam" needs --cluster-cidr semantics, "route" and
 # "service-lb" a cloud. cli/cluster.py enables them for cluster-up.
@@ -103,6 +105,7 @@ class ControllerManager:
             "nodeipam": NodeIpamController,
             "ephemeral": EphemeralVolumeController,
             "root-ca-cert-publisher": RootCAPublisher,
+            "endpointslicemirroring": EndpointSliceMirroringController,
             "service-lb": ServiceLBController,
             "route": RouteController,
         }

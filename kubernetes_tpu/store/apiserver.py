@@ -282,7 +282,20 @@ class APIServer:
 
     # ---- lifecycle -------------------------------------------------------
 
+    SYSTEM_NAMESPACES = ("default", "kube-system", "kube-public",
+                         "kube-node-lease")
+
     def start(self):
+        # the system namespaces always exist (pkg/controlplane's
+        # SystemNamespaces controller creates them on startup): namespaced
+        # controllers like the root-CA publisher key off Namespace objects
+        for ns in self.SYSTEM_NAMESPACES:
+            try:
+                self.store.create("Namespace", {
+                    "kind": "Namespace", "metadata": {"name": ns},
+                    "status": {"phase": "Active"}})
+            except AlreadyExists:
+                pass
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()

@@ -386,9 +386,8 @@ def test_sa_token_authenticates_against_apiserver():
         # mint what the token controller would have (no controllers here:
         # exercise only the authn path over the minted secret)
         admin = HTTPClient(server.url)  # anonymous allowed by default authn?
-        server.store.create("Namespace", {
-            "apiVersion": "v1", "kind": "Namespace",
-            "metadata": {"name": "default"}})
+        # (the "default" Namespace now always exists: the apiserver seeds
+        # the system namespaces at start, like pkg/controlplane)
         server.store.create("Secret", {
             "apiVersion": "v1", "kind": "Secret",
             "metadata": {"name": "robot-token", "namespace": "default",
@@ -471,3 +470,94 @@ def test_root_ca_published_to_every_namespace(client):
         assert wait_until(lambda: published("team-b"))
     finally:
         stop(ctrl, factory)
+
+
+# ----------------------------------------------- endpointslice mirroring
+
+def test_mirrors_custom_endpoints_for_selectorless_service(client):
+    from kubernetes_tpu.controllers.endpointslicemirroring import (
+        EndpointSliceMirroringController)
+    svcs = client.resource("services", "default")
+    eps = client.resource("endpoints", "default")
+    slices = client.resource("endpointslices", "default")
+    # selector-LESS service with hand-maintained Endpoints (external DB)
+    svcs.create({"kind": "Service", "metadata": {"name": "ext-db"},
+                 "spec": {"clusterIP": "10.96.5.1",
+                          "ports": [{"port": 5432}]}})
+    eps.create({"kind": "Endpoints", "metadata": {"name": "ext-db"},
+                "subsets": [{"addresses": [{"ip": "192.0.2.10"}],
+                             "notReadyAddresses": [{"ip": "192.0.2.11"}],
+                             "ports": [{"port": 5432,
+                                        "protocol": "TCP"}]}]})
+    # selector-DRIVEN service: its endpoints must NOT be mirrored
+    svcs.create({"kind": "Service", "metadata": {"name": "managed"},
+                 "spec": {"clusterIP": "10.96.5.2",
+                          "selector": {"app": "x"},
+                          "ports": [{"port": 80}]}})
+    eps.create({"kind": "Endpoints", "metadata": {"name": "managed"},
+                "subsets": [{"addresses": [{"ip": "10.88.0.1"}],
+                             "ports": [{"port": 80}]}]})
+    ctrl, factory = run_controller(
+        client, EndpointSliceMirroringController(client))
+    try:
+        def mirrored():
+            try:
+                return slices.get("ext-db-mirror-0")
+            except ApiError:
+                return None
+        assert wait_until(mirrored)
+        sl = mirrored()
+        ready = [e for e in sl["endpoints"] if e["conditions"]["ready"]]
+        notready = [e for e in sl["endpoints"]
+                    if not e["conditions"]["ready"]]
+        assert [e["addresses"] for e in ready] == [["192.0.2.10"]]
+        assert [e["addresses"] for e in notready] == [["192.0.2.11"]]
+        assert sl["ports"] == [{"name": "", "port": 5432,
+                                "protocol": "TCP"}]
+        time.sleep(0.3)
+        with pytest.raises(ApiError):
+            slices.get("managed-mirror-0")  # selector-driven: not mirrored
+        # endpoints update flows through; endpoints delete removes mirror
+        ep = eps.get("ext-db")
+        ep["subsets"][0]["addresses"].append({"ip": "192.0.2.12"})
+        eps.update(ep)
+        assert wait_until(lambda: len(mirrored()["endpoints"]) == 3)
+        eps.delete("ext-db")
+        assert wait_until(lambda: mirrored() is None)
+    finally:
+        stop(ctrl, factory)
+
+
+def test_mirroring_and_endpointslice_controllers_coexist(client):
+    """Both default controllers running: the endpointslice controller must
+    NOT delete the mirroring controller's slices for selector-less
+    Services (they carry a foreign managed-by label)."""
+    from kubernetes_tpu.controllers.endpointslicemirroring import (
+        EndpointSliceMirroringController)
+    svcs = client.resource("services", "default")
+    eps = client.resource("endpoints", "default")
+    slices = client.resource("endpointslices", "default")
+    svcs.create({"kind": "Service", "metadata": {"name": "xdb"},
+                 "spec": {"clusterIP": "10.96.5.9",
+                          "ports": [{"port": 5432}]}})
+    eps.create({"kind": "Endpoints", "metadata": {"name": "xdb"},
+                "subsets": [{"addresses": [{"ip": "192.0.2.20"}],
+                             "ports": [{"port": 5432}]}]})
+    c1, f1 = run_controller(client, EndpointSliceMirroringController(client))
+    c2, f2 = run_controller(client, EndpointSliceController(client))
+    try:
+        def mirror():
+            try:
+                return slices.get("xdb-mirror-0")
+            except ApiError:
+                return None
+        assert wait_until(mirror)
+        # poke the endpointslice controller's sync for this service and
+        # give it time to (wrongly) delete; the mirror must survive
+        c2.queue.add("default/xdb")
+        time.sleep(0.5)
+        assert mirror() is not None, \
+            "endpointslice controller deleted the mirror slice"
+    finally:
+        stop(c1, f1)
+        stop(c2, f2)
