@@ -18,6 +18,8 @@ from kubernetes_tpu.controllers.base import Controller, split_key
 from kubernetes_tpu.controllers.endpoints import _resolve_target_port
 
 SERVICE_NAME_LABEL = "kubernetes.io/service-name"
+MANAGED_BY_LABEL = "endpointslice.kubernetes.io/managed-by"
+MANAGED_BY = "endpointslice-controller.k8s.io"
 MAX_ENDPOINTS_PER_SLICE = 100
 
 
@@ -83,7 +85,8 @@ class EndpointSliceController(Controller):
                     "apiVersion": "discovery.k8s.io/v1",
                     "kind": "EndpointSlice",
                     "metadata": {"name": f"{name}-{idx}", "namespace": ns,
-                                 "labels": {SERVICE_NAME_LABEL: name}},
+                                 "labels": {SERVICE_NAME_LABEL: name,
+                                            MANAGED_BY_LABEL: MANAGED_BY}},
                     "addressType": "IPv4",
                     "ports": g["ports"],
                     "endpoints": eps[off:off + MAX_ENDPOINTS_PER_SLICE]})
@@ -99,12 +102,11 @@ class EndpointSliceController(Controller):
             if (s.get("metadata") or {}).get("namespace", "") == ns
             and ((s.get("metadata") or {}).get("labels") or {})
             .get(SERVICE_NAME_LABEL) == name
-            # slices another manager owns (the mirroring controller's) are
-            # not this controller's to reconcile or delete
+            # only slices THIS controller stamped are its to reconcile or
+            # delete: a foreign manager's mirrors and a user's hand-made
+            # unlabeled slices are both left alone (upstream contract)
             and ((s.get("metadata") or {}).get("labels") or {})
-            .get("endpointslice.kubernetes.io/managed-by",
-                 "endpointslice-controller.k8s.io")
-            == "endpointslice-controller.k8s.io"]
+            .get(MANAGED_BY_LABEL) == MANAGED_BY]
         if svc is None or not (svc.get("spec") or {}).get("selector"):
             for s in existing:
                 try:

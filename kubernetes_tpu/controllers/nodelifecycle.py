@@ -45,6 +45,7 @@ class NodeLifecycleController(Controller):
         self._monitor: threading.Thread | None = None
 
     def register(self, factory: InformerFactory) -> None:
+        self.lease_informer = factory.informer("leases", None)
         self.node_informer = factory.informer("nodes", None)
         self.node_informer.add_event_handler(self.handler())
         self.pod_informer = factory.informer("pods", None)
@@ -62,6 +63,23 @@ class NodeLifecycleController(Controller):
 
     # ---- monitorNodeHealth ----------------------------------------------
 
+    def _lease_renew_time(self, node_name: str):
+        """renewTime of the node's kube-node-lease Lease, if any — lease
+        renewal counts as a heartbeat (monitorNodeHealth's probeTimestamp
+        advances on lease updates; upstream kubelets renew every 10s while
+        touching node STATUS only 5-minutely)."""
+        inf = getattr(self, "lease_informer", None)
+        if inf is None:
+            return None
+        lease = inf.store.get(f"kube-node-lease/{node_name}")
+        if lease is None:
+            return None
+        rt = (lease.get("spec") or {}).get("renewTime")
+        try:
+            return float(rt)
+        except (TypeError, ValueError):
+            return None
+
     def _wanted_taint(self, node: dict) -> str | None:
         cond = _ready_condition(node)
         if cond is None:
@@ -69,9 +87,16 @@ class NodeLifecycleController(Controller):
         if cond.get("status") == "False":
             return TAINT_NOT_READY
         hb = cond.get("lastHeartbeatTime")
-        if hb is not None and time.time() - float(hb) > self.grace_period:
+        renew = self._lease_renew_time(
+            (node.get("metadata") or {}).get("name", ""))
+        candidates = [renew]
+        if hb is not None:
+            candidates.append(float(hb))
+        latest = max([t for t in candidates if t is not None],
+                     default=None)
+        if latest is not None and time.time() - latest > self.grace_period:
             return TAINT_UNREACHABLE
-        if cond.get("status") == "Unknown":
+        if cond.get("status") == "Unknown" and renew is None:
             return TAINT_UNREACHABLE
         return None
 

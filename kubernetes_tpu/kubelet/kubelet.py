@@ -165,9 +165,38 @@ class Kubelet:
             except ApiError:
                 pass
 
+    def _renew_lease(self):
+        """The kubelet's cheap heartbeat (pkg/kubelet/nodelease): a Lease in
+        kube-node-lease renewed every period — node-lifecycle treats a
+        fresh renewTime as liveness even when the status heartbeat lags
+        (status updates are 5-minutely upstream; leases are the signal).
+        Never raises: a throttled/conflicted renewal (APF 429, rv race) is
+        simply dropped until the next period — surfacing it would be
+        misread as the node having vanished (heartbeat_once re-registers on
+        ApiError) or kill a kubemark driver thread."""
+        leases = self.client.leases("kube-node-lease")
+        try:
+            try:
+                lease = leases.get(self.node_name)
+                lease.setdefault("spec", {})["renewTime"] = time.time()
+                leases.update(lease)
+            except ApiError as e:
+                if e.code != 404:
+                    return
+                leases.create({
+                    "kind": "Lease",
+                    "metadata": {"name": self.node_name,
+                                 "namespace": "kube-node-lease"},
+                    "spec": {"holderIdentity": self.node_name,
+                             "leaseDurationSeconds": 40,
+                             "renewTime": time.time()}})
+        except ApiError:
+            return
+
     def _heartbeat_loop(self):
         while not self._stop.wait(self.heartbeat_period):
             self.heartbeat_once()
+            self._renew_lease()
 
     # ---- syncLoop --------------------------------------------------------
 

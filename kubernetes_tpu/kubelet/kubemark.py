@@ -103,6 +103,7 @@ class HollowCluster:
                 if self._stop.is_set():
                     return
                 kubelet.kubelet.heartbeat_once()
+                kubelet.kubelet._renew_lease()
                 budget = self.heartbeat_period / max(1, len(shard))
                 self._stop.wait(max(0.0, budget - 0.001))
             leftover = self.heartbeat_period - (time.time() - t0)

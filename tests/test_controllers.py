@@ -540,3 +540,54 @@ def test_endpoints_named_targetport_resolved_per_pod(client):
     finally:
         ctrl.stop()
         factory.stop_all()
+
+
+def test_node_lease_renewal_counts_as_heartbeat():
+    """pkg/kubelet/nodelease: a fresh Lease renewTime keeps a node healthy
+    even when the status heartbeat is stale (upstream kubelets renew
+    leases every 10s but touch node status only 5-minutely); a node with
+    BOTH stale is tainted unreachable."""
+    import time as _time
+    from kubernetes_tpu.client.clientset import DirectClient
+    from kubernetes_tpu.client.informer import InformerFactory
+    from kubernetes_tpu.controllers.nodelifecycle import (
+        TAINT_UNREACHABLE, NodeLifecycleController)
+    from kubernetes_tpu.store.store import ObjectStore
+    client = DirectClient(ObjectStore())
+    stale = _time.time() - 3600
+    for name, lease_fresh in (("leasey", True), ("deady", False)):
+        client.nodes().create({
+            "kind": "Node", "metadata": {"name": name},
+            "spec": {},
+            "status": {"conditions": [{
+                "type": "Ready", "status": "True",
+                "lastHeartbeatTime": stale}]}})
+        if lease_fresh:
+            client.leases("kube-node-lease").create({
+                "kind": "Lease",
+                "metadata": {"name": name,
+                             "namespace": "kube-node-lease"},
+                "spec": {"holderIdentity": name,
+                         "renewTime": _time.time()}})
+    ctrl = NodeLifecycleController(client, grace_period=5.0,
+                                   monitor_period=0.2)
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    try:
+        def taints(name):
+            return [t.get("key") for t in
+                    (client.nodes().get(name).get("spec") or {})
+                    .get("taints") or []]
+        deadline = _time.time() + 8
+        while _time.time() < deadline:
+            if TAINT_UNREACHABLE in taints("deady"):
+                break
+            _time.sleep(0.1)
+        assert TAINT_UNREACHABLE in taints("deady")
+        assert TAINT_UNREACHABLE not in taints("leasey")  # lease saved it
+    finally:
+        ctrl.stop()
+        factory.stop_all()
