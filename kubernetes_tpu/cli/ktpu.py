@@ -458,6 +458,66 @@ def cmd_top(client: HTTPClient, args, out) -> int:
     return 0
 
 
+def _kv_edits(pairs: list) -> tuple[dict, list]:
+    """['k=v', 'gone-'] -> ({k: v}, [gone]) — kubectl label/annotate
+    syntax (trailing '-' removes)."""
+    sets, removes = {}, []
+    for p in pairs:
+        if p.endswith("-") and "=" not in p:
+            removes.append(p[:-1])
+        elif "=" in p:
+            k, _, v = p.partition("=")
+            sets[k] = v
+        else:
+            raise SystemExit(f"invalid pair {p!r} (want k=v or k-)")
+    return sets, removes
+
+
+def cmd_label(client: HTTPClient, args, out, field: str = "labels") -> int:
+    """kubectl label/annotate: read-modify-write with the rv precondition
+    (--overwrite required to change an existing key, like kubectl)."""
+    plural = resolve_plural(args.resource, client)
+    res = client.resource(plural, args.namespace)
+    obj = res.get(args.name)
+    sets, removes = _kv_edits(args.pairs)
+    md = obj.setdefault("metadata", {})
+    cur = md.setdefault(field, {})
+    if not args.overwrite:
+        clashes = [k for k, v in sets.items()
+                   if k in cur and cur[k] != v]
+        if clashes:
+            out.write(f"error: {clashes[0]!r} already has a value; "
+                      "use --overwrite\n")
+            return 1
+    cur.update(sets)
+    for k in removes:
+        cur.pop(k, None)
+    res.update(obj)
+    kind, _ns = _kind_info(client, plural)
+    verb = "labeled" if field == "labels" else "annotated"
+    out.write(f"{kind.lower()}/{args.name} {verb}\n")
+    return 0
+
+
+def cmd_api_resources(client: HTTPClient, args, out) -> int:
+    """kubectl api-resources: the serving table, CRDs included."""
+    from kubernetes_tpu.store.apiserver import ALL_RESOURCES
+    out.write(f"{'NAME':<36}{'KIND':<34}{'NAMESPACED':<10}\n")
+    rows = sorted(ALL_RESOURCES.items())
+    try:
+        client.discover_custom()
+        custom = getattr(client, "_custom", {}) or {}
+        rows += sorted((p, info) for p, info in custom.items()
+                       if p not in ALL_RESOURCES)
+    except Exception:
+        pass
+    for plural, info in rows:
+        kind, namespaced = info[0], info[1]
+        out.write(f"{plural:<36}{kind:<34}"
+                  f"{str(bool(namespaced)).lower():<10}\n")
+    return 0
+
+
 REVISION_ANNOTATION = "deployment.kubernetes.io/revision"
 
 
@@ -581,6 +641,19 @@ def build_parser() -> argparse.ArgumentParser:
     pf.add_argument("--one-shot", action="store_true",
                     help="serve a single connection then exit")
 
+    for nm in ("label", "annotate"):
+        lb = sub.add_parser(nm)
+        lb.add_argument("resource")
+        lb.add_argument("name")
+        lb.add_argument("pairs", nargs="+", help="k=v ... or k- to remove")
+        lb.add_argument("--overwrite", action="store_true")
+
+    sub.add_parser("api-resources")
+
+    at = sub.add_parser("attach")  # kubectl attach ~ exec without command
+    at.add_argument("name")
+    at.add_argument("-c", "--container", default=None)
+
     tp = sub.add_parser("top")
     tp.add_argument("resource", choices=["nodes", "pods"])
     tp.add_argument("-A", "--all-namespaces", action="store_true")
@@ -632,6 +705,19 @@ def main(argv=None, out=None) -> int:
             return cmd_port_forward(client, args, out)
         if args.cmd == "top":
             return cmd_top(client, args, out)
+        if args.cmd == "label":
+            return cmd_label(client, args, out, field="labels")
+        if args.cmd == "annotate":
+            return cmd_label(client, args, out, field="annotations")
+        if args.cmd == "api-resources":
+            return cmd_api_resources(client, args, out)
+        if args.cmd == "attach":
+            # attach to the main container's stream: the hollow runtime has
+            # no live stdout stream, so attach surfaces the current logs
+            # (the closest observable analog of the attached terminal)
+            out.write(client.pod_logs(args.namespace, args.name,
+                                      container=args.container or ""))
+            return 0
         if args.cmd == "rollout":
             args.name = args.kind_name.split("/", 1)[-1]
             return cmd_rollout(client, args, out)

@@ -45,7 +45,11 @@ from kubernetes_tpu.controllers.statefulset import StatefulSetController
 from kubernetes_tpu.controllers.attachdetach import AttachDetachController
 from kubernetes_tpu.controllers.ephemeral import EphemeralVolumeController
 from kubernetes_tpu.controllers.nodeipam import NodeIpamController
+from kubernetes_tpu.controllers.csrlifecycle import (CSRApprovingController,
+                                                     CSRCleanerController)
 from kubernetes_tpu.controllers.rootca import RootCAPublisher
+from kubernetes_tpu.controllers.volumeprotection import (
+    PVCProtectionController, PVProtectionController)
 from kubernetes_tpu.controllers.route import RouteController
 from kubernetes_tpu.controllers.servicelb import ServiceLBController
 from kubernetes_tpu.controllers.ttl import TTLController
@@ -59,7 +63,9 @@ DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "daemonset",
                        "resourceclaim", "replicationcontroller", "podgc",
                        "resourcequota", "ttl", "clusterroleaggregation",
                        "csrsigning", "ephemeral", "attachdetach",
-                       "root-ca-cert-publisher", "endpointslicemirroring")
+                       "root-ca-cert-publisher", "endpointslicemirroring",
+                       "pvc-protection", "pv-protection", "csrapproving",
+                       "csrcleaner")
 # Cloud-provider loops (upstream: cloud-controller-manager / kcm flags):
 # opt-in by name — "nodeipam" needs --cluster-cidr semantics, "route" and
 # "service-lb" a cloud. cli/cluster.py enables them for cluster-up.
@@ -106,6 +112,10 @@ class ControllerManager:
             "ephemeral": EphemeralVolumeController,
             "root-ca-cert-publisher": RootCAPublisher,
             "endpointslicemirroring": EndpointSliceMirroringController,
+            "pvc-protection": PVCProtectionController,
+            "pv-protection": PVProtectionController,
+            "csrapproving": CSRApprovingController,
+            "csrcleaner": CSRCleanerController,
             "service-lb": ServiceLBController,
             "route": RouteController,
         }

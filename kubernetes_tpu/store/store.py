@@ -468,7 +468,26 @@ class ObjectStore:
             rv = self._bump_locked()
             if not owned:
                 obj = json.loads(json.dumps(obj))
-            obj.setdefault("metadata", {})["resourceVersion"] = str(rv)
+            md = obj.setdefault("metadata", {})
+            md["resourceVersion"] = str(rv)
+            # deletionTimestamp is SERVER-owned and sticky (apimachinery:
+            # immutable once set): carry the stored value — a payload can
+            # neither resurrect a terminating object by dropping it nor
+            # destroy a live one by injecting it (which would bypass the
+            # delete reactors and admission)
+            cur_dt = (current.get("metadata") or {}).get("deletionTimestamp")
+            if cur_dt is not None:
+                md["deletionTimestamp"] = cur_dt
+            else:
+                md.pop("deletionTimestamp", None)
+            if md.get("deletionTimestamp") and not md.get("finalizers"):
+                # the last finalizer just came off a terminating object:
+                # the update COMPLETES the graceful delete
+                space.pop(k, None)
+                self._journal_locked({"op": "del", "kind": kind,
+                                      "ns": k[0], "name": k[1], "rv": rv})
+                self._emit_locked(kind, Event(DELETED, obj, rv))
+                return fastcopy(obj)
             space[k] = obj
             self._journal_locked({"op": "set", "kind": kind, "ns": k[0],
                                   "name": k[1], "rv": rv, "obj": obj})
@@ -515,11 +534,34 @@ class ObjectStore:
         return out
 
     def delete(self, kind: str, namespace: str, name: str) -> dict:
+        """Finalizer-aware deletion (apimachinery's graceful-deletion
+        contract, ``registry.Store.Delete``): an object carrying
+        ``metadata.finalizers`` is not removed — it gets a
+        ``deletionTimestamp`` and persists (MODIFIED event) until the last
+        finalizer is removed by whoever owns it (an update() dropping the
+        final finalizer of a terminating object completes the delete).
+        Objects without finalizers are removed immediately, as before."""
+        import time as _time
         with self._lock:
             k = (namespace or "", name)
             space = self._data.setdefault(kind, {})
             if k not in space:
                 raise NotFound(f"{kind} {namespace}/{name}")
+            cur = space[k]
+            md = cur.get("metadata") or {}
+            if md.get("finalizers"):
+                if md.get("deletionTimestamp"):
+                    return fastcopy(cur)  # already terminating
+                obj = fastcopy(cur)
+                rv = self._bump_locked()
+                obj["metadata"]["deletionTimestamp"] = _time.time()
+                obj["metadata"]["resourceVersion"] = str(rv)
+                space[k] = obj
+                self._journal_locked({"op": "set", "kind": kind,
+                                      "ns": k[0], "name": k[1], "rv": rv,
+                                      "obj": obj})
+                self._emit_locked(kind, Event(MODIFIED, obj, rv))
+                return fastcopy(obj)
             obj = fastcopy(space.pop(k))
             rv = self._bump_locked()
             obj["metadata"]["resourceVersion"] = str(rv)

@@ -187,3 +187,44 @@ def test_kubelet_metrics_endpoint(cluster):
     with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as r:
         text = r.read().decode()
     assert "# TYPE" in text  # Prometheus exposition
+
+
+def test_label_annotate_and_api_resources(cluster):
+    server, client = cluster
+    out = io.StringIO()
+    assert main(["--server", server.url, "label", "pods", "app",
+                 "tier=web", "color=blue"], out=out) == 0
+    got = client.pods("default").get("app")["metadata"]["labels"]
+    assert got["tier"] == "web" and got["color"] == "blue"
+    # changing an existing value needs --overwrite, like kubectl
+    out = io.StringIO()
+    assert main(["--server", server.url, "label", "pods", "app",
+                 "tier=db"], out=out) == 1
+    assert "--overwrite" in out.getvalue()
+    assert main(["--server", server.url, "label", "pods", "app",
+                 "tier=db", "--overwrite"], out=io.StringIO()) == 0
+    assert client.pods("default").get("app")["metadata"]["labels"][
+        "tier"] == "db"
+    # key- removes
+    assert main(["--server", server.url, "label", "pods", "app",
+                 "color-"], out=io.StringIO()) == 0
+    assert "color" not in client.pods("default").get("app")["metadata"][
+        "labels"]
+    # annotate rides the same machinery
+    assert main(["--server", server.url, "annotate", "pods", "app",
+                 "note=hi"], out=io.StringIO()) == 0
+    assert client.pods("default").get("app")["metadata"]["annotations"][
+        "note"] == "hi"
+    # api-resources lists the serving table
+    out = io.StringIO()
+    assert main(["--server", server.url, "api-resources"], out=out) == 0
+    text = out.getvalue()
+    assert "pods" in text and "volumeattachments" in text
+    assert "NAMESPACED" in text
+
+
+def test_attach_streams_container_output(cluster):
+    server, client = cluster
+    out = io.StringIO()
+    assert main(["--server", server.url, "attach", "app"], out=out) == 0
+    assert "started" in out.getvalue()

@@ -1,0 +1,179 @@
+"""PVC/PV protection finalizers + CSR approving/cleaning controllers.
+
+Reference: ``pkg/controller/volume/{pvcprotection,pvprotection}`` (in-use
+storage cannot be deleted out from under consumers — graceful deletion
+via finalizers) and ``pkg/controller/certificates/{approver,cleaner}``.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client.clientset import ApiError, DirectClient
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.controllers.csrlifecycle import (CSRApprovingController,
+                                                     CSRCleanerController,
+                                                     SIGNER_KUBELET_CLIENT)
+from kubernetes_tpu.controllers.volumeprotection import (
+    PVC_FINALIZER, PVCProtectionController, PVProtectionController)
+from kubernetes_tpu.store.store import ObjectStore
+from kubernetes_tpu.testing.wrappers import make_pod
+
+
+def wait_until(fn, timeout=8.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+@pytest.fixture
+def client():
+    return DirectClient(ObjectStore())
+
+
+def run_controller(client, ctrl):
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    return ctrl, factory
+
+
+def stop(ctrl, factory):
+    ctrl.stop()
+    factory.stop_all()
+
+
+# -------------------------------------------------------------- finalizers
+
+def test_store_graceful_deletion_with_finalizers(client):
+    cms = client.resource("configmaps", "default")
+    cms.create({"kind": "ConfigMap",
+                "metadata": {"name": "held",
+                             "finalizers": ["example.com/hold"]},
+                "data": {}})
+    cms.delete("held")
+    got = cms.get("held")  # still present, terminating
+    assert got["metadata"]["deletionTimestamp"]
+    got["metadata"]["finalizers"] = []
+    cms.update(got)  # last finalizer off -> delete completes
+    with pytest.raises(ApiError):
+        cms.get("held")
+
+
+def test_pvc_protection_blocks_delete_while_pod_mounts(client):
+    pvcs = client.resource("persistentvolumeclaims", "default")
+    pvcs.create({"kind": "PersistentVolumeClaim",
+                 "metadata": {"name": "data"},
+                 "spec": {"resources": {"requests": {"storage": "1Gi"}}}})
+    pod = make_pod("user").obj().to_dict()
+    pod["spec"]["volumes"] = [
+        {"name": "d", "persistentVolumeClaim": {"claimName": "data"}}]
+    client.pods("default").create(pod)
+    ctrl, factory = run_controller(client, PVCProtectionController(client))
+    try:
+        assert wait_until(lambda: PVC_FINALIZER in
+                          (pvcs.get("data")["metadata"].get("finalizers")
+                           or []))
+        pvcs.delete("data")
+        time.sleep(0.3)
+        got = pvcs.get("data")  # still here: a pod mounts it
+        assert got["metadata"]["deletionTimestamp"]
+        # pod goes away -> finalizer comes off -> the delete completes
+        client.pods("default").delete("user")
+
+        def gone():
+            try:
+                pvcs.get("data")
+                return False
+            except ApiError:
+                return True
+        assert wait_until(gone)
+    finally:
+        stop(ctrl, factory)
+
+
+def test_pv_protection_blocks_delete_while_bound(client):
+    pvs = client.resource("persistentvolumes", None)
+    pvcs = client.resource("persistentvolumeclaims", "default")
+    pvs.create({"kind": "PersistentVolume", "metadata": {"name": "vol"},
+                "spec": {"capacity": {"storage": "1Gi"}}})
+    pvcs.create({"kind": "PersistentVolumeClaim",
+                 "metadata": {"name": "claim"},
+                 "spec": {"volumeName": "vol",
+                          "resources": {"requests": {"storage": "1Gi"}}}})
+    ctrl, factory = run_controller(client, PVProtectionController(client))
+    try:
+        assert wait_until(lambda: (pvs.get("vol")["metadata"]
+                                   .get("finalizers")))
+        pvs.delete("vol")
+        time.sleep(0.3)
+        assert pvs.get("vol")["metadata"]["deletionTimestamp"]
+        pvcs.delete("claim")
+
+        def gone():
+            try:
+                pvs.get("vol")
+                return False
+            except ApiError:
+                return True
+        assert wait_until(gone)
+    finally:
+        stop(ctrl, factory)
+
+
+# --------------------------------------------------------------------- CSR
+
+def _csr(name, signer, groups=(), username="", created=None):
+    obj = {"kind": "CertificateSigningRequest",
+           "metadata": {"name": name},
+           "spec": {"signerName": signer, "groups": list(groups),
+                    "username": username, "request": ""}}
+    if created is not None:
+        obj["metadata"]["creationTimestamp"] = created
+    return obj
+
+
+def test_kubelet_client_csrs_auto_approved(client):
+    res = client.resource("certificatesigningrequests", None)
+    res.create(_csr("node-boot", SIGNER_KUBELET_CLIENT,
+                    groups=["system:bootstrappers"]))
+    res.create(_csr("random-user", "kubernetes.io/kube-apiserver-client",
+                    groups=["system:authenticated"]))
+    ctrl, factory = run_controller(client, CSRApprovingController(client))
+    try:
+        def approved(name):
+            conds = (res.get(name).get("status") or {}) \
+                .get("conditions") or []
+            return any(c.get("type") == "Approved" for c in conds)
+        assert wait_until(lambda: approved("node-boot"))
+        time.sleep(0.2)
+        assert not approved("random-user")  # wrong signer: untouched
+    finally:
+        stop(ctrl, factory)
+
+
+def test_stale_and_finished_csrs_cleaned(client):
+    res = client.resource("certificatesigningrequests", None)
+    old = time.time() - 7200
+    issued = _csr("issued-old", SIGNER_KUBELET_CLIENT, created=old)
+    issued["status"] = {"certificate": "UEVN"}
+    res.create(issued)
+    res.create(_csr("fresh", SIGNER_KUBELET_CLIENT))
+    ancient = _csr("ancient-pending", SIGNER_KUBELET_CLIENT,
+                   created=time.time() - 2 * 24 * 3600)
+    res.create(ancient)
+    ctrl = CSRCleanerController(client)
+    ctrl.tick_interval = 0.2
+    ctrl, factory = run_controller(client, ctrl)
+    try:
+        def names():
+            return {(c.get("metadata") or {}).get("name")
+                    for c in res.list()}
+        assert wait_until(lambda: names() == {"fresh"}), names()
+    finally:
+        stop(ctrl, factory)
