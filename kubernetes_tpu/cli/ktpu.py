@@ -499,6 +499,54 @@ def cmd_label(client: HTTPClient, args, out, field: str = "labels") -> int:
     return 0
 
 
+def cmd_wait(client: HTTPClient, args, out) -> int:
+    """kubectl wait --for=condition=X / --for=delete / --for=jsonpath-free
+    phase matching, polling until the condition holds or --timeout."""
+    import time as _time
+    plural = resolve_plural(args.resource, client)
+    res = client.resource(plural, args.namespace)
+    kind_lower = _kind_info(client, plural)[0].lower()
+    want = args.wait_for
+    if want != "delete" and not want.startswith(("condition=", "phase=")):
+        out.write(f"error: unsupported --for {want!r} "
+                  "(want condition=Type[=Status], phase=X, or delete)\n")
+        return 2
+    deadline = _time.time() + args.timeout
+    while _time.time() < deadline:
+        try:
+            obj = res.get(args.name)
+        except ApiError as e:
+            if e.code == 404:
+                if want == "delete":
+                    out.write(f"{kind_lower}/{args.name} condition met\n")
+                    return 0
+                _time.sleep(args.poll)
+                continue
+            raise
+        if want == "delete":
+            _time.sleep(args.poll)
+            continue
+        if want.startswith("condition="):
+            parts = want[len("condition="):].split("=", 1)
+            ctype = parts[0]
+            cstatus = parts[1] if len(parts) > 1 else "True"
+            conds = (obj.get("status") or {}).get("conditions") or []
+            if any(c.get("type", "").lower() == ctype.lower()
+                   and str(c.get("status", "")).lower() == cstatus.lower()
+                   for c in conds):
+                out.write(f"{kind_lower}/{args.name} condition met\n")
+                return 0
+        elif want.startswith("phase="):
+            if (obj.get("status") or {}).get("phase", "").lower() \
+                    == want[len("phase="):].lower():
+                out.write(f"{kind_lower}/{args.name} condition met\n")
+                return 0
+        _time.sleep(args.poll)
+    out.write(f"error: timed out waiting for {want} on "
+              f"{kind_lower}/{args.name}\n")
+    return 1
+
+
 def cmd_api_resources(client: HTTPClient, args, out) -> int:
     """kubectl api-resources: the serving table, CRDs included."""
     from kubernetes_tpu.store.apiserver import ALL_RESOURCES
@@ -650,6 +698,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("api-resources")
 
+    wt = sub.add_parser("wait")
+    wt.add_argument("resource")
+    wt.add_argument("name")
+    wt.add_argument("--for", dest="wait_for", required=True,
+                    help="condition=Type[=Status] | phase=X | delete")
+    wt.add_argument("--timeout", type=float, default=30.0)
+    wt.add_argument("--poll", type=float, default=0.2)
+
     at = sub.add_parser("attach")  # kubectl attach ~ exec without command
     at.add_argument("name")
     at.add_argument("-c", "--container", default=None)
@@ -711,6 +767,8 @@ def main(argv=None, out=None) -> int:
             return cmd_label(client, args, out, field="annotations")
         if args.cmd == "api-resources":
             return cmd_api_resources(client, args, out)
+        if args.cmd == "wait":
+            return cmd_wait(client, args, out)
         if args.cmd == "attach":
             # attach to the main container's stream: the hollow runtime has
             # no live stdout stream, so attach surfaces the current logs

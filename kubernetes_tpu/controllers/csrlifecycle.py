@@ -16,8 +16,7 @@ import time
 from kubernetes_tpu.client.clientset import ApiError
 from kubernetes_tpu.client.informer import InformerFactory
 from kubernetes_tpu.controllers.base import Controller
-from kubernetes_tpu.controllers.certificates import (
-    SIGNER_KUBE_APISERVER_CLIENT, _is_approved, _is_denied)
+from kubernetes_tpu.controllers.certificates import _is_approved, _is_denied
 from kubernetes_tpu.utils.clock import rfc3339_now
 
 SIGNER_KUBELET_CLIENT = "kubernetes.io/kube-apiserver-client-kubelet"

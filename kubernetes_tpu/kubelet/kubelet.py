@@ -200,7 +200,13 @@ class Kubelet:
 
     # ---- syncLoop --------------------------------------------------------
 
-    def start(self, wait_sync: float = 10.0, serve: bool = True):
+    def start(self, wait_sync: float = 10.0, serve: bool = True,
+              static_pod_path: Optional[str] = None,
+              static_poll_s: float = 1.0):
+        self._static_pod_path = static_pod_path
+        self._static_poll_s = static_poll_s
+        self._static: dict[str, tuple] = {}  # uid -> (name, digest)
+        self._static_mirror_pending: set[str] = set()
         if serve:
             from kubernetes_tpu.kubelet.server import KubeletServer
             self.server = KubeletServer(self.runtime, self._uid_of,
@@ -218,11 +224,110 @@ class Kubelet:
         self._informer.add_event_handler(self._on_pod_event)
         self._informer.start()
         self._informer.wait_for_cache_sync(wait_sync)
-        for target in (self._heartbeat_loop, self._pleg_loop):
+        loops = [self._heartbeat_loop, self._pleg_loop]
+        if static_pod_path:
+            loops.append(self._static_pod_loop)
+        for target in loops:
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
         return self
+
+    # ---- static pods (the FILE pod source of syncLoop) -------------------
+
+    def _static_pod_loop(self):
+        """The kubelet's file source (``pkg/kubelet/config/file.go``): pod
+        manifests in --pod-manifest-path run WITHOUT the apiserver —
+        static pods. Each gets a MIRROR POD posted to the API (read-only
+        reflection so kubectl sees it; ``pkg/kubelet/pod/mirror_client.go``)
+        named <manifest-name>-<node>. Removing the file stops the pod and
+        deletes the mirror; editing it restarts the pod with the new spec;
+        deleting the MIRROR through the API never touches the pod (the
+        file is the source of truth) — the mirror is recreated."""
+        while not self._stop.wait(self._static_poll_s):
+            try:
+                self._sync_static_pods()
+            except Exception:
+                pass
+
+    def _sync_static_pods(self):
+        import json as _json
+        import os
+        path = self._static_pod_path
+        seen: dict[str, dict] = {}
+        for fn in sorted(os.listdir(path)) if os.path.isdir(path) else []:
+            if not fn.endswith((".json", ".yaml", ".yml")):
+                continue
+            try:
+                with open(os.path.join(path, fn)) as f:
+                    if fn.endswith(".json"):
+                        manifest = _json.load(f)
+                    else:
+                        import yaml
+                        manifest = yaml.safe_load(f)
+            except Exception:
+                continue  # torn/invalid file: skip until it parses
+            if not isinstance(manifest, dict) or                     manifest.get("kind") != "Pod":
+                continue
+            md = manifest.setdefault("metadata", {})
+            name = f"{md.get('name', fn.split('.')[0])}-{self.node_name}"
+            uid = f"static-{name}"
+            digest = _json.dumps(manifest, sort_keys=True)
+            seen[uid] = (manifest, name, digest)
+        # (re)start static pods: new manifests AND edited ones (file.go
+        # re-syncs on content change)
+        for uid, (manifest, name, digest) in seen.items():
+            prior = self._static.get(uid)
+            if prior is not None and prior[1] == digest:
+                continue
+            pod = _json.loads(_json.dumps(manifest))
+            md = pod.setdefault("metadata", {})
+            md["name"] = name
+            md["uid"] = uid
+            md.setdefault("annotations", {})[
+                "kubernetes.io/config.source"] = "file"
+            pod.setdefault("spec", {})["nodeName"] = self.node_name
+            self._static[uid] = (name, digest)
+            self._static_mirror_pending.add(uid)
+            with self._pods_lock:
+                self._pods[uid] = pod
+            self.workers.update_pod(uid, pod)
+        # mirrors: create (and RE-create after API-side deletion or a
+        # transient failure) until one sticks — 409 means it stuck
+        for uid in list(self._static_mirror_pending):
+            if uid not in seen:
+                self._static_mirror_pending.discard(uid)
+                continue
+            with self._pods_lock:
+                pod = self._pods.get(uid)
+            if pod is None:
+                continue
+            mirror = _json.loads(_json.dumps(pod))
+            mirror["metadata"].setdefault("annotations", {})[
+                "kubernetes.io/config.mirror"] = uid
+            ns = (pod.get("metadata") or {}).get("namespace",
+                                                 "default") or "default"
+            try:
+                self.client.pods(ns).create(mirror)
+                self._static_mirror_pending.discard(uid)
+            except ApiError as e:
+                if e.code == 409:
+                    self._static_mirror_pending.discard(uid)
+                # anything else: retry next poll
+        # stop static pods whose manifest vanished
+        for uid in [u for u in self._static if u not in seen]:
+            name, _digest = self._static.pop(uid)
+            self._static_mirror_pending.discard(uid)
+            with self._pods_lock:
+                pod = self._pods.pop(uid, None)
+            self.workers.update_pod(uid, None)
+            if pod is not None:
+                try:
+                    self.client.pods((pod.get("metadata") or {})
+                                     .get("namespace", "default")
+                                     or "default").delete(name)
+                except ApiError:
+                    pass
 
     def stop(self):
         self._stop.set()
@@ -260,6 +365,12 @@ class Kubelet:
     def _on_pod_event(self, type_, obj, old):
         uid = (obj.get("metadata") or {}).get("uid", "")
         if not uid:
+            return
+        if uid in getattr(self, "_static", {}):
+            # a FILE-sourced pod: API events (someone deleting the mirror)
+            # never affect it — mirror_client recreates the reflection
+            if type_ == "DELETED":
+                self._static_mirror_pending.add(uid)
             return
         if type_ == "DELETED":
             with self._pods_lock:

@@ -228,3 +228,147 @@ def test_attach_streams_container_output(cluster):
     out = io.StringIO()
     assert main(["--server", server.url, "attach", "app"], out=out) == 0
     assert "started" in out.getvalue()
+
+
+def test_static_pods_run_with_mirror(tmp_path):
+    """The kubelet's FILE pod source (pkg/kubelet/config/file.go): a
+    manifest dropped in --pod-manifest-path runs without the apiserver
+    involved in scheduling, with a read-only mirror pod reflecting it to
+    the API; removing the file stops the pod and the mirror."""
+    server = APIServer().start()
+    node = None
+    try:
+        client = HTTPClient(server.url)
+        manifest_dir = tmp_path / "manifests"
+        manifest_dir.mkdir()
+        node = HollowNode(client, "sn-1")
+        node.kubelet.start(static_pod_path=str(manifest_dir),
+                           static_poll_s=0.1)
+        (manifest_dir / "etcd.json").write_text(json.dumps({
+            "kind": "Pod", "metadata": {"name": "etcd"},
+            "spec": {"containers": [{"name": "etcd",
+                                     "image": "etcd:3.5"}]}}))
+        deadline = time.time() + 10
+        mirror = None
+        while time.time() < deadline:
+            try:
+                mirror = client.pods("default").get("etcd-sn-1")
+                break
+            except Exception:
+                time.sleep(0.1)
+        assert mirror is not None, "mirror pod never appeared"
+        ann = mirror["metadata"]["annotations"]
+        assert ann["kubernetes.io/config.source"] == "file"
+        assert "kubernetes.io/config.mirror" in ann
+        assert mirror["spec"]["nodeName"] == "sn-1"
+        # the static pod actually RUNS in the node's runtime
+        assert any(sb.pod_uid == "static-etcd-sn-1"
+                   for sb in node.kubelet.runtime.list_sandboxes())
+        # file removed -> pod stops, mirror deleted
+        (manifest_dir / "etcd.json").unlink()
+        deadline = time.time() + 10
+        gone = False
+        while time.time() < deadline:
+            try:
+                client.pods("default").get("etcd-sn-1")
+                time.sleep(0.1)
+            except Exception:
+                gone = True
+                break
+        assert gone, "mirror pod not removed"
+    finally:
+        if node is not None:
+            node.stop()
+        server.stop()
+
+
+def test_wait_for_condition_and_delete(cluster):
+    server, client = cluster
+    import threading
+    out = io.StringIO()
+    # pod Ready condition set by the hollow kubelet once Running
+    rc = main(["--server", server.url, "wait", "pods", "app",
+               "--for", "phase=Running", "--timeout", "10"], out=out)
+    assert rc == 0, out.getvalue()
+    # --for delete returns once the object is gone
+    done = {}
+
+    def waiter():
+        done["rc"] = main(["--server", server.url, "wait", "pods", "app",
+                           "--for", "delete", "--timeout", "15"],
+                          out=io.StringIO())
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    client.pods("default").delete("app")
+    t.join(timeout=15)
+    assert done.get("rc") == 0
+    # timeout path
+    out = io.StringIO()
+    pod2 = make_pod("p2").obj().to_dict()
+    pod2["spec"]["nodeName"] = "kn-1"
+    client.pods("default").create(pod2)
+    rc = main(["--server", server.url, "wait", "pods", "p2",
+               "--for", "condition=NeverHappens", "--timeout", "1"],
+              out=out)
+    assert rc == 1 and "timed out" in out.getvalue()
+
+
+def test_static_pod_survives_mirror_deletion_and_manifest_edit(tmp_path):
+    """The file is the source of truth: deleting the MIRROR via the API
+    neither stops the static pod nor sticks (the mirror is recreated);
+    editing the manifest restarts the pod with the new spec."""
+    server = APIServer().start()
+    node = None
+    try:
+        client = HTTPClient(server.url)
+        manifest_dir = tmp_path / "m"
+        manifest_dir.mkdir()
+        node = HollowNode(client, "sm-1")
+        node.kubelet.start(static_pod_path=str(manifest_dir),
+                           static_poll_s=0.1)
+        mf = manifest_dir / "kapi.json"
+        mf.write_text(json.dumps({
+            "kind": "Pod", "metadata": {"name": "kapi"},
+            "spec": {"containers": [{"name": "c", "image": "api:v1"}]}}))
+
+        def mirror():
+            try:
+                return client.pods("default").get("kapi-sm-1")
+            except Exception:
+                return None
+        deadline = time.time() + 10
+        while time.time() < deadline and mirror() is None:
+            time.sleep(0.1)
+        assert mirror() is not None
+        # API-side deletion: pod keeps running, mirror comes back
+        client.pods("default").delete("kapi-sm-1")
+        deadline = time.time() + 10
+        while time.time() < deadline and mirror() is None:
+            time.sleep(0.1)
+        assert mirror() is not None, "mirror not recreated"
+        assert any(sb.pod_uid == "static-kapi-sm-1"
+                   for sb in node.kubelet.runtime.list_sandboxes()), \
+            "static pod was stopped by a mirror deletion"
+        # manifest edit: the new spec rolls out
+        mf.write_text(json.dumps({
+            "kind": "Pod", "metadata": {"name": "kapi"},
+            "spec": {"containers": [{"name": "c", "image": "api:v2"}]}}))
+        deadline = time.time() + 10
+        img = None
+        while time.time() < deadline:
+            m = mirror()
+            img = (m or {}).get("spec", {}).get(
+                "containers", [{}])[0].get("image")
+            with node.kubelet._pods_lock:
+                run_img = (node.kubelet._pods.get("static-kapi-sm-1") or
+                           {}).get("spec", {}).get(
+                    "containers", [{}])[0].get("image")
+            if run_img == "api:v2":
+                break
+            time.sleep(0.1)
+        assert run_img == "api:v2", run_img
+    finally:
+        if node is not None:
+            node.stop()
+        server.stop()
