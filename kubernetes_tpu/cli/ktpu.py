@@ -260,9 +260,12 @@ def cmd_delete(client: HTTPClient, args, out) -> int:
         plural = resolve_plural(args.resource, client)
         _, namespaced = _kind_info(client, plural)
         targets.append((plural, args.namespace if namespaced else None, args.name))
+    policy = {"foreground": "Foreground",
+              "orphan": "Orphan"}.get(getattr(args, "cascade", "background"))
     for plural, ns, name in targets:
         try:
-            client.resource(plural, ns).delete(name)
+            client.resource(plural, ns).delete(
+                name, propagation_policy=policy)
             out.write(f"{plural[:-1]}/{name} deleted\n")
         except ApiError as e:
             if e.code != 404:
@@ -655,6 +658,9 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("resource", nargs="?", default="")
     d.add_argument("name", nargs="?", default="")
     d.add_argument("-f", "--filename", default=None)
+    d.add_argument("--cascade", default="background",
+                   choices=["background", "foreground", "orphan"],
+                   help="DeleteOptions.propagationPolicy")
 
     de = sub.add_parser("describe")
     de.add_argument("resource")

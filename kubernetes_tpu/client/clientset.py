@@ -159,8 +159,13 @@ class ResourceClient:
         return self._t.apply(self.plural, self.kind, self.namespace, obj,
                              field_manager, force)
 
-    def delete(self, name: str) -> dict:
-        return self._t.delete(self.plural, self.kind, self.namespace, name)
+    def delete(self, name: str,
+               propagation_policy: Optional[str] = None) -> dict:
+        """``propagation_policy``: Background (default) | Foreground |
+        Orphan — DeleteOptions.propagationPolicy; Foreground/Orphan stamp
+        the GC finalizer so the garbage collector completes the delete."""
+        return self._t.delete(self.plural, self.kind, self.namespace, name,
+                              propagation_policy)
 
     def watch(self, since_rv: int = 0) -> Iterator[Event]:
         return self._t.watch(self.plural, self.kind, self.namespace, since_rv)
@@ -321,8 +326,17 @@ class DirectClient(_Handles):
         return self.store.update(kind, obj, expect_rv=expect)
 
     @_api_errors
-    def delete(self, plural, kind, ns, name):
+    def delete(self, plural, kind, ns, name, propagation_policy=None):
         self._react("delete", plural, {"metadata": {"name": name, "namespace": ns}})
+        if propagation_policy in ("Foreground", "Orphan"):
+            fin = ("foregroundDeletion" if propagation_policy == "Foreground"
+                   else "orphan")
+            cur = self.store.get(kind, ns or "", name)
+            fins = (cur.get("metadata") or {}).get("finalizers") or []
+            if fin not in fins:
+                cur.setdefault("metadata", {})["finalizers"] = \
+                    list(fins) + [fin]
+                self.store.update(kind, cur)
         return self.store.delete(kind, ns or "", name)
 
     def watch(self, plural, kind, ns, since_rv):
@@ -664,8 +678,10 @@ class HTTPClient(_Handles):
         return self._req("PUT", self._path(plural, ns, name, sub), obj,
                          headers=headers)
 
-    def delete(self, plural, kind, ns, name):
-        return self._req("DELETE", self._path(plural, ns, name))
+    def delete(self, plural, kind, ns, name, propagation_policy=None):
+        q = (f"propagationPolicy={propagation_policy}"
+             if propagation_policy else "")
+        return self._req("DELETE", self._path(plural, ns, name, query=q))
 
     def bind(self, ns, name, node_name):
         return self._req("POST", self._path("pods", ns, name, "binding"),

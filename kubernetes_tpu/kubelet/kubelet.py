@@ -292,8 +292,10 @@ class Kubelet:
             with self._pods_lock:
                 self._pods[uid] = pod
             self.workers.update_pod(uid, pod)
-        # mirrors: create (and RE-create after API-side deletion or a
-        # transient failure) until one sticks — 409 means it stuck
+        # mirrors: create (and RE-create after API-side deletion, a
+        # transient failure, or a manifest EDIT) until the API copy carries
+        # the current manifest hash (mirror_client.go deletes and recreates
+        # on hash change — kubernetes.io/config.hash)
         for uid in list(self._static_mirror_pending):
             if uid not in seen:
                 self._static_mirror_pending.discard(uid)
@@ -302,18 +304,33 @@ class Kubelet:
                 pod = self._pods.get(uid)
             if pod is None:
                 continue
+            digest = self._static[uid][1]
             mirror = _json.loads(_json.dumps(pod))
-            mirror["metadata"].setdefault("annotations", {})[
-                "kubernetes.io/config.mirror"] = uid
+            ann = mirror["metadata"].setdefault("annotations", {})
+            ann["kubernetes.io/config.mirror"] = uid
+            ann["kubernetes.io/config.hash"] = digest
             ns = (pod.get("metadata") or {}).get("namespace",
                                                  "default") or "default"
+            name = (pod.get("metadata") or {}).get("name", "")
             try:
                 self.client.pods(ns).create(mirror)
                 self._static_mirror_pending.discard(uid)
             except ApiError as e:
-                if e.code == 409:
-                    self._static_mirror_pending.discard(uid)
-                # anything else: retry next poll
+                if e.code != 409:
+                    continue  # transient: retry next poll
+                # a mirror exists: current hash -> done; stale hash (the
+                # manifest was edited) -> delete it, recreate next poll
+                try:
+                    cur = self.client.pods(ns).get(name)
+                    cur_hash = ((cur.get("metadata") or {})
+                                .get("annotations") or {}).get(
+                        "kubernetes.io/config.hash")
+                    if cur_hash == digest:
+                        self._static_mirror_pending.discard(uid)
+                    else:
+                        self.client.pods(ns).delete(name)
+                except ApiError:
+                    pass  # retry next poll
         # stop static pods whose manifest vanished
         for uid in [u for u in self._static if u not in seen]:
             name, _digest = self._static.pop(uid)

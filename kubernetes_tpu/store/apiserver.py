@@ -1218,7 +1218,35 @@ class APIServer:
                 plural, kind, ns, name, _ = r
                 if name is None:
                     return self._error(405, "collection delete unsupported")
+                # DeleteOptions.propagationPolicy (query param or body):
+                # Foreground/Orphan stamp the matching GC finalizer BEFORE
+                # the delete, so the object terminates and the garbage
+                # collector finishes the job (delete dependents first /
+                # strip ownerReferences) exactly like
+                # registry.Store.Delete + gc_admission upstream
+                qs = parse_qs(urlparse(self.path).query)
+                policy = qs.get("propagationPolicy", [""])[0]
+                if not policy:
+                    try:
+                        body = self._read_body()
+                        policy = (body or {}).get("propagationPolicy", "")
+                    except Exception:
+                        policy = ""
+                fin = {"Foreground": "foregroundDeletion",
+                       "Orphan": "orphan"}.get(policy)
                 with server._crd_guard(kind):
+                    if fin is not None:
+                        try:
+                            cur = server.store.get(kind, ns or "", name)
+                            fins = (cur.get("metadata") or {})                                 .get("finalizers") or []
+                            if fin not in fins:
+                                cur.setdefault("metadata", {})[
+                                    "finalizers"] = list(fins) + [fin]
+                                server.store.update(kind, cur)
+                        except NotFound as e:
+                            return self._error(404, str(e), "NotFound")
+                        except Conflict:
+                            pass  # racing writer; delete still proceeds
                     try:
                         out = server.store.delete(kind, ns or "", name)
                     except NotFound as e:

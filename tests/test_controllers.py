@@ -591,3 +591,74 @@ def test_node_lease_renewal_counts_as_heartbeat():
     finally:
         ctrl.stop()
         factory.stop_all()
+
+
+def test_gc_foreground_and_orphan_propagation():
+    """DeleteOptions.propagationPolicy: Foreground holds the owner
+    terminating until the GC deletes its dependents; Orphan strips
+    ownerReferences so dependents survive the owner
+    (pkg/controller/garbagecollector attemptToDeleteItem finalizers)."""
+    from kubernetes_tpu.client.clientset import ApiError, DirectClient
+    from kubernetes_tpu.client.informer import InformerFactory
+    from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
+    from kubernetes_tpu.store.store import ObjectStore
+    from kubernetes_tpu.testing.wrappers import make_pod
+    client = DirectClient(ObjectStore())
+    rss = client.resource("replicasets", "default")
+    rs = rss.create({"kind": "ReplicaSet", "metadata": {"name": "fg"},
+                     "spec": {"replicas": 1}})
+    uid = rs["metadata"]["uid"]
+    pod = make_pod("fg-pod").obj().to_dict()
+    pod["metadata"]["ownerReferences"] = [{
+        "kind": "ReplicaSet", "name": "fg", "uid": uid,
+        "controller": True, "blockOwnerDeletion": True}]
+    client.pods("default").create(pod)
+    gc = GarbageCollector(client)
+    factory = InformerFactory(client)
+    gc.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    try:
+        # FOREGROUND: owner terminates, dependent deleted FIRST
+        rss.delete("fg", propagation_policy="Foreground")
+        held = rss.get("fg")
+        assert held["metadata"]["deletionTimestamp"]
+        assert "foregroundDeletion" in held["metadata"]["finalizers"]
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            gc.sweep()
+            try:
+                rss.get("fg")
+            except ApiError:
+                break
+            time.sleep(0.1)
+        with pytest.raises(ApiError):
+            rss.get("fg")       # owner finally gone...
+        with pytest.raises(ApiError):
+            client.pods("default").get("fg-pod")  # ...after its dependent
+
+        # ORPHAN: dependents lose the reference and SURVIVE
+        rs2 = rss.create({"kind": "ReplicaSet", "metadata": {"name": "or"},
+                          "spec": {"replicas": 1}})
+        pod2 = make_pod("or-pod").obj().to_dict()
+        pod2["metadata"]["ownerReferences"] = [{
+            "kind": "ReplicaSet", "name": "or",
+            "uid": rs2["metadata"]["uid"], "controller": True}]
+        client.pods("default").create(pod2)
+        rss.delete("or", propagation_policy="Orphan")
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            gc.sweep()
+            try:
+                rss.get("or")
+            except ApiError:
+                break
+            time.sleep(0.1)
+        with pytest.raises(ApiError):
+            rss.get("or")
+        survivor = client.pods("default").get("or-pod")
+        assert not (survivor["metadata"].get("ownerReferences"))
+        gc.sweep()  # background pass must NOT collect the orphan
+        client.pods("default").get("or-pod")
+    finally:
+        factory.stop_all()

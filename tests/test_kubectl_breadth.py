@@ -355,7 +355,7 @@ def test_static_pod_survives_mirror_deletion_and_manifest_edit(tmp_path):
             "kind": "Pod", "metadata": {"name": "kapi"},
             "spec": {"containers": [{"name": "c", "image": "api:v2"}]}}))
         deadline = time.time() + 10
-        img = None
+        img = run_img = None
         while time.time() < deadline:
             m = mirror()
             img = (m or {}).get("spec", {}).get(
@@ -364,10 +364,11 @@ def test_static_pod_survives_mirror_deletion_and_manifest_edit(tmp_path):
                 run_img = (node.kubelet._pods.get("static-kapi-sm-1") or
                            {}).get("spec", {}).get(
                     "containers", [{}])[0].get("image")
-            if run_img == "api:v2":
+            if run_img == "api:v2" and img == "api:v2":
                 break
             time.sleep(0.1)
         assert run_img == "api:v2", run_img
+        assert img == "api:v2", "mirror pod not refreshed after edit"
     finally:
         if node is not None:
             node.stop()
