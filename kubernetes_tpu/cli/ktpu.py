@@ -322,11 +322,19 @@ def cmd_describe(client: HTTPClient, args, out) -> int:
 
 
 def cmd_scale(client: HTTPClient, args, out) -> int:
+    """kubectl scale via the /scale subresource (ScaleREST) — the same
+    interface HPA drives, touching only spec.replicas."""
     plural = resolve_plural(args.resource, client)
     res = client.resource(plural, args.namespace)
-    obj = res.get(args.name)
-    obj.setdefault("spec", {})["replicas"] = args.replicas
-    res.update(obj)
+    try:
+        res.update_scale(args.name, args.replicas)
+    except ApiError as e:
+        if e.code != 404:
+            raise
+        # kinds without a scale subresource (CRDs): plain spec update
+        obj = res.get(args.name)
+        obj.setdefault("spec", {})["replicas"] = args.replicas
+        res.update(obj)
     out.write(f"{plural[:-1]}/{args.name} scaled\n")
     return 0
 

@@ -182,6 +182,16 @@ class ResourceClient:
     def evict(self, name: str) -> dict:
         return self._t.evict(self.namespace, name)
 
+    # scale subresource (autoscaling/v1 Scale; Deployment/RS/STS/RC)
+    def get_scale(self, name: str) -> dict:
+        return self._t.get_scale(self.plural, self.kind, self.namespace,
+                                 name)
+
+    def update_scale(self, name: str, replicas: int,
+                     expect_rv: Optional[str] = None) -> dict:
+        return self._t.update_scale(self.plural, self.kind, self.namespace,
+                                    name, replicas, expect_rv)
+
 
 class _Handles:
     def pods(self, ns: str = "default") -> ResourceClient:
@@ -324,6 +334,27 @@ class DirectClient(_Handles):
             obj = cur
             expect = obj["metadata"].get("resourceVersion") or None
         return self.store.update(kind, obj, expect_rv=expect)
+
+    @_api_errors
+    def get_scale(self, plural, kind, ns, name):
+        from kubernetes_tpu.store.apiserver import SCALABLE_KINDS, _scale_of
+        if kind not in SCALABLE_KINDS:
+            raise NotFound(f"{kind} has no scale subresource")
+        return _scale_of(kind, self.store.get(kind, ns or "", name))
+
+    @_api_errors
+    def update_scale(self, plural, kind, ns, name, replicas, expect_rv):
+        from kubernetes_tpu.store.apiserver import SCALABLE_KINDS, _scale_of
+        if kind not in SCALABLE_KINDS:
+            raise NotFound(f"{kind} has no scale subresource")
+        cur = self.store.get(kind, ns or "", name)
+        cur.setdefault("spec", {})["replicas"] = int(replicas)
+        cur = self._react("update", plural, cur)  # fake-clientset reactors
+        if expect_rv is None:
+            # GuaranteedUpdate shape: precondition on the read's own rv
+            expect_rv = (cur.get("metadata") or {}).get("resourceVersion")
+        return _scale_of(kind, self.store.update(kind, cur,
+                                                 expect_rv=expect_rv))
 
     @_api_errors
     def delete(self, plural, kind, ns, name, propagation_policy=None):
@@ -682,6 +713,18 @@ class HTTPClient(_Handles):
         q = (f"propagationPolicy={propagation_policy}"
              if propagation_policy else "")
         return self._req("DELETE", self._path(plural, ns, name, query=q))
+
+    def get_scale(self, plural, kind, ns, name):
+        return self._req("GET", self._path(plural, ns, name, "scale"))
+
+    def update_scale(self, plural, kind, ns, name, replicas, expect_rv):
+        body = {"kind": "Scale", "apiVersion": "autoscaling/v1",
+                "metadata": {"name": name,
+                             **({"resourceVersion": expect_rv}
+                                if expect_rv else {})},
+                "spec": {"replicas": int(replicas)}}
+        return self._req("PUT", self._path(plural, ns, name, "scale"),
+                         body)
 
     def bind(self, ns, name, node_name):
         return self._req("POST", self._path("pods", ns, name, "binding"),

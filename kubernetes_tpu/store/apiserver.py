@@ -680,6 +680,18 @@ class APIServer:
                     return self._error(404, f"unknown path {path}")
                 plural, kind, ns, name, sub = r
                 qs = parse_qs(urlparse(self.path).query)
+                if sub == "scale" and name:
+                    if kind not in SCALABLE_KINDS:
+                        # upstream 404s unregistered scale subresources —
+                        # falling through would leak the full object
+                        return self._error(
+                            404, f"{kind} has no scale subresource",
+                            "NotFound")
+                    try:
+                        obj = server.store.get(kind, ns or "", name)
+                    except NotFound as e:
+                        return self._error(404, str(e), "NotFound")
+                    return self._send_json(200, _scale_of(kind, obj))
                 if sub == "log" and kind == "Pod" and name:
                     # kubectl logs: proxy to the pod's kubelet
                     # (kubelet server /containerLogs, reached via
@@ -1072,6 +1084,49 @@ class APIServer:
                     body = self._read_body()
                 except _BadRequest as e:
                     return self._error(400, str(e), "BadRequest")
+                if sub == "scale" and name:
+                    if kind not in SCALABLE_KINDS:
+                        # the full-object update path would store the Scale
+                        # body AS the object — 404 like upstream
+                        return self._error(
+                            404, f"{kind} has no scale subresource",
+                            "NotFound")
+                    # ScaleREST.Update: only spec.replicas moves. A caller
+                    # rv is the strict precondition; with none, this is a
+                    # GuaranteedUpdate-style retry against each read's own
+                    # rv so a concurrent writer is never silently reverted
+                    want = int(((body.get("spec") or {})
+                                .get("replicas", 1)) or 0)
+                    caller_rv = ((body.get("metadata") or {})
+                                 .get("resourceVersion") or None)
+                    for attempt in range(5):
+                        try:
+                            cur = server.store.get(kind, ns or "", name)
+                        except NotFound as e:
+                            return self._error(404, str(e), "NotFound")
+                        cur.setdefault("spec", {})["replicas"] = want
+                        try:
+                            cur = server._admit("UPDATE", kind, cur,
+                                                "scale")
+                        except AdmissionError as e:
+                            return self._error(400, str(e),
+                                               "AdmissionDenied")
+                        commits = server._pop_commits(cur)
+                        expect = caller_rv or (cur.get("metadata") or {})\
+                            .get("resourceVersion")
+                        try:
+                            out = server.store.update(kind, cur,
+                                                      expect_rv=expect)
+                            server._commit(commits, True)
+                            return self._send_json(200,
+                                                   _scale_of(kind, out))
+                        except Conflict as e:
+                            server._commit(commits, False)
+                            if caller_rv is not None or attempt == 4:
+                                return self._error(409, str(e), "Conflict")
+                        except NotFound as e:
+                            server._commit(commits, False)
+                            return self._error(404, str(e), "NotFound")
                 if sub in (None, "status"):
                     # status fragments convert too (a v1 controller PUTs
                     # v1-shaped status; the store must only hold hub shape)
@@ -1256,6 +1311,49 @@ class APIServer:
                     return self._send_json(200, self._conv_out(kind, out))
 
         return Handler
+
+
+SCALABLE_KINDS = {"Deployment", "ReplicaSet", "StatefulSet",
+                  "ReplicationController"}
+
+
+def _scale_of(kind: str, obj: dict) -> dict:
+    """autoscaling/v1 Scale wire shape for a workload object
+    (``pkg/registry/apps/deployment/storage`` ScaleREST.Get analog)."""
+    md = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    sel = spec.get("selector") or {}
+    parts = []
+    if isinstance(sel, dict):
+        labels = sel.get("matchLabels")
+        if labels is None and "matchExpressions" not in sel:
+            labels = {k: v for k, v in sel.items()
+                      if not isinstance(v, (list, dict))}
+        for k, v in (labels or {}).items():
+            parts.append(f"{k}={v}")
+        for e in sel.get("matchExpressions") or []:
+            op = (e.get("operator") or "").lower()
+            vals = ",".join(e.get("values") or [])
+            key = e.get("key", "")
+            if op == "in":
+                parts.append(f"{key} in ({vals})")
+            elif op == "notin":
+                parts.append(f"{key} notin ({vals})")
+            elif op == "exists":
+                parts.append(key)
+            elif op == "doesnotexist":
+                parts.append(f"!{key}")
+    sel_str = ",".join(parts)
+    return {
+        "kind": "Scale", "apiVersion": "autoscaling/v1",
+        "metadata": {"name": md.get("name", ""),
+                     "namespace": md.get("namespace", ""),
+                     "resourceVersion": md.get("resourceVersion", "")},
+        "spec": {"replicas": int(spec.get("replicas", 1) or 0)},
+        "status": {"replicas": int((obj.get("status") or {})
+                                   .get("replicas", 0) or 0),
+                   "selector": sel_str},
+    }
 
 
 def _field_label_selector(qs) -> Optional[Callable[[dict], bool]]:

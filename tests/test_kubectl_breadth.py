@@ -17,7 +17,7 @@ import urllib.request
 import pytest
 
 from kubernetes_tpu.cli.ktpu import main
-from kubernetes_tpu.client.clientset import HTTPClient
+from kubernetes_tpu.client.clientset import ApiError, HTTPClient
 from kubernetes_tpu.kubelet.kubelet import HollowNode
 from kubernetes_tpu.store.apiserver import APIServer
 from kubernetes_tpu.testing.wrappers import make_pod
@@ -373,3 +373,36 @@ def test_static_pod_survives_mirror_deletion_and_manifest_edit(tmp_path):
         if node is not None:
             node.stop()
         server.stop()
+
+
+def test_scale_subresource(cluster):
+    """GET/PUT /scale (autoscaling/v1 Scale, ScaleREST): replicas move
+    through the subresource without touching the rest of the spec; the
+    Scale metadata rv is the optimistic precondition."""
+    server, client = cluster
+    deps = client.resource("deployments", "default")
+    deps.create({"kind": "Deployment", "metadata": {"name": "web"},
+                 "spec": {"replicas": 2,
+                          "selector": {"matchLabels": {"app": "web"}},
+                          "template": {"spec": {"containers": [
+                              {"name": "c", "image": "i"}]}}},
+                 "status": {"replicas": 2}})
+    sc = deps.get_scale("web")
+    assert sc["kind"] == "Scale"
+    assert sc["spec"]["replicas"] == 2
+    assert sc["status"]["selector"] == "app=web"
+    out = deps.update_scale("web", 5)
+    assert out["spec"]["replicas"] == 5
+    got = deps.get("web")
+    assert got["spec"]["replicas"] == 5
+    assert got["spec"]["template"]["spec"]["containers"]  # spec intact
+    # stale rv precondition -> 409
+    with pytest.raises(ApiError) as ei:
+        deps.update_scale("web", 9, expect_rv=sc["metadata"][
+            "resourceVersion"])
+    assert ei.value.code == 409
+    # ktpu scale rides the subresource
+    out_io = io.StringIO()
+    assert main(["--server", server.url, "scale", "deployments", "web",
+                 "--replicas", "3"], out=out_io) == 0
+    assert deps.get("web")["spec"]["replicas"] == 3
