@@ -295,5 +295,9 @@ def default_chain(store: ObjectStore) -> AdmissionChain:
         limit_ranger(store),
         MutatingWebhooks(store),
     ]
-    chain.validating += [ValidatingWebhooks(store), resource_quota(store)]
+    from kubernetes_tpu.store.podsecurity import pod_security
+    # PodSecurity before the webhooks (upstream runs it among the
+    # built-ins; a policy-rejected pod must not reach external hooks)
+    chain.validating += [pod_security(store), ValidatingWebhooks(store),
+                         resource_quota(store)]
     return chain

@@ -1017,6 +1017,9 @@ class APIServer:
                     results = []
                     to_create = []
                     for item in body["items"]:
+                        md = item.setdefault("metadata", {})
+                        if ns:
+                            md["namespace"] = ns
                         try:
                             item = server._admit("CREATE", kind, item)
                         except AdmissionError as e:
@@ -1024,9 +1027,6 @@ class APIServer:
                                             "reason": "AdmissionDenied"})
                             continue
                         hooks = server._pop_commits(item)
-                        md = item.setdefault("metadata", {})
-                        if ns:
-                            md["namespace"] = ns
                         to_create.append((len(results), item, hooks))
                         results.append({"code": 201})
                     for idx, item, hooks in to_create:
@@ -1049,14 +1049,17 @@ class APIServer:
                         err = server.validate_crd(body)
                         if err:
                             return self._error(400, err, "Invalid")
+                    md = body.setdefault("metadata", {})
+                    if ns:
+                        # stamp the request-URL namespace BEFORE admission:
+                        # namespace-scoped policy (PodSecurity, quota)
+                        # reads it off the object
+                        md["namespace"] = ns
                     try:
                         body = server._admit("CREATE", kind, body)
                     except AdmissionError as e:
                         return self._error(400, str(e), "AdmissionDenied")
                     commits = server._pop_commits(body)
-                    md = body.setdefault("metadata", {})
-                    if ns:
-                        md["namespace"] = ns
                     try:
                         # body is this request's freshly-parsed JSON: hand
                         # ownership to the store (skips its defensive copy)
@@ -1095,8 +1098,11 @@ class APIServer:
                     # rv is the strict precondition; with none, this is a
                     # GuaranteedUpdate-style retry against each read's own
                     # rv so a concurrent writer is never silently reverted
-                    want = int(((body.get("spec") or {})
-                                .get("replicas", 1)) or 0)
+                    raw = (body.get("spec") or {}).get("replicas")
+                    if raw is None:
+                        return self._error(
+                            400, "spec.replicas is required", "BadRequest")
+                    want = int(raw)
                     caller_rv = ((body.get("metadata") or {})
                                  .get("resourceVersion") or None)
                     for attempt in range(5):

@@ -1,0 +1,145 @@
+"""Pod Security admission — baseline/restricted standard enforcement.
+
+Reference: ``staging/src/k8s.io/pod-security-admission`` — namespaces
+label ``pod-security.kubernetes.io/enforce`` with a level; pod writes are
+checked against that level's controls with every violation named.
+"""
+
+import pytest
+
+from kubernetes_tpu.client.clientset import ApiError, HTTPClient
+from kubernetes_tpu.store.apiserver import APIServer
+from kubernetes_tpu.store.podsecurity import check_pod
+from kubernetes_tpu.testing.wrappers import make_pod
+
+
+@pytest.fixture()
+def api():
+    server = APIServer()
+    server.enable_admission()
+    server.start()
+    yield server
+    server.stop()
+
+
+def _label_ns(client, name, level):
+    nss = client.resource("namespaces", None)
+    try:
+        ns = nss.get(name)
+    except ApiError:
+        ns = nss.create({"kind": "Namespace", "metadata": {"name": name}})
+    ns.setdefault("metadata", {}).setdefault("labels", {})[
+        "pod-security.kubernetes.io/enforce"] = level
+    nss.update(ns)
+
+
+def _restricted_ok_pod(name, ns):
+    pod = make_pod(name, ns).req({"cpu": "100m"}).obj().to_dict()
+    for c in pod["spec"]["containers"]:
+        c["securityContext"] = {
+            "allowPrivilegeEscalation": False,
+            "runAsNonRoot": True,
+            "capabilities": {"drop": ["ALL"]},
+            "seccompProfile": {"type": "RuntimeDefault"},
+        }
+    return pod
+
+
+def test_baseline_blocks_privileged_and_host_access(api):
+    c = HTTPClient(api.url)
+    _label_ns(c, "guarded", "baseline")
+    pod = make_pod("bad", "guarded").obj().to_dict()
+    pod["spec"]["hostNetwork"] = True
+    pod["spec"]["containers"][0]["securityContext"] = {"privileged": True}
+    with pytest.raises(ApiError) as ei:
+        c.pods("guarded").create(pod)
+    msg = str(ei.value)
+    assert "PodSecurity" in msg and "privileged container" in msg \
+        and "hostNetwork" in msg
+    # an ordinary pod is fine at baseline
+    c.pods("guarded").create(make_pod("ok", "guarded").obj().to_dict())
+
+
+def test_baseline_blocks_hostpath_and_hostport(api):
+    c = HTTPClient(api.url)
+    _label_ns(c, "guarded", "baseline")
+    pod = make_pod("vol", "guarded").obj().to_dict()
+    pod["spec"]["volumes"] = [{"name": "h", "hostPath": {"path": "/etc"}}]
+    with pytest.raises(ApiError) as ei:
+        c.pods("guarded").create(pod)
+    assert "hostPath" in str(ei.value)
+
+
+def test_restricted_requires_hardening(api):
+    c = HTTPClient(api.url)
+    _label_ns(c, "locked", "restricted")
+    # a plain pod violates multiple restricted controls
+    with pytest.raises(ApiError) as ei:
+        c.pods("locked").create(make_pod("plain", "locked").obj().to_dict())
+    msg = str(ei.value)
+    assert "runAsNonRoot" in msg and 'drop "ALL"' in msg \
+        and "seccompProfile" in msg
+    # the fully hardened pod is admitted
+    c.pods("locked").create(_restricted_ok_pod("hard", "locked"))
+
+
+def test_unlabeled_namespace_is_privileged(api):
+    c = HTTPClient(api.url)
+    pod = make_pod("wild").obj().to_dict()
+    pod["spec"]["hostNetwork"] = True  # fine: default namespace unlabeled
+    c.pods("default").create(pod)
+
+
+def test_status_updates_exempt(api):
+    """The kubelet's status heartbeats must not be policy-checked (the
+    spec is unchanged; upstream exempts non-spec updates)."""
+    c = HTTPClient(api.url)
+    _label_ns(c, "guarded", "baseline")
+    c.pods("guarded").create(make_pod("run", "guarded").obj().to_dict())
+    # namespace tightened AFTER creation: status writes still flow
+    got = c.pods("guarded").get("run")
+    got.setdefault("status", {})["phase"] = "Running"
+    c.pods("guarded").update_status(got)
+
+
+def test_check_pod_level_logic():
+    spec_ok = make_pod("x").obj().to_dict()
+    assert check_pod("privileged", spec_ok) == []
+    assert check_pod("baseline", spec_ok) == []
+    assert check_pod("restricted", spec_ok) != []
+    hard = _restricted_ok_pod("y", "default")
+    assert check_pod("restricted", hard) == []
+
+
+def test_namespace_from_request_url_not_body(api):
+    """The policy must key off the REQUEST namespace: a manifest omitting
+    metadata.namespace POSTed to a guarded namespace's collection URL is
+    still checked against that namespace's level (the bypass would be a
+    policy hole)."""
+    c = HTTPClient(api.url)
+    _label_ns(c, "guarded", "baseline")
+    pod = make_pod("sneaky").obj().to_dict()
+    pod["metadata"].pop("namespace", None)  # body carries no namespace
+    pod["spec"]["hostNetwork"] = True
+    with pytest.raises(ApiError) as ei:
+        c.pods("guarded").create(pod)
+    assert "PodSecurity" in str(ei.value)
+
+
+def test_metadata_only_update_exempt_after_tightening(api):
+    """An existing pod in a namespace that tightens its level afterwards
+    can still take metadata-only updates (labels, finalizers) — only spec
+    changes re-trigger enforcement."""
+    c = HTTPClient(api.url)
+    pod = make_pod("legacy", "guarded").obj().to_dict()
+    pod["spec"]["hostNetwork"] = True
+    _label_ns(c, "guarded", "privileged")
+    c.pods("guarded").create(pod)
+    _label_ns(c, "guarded", "baseline")  # tighten AFTER creation
+    got = c.pods("guarded").get("legacy")
+    got["metadata"].setdefault("labels", {})["touched"] = "yes"
+    c.pods("guarded").update(got)  # metadata-only: allowed
+    got = c.pods("guarded").get("legacy")
+    got["spec"]["hostPID"] = True
+    with pytest.raises(ApiError):  # spec change: enforced
+        c.pods("guarded").update(got)
