@@ -114,7 +114,17 @@ class ResourceClient:
         self.kind, self.namespaced = reg[0], reg[1]
         self.namespace = namespace if self.namespaced else None
 
-    def create(self, obj: dict) -> dict:
+    def create(self, obj: dict, dry_run: bool = False) -> dict:
+        """``dry_run``: server-side ?dryRun=All — the full admission +
+        validation path runs and the would-be object returns, nothing
+        persists."""
+        if dry_run:
+            fn = getattr(self._t, "create_dry_run", None)
+            if fn is None:
+                # never silently persist what the caller asked to preview
+                raise ApiError(400, "dry-run is not supported by this "
+                                    "transport", "BadRequest")
+            return fn(self.plural, self.kind, self.namespace, obj)
         return self._t.create(self.plural, self.kind, self.namespace, obj)
 
     def create_many(self, objs: list[dict]) -> list[dict]:
@@ -713,6 +723,10 @@ class HTTPClient(_Handles):
         q = (f"propagationPolicy={propagation_policy}"
              if propagation_policy else "")
         return self._req("DELETE", self._path(plural, ns, name, query=q))
+
+    def create_dry_run(self, plural, kind, ns, obj):
+        return self._req("POST", self._path(plural, ns,
+                                            query="dryRun=All"), obj)
 
     def get_scale(self, plural, kind, ns, name):
         return self._req("GET", self._path(plural, ns, name, "scale"))

@@ -747,6 +747,10 @@ class APIServer:
                 self.close_connection = True
                 return None
 
+            def _dry_run(self) -> bool:
+                qs = parse_qs(urlparse(self.path).query)
+                return bool(qs.get("dryRun"))
+
             def _kubelet_endpoint(self, ns: str, pod_name: str):
                 """-> (base_url, pod) or an error response already sent."""
                 try:
@@ -1006,6 +1010,14 @@ class APIServer:
                     except NotFound as e:
                         return self._error(404, str(e), "NotFound")
                     return self._send_json(200, out)
+                if self._dry_run() and (
+                        body.get("kind") == "List"
+                        and isinstance(body.get("items"), list)):
+                    # honest over silent: the batch path has per-item
+                    # store semantics a preview can't faithfully simulate
+                    return self._error(
+                        400, "dryRun is not supported for bulk creates",
+                        "BadRequest")
                 if body.get("kind") == "List" and isinstance(
                         body.get("items"), list) and kind != "CustomResourceDefinition":
                     # Bulk create: POST a v1 List manifest to a collection
@@ -1060,6 +1072,36 @@ class APIServer:
                     except AdmissionError as e:
                         return self._error(400, str(e), "AdmissionDenied")
                     commits = server._pop_commits(body)
+                    # a mutating webhook's JSON patch deep-copies the
+                    # object: re-resolve metadata and re-stamp the request
+                    # namespace on the post-admission dict
+                    md = body.setdefault("metadata", {})
+                    if ns:
+                        md["namespace"] = ns
+                    if self._dry_run():
+                        # server-side dry run (?dryRun=All, endpoints/
+                        # handlers/create.go): the FULL path — admission
+                        # mutations included — except persistence; quota
+                        # holds release as failed commits
+                        server._commit(commits, False)
+                        name_prev = md.get("name", "")
+                        if not name_prev and md.get("generateName"):
+                            # name generation runs in real creates; the
+                            # preview synthesizes the same shape without
+                            # consuming the suffix counter
+                            name_prev = md["name"] =                                 f"{md['generateName']}xxxxx"
+                        if name_prev:
+                            try:
+                                server.store.get(kind, ns or "", name_prev)
+                                if not md.get("generateName"):
+                                    return self._error(
+                                        409, f"{kind} {name_prev!r} "
+                                             "already exists",
+                                        "AlreadyExists")
+                            except NotFound:
+                                pass
+                        return self._send_json(
+                            201, self._conv_out(kind, body))
                     try:
                         # body is this request's freshly-parsed JSON: hand
                         # ownership to the store (skips its defensive copy)
@@ -1094,6 +1136,19 @@ class APIServer:
                         return self._error(
                             404, f"{kind} has no scale subresource",
                             "NotFound")
+                    if self._dry_run():
+                        # preview: current object with replicas applied
+                        try:
+                            cur = server.store.get(kind, ns or "", name)
+                        except NotFound as e:
+                            return self._error(404, str(e), "NotFound")
+                        raw0 = (body.get("spec") or {}).get("replicas")
+                        if raw0 is None:
+                            return self._error(
+                                400, "spec.replicas is required",
+                                "BadRequest")
+                        cur.setdefault("spec", {})["replicas"] = int(raw0)
+                        return self._send_json(200, _scale_of(kind, cur))
                     # ScaleREST.Update: only spec.replicas moves. A caller
                     # rv is the strict precondition; with none, this is a
                     # GuaranteedUpdate-style retry against each read's own
@@ -1147,6 +1202,20 @@ class APIServer:
                     except AdmissionError as e:
                         return self._error(400, str(e), "AdmissionDenied")
                     commits = server._pop_commits(body)
+                    if self._dry_run():
+                        server._commit(commits, False)
+                        try:
+                            cur = server.store.get(kind, ns or "", name)
+                        except NotFound as e:
+                            return self._error(404, str(e), "NotFound")
+                        if sub == "status":
+                            # preview the REAL status merge: stored object
+                            # with only status replaced
+                            cur["status"] = body.get("status", body)
+                            return self._send_json(
+                                200, self._conv_out(kind, cur))
+                        return self._send_json(
+                            200, self._conv_out(kind, body))
                     if sub == "status":
                         try:
                             cur = server.store.get(kind, ns or "", name)
@@ -1195,6 +1264,10 @@ class APIServer:
                              "supported", "UnsupportedMediaType")
                 if name is None:
                     return self._error(405, "apply needs a resource name")
+                if self._dry_run():
+                    return self._error(
+                        400, "dryRun is not supported for server-side "
+                             "apply here", "BadRequest")
                 if sub is not None:
                     # subresource-scoped apply (status) is not implemented;
                     # silently merging against the whole object would let a
@@ -1279,6 +1352,13 @@ class APIServer:
                 plural, kind, ns, name, _ = r
                 if name is None:
                     return self._error(405, "collection delete unsupported")
+                if self._dry_run():
+                    # delete preview: the object that WOULD be deleted
+                    try:
+                        cur = server.store.get(kind, ns or "", name)
+                    except NotFound as e:
+                        return self._error(404, str(e), "NotFound")
+                    return self._send_json(200, self._conv_out(kind, cur))
                 # DeleteOptions.propagationPolicy (query param or body):
                 # Foreground/Orphan stamp the matching GC finalizer BEFORE
                 # the delete, so the object terminates and the garbage

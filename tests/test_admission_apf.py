@@ -341,3 +341,45 @@ def test_queueset_overflow_rejects_429():
     fc.release(level)                 # frees the seat -> waiter admitted
     assert waiter_admitted.wait(2.0)
     t.join(timeout=2.0)
+
+
+# ------------------------------------------------------- server-side dry run
+
+def test_dry_run_create_runs_admission_but_persists_nothing():
+    """?dryRun=All (endpoints/handlers/create.go): admission mutations and
+    denials apply, the would-be object returns, and the store is
+    untouched — including quota holds, which must release."""
+    from kubernetes_tpu.testing.wrappers import make_pod
+    server = APIServer()
+    server.enable_admission()
+    server.start()
+    try:
+        c = HTTPClient(server.url)
+        pod = make_pod("ghost").req({"cpu": "100m"}).obj().to_dict()
+        out = c.pods("default").create(pod, dry_run=True)
+        # admission ran: the default tolerations were injected
+        assert any(t.get("key") == "node.kubernetes.io/not-ready"
+                   for t in out["spec"]["tolerations"])
+        # nothing persisted
+        with pytest.raises(ApiError) as ei:
+            c.pods("default").get("ghost")
+        assert ei.value.code == 404
+        # a denying policy still denies in dry-run
+        nss = c.resource("namespaces", None)
+        ns = nss.get("default")
+        ns.setdefault("metadata", {}).setdefault("labels", {})[
+            "pod-security.kubernetes.io/enforce"] = "baseline"
+        nss.update(ns)
+        bad = make_pod("priv").obj().to_dict()
+        bad["spec"]["hostNetwork"] = True
+        with pytest.raises(ApiError) as ei:
+            c.pods("default").create(bad, dry_run=True)
+        assert "PodSecurity" in str(ei.value)
+        # dry-run against an existing name reports the conflict
+        c.pods("default").create(make_pod("real").obj().to_dict())
+        with pytest.raises(ApiError) as ei:
+            c.pods("default").create(make_pod("real").obj().to_dict(),
+                                     dry_run=True)
+        assert ei.value.code == 409
+    finally:
+        server.stop()

@@ -248,7 +248,7 @@ def test_static_pods_run_with_mirror(tmp_path):
             "kind": "Pod", "metadata": {"name": "etcd"},
             "spec": {"containers": [{"name": "etcd",
                                      "image": "etcd:3.5"}]}}))
-        deadline = time.time() + 10
+        deadline = time.time() + 25
         mirror = None
         while time.time() < deadline:
             try:
@@ -266,7 +266,7 @@ def test_static_pods_run_with_mirror(tmp_path):
                    for sb in node.kubelet.runtime.list_sandboxes())
         # file removed -> pod stops, mirror deleted
         (manifest_dir / "etcd.json").unlink()
-        deadline = time.time() + 10
+        deadline = time.time() + 25
         gone = False
         while time.time() < deadline:
             try:
@@ -337,13 +337,13 @@ def test_static_pod_survives_mirror_deletion_and_manifest_edit(tmp_path):
                 return client.pods("default").get("kapi-sm-1")
             except Exception:
                 return None
-        deadline = time.time() + 10
+        deadline = time.time() + 25
         while time.time() < deadline and mirror() is None:
             time.sleep(0.1)
         assert mirror() is not None
         # API-side deletion: pod keeps running, mirror comes back
         client.pods("default").delete("kapi-sm-1")
-        deadline = time.time() + 10
+        deadline = time.time() + 25
         while time.time() < deadline and mirror() is None:
             time.sleep(0.1)
         assert mirror() is not None, "mirror not recreated"
@@ -354,7 +354,7 @@ def test_static_pod_survives_mirror_deletion_and_manifest_edit(tmp_path):
         mf.write_text(json.dumps({
             "kind": "Pod", "metadata": {"name": "kapi"},
             "spec": {"containers": [{"name": "c", "image": "api:v2"}]}}))
-        deadline = time.time() + 10
+        deadline = time.time() + 25
         img = run_img = None
         while time.time() < deadline:
             m = mirror()
