@@ -84,6 +84,8 @@ def materialize(case: dict, params: dict):
                 md = d.setdefault("metadata", {})
                 md["name"] = f"{md.pop('generateName', 'pod-')}{len(out)}-{i}"
                 out.append(Pod.from_dict(d))
+        elif code == "simulateAutoscale":
+            pass  # handled by _run_autoscaler_workload after materialize
         elif code == "generateWorkload":
             from benchmarks.workloads import WORKLOADS
             gen = WORKLOADS[op["generator"]]
@@ -109,6 +111,11 @@ def run_workload(case: dict, workload: dict, scale: float = 1.0,
     if churn_op is not None:
         return _run_churn_workload(case, workload, params, churn_op, log,
                                    scale=scale, batch=batch)
+    autoscale_op = next((op for op in case["workloadTemplate"]
+                         if op["opcode"] == "simulateAutoscale"), None)
+    if autoscale_op is not None:
+        return _run_autoscaler_workload(case, workload, params,
+                                        autoscale_op, log, scale=scale)
     nodes, measured, warm = materialize(case, params)
     log(f"  materialized {len(nodes)} nodes, {len(measured)} measured pods")
 
@@ -165,6 +172,56 @@ def run_workload(case: dict, workload: dict, scale: float = 1.0,
         "scheduled": scheduled, "pods": len(measured), "nodes": len(nodes),
         "encode_s": round(encode_s, 2), "compile_s": round(compile_s, 2),
         "measure_s": round(dt, 2),
+        "thresholds": thresholds, "passed": passed,
+    }
+
+
+def _run_autoscaler_workload(case: dict, workload: dict, params: dict,
+                             op: dict, log, scale: float = 1.0) -> dict:
+    """The ``simulateAutoscale`` opcode: a full cluster (warm pods bound
+    round-robin), the measured pods pending, and K candidate node groups
+    evaluated by the batched tensor scale-up simulation — the measured
+    quantity is the autoscaler DECISION latency (one ``run_filters`` over
+    all K expansion hypotheses + the per-group binpack + the expander).
+    Reference workload shape: the reference autoscaler's scalability tests
+    measure the same RunOnce simulate phase."""
+    from kubernetes_tpu.autoscaler.expander import EXPANDERS
+    from kubernetes_tpu.autoscaler.nodegroup import load_node_group
+    from kubernetes_tpu.autoscaler.simulator import simulate_scale_up
+
+    nodes, measured, warm = materialize(case, params)
+    # warm pods model the existing load: bind them round-robin so the
+    # initial cluster is genuinely full for the pending set
+    for i, p in enumerate(warm):
+        p.spec.node_name = nodes[i % len(nodes)].metadata.name
+    groups = [load_node_group(_load_template(path))
+              for path in op["nodeGroupTemplatePaths"]]
+    expander = EXPANDERS[op.get("expander", "least-waste")]
+    log(f"  {len(nodes)} full nodes, {len(measured)} pending pods, "
+        f"{len(groups)} candidate groups")
+
+    # warmup excluded (JIT compile of the filter program), as everywhere
+    t0 = time.time()
+    simulate_scale_up(nodes, warm, measured, groups)
+    compile_s = time.time() - t0
+    t0 = time.time()
+    options = simulate_scale_up(nodes, warm, measured, groups)
+    decision_s = time.time() - t0
+    choice = expander(options, seed=0)
+
+    placed = choice.pods_placed if choice else 0
+    thresholds = workload.get("thresholds") or {}
+    passed = placed >= len(measured)
+    if "ScaleUpDecisionSeconds" in thresholds:
+        passed = passed and decision_s <= thresholds["ScaleUpDecisionSeconds"]
+    return {
+        "case": case["name"], "workload": workload["name"],
+        "ScaleUpDecisionSeconds": round(decision_s, 4),
+        "compile_s": round(compile_s, 2),
+        "candidate_groups": len(groups),
+        "pods_placed": placed, "pods": len(measured), "nodes": len(nodes),
+        "chosen_group": choice.group.name if choice else None,
+        "nodes_needed": choice.nodes_needed if choice else 0,
         "thresholds": thresholds, "passed": passed,
     }
 

@@ -577,6 +577,48 @@ def cmd_api_resources(client: HTTPClient, args, out) -> int:
     return 0
 
 
+def cmd_autoscale(client: HTTPClient, args, out) -> int:
+    """ktpu autoscale status: the cluster-autoscaler's published status
+    (the ``cluster-autoscaler-status`` ConfigMap, same surface as the
+    reference autoscaler's kube-system ConfigMap)."""
+    from kubernetes_tpu.autoscaler import STATUS_CONFIGMAP
+    try:
+        cm = client.resource("configmaps", args.namespace).get(
+            STATUS_CONFIGMAP)
+    except ApiError as e:
+        if e.code != 404:
+            raise
+        out.write("error: no autoscaler status published "
+                  f"(configmap {STATUS_CONFIGMAP!r} not found in "
+                  f"{args.namespace!r})\n")
+        return 1
+    data = cm.get("data") or {}
+    if args.output == "json":
+        out.write(data.get("status", "{}") + "\n")
+        return 0
+    st = json.loads(data.get("status", "{}") or "{}")
+    out.write(f"Last probe:   {data.get('lastProbeTime', '<unknown>')}\n")
+    out.write(f"Expander:     {st.get('expander', '<unknown>')}\n")
+    groups = st.get("groups") or {}
+    if groups:
+        out.write(f"{'GROUP':<24}{'SIZE':>6}{'MIN':>6}{'MAX':>6}  STATE\n")
+        for name in sorted(groups):
+            g = groups[name]
+            state = ("backoff" if g.get("backoff")
+                     else "cooldown" if g.get("cooldown") else "ready")
+            out.write(f"{name:<24}{g.get('size', 0):>6}"
+                      f"{g.get('minSize', 0):>6}{g.get('maxSize', 0):>6}"
+                      f"  {state}\n")
+    for verb, key in (("scale-up", "lastScaleUp"),
+                      ("scale-down", "lastScaleDown")):
+        ev = st.get(key)
+        if ev:
+            what = ",".join(ev.get("nodes", [])) or ev.get("node", "")
+            out.write(f"Last {verb}: group={ev.get('group')} "
+                      f"nodes={what} at={ev.get('at')}\n")
+    return 0
+
+
 REVISION_ANNOTATION = "deployment.kubernetes.io/revision"
 
 
@@ -732,6 +774,11 @@ def build_parser() -> argparse.ArgumentParser:
     ro.add_argument("action",
                     choices=["status", "history", "undo", "restart"])
     ro.add_argument("kind_name", help="deployment/<name>")
+
+    asc = sub.add_parser("autoscale")
+    asc.add_argument("action", choices=["status"])
+    asc.add_argument("-o", "--output", choices=["table", "json"],
+                     default="table")
     return ap
 
 
@@ -793,6 +840,8 @@ def main(argv=None, out=None) -> int:
         if args.cmd == "rollout":
             args.name = args.kind_name.split("/", 1)[-1]
             return cmd_rollout(client, args, out)
+        if args.cmd == "autoscale":
+            return cmd_autoscale(client, args, out)
     except ApiError as e:
         out.write(f"Error from server ({e.reason or e.code}): {e}\n")
         return 1

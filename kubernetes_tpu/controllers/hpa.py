@@ -15,7 +15,6 @@ hollow kubelet (or a test) publishes — the shape of the data matches
 from __future__ import annotations
 
 import math
-import time
 from typing import Callable, Optional
 
 from kubernetes_tpu.api.resource import canonical
@@ -24,6 +23,7 @@ from kubernetes_tpu.api.types import LabelSelector
 from kubernetes_tpu.client.clientset import ApiError
 from kubernetes_tpu.client.informer import InformerFactory
 from kubernetes_tpu.controllers.base import Controller, active_pods, split_key
+from kubernetes_tpu.utils.clock import REAL_CLOCK
 
 USAGE_ANNOTATION = "kubernetes-tpu.io/cpu-usage"
 TOLERANCE = 0.1  # upstream defaultTestingTolerance: skip scaling within 10%
@@ -43,10 +43,13 @@ class HorizontalPodAutoscalerController(Controller):
     tick_interval = 2.0  # upstream --horizontal-pod-autoscaler-sync-period 15s
 
     def __init__(self, client, metrics_fn: Callable = annotation_metrics,
-                 downscale_stabilization_s: float = 30.0):
+                 downscale_stabilization_s: float = 30.0, clock=None):
         super().__init__(client)
         self.metrics_fn = metrics_fn
         self.downscale_stabilization_s = downscale_stabilization_s
+        # injectable clock (utils/clock.py): HPA-vs-autoscaler interplay
+        # tests advance the stabilization window instead of sleeping it out
+        self.clock = clock or REAL_CLOCK
         # key -> [(ts, recommended replicas)]; scale-down takes the max over
         # the stabilization window (upstream stabilizeRecommendation).
         self._recommendations: dict[str, list[tuple[float, int]]] = {}
@@ -125,7 +128,7 @@ class HorizontalPodAutoscalerController(Controller):
         # Scale-down stabilization: the effective recommendation is the max
         # over the window, seeded with the replica count first observed, so a
         # dip must persist for the whole window before replicas drop.
-        now = time.time()
+        now = self.clock.now()
         recs = self._recommendations.setdefault(key, [(now, current)])
         recs.append((now, desired))
         cutoff = now - self.downscale_stabilization_s

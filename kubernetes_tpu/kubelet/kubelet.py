@@ -41,6 +41,7 @@ class Kubelet:
                  runtime: Optional[ContainerRuntime] = None,
                  allocatable: Optional[dict] = None,
                  labels: Optional[dict] = None,
+                 taints: Optional[list] = None,
                  heartbeat_period: float = 2.0,
                  register_node: bool = True):
         self.client = client
@@ -52,6 +53,14 @@ class Kubelet:
         self.allocatable = allocatable or {"cpu": "8", "memory": "16Gi",
                                            "pods": "110"}
         self.labels = labels or {}
+        # --register-with-taints: emitted at registration (and heartbeat
+        # re-registration) so node-group templates with taints provision
+        # nodes matching what the autoscaler simulation evaluated
+        self.taints = [dict(t) for t in (taints or [])]
+        # deprovisioned (autoscaler scale-down): a dead kubelet must never
+        # heartbeat or re-register — heartbeat_once's 404-heal path would
+        # otherwise resurrect the just-deleted Node as a Ready zombie
+        self.dead = False
         self.heartbeat_period = heartbeat_period
         self.register_node = register_node
         self.pleg = GenericPLEG(self.runtime)
@@ -101,10 +110,13 @@ class Kubelet:
             "conditions": [self._ready_condition()],
         }
         self._apply_endpoint_status(status)
+        spec: dict = {}
+        if self.taints:
+            spec["taints"] = [dict(t) for t in self.taints]
         return {
             "apiVersion": "v1", "kind": "Node",
             "metadata": {"name": self.node_name, "labels": dict(self.labels)},
-            "spec": {},
+            "spec": spec,
             "status": status,
         }
 
@@ -135,6 +147,8 @@ class Kubelet:
                 "lastHeartbeatTime": time.time()}
 
     def _register(self):
+        if self.dead:
+            return
         try:
             self.client.nodes().create(self._node_object())
         except ApiError as e:
@@ -147,6 +161,8 @@ class Kubelet:
         daemonEndpoints on the adopted Node would 502 every logs/exec proxy
         until corrected). Re-registers if the Node vanished. Shared by the
         per-kubelet loop and the kubemark driver pool."""
+        if self.dead:
+            return
         try:
             node = self.client.nodes().get(self.node_name)
             st = node.setdefault("status", {})

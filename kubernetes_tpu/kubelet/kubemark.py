@@ -10,6 +10,11 @@ bulk create, and heartbeats ride a small driver pool instead of a timer
 thread per node. Each node still runs the REAL Kubelet sync machinery —
 admission (allocatable/cpu/device/topology), FakeRuntime sandbox +
 container lifecycle, status writes — via its own PodWorkers.
+
+Membership is dynamic (the cluster-autoscaler's node groups scale it):
+``add_nodes``/``remove_node`` fold nodes into the FIXED driver-shard pool
+— no thread per scale-up batch — and a removed kubelet is marked dead so
+an in-flight heartbeat cannot resurrect its just-deleted Node object.
 """
 
 from __future__ import annotations
@@ -46,6 +51,11 @@ class HollowCluster:
             self.nodes.append(hn)
         self._by_name = {hn.kubelet.node_name: hn.kubelet
                          for hn in self.nodes}
+        # fixed driver shards; membership mutates under _shard_lock and the
+        # driver threads iterate a snapshot per sweep
+        self._shards: list[list[HollowNode]] = [
+            self.nodes[i::self.drivers] for i in range(self.drivers)]
+        self._shard_lock = threading.Lock()
         self._informer: SharedInformer | None = None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -54,20 +64,82 @@ class HollowCluster:
 
     def start(self, wait_sync: float = 30.0) -> "HollowCluster":
         # one bulk registration for the whole fleet
-        self.client.nodes().create_many(
-            [hn.kubelet._node_object() for hn in self.nodes])
+        if self.nodes:
+            self.client.nodes().create_many(
+                [hn.kubelet._node_object() for hn in self.nodes])
         # one shared watch stream; dispatch by spec.nodeName
         self._informer = SharedInformer(self.client.resource("pods", None))
         self._informer.add_event_handler(self._on_pod_event)
         self._informer.start()
         self._informer.wait_for_cache_sync(wait_sync)
-        shards = [self.nodes[i::self.drivers] for i in range(self.drivers)]
-        for shard in shards:
+        for shard in self._shards:
             t = threading.Thread(target=self._driver_loop, args=(shard,),
                                  daemon=True)
             t.start()
             self._threads.append(t)
         return self
+
+    # ---- dynamic membership (cluster-autoscaler node groups) -------------
+
+    def add_nodes(self, names: list[str], allocatable: dict | None = None,
+                  labels: dict | None = None,
+                  taints: list | None = None) -> list[HollowNode]:
+        """Provision hollow kubelets mid-flight (the autoscaler's scale-up
+        path): bulk-register the node objects, join the shared pod watch
+        by name, and fold the batch into the existing driver shards. Each
+        node gets a ``kubernetes.io/hostname`` label on top of ``labels``;
+        ``taints`` register with the node (template fidelity)."""
+        added = []
+        for name in names:
+            hn = HollowNode(self.client, name,
+                            allocatable=dict(allocatable or {
+                                "cpu": "8", "memory": "16Gi", "pods": "110"}),
+                            labels={**(labels or {}),
+                                    "kubernetes.io/hostname": name},
+                            taints=list(taints or []),
+                            heartbeat_period=self.heartbeat_period,
+                            register_node=False)
+            hn.kubelet.recorder = NullRecorder()
+            added.append(hn)
+        # join the watch fan-out BEFORE the nodes become visible: a pod
+        # bound in the gap between create and fan-out registration would
+        # have its event dropped with no relist to heal it
+        self.nodes.extend(added)
+        for hn in added:
+            self._by_name[hn.kubelet.node_name] = hn.kubelet
+        try:
+            self.client.nodes().create_many(
+                [hn.kubelet._node_object() for hn in added])
+        except Exception:
+            for hn in added:
+                self._by_name.pop(hn.kubelet.node_name, None)
+            self.nodes = [hn for hn in self.nodes if hn not in added]
+            raise
+        with self._shard_lock:
+            for hn in added:  # least-loaded shard keeps heartbeats level
+                min(self._shards, key=len).append(hn)
+        return added
+
+    def remove_node(self, name: str):
+        """Deprovision one hollow kubelet (scale-down): mark it dead (so an
+        in-flight heartbeat cannot re-register the Node it is about to
+        lose), stop its sync machinery, drop it from the watch fan-out and
+        its driver shard, delete the node object."""
+        kubelet = self._by_name.pop(name, None)
+        if kubelet is None:
+            return
+        kubelet.dead = True
+        self.nodes = [hn for hn in self.nodes
+                      if hn.kubelet.node_name != name]
+        with self._shard_lock:
+            for shard in self._shards:
+                shard[:] = [hn for hn in shard
+                            if hn.kubelet.node_name != name]
+        kubelet.workers.stop()
+        try:
+            self.client.nodes().delete(name)
+        except Exception:
+            pass  # already gone (raced with another deleter)
 
     def stop(self):
         self._stop.set()
@@ -98,13 +170,21 @@ class HollowCluster:
         # spread the shard's heartbeats across the period so the apiserver
         # sees a steady trickle, not a thundering herd every period
         while not self._stop.is_set():
+            with self._shard_lock:
+                sweep = list(shard)
+            if not sweep:
+                self._stop.wait(self.heartbeat_period)
+                continue
             t0 = time.time()
-            for kubelet in shard:
+            for hn in sweep:
                 if self._stop.is_set():
                     return
-                kubelet.kubelet.heartbeat_once()
-                kubelet.kubelet._renew_lease()
-                budget = self.heartbeat_period / max(1, len(shard))
+                if self._by_name.get(
+                        hn.kubelet.node_name) is not hn.kubelet:
+                    continue  # removed (scale-down) mid-sweep
+                hn.kubelet.heartbeat_once()
+                hn.kubelet._renew_lease()
+                budget = self.heartbeat_period / len(sweep)
                 self._stop.wait(max(0.0, budget - 0.001))
             leftover = self.heartbeat_period - (time.time() - t0)
             if leftover > 0:

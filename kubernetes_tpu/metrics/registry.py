@@ -217,3 +217,28 @@ BIND_RESULTS = REGISTRY.counter(
 GANG_ROUNDS = REGISTRY.histogram(
     "scheduler_gang_rounds", "Conflict-resolution rounds per gang batch",
     buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64))
+
+# Snapshot-freshness observability (the autoscaler's overlay rides the
+# cache's encoded snapshot; staleness shows up here first).
+CACHE_GENERATION = REGISTRY.gauge(
+    "scheduler_cache_generation",
+    "SchedulerCache generation counter (any encode-relevant mutation)")
+CACHE_FULL_ENCODES = REGISTRY.gauge(
+    "scheduler_cache_snapshot_full_encodes",
+    "Full cluster re-encodes performed by snapshot() (vs patch/clean paths)")
+
+# Cluster-autoscaler SLIs (cluster-autoscaler/metrics/metrics.go analogs).
+AUTOSCALER_LOOP_DURATION = REGISTRY.histogram(
+    "cluster_autoscaler_loop_duration_seconds",
+    "One autoscaler reconcile (observe + simulate + act) by phase")
+AUTOSCALER_DECISIONS = REGISTRY.counter(
+    "cluster_autoscaler_decisions_total",
+    "Autoscaler decisions by action (scaleUp|scaleDown|noop|backoff)")
+AUTOSCALER_SCALED = REGISTRY.counter(
+    "cluster_autoscaler_scaled_nodes_total",
+    "Nodes added/removed by direction and node group")
+AUTOSCALER_UNSCHEDULABLE = REGISTRY.gauge(
+    "cluster_autoscaler_unschedulable_pods",
+    "Pending pods the last loop saw as unschedulable")
+AUTOSCALER_GROUP_SIZE = REGISTRY.gauge(
+    "cluster_autoscaler_node_group_size", "Current size by node group")

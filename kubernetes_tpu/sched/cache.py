@@ -70,6 +70,9 @@ class SchedulerCache:
         # encode-relevant node fingerprints: heartbeats that only touch
         # status/conditions must not invalidate the encoding at all
         self._node_fps: dict[str, tuple] = {}
+        # observability: full re-encodes performed by snapshot() (the
+        # autoscaler's overlay path depends on snapshot freshness)
+        self._full_encodes = 0
 
     # ---- delta log (drain-context patch feed) ----------------------------
 
@@ -412,9 +415,18 @@ class SchedulerCache:
         with self._encode_lock:
             return self._snapshot_serialized(pending_pods, slot_headroom)
 
+    def _export_gauges_locked(self):
+        from kubernetes_tpu.metrics.registry import (
+            CACHE_FULL_ENCODES,
+            CACHE_GENERATION,
+        )
+        CACHE_GENERATION.set(self._generation)
+        CACHE_FULL_ENCODES.set(self._full_encodes)
+
     def _snapshot_serialized(self, pending_pods, slot_headroom):
         with self._lock:
             self._expire_assumed_locked()
+            self._export_gauges_locked()
             self._snap_seq = self._dlog_seq
             nodes = list(self._nodes.values())
             gen = self._generation
@@ -463,6 +475,8 @@ class SchedulerCache:
             self._cached = (gen, ct, meta)
             if self._generation == gen:
                 self._needs_full = False
+            self._full_encodes += 1
+            self._export_gauges_locked()
         return nodes, ct, meta
 
     def patch_state_fork(self):
@@ -518,4 +532,6 @@ class SchedulerCache:
     def stats(self) -> dict[str, int]:
         with self._lock:
             return {"nodes": len(self._nodes), "pods": len(self._pods),
-                    "assumed": len(self._assumed), "generation": self._generation}
+                    "assumed": len(self._assumed),
+                    "generation": self._generation,
+                    "full_encodes": self._full_encodes}

@@ -209,6 +209,13 @@ class SchedulingQueue:
             self.closed = True
             self._lock.notify_all()
 
+    def unschedulable_pods(self) -> list[Pod]:
+        """Snapshot of the unschedulable map's pods — the cluster
+        autoscaler's scale-up signal (the reference reads the analogous
+        list through its unschedulablePods lister)."""
+        with self._lock:
+            return [item.pod for item in self._unschedulable.values()]
+
     def stats(self) -> dict[str, int]:
         with self._lock:
             nb = sum(1 for _, it in self._backoff if self._current_locked(it))

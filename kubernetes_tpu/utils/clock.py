@@ -1,14 +1,45 @@
-"""Wire-format timestamps.
+"""Wire-format timestamps + injectable clocks.
 
 Reference shape: metav1.Time serializes as RFC3339 with second precision
 (``apimachinery/pkg/apis/meta/v1/time.go``, MarshalJSON). Every condition
 ``lastTransitionTime``, managedFields ``time``, event timestamp etc. is a
 string of this shape on the wire; kubectl-shaped consumers parse it.
+
+``Clock``/``FakeClock`` mirror ``k8s.io/utils/clock``: controllers with
+time-window logic (HPA stabilization, autoscaler cooldowns) take a clock so
+tests advance time deterministically instead of sleeping through windows.
 """
 
 from __future__ import annotations
 
 import datetime
+import time as _time
+
+
+class Clock:
+    """Real wall clock (clock.RealClock analog)."""
+
+    def now(self) -> float:
+        return _time.time()
+
+
+class FakeClock(Clock):
+    """Manually-advanced clock for tests (clock.FakeClock analog)."""
+
+    def __init__(self, t: float = 0.0):
+        self._t = float(t)
+
+    def now(self) -> float:
+        return self._t
+
+    def advance(self, dt: float) -> None:
+        self._t += float(dt)
+
+    def set(self, t: float) -> None:
+        self._t = float(t)
+
+
+REAL_CLOCK = Clock()
 
 
 def rfc3339_now() -> str:

@@ -1,0 +1,572 @@
+"""Cluster autoscaler: hypothetical-node overlay, batched scale-up
+simulation, expanders, scale-down re-placement proof, and the kubemark
+end-to-end (gang scale-up from zero + idle reclaim).
+
+Reference: ``kubernetes/autoscaler`` ClusterAutoscaler (simulator/,
+expander/, core ScaleUp/ScaleDown).
+"""
+
+import io
+import json
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.autoscaler.expander import (
+    least_waste,
+    most_pods,
+    priority,
+    random_expander,
+)
+from kubernetes_tpu.autoscaler.nodegroup import (
+    NODE_GROUP_LABEL,
+    NodeGroup,
+    StaticNodeGroupProvider,
+    load_node_group,
+)
+from kubernetes_tpu.autoscaler import simulator
+from kubernetes_tpu.autoscaler.simulator import (
+    simulate_scale_down,
+    simulate_scale_up,
+)
+from kubernetes_tpu.encode.snapshot import SnapshotEncoder
+from kubernetes_tpu.ops.filters import run_filters
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+pytestmark = pytest.mark.autoscaler
+
+
+def _group(name, min_size, max_size, caps, taints=(), labels=(), **kw):
+    tpl = make_node(f"{name}-tpl").capacity(dict(caps))
+    for k, v in dict(labels).items():
+        tpl = tpl.label(k, v)
+    for key, value, effect in taints:
+        tpl = tpl.taint(key, value, effect)
+    return NodeGroup(name, min_size, max_size, tpl.obj(), **kw)
+
+
+def wait_for(pred, timeout=30.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ---------------------------------------------------------------- overlay
+
+def test_hypothetical_overlay_feasibility():
+    """Template rows behave like real nodes under the filter pipeline:
+    labels satisfy selectors, taints repel intolerant pods, capacity
+    gates fit — and the real rows are untouched."""
+    enc = SnapshotEncoder()
+    real = make_node("real").capacity({"cpu": "1", "pods": "10"}).obj()
+    blocker = make_pod("blocker").req({"cpu": "900m"}).node("real").obj()
+    want_ssd = (make_pod("want-ssd").req({"cpu": "500m"})
+                .node_selector({"disk": "ssd"}).obj())
+    plain = make_pod("plain").req({"cpu": "500m"}).obj()
+    pending = [want_ssd, plain]
+    ct, meta = enc.encode_cluster([real], [blocker], pending_pods=pending,
+                                  pending_slots=False)
+    ssd = make_node("hypo-ssd").capacity({"cpu": "4", "pods": "10"}) \
+        .label("disk", "ssd").obj()
+    tainted = make_node("hypo-taint").capacity({"cpu": "4", "pods": "10"}) \
+        .taint("dedicated", "infra").obj()
+    ct2, rows = enc.with_hypothetical(ct, meta, [ssd, tainted])
+    assert len(rows) == 2
+    mask = np.asarray(run_filters(ct2, enc.encode_pods(pending, meta)))
+    # real node is full: neither pending pod fits there
+    assert not mask[0, 0] and not mask[1, 0]
+    # ssd template: selector satisfied for want-ssd, plain also fits
+    assert mask[0, rows[0]] and mask[1, rows[0]]
+    # tainted template repels both (no toleration); selector also unmet
+    assert not mask[0, rows[1]] and not mask[1, rows[1]]
+
+
+def test_overlay_empty_is_identity():
+    enc = SnapshotEncoder()
+    ct, meta = enc.encode_cluster(
+        [make_node("n").capacity({"cpu": "1"}).obj()], [])
+    ct2, rows = enc.with_hypothetical(ct, meta, [])
+    assert rows == [] and ct2 is ct
+
+
+# ----------------------------------------------------- batched simulation
+
+def test_scale_up_is_one_batched_evaluation(monkeypatch):
+    """Acceptance: K candidate groups evaluate via ONE run_filters call
+    over the hypothetical-node overlay, not K sequential passes."""
+    calls = {"n": 0}
+    real_run_filters = simulator.run_filters
+
+    def counting(ct, pb, enabled=None):
+        calls["n"] += 1
+        return real_run_filters(ct, pb, enabled)
+
+    monkeypatch.setattr(simulator, "run_filters", counting)
+    nodes = [make_node("full").capacity({"cpu": "1", "pods": "10"}).obj()]
+    bound = [make_pod("b").req({"cpu": "900m"}).node("full").obj()]
+    pending = [make_pod(f"p{i}").req({"cpu": "600m"}).obj()
+               for i in range(6)]
+    groups = [_group("g-small", 0, 10, {"cpu": "1", "pods": "10"}),
+              _group("g-med", 0, 10, {"cpu": "2", "pods": "10"}),
+              _group("g-big", 0, 10, {"cpu": "8", "pods": "10"})]
+    options = simulate_scale_up(nodes, bound, pending, groups)
+    assert calls["n"] == 1, "candidate evaluation must be one batched call"
+    by_name = {o.group.name: o for o in options}
+    assert by_name["g-small"].pods_placed == 6
+    assert by_name["g-small"].nodes_needed == 6   # one 600m pod per 1-cpu
+    assert by_name["g-med"].nodes_needed == 2     # three per 2-cpu node
+    assert by_name["g-big"].nodes_needed == 1
+
+
+def test_scale_up_skips_pods_that_fit_existing_nodes():
+    nodes = [make_node("roomy").capacity({"cpu": "4", "pods": "10"}).obj()]
+    pending = [make_pod("p").req({"cpu": "500m"}).obj()]
+    options = simulate_scale_up(nodes, [], pending,
+                                [_group("g", 0, 5, {"cpu": "8"})])
+    assert options == []  # the scheduler just hasn't reached it yet
+
+
+def test_scale_up_headroom_caps_expansion():
+    nodes = [make_node("full").capacity({"cpu": "1", "pods": "10"}).obj()]
+    bound = [make_pod("b").req({"cpu": "1"}).node("full").obj()]
+    pending = [make_pod(f"p{i}").req({"cpu": "900m"}).obj()
+               for i in range(5)]
+    g = _group("g", 0, 5, {"cpu": "1", "pods": "10"})
+    options = simulate_scale_up(nodes, bound, pending, [g],
+                                headroom={"g": 2})
+    assert len(options) == 1
+    assert options[0].nodes_needed == 2 and options[0].pods_placed == 2
+
+
+def test_scale_up_respects_template_taints_and_selectors():
+    nodes = [make_node("full").capacity({"cpu": "1", "pods": "10"}).obj()]
+    bound = [make_pod("b").req({"cpu": "1"}).node("full").obj()]
+    tolerant = (make_pod("tol").req({"cpu": "500m"})
+                .toleration("dedicated", "Equal", "infra", "NoSchedule")
+                .obj())
+    intolerant = make_pod("plain").req({"cpu": "500m"}).obj()
+    g = _group("dedicated", 0, 5, {"cpu": "8", "pods": "10"},
+               taints=[("dedicated", "infra", "NoSchedule")])
+    options = simulate_scale_up(nodes, bound, [tolerant, intolerant], [g])
+    assert len(options) == 1
+    assert options[0].pod_indices == [0]  # only the tolerating pod
+
+
+# -------------------------------------------------------------- expanders
+
+def _opt(name, waste, placed, nodes_needed=1, prio=0):
+    from kubernetes_tpu.autoscaler.simulator import ScaleUpOption
+    return ScaleUpOption(
+        group=_group(name, 0, 10, {"cpu": "1"}, priority=prio),
+        pod_indices=list(range(placed)), nodes_needed=nodes_needed,
+        waste=waste)
+
+
+def test_expanders():
+    a = _opt("a", waste=0.8, placed=4, nodes_needed=4)
+    b = _opt("b", waste=0.2, placed=4, nodes_needed=1)
+    c = _opt("c", waste=0.5, placed=6, nodes_needed=2, prio=7)
+    assert least_waste([a, b, c]).group.name == "b"
+    assert most_pods([a, b, c]).group.name == "c"
+    assert priority([a, b, c]).group.name == "c"
+    assert random_expander([a, b, c], seed=0) is not None
+    assert least_waste([]) is None
+    # deterministic tie-break from the seed
+    tie1, tie2 = _opt("t1", 0.5, 3), _opt("t2", 0.5, 3)
+    picks = {random_expander([tie1, tie2], seed=s).group.name
+             for s in range(8)}
+    assert picks == {"t1", "t2"}
+
+
+# ------------------------------------------------------------- scale-down
+
+def _three_nodes():
+    caps = {"cpu": "4", "memory": "8Gi", "pods": "10"}
+    return [make_node(n).capacity(caps).obj() for n in ("m0", "m1", "m2")]
+
+
+def test_scale_down_replacement_proof():
+    """An idle-ish node drains only when every resident provably fits
+    elsewhere; a resident that fits nowhere else blocks its node."""
+    nodes = _three_nodes()
+    bound = [make_pod("r0").req({"cpu": "500m"}).node("m1").obj(),
+             make_pod("r1").req({"cpu": "3500m"}).node("m2").obj(),
+             make_pod("r2").req({"cpu": "3"}).node("m0").obj()]
+    plan = simulate_scale_down(nodes, bound, ["m1", "m2"],
+                               utilization_threshold=0.95)
+    assert plan.removable == ["m1"]
+    assert plan.placements["m1"] == [("default/r0", "m0")]
+    assert "fits nowhere else" in plan.blocked["m2"]
+
+
+def test_scale_down_utilization_gate():
+    nodes = _three_nodes()
+    bound = [make_pod("busy").req({"cpu": "3"}).node("m1").obj()]
+    plan = simulate_scale_down(nodes, bound, ["m1"],
+                               utilization_threshold=0.5)
+    assert plan.removable == []
+    assert "utilization" in plan.blocked["m1"]
+
+
+def test_scale_down_shared_ledger_no_double_booking():
+    """Two candidates' residents must not both claim the same free room."""
+    caps = {"cpu": "4", "pods": "10"}
+    nodes = [make_node(n).capacity(caps).obj() for n in ("a", "b", "t")]
+    bound = [make_pod("pa").req({"cpu": "3"}).node("a").obj(),
+             make_pod("pb").req({"cpu": "3"}).node("b").obj()]
+    plan = simulate_scale_down(nodes, bound, ["a", "b"],
+                               utilization_threshold=0.95)
+    # target t has 4 cpu: holds one 3-cpu re-placement, not two
+    assert plan.removable == ["a"]
+    assert "pb" in plan.blocked["b"]
+
+
+def test_scale_down_pdb_blocks_eviction():
+    nodes = _three_nodes()
+    pod = make_pod("guarded").req({"cpu": "100m"}).node("m1") \
+        .label("app", "web").obj()
+    pod_dict = pod.to_dict()
+    pod_dict.setdefault("status", {})["phase"] = "Running"
+    pod_dict["status"]["conditions"] = [{"type": "Ready", "status": "True"}]
+    pdb = {"apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+           "metadata": {"name": "web-pdb", "namespace": "default"},
+           "spec": {"minAvailable": 1,
+                    "selector": {"matchLabels": {"app": "web"}}}}
+    plan = simulate_scale_down(nodes, [pod], ["m1"],
+                               utilization_threshold=0.95,
+                               pdbs=[pdb], all_pod_dicts=[pod_dict])
+    assert plan.removable == []
+    assert "PDB" in plan.blocked["m1"]
+
+
+def test_scale_down_pdb_budget_charges_across_evictions():
+    """A budget with ONE disruption left must not approve TWO evictions:
+    the simulation charges the allowance per approved pod instead of
+    re-reading the same static status."""
+    nodes = _three_nodes()
+    pods = [make_pod(f"web-{i}").req({"cpu": "100m"}).node("m1")
+            .label("app", "web").obj() for i in range(2)]
+    dicts = []
+    for p in pods:
+        d = p.to_dict()
+        d.setdefault("status", {})["phase"] = "Running"
+        d["status"]["conditions"] = [{"type": "Ready", "status": "True"}]
+        dicts.append(d)
+    pdb = {"apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+           "metadata": {"name": "web-pdb", "namespace": "default"},
+           "spec": {"minAvailable": 1,
+                    "selector": {"matchLabels": {"app": "web"}}}}
+    # 2 healthy, minAvailable 1 -> disruptionsAllowed = 1: first eviction
+    # passes, the second must trip the charged budget
+    plan = simulate_scale_down(nodes, pods, ["m1"],
+                               utilization_threshold=0.95,
+                               pdbs=[pdb], all_pod_dicts=dicts)
+    assert plan.removable == []
+    assert "PDB" in plan.blocked["m1"]
+
+
+def test_scale_down_ignores_daemonset_and_mirror_pods():
+    """Daemon/mirror pods need no re-placement proof — the drain skips
+    them, so the simulation must too."""
+    nodes = _three_nodes()
+    ds_pod = make_pod("ds-x").req({"cpu": "100m"}).node("m1").obj()
+    ds_pod.metadata.owner_references.append(
+        {"kind": "DaemonSet", "name": "ds"})
+    mirror = make_pod("mirror-x").req({"cpu": "100m"}).node("m1").obj()
+    mirror.metadata.annotations["kubernetes.io/config.mirror"] = "abc"
+    plan = simulate_scale_down(nodes, [ds_pod, mirror], ["m1"],
+                               utilization_threshold=0.95)
+    assert plan.removable == ["m1"]
+    assert plan.placements["m1"] == []
+
+
+def test_hollow_node_registers_template_taints():
+    """Template fidelity: the node the provider registers must carry the
+    taints the scale-up simulation evaluated."""
+    from kubernetes_tpu.client.clientset import DirectClient
+    from kubernetes_tpu.kubelet.kubelet import Kubelet
+    from kubernetes_tpu.store.store import ObjectStore
+    kl = Kubelet(DirectClient(ObjectStore()), "t0",
+                 taints=[{"key": "dedicated", "value": "infra",
+                          "effect": "NoSchedule"}],
+                 register_node=False)
+    obj = kl._node_object()
+    assert obj["spec"]["taints"] == [{"key": "dedicated", "value": "infra",
+                                      "effect": "NoSchedule"}]
+
+
+def test_scale_down_reclaims_multiple_nodes_to_min_in_one_pass():
+    """Live min-size accounting: with min_size=1 and three idle nodes, one
+    reconcile reclaims two and stops exactly at the floor."""
+    from kubernetes_tpu.autoscaler import ClusterAutoscaler
+    from kubernetes_tpu.client.clientset import DirectClient
+    from kubernetes_tpu.store.store import ObjectStore
+    client = DirectClient(ObjectStore())
+    provider = StaticNodeGroupProvider(
+        client, [_group("idle-pool", 1, 5, {"cpu": "2", "pods": "10"})])
+    provider.scale_up("idle-pool", 3)
+    ca = ClusterAutoscaler(client, provider, scale_down_unneeded_s=0.0)
+    summary = ca.run_once()
+    assert len(summary["scaled_down"]) == 2
+    assert provider.target_size("idle-pool") == 1
+    assert len(client.nodes().list()) == 1
+
+
+# ----------------------------------------------- config sanity + loading
+
+def test_check_node_groups_fails_fast():
+    from kubernetes_tpu.utils.sanity import check_node_groups
+    bad = _group("bad", 5, 2, {"cpu": "1"})
+    no_alloc = NodeGroup("empty", 0, 1, make_node("t").obj())
+    dup = _group("bad", 0, 1, {"cpu": "1"})
+    problems = check_node_groups([bad, no_alloc, dup])
+    assert any("min_size 5 > max_size 2" in p for p in problems)
+    assert any("no allocatable" in p for p in problems)
+    assert any("duplicate" in p for p in problems)
+    assert check_node_groups([_group("ok", 0, 3, {"cpu": "1"})]) == []
+
+
+def test_autoscaler_rejects_bad_groups_at_construction():
+    from kubernetes_tpu.autoscaler import ClusterAutoscaler
+    from kubernetes_tpu.client.clientset import DirectClient
+    from kubernetes_tpu.store.store import ObjectStore
+    client = DirectClient(ObjectStore())
+    provider = StaticNodeGroupProvider(
+        client, [_group("bad", 9, 1, {"cpu": "1"})])
+    with pytest.raises(ValueError, match="min_size 9 > max_size 1"):
+        ClusterAutoscaler(client, provider)
+    with pytest.raises(ValueError, match="unknown expander"):
+        ClusterAutoscaler(
+            client, StaticNodeGroupProvider(
+                client, [_group("ok", 0, 1, {"cpu": "1"})]),
+            expander="does-not-exist")
+
+
+def test_load_node_group_yaml():
+    import os
+    import yaml
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks", "config", "templates",
+        "node-group-default.yaml")
+    with open(path) as f:
+        g = load_node_group(yaml.safe_load(f))
+    assert g.name == "perf-group" and g.min_size == 0 and g.max_size == 50
+    assert g.template.status.allocatable["cpu"] == "32"
+    stamped = g.template_node("perf-group-0")
+    assert stamped.metadata.labels[NODE_GROUP_LABEL] == "perf-group"
+    from kubernetes_tpu.utils.sanity import check_node_groups
+    assert check_node_groups([g]) == []
+
+
+# --------------------------------------------- queue + cache observability
+
+def test_queue_unschedulable_pods_snapshot():
+    from kubernetes_tpu.sched.queue import SchedulingQueue
+    q = SchedulingQueue()
+    q.park_unschedulable(make_pod("u1").obj(), attempts=1)
+    q.park_unschedulable(make_pod("u2").obj(), attempts=2)
+    names = {p.metadata.name for p in q.unschedulable_pods()}
+    assert names == {"u1", "u2"}
+    q.close()
+
+
+def test_cache_exports_generation_and_full_encode_gauges():
+    from kubernetes_tpu.metrics.registry import (
+        CACHE_FULL_ENCODES,
+        CACHE_GENERATION,
+    )
+    from kubernetes_tpu.sched.cache import SchedulerCache
+    cache = SchedulerCache()
+    cache.add_node(make_node("n1").capacity({"cpu": "1"}).obj())
+    cache.snapshot()
+    g1 = CACHE_GENERATION.get()
+    e1 = CACHE_FULL_ENCODES.get()
+    assert cache.stats()["full_encodes"] >= 1
+    cache.add_node(make_node("n2").capacity({"cpu": "1"}).obj())
+    cache.snapshot()
+    assert CACHE_GENERATION.get() > g1
+    assert CACHE_FULL_ENCODES.get() == e1 + 1
+    # clean snapshot: generation gauge stays, no new full encode
+    cache.snapshot()
+    assert CACHE_FULL_ENCODES.get() == e1 + 1
+
+
+# ----------------------------------------------------- HPA with FakeClock
+
+def test_hpa_stabilization_with_fake_clock():
+    """Satellite: the HPA scale-down stabilization window advances by
+    FakeClock, not wall time — the HPA/autoscaler interplay is testable
+    deterministically."""
+    from kubernetes_tpu.client.clientset import DirectClient
+    from kubernetes_tpu.client.informer import InformerFactory
+    from kubernetes_tpu.controllers import HorizontalPodAutoscalerController
+    from kubernetes_tpu.controllers.hpa import USAGE_ANNOTATION
+    from kubernetes_tpu.store.store import ObjectStore
+    from kubernetes_tpu.utils.clock import FakeClock
+
+    client = DirectClient(ObjectStore())
+    clock = FakeClock(1000.0)
+    ctrl = HorizontalPodAutoscalerController(
+        client, downscale_stabilization_s=300.0, clock=clock)
+    ctrl.tick_interval = 0.1
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    try:
+        client.resource("deployments").create({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"replicas": 3,
+                     "selector": {"matchLabels": {"app": "web"}},
+                     "template": {"metadata": {"labels": {"app": "web"}},
+                                  "spec": {"containers": [{"name": "c"}]}}}})
+        for i in range(3):
+            p = make_pod(f"w{i}").label("app", "web") \
+                .req({"cpu": "1"}).obj().to_dict()
+            p["metadata"].setdefault("annotations", {})[
+                USAGE_ANNOTATION] = "100m"   # 10% used vs 50% target
+            p["spec"]["nodeName"] = "n1"
+            p["status"] = {"phase": "Running"}
+            client.pods().create(p)
+        client.resource("horizontalpodautoscalers").create({
+            "apiVersion": "autoscaling/v2", "kind": "HorizontalPodAutoscaler",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"scaleTargetRef": {"kind": "Deployment", "name": "web"},
+                     "minReplicas": 1, "maxReplicas": 5,
+                     "metrics": [{"type": "Resource", "resource": {
+                         "name": "cpu", "target": {
+                             "type": "Utilization",
+                             "averageUtilization": 50}}}]}})
+        time.sleep(1.0)  # several ticks inside the (frozen) window
+        assert client.resource("deployments").get("web")["spec"][
+            "replicas"] == 3
+        clock.advance(301.0)  # window elapses instantly
+        assert wait_for(lambda: client.resource("deployments")
+                        .get("web")["spec"]["replicas"] == 1, 10.0)
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+# ---------------------------------------------------------- CLI + status
+
+def test_ktpu_autoscale_status():
+    from kubernetes_tpu.autoscaler import ClusterAutoscaler
+    from kubernetes_tpu.cli.ktpu import main as ktpu_main
+    from kubernetes_tpu.client.clientset import HTTPClient
+    from kubernetes_tpu.store.apiserver import APIServer
+
+    server = APIServer().start()
+    try:
+        client = HTTPClient(server.url)
+        provider = StaticNodeGroupProvider(
+            client, [_group("cli-group", 0, 4, {"cpu": "2"})])
+        ca = ClusterAutoscaler(client, provider)
+        ca.run_once()   # publishes the status ConfigMap
+        out = io.StringIO()
+        rc = ktpu_main(["--server", server.url, "autoscale", "status"],
+                       out=out)
+        assert rc == 0
+        text = out.getvalue()
+        assert "cli-group" in text and "ready" in text
+        out = io.StringIO()
+        assert ktpu_main(["--server", server.url, "autoscale", "status",
+                          "-o", "json"], out=out) == 0
+        st = json.loads(out.getvalue())
+        assert st["groups"]["cli-group"]["maxSize"] == 4
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------ end-to-end
+
+def test_gang_scale_up_and_idle_scale_down_e2e():
+    """Acceptance: an unschedulable gang on an empty cluster provisions a
+    node group from zero (hollow kubelets through the apiserver), every
+    member binds, the unschedulable set drains to zero; deleting most of
+    the gang lets scale-down reclaim idle nodes — but never a node whose
+    resident the tensor simulation cannot re-place."""
+    from kubernetes_tpu.autoscaler import (
+        ClusterAutoscaler,
+        HollowNodeGroupProvider,
+    )
+    from kubernetes_tpu.client.clientset import HTTPClient
+    from kubernetes_tpu.config.types import SchedulerConfiguration
+    from kubernetes_tpu.sched.runner import SchedulerRunner
+    from kubernetes_tpu.store.apiserver import APIServer
+
+    server = APIServer().start()
+    provider = runner = None
+    try:
+        client = HTTPClient(server.url)
+        provider = HollowNodeGroupProvider(
+            HTTPClient(server.url),
+            [_group("gang-pool", 0, 4, {"cpu": "2", "memory": "4Gi",
+                                        "pods": "110"})],
+            heartbeat_period=1.0)
+        runner = SchedulerRunner(
+            HTTPClient(server.url),
+            SchedulerConfiguration(backoff_initial_s=0.05,
+                                   backoff_max_s=0.2))
+        runner.start()
+        ca = ClusterAutoscaler(HTTPClient(server.url), provider,
+                               scale_down_unneeded_s=0.0)
+
+        gang = [make_pod(f"gang-{i}").label("gang", "g1")
+                .req({"cpu": "500m"}).obj().to_dict() for i in range(8)]
+        client.pods("default").create_many(gang)
+        pods = client.pods("default")
+
+        def all_bound():
+            ca.run_once()
+            return all(p["spec"].get("nodeName") for p in pods.list())
+
+        assert wait_for(all_bound, 60.0, interval=0.3), [
+            (p["metadata"]["name"], p["spec"].get("nodeName"))
+            for p in pods.list()]
+        # 8 pods x 500m on 2-cpu nodes -> 2 nodes provisioned, gang bound
+        assert provider.target_size("gang-pool") == 2
+        assert wait_for(lambda: runner.queue.stats()["unschedulable"] == 0,
+                        10.0)
+
+        # pods actually run on the hollow kubelets
+        assert wait_for(lambda: sum(
+            1 for p in pods.list()
+            if (p.get("status") or {}).get("phase") == "Running") == 8,
+            30.0)
+
+        # keep one PDB-guarded resident: its node can never drain (the
+        # simulation must refuse the eviction), the now-idle node can
+        survivor_node = pods.get("gang-0")["spec"]["nodeName"]
+        client.resource("poddisruptionbudgets", "default").create({
+            "apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+            "metadata": {"name": "gang-pdb", "namespace": "default"},
+            "spec": {"minAvailable": 1,
+                     "selector": {"matchLabels": {"gang": "g1"}}}})
+        for i in range(1, 8):
+            pods.delete(f"gang-{i}")
+
+        def reclaimed():
+            ca.run_once()
+            return provider.target_size("gang-pool") == 1
+
+        assert wait_for(reclaimed, 30.0, interval=0.3)
+        assert pods.get("gang-0")["spec"]["nodeName"] == survivor_node
+        node_names = {n["metadata"]["name"]
+                      for n in client.nodes().list()}
+        assert survivor_node in node_names and len(node_names) == 1
+        st = ca.status()
+        assert st["lastScaleUp"]["group"] == "gang-pool"
+        assert st["lastScaleDown"]["group"] == "gang-pool"
+    finally:
+        if runner is not None:
+            runner.stop()
+        if provider is not None:
+            provider.stop()
+        server.stop()
