@@ -17,7 +17,9 @@ reference publishes no in-repo numbers, "published": {}).
 
 Env knobs: BENCH_CASE (only this case), BENCH_SCALE (default 1.0),
 BENCH_BATCH (default 1024), BENCH_CONNECTED=0 to skip the connected run,
-BENCH_CONNECTED_PODS/NODES (default 2000/1000).
+BENCH_CONNECTED_PODS/NODES (default 2000/1000), BENCH_CONNECTED_PIPELINE
+(dispatch-pipeline depth for the connected run — sweep it to find the
+knee; unset = SchedulerConfiguration.pipeline_depth default).
 """
 
 from __future__ import annotations
@@ -64,9 +66,11 @@ def main():
     connected = None
     if os.environ.get("BENCH_CONNECTED", "1") != "0" and not only_case:
         log("[bench] connected-path run ...")
+        _pipe = os.environ.get("BENCH_CONNECTED_PIPELINE")
         connected = run_connected(
             n_pods=int(os.environ.get("BENCH_CONNECTED_PODS", "10000")),
             n_nodes=int(os.environ.get("BENCH_CONNECTED_NODES", "5000")),
+            pipeline_depth=int(_pipe) if _pipe else None,
             log=log)
         log("[bench] " + json.dumps(connected))
 

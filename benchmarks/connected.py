@@ -67,12 +67,15 @@ def _watch_bound(url: str, ns: str, rv0: int, n_pods: int,
     dead.set()
 
 
-def _churn_loop(client, stop, period_s: float = 0.1, counter=None) -> None:
+def _churn_loop(client, stop, period_s: float = 0.1, counter=None,
+                hurry=None) -> None:
     """scheduler_perf's ``churn`` op analog: recycle nodes and short-lived
     pods (namespace ``churn``, excluded from the measured set) during the
     measured window. Exercises event-driven requeue
     (MoveAllToActiveOrBackoffQueue on node events), cache delta deletes,
-    and the drain context's invalidate-and-rebuild path under load."""
+    and the drain context's invalidate-and-rebuild path under load.
+    ``hurry``: optional Event — once set, the loop drops to a 10ms cadence
+    so a fixed op budget completes quickly after the measured drain."""
     import itertools
     from kubernetes_tpu.testing.wrappers import make_node, make_pod
     seq = itertools.count()
@@ -96,13 +99,15 @@ def _churn_loop(client, stop, period_s: float = 0.1, counter=None) -> None:
                 counter["ops"] = counter.get("ops", 0) + 4
         except Exception:
             pass  # churn is background noise; the bench owns correctness
-        stop.wait(period_s)
+        stop.wait(period_s if hurry is None or not hurry.is_set()
+                  else min(period_s, 0.01))
 
 
 def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
                   batch_size: int = 512, drain_batches: int = 2,
                   timeout: float = 300.0, churn: bool = False,
-                  churn_period_s: float = 0.1,
+                  churn_period_s: float = 0.1, min_churn_ops: int = 500,
+                  pipeline_depth: int | None = None,
                   log=lambda *a: None) -> dict:
     from kubernetes_tpu.client.clientset import HTTPClient
     from kubernetes_tpu.config.types import SchedulerConfiguration
@@ -123,10 +128,14 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         seed_client.nodes().create_many([n.to_dict() for n in nodes])
         log(f"  seeded {n_nodes} nodes in {time.time()-t0:.1f}s")
 
-        runner = SchedulerRunner(
-            HTTPClient(url),
-            SchedulerConfiguration(batch_size=batch_size,
-                                   max_drain_batches=drain_batches))
+        cfg_kw = dict(batch_size=batch_size,
+                      max_drain_batches=drain_batches)
+        if pipeline_depth is not None:
+            # clamp like the scheduler does, so the reported depth is the
+            # depth that actually ran (depth 0 would silently run as 1)
+            cfg_kw["pipeline_depth"] = max(1, int(pipeline_depth))
+        runner = SchedulerRunner(HTTPClient(url),
+                                 SchedulerConfiguration(**cfg_kw))
         # informers first (nodes sync into the scheduler cache); the loop
         # starts after pod creation so the first pop drains a deep backlog
         runner.start(start_loop=False)
@@ -142,15 +151,17 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         watcher.start()
         ready.wait(30.0)  # spawn + import + stream setup is seconds
 
-        churn_stop = None
+        churn_stop = churn_hurry = None
         churn_stats: dict = {}
         if churn:
             import threading
             churn_stop = threading.Event()
+            churn_hurry = threading.Event()
             threading.Thread(target=_churn_loop,
                              args=(HTTPClient(url), churn_stop),
                              kwargs={"counter": churn_stats,
-                                     "period_s": churn_period_s},
+                                     "period_s": churn_period_s,
+                                     "hurry": churn_hurry},
                              daemon=True).start()
 
         _trace_window()  # spans from here on belong to the measured window
@@ -211,9 +222,10 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
                 milestones[frac] = round(dt, 2)
         log(f"  created {n_pods} pods in {t_created-t_start:.1f}s; "
             f"all bound at +{dt:.1f}s")
-        if churn_stop is not None:
-            churn_stop.set()
-        runner.stop()
+        # Snapshot the MEASURED window's metrics BEFORE the churn budget
+        # phase below: the hurry-phase keeps the live scheduler processing
+        # small fast churn batches, which would otherwise skew the reported
+        # p99/p50/span totals the same way an earlier phase would.
         # p99 attempt latency (scheduled results) from the live histogram —
         # bucket upper bound, like Prometheus histogram_quantile
         p99 = ATTEMPT_DURATION.percentile(0.99, {"result": "scheduled"})
@@ -221,6 +233,24 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         # where the window went: scheduler-side span totals (ms) + the bind
         # progress curve, so a BENCH file diagnoses its own bottleneck
         span_ms = _span_totals()
+        attempt_buckets = [
+            (b, c) for b, c in ATTEMPT_DURATION.bucket_counts(
+                {"result": "scheduled"}) if c]
+        ctx_stats = dict(runner.scheduler.ctx_stats)
+        encode_cache = runner.cache.encode_cache_stats()
+        if churn_stop is not None:
+            # fixed churn-op budget DECOUPLED from drain duration: a fast
+            # drain must not mean the churn path went unexercised (r05: the
+            # 2k-pod window shrank to 1.2s and applied only 36 ops). Keep
+            # churning at a hurried cadence against the LIVE scheduler
+            # until the budget lands, then tear down.
+            churn_hurry.set()
+            budget_deadline = time.time() + 60.0
+            while (churn_stats.get("ops", 0) < min_churn_ops
+                   and time.time() < budget_deadline):
+                time.sleep(0.05)
+            churn_stop.set()
+        runner.stop()
         out = {
             "case": "ConnectedChurn" if churn else "ConnectedScheduler",
             "workload": f"{n_pods}x{n_nodes}",
@@ -239,10 +269,13 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         }
         if churn:
             out["churn_api_ops"] = churn_stats.get("ops", 0)
-        out["ctx_stats"] = dict(runner.scheduler.ctx_stats)
-        out["attempt_buckets"] = [
-            (b, c) for b, c in ATTEMPT_DURATION.bucket_counts(
-                {"result": "scheduled"}) if c]
+        # pipeline + incremental-encode attribution (measured-window
+        # snapshot, like p99/spans): depth knob in effect, and how many pod
+        # rows the hot path served from the informer-time compile cache
+        out["ctx_stats"] = ctx_stats
+        out["pipeline_depth"] = runner.cfg.pipeline_depth
+        out["encode_cache"] = encode_cache
+        out["attempt_buckets"] = attempt_buckets
         return out
     finally:
         try:
@@ -433,10 +466,12 @@ if __name__ == "__main__":
     import os
     import sys
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    _pipe = os.environ.get("BENCH_CONNECTED_PIPELINE")
     res = run_connected(
         n_pods=int(os.environ.get("BENCH_CONNECTED_PODS", "2000")),
         n_nodes=int(os.environ.get("BENCH_CONNECTED_NODES", "1000")),
         batch_size=int(os.environ.get("BENCH_CONNECTED_BATCH", "512")),
         drain_batches=int(os.environ.get("BENCH_CONNECTED_DRAIN", "2")),
+        pipeline_depth=int(_pipe) if _pipe else None,
         log=lambda *a: print(*a, file=sys.stderr))
     print(json.dumps(res))

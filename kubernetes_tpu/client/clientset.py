@@ -189,6 +189,13 @@ class ResourceClient:
         Returns per-item error message or None (success), request order."""
         return self._t.bind_many(bindings)
 
+    def update_status_many(self, items: list[tuple[str, str, dict]]
+                           ) -> list[Optional[str]]:
+        """Bulk pod status: ``[(namespace, name, status)]`` in one request
+        (the kubemark status batcher's transport). Returns per-item error
+        message or None (success), request order."""
+        return self._t.update_status_many(items)
+
     def evict(self, name: str) -> dict:
         return self._t.evict(self.namespace, name)
 
@@ -402,6 +409,9 @@ class DirectClient(_Handles):
 
     def bind_many(self, bindings):
         return self.store.bind_many(bindings)
+
+    def update_status_many(self, items):
+        return self.store.update_status_many("Pod", items)
 
     @_api_errors
     def evict(self, ns, name):
@@ -764,6 +774,14 @@ class HTTPClient(_Handles):
                             {"namespace": ns, "name": name,
                              "target": {"kind": "Node", "name": node}}
                             for ns, name, node in bindings]})
+        return [None if r.get("code") == 200 else r.get("message", "error")
+                for r in out.get("results", [])]
+
+    def update_status_many(self, items):
+        out = self._req("POST", self._path("pods", None, "-", "status"),
+                        {"statuses": [
+                            {"namespace": ns, "name": name, "status": status}
+                            for ns, name, status in items]})
         return [None if r.get("code") == 200 else r.get("message", "error")
                 for r in out.get("results", [])]
 

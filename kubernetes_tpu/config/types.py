@@ -118,6 +118,13 @@ class SchedulerConfiguration:
     # models/gang.py gang_drain) — one dispatch + one readback for the whole
     # backlog instead of a ~100ms round trip per batch on remote TPUs.
     max_drain_batches: int = 8
+    # Dispatch-pipeline depth: how many fused drains may be in flight on the
+    # device at once (sched/scheduler.py). Depth 1 reproduces the old
+    # one-deep software pipeline (resolve k blocks dispatch k+1); depth N
+    # lets dispatch of drain k+1..k+N overlap resolve of drain k, hiding
+    # host-side apply/bind work behind device execution. jax dispatch is
+    # asynchronous, so deeper pipelines cost HBM for queued programs only.
+    pipeline_depth: int = 2
     max_gang_rounds: int = 64
     seed: int = 0
     backoff_initial_s: float = 1.0
@@ -145,6 +152,7 @@ class SchedulerConfiguration:
         for yaml_key, attr in [
             ("batchSize", "batch_size"), ("maxGangRounds", "max_gang_rounds"),
             ("maxDrainBatches", "max_drain_batches"),
+            ("pipelineDepth", "pipeline_depth"),
             ("seed", "seed"), ("backoffInitialSeconds", "backoff_initial_s"),
             ("backoffMaxSeconds", "backoff_max_s"), ("assumeTTLSeconds", "assume_ttl_s"),
             ("clientQPS", "client_qps"), ("parallelism", "parallelism"),
@@ -194,5 +202,7 @@ def validate(cfg: SchedulerConfiguration):
         raise ValidationError("maxGangRounds must be >= 1")
     if cfg.max_drain_batches < 1:
         raise ValidationError("maxDrainBatches must be >= 1")
+    if cfg.pipeline_depth < 1:
+        raise ValidationError("pipelineDepth must be >= 1")
     if cfg.bind_workers < 1:
         raise ValidationError("bindWorkers must be >= 1")

@@ -151,6 +151,24 @@ class _Unfit(Exception):
     """Internal: delta does not fit the resident buckets -> rebuild."""
 
 
+def entries_all_folded(cs: CtxPatchState, entries: list) -> bool:
+    """True when every delta-log entry is an ``assume`` this context already
+    folded device-side (``cs.folded``) — i.e. the log contains nothing the
+    resident encoding doesn't know. The pipelined scheduler then advances
+    its log cursor WITHOUT compiling a patch and, critically, without
+    draining the dispatch pipeline first: a compile needs the patch state
+    current with every in-flight drain's folds, but a no-op advance does
+    not. This is the steady-state gate that lets drain k+1 dispatch while
+    drain k still executes (sched/scheduler.py _schedule_drain)."""
+    for _seq, op, payload in entries:
+        if op != "assume":
+            return False
+        key, node_name, _pod = payload
+        if cs.folded.get(key) != node_name:
+            return False
+    return True
+
+
 def compile_patch(encoder, meta: SnapshotMeta, cs: CtxPatchState,
                   entries: list, nom_target: dict,
                   nom_bucket: int) -> Optional[dict]:

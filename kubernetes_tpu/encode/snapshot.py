@@ -335,23 +335,36 @@ class SnapshotEncoder:
         self.node_headroom = 0
         self.value_headroom = 0
         self.ns_headroom = 0
+        # informer-event-time pod compile cache (precompile_pod): key ->
+        # (pod object, epoch, compiled record). Hits are validated by OBJECT
+        # IDENTITY (informers build a fresh Pod per event, so a new version
+        # never aliases a cached one) and by the catalog epoch below — any
+        # volume/namespace/DRA catalog change invalidates every record.
+        self._pod_cache: dict[str, tuple] = {}
+        self._pod_cache_max = 65536
+        self._pod_epoch = 0
+        self.pod_cache_hits = 0
+        self.pod_cache_misses = 0
 
     def set_volumes(self, catalog) -> None:
         """Attach the PVC/PV/StorageClass catalog consulted by the next
         encode_cluster/encode_pods pair (sched/volumebinding.VolumeCatalog)."""
         self._volumes = catalog
+        self._pod_epoch += 1  # precompiled pod records may embed stale state
 
     def set_namespaces(self, namespace_labels: dict[str, dict]) -> None:
         """Attach the namespace-name -> labels snapshot used to resolve
         affinity terms' namespaceSelector (GetNamespaceLabelsSnapshot
         analog)."""
         self._namespace_labels = dict(namespace_labels or {})
+        self._pod_epoch += 1  # term namespace resolution may change
 
     def set_dra(self, catalog) -> None:
         """Attach the DRA catalog (sched/dra.DraCatalog): device classes
         become synthetic ``dra:<class>`` resources on the shared axis —
         slices extend node allocatable, claim demands extend pod requests."""
         self._dra = catalog
+        self._pod_epoch += 1  # precompiled pod records may embed stale state
 
     @property
     def cluster_depends_on_namespace_labels(self) -> bool:
@@ -943,101 +956,161 @@ class SnapshotEncoder:
 
     # -- pod side -----------------------------------------------------------
 
+    def _compile_pod(self, p: Pod) -> dict:
+        """Host-side compile of ONE pod: selectors/affinity terms to int-set
+        tables, tolerations/ports/images interned. This is the expensive
+        half of ``encode_pods`` (the array fill is cheap); it only reads the
+        intern tables (append-only) and the volume/namespace/DRA catalogs,
+        so it can run at informer-event time (``precompile_pod``) instead of
+        on the drain hot path."""
+        aff = p.spec.affinity
+        na = aff.node_affinity if aff else None
+        req_pairs = [(t, 1.0) for t in (na.required if na else [])]
+        pref_pairs = [(t.preference, float(t.weight)) for t in (na.preferred if na else [])]
+        req_terms = self._compile_terms(req_pairs, (0, 0, 0))
+        pref_terms = self._compile_terms(pref_pairs, (0, 0, 0))
+        sel = [(self.keys.intern(k), self.values.intern(v))
+               for k, v in sorted(p.spec.node_selector.items())]
+        tols = []
+        for t in p.spec.tolerations:
+            tols.append((
+                self.keys.intern(t.key) if t.key else -1,
+                TOLOPC_EXISTS if t.operator == TOL_OP_EXISTS else TOLOPC_EQUAL,
+                self.values.intern(t.value) if t.value else self.values.intern(""),
+                EFFECTC[t.effect] if t.effect else -1,
+            ))
+        ports = [(PROTOC.get(proto, 3), port, self.ips.intern(ip))
+                 for (ip, proto, port) in p.host_ports()]
+        images = []
+        for c in p.spec.containers:
+            if c.image:
+                images.append(self._intern_image(c.image))
+        pa = aff.pod_affinity if aff else None
+        pan = aff.pod_anti_affinity if aff else None
+        own_ns = self.namespaces.intern(p.metadata.namespace)
+
+        def _term_ns(t):
+            ns_set = resolve_term_namespaces(
+                t, p.metadata.namespace, self._namespace_labels)
+            return (None if ns_set is None else
+                    tuple(self.namespaces.intern(n) for n in sorted(ns_set)))
+
+        def _pod_terms(terms):
+            out = []
+            for t in terms:
+                eff = affinity_term_selector(t, p.metadata.labels)
+                valid, exprs = self._compile_selector(eff)
+                out.append((self.keys.intern(t.topology_key), valid, exprs,
+                            _term_ns(t)))
+            return out
+
+        aff_req = _pod_terms(pa.required if pa else [])
+        anti_req = _pod_terms(pan.required if pan else [])
+        paff = []
+        for wt in (pa.preferred if pa else []):
+            kid = self.keys.intern(wt.term.topology_key)
+            eff = affinity_term_selector(wt.term, p.metadata.labels)
+            valid, exprs = self._compile_selector(eff)
+            paff.append((kid, valid, exprs, float(wt.weight),
+                         _term_ns(wt.term)))
+        for wt in (pan.preferred if pan else []):
+            kid = self.keys.intern(wt.term.topology_key)
+            eff = affinity_term_selector(wt.term, p.metadata.labels)
+            valid, exprs = self._compile_selector(eff)
+            paff.append((kid, valid, exprs, -float(wt.weight),
+                         _term_ns(wt.term)))
+        spreads = []
+        for sc in p.spec.topology_spread_constraints:
+            eff = spread_selector(sc, p.metadata.labels)
+            valid, exprs = self._compile_selector(eff)
+            spreads.append((self.keys.intern(sc.topology_key), valid, exprs,
+                            int(sc.max_skew),
+                            sc.when_unsatisfiable == "DoNotSchedule",
+                            int(sc.min_domains or 0),
+                            sc.node_affinity_policy != NODE_INCLUSION_IGNORE,
+                            sc.node_taints_policy == NODE_INCLUSION_HONOR))
+        labels = self._label_ids(p.metadata.labels)
+        # volumes: PVC groups -> (group_id, compiled term) pairs
+        from kubernetes_tpu.sched.volumebinding import compile_pod_volumes
+        vinfo = compile_pod_volumes(p, self._volumes, self._rwop_in_use)
+        vol_terms = []
+        for g_idx, group in enumerate(vinfo.groups):
+            for _w, exprs in self._compile_terms([(t, 1.0) for t in group],
+                                                 (0, 0, 0)):
+                vol_terms.append((g_idx, exprs))
+        vol_rwo = [self.pv_names.intern(n) for n in vinfo.rwo_pv_names]
+        return dict(
+            pod=p, req_terms=req_terms, pref_terms=pref_terms, sel=sel,
+            tols=tols, ports=ports, images=images, labels=labels, ns=own_ns,
+            aff_req=aff_req, anti_req=anti_req, paff=paff, spreads=spreads,
+            vol_terms=vol_terms, vol_groups=len(vinfo.groups),
+            vol_rwo=vol_rwo, attach_req=vinfo.attach_count,
+        )
+
+    def precompile_pod(self, p: Pod) -> bool:
+        """Compile a pod's encode record AHEAD of batch-encode time — the
+        informer layer calls this per watch event, so by the time the drain
+        pops the pod, ``encode_pods`` pays array-fill cost only (the
+        incremental-encode half of the connected-path pipeline; see
+        sched/cache.py precompile_pod for the locking discipline).
+
+        Volume-carrying pods are skipped: their compile reads catalog state
+        (``_rwop_in_use``) that every cluster encode rewrites. Returns True
+        when the record was cached."""
+        if p.spec.volumes:
+            return False
+        if len(self._pod_cache) >= self._pod_cache_max:
+            self._pod_cache.clear()  # backstop; steady state evicts per key
+        self._pod_cache[p.key] = (p, self._pod_epoch, self._compile_pod(p))
+        return True
+
+    def pod_cache_discard(self, key: str) -> None:
+        """Drop a pod's precompiled record — bound/deleted pods never
+        encode again, and keeping their Pod + compiled tables alive would
+        grow the cache to the wholesale-clear backstop (which would dump
+        live pending pods' records too). Plain dict.pop: GIL-atomic, safe
+        from informer threads WITHOUT the encode lock (a concurrent
+        encode_pods either sees the entry or recompiles; both correct)."""
+        self._pod_cache.pop(key, None)
+
     def encode_pods(self, pods: list[Pod], meta: SnapshotMeta,
-                    min_p: int = 1) -> PodBatch:
+                    min_p: int = 1, cache_rows: bool = True) -> PodBatch:
         """``min_p`` pins the pod-axis bucket floor so callers with a fixed
         batch shape (the fused drain) never trigger a smaller-bucket
-        recompile for a partial chunk."""
+        recompile for a partial chunk. ``cache_rows=False`` skips storing
+        compile records for misses — for callers encoding DERIVED pod
+        objects (a profile's addedAffinity wrap) whose identity will never
+        be seen again; storing those would evict live precompiled records."""
         P = next_bucket(len(pods), minimum=min_p)
         R = len(meta.resources)
         meta.pod_keys = [p.key for p in pods]
 
         # First pass: compile everything host-side, find bucket sizes.
+        # Pods precompiled at informer-event time (``precompile_pod``) skip
+        # the compile entirely — the drain hot path then pays array-fill
+        # cost only. Identity + epoch guard staleness: a new watch object
+        # or any catalog change (volumes/namespaces/DRA) misses the cache.
         compiled = []
         for p in pods:
-            aff = p.spec.affinity
-            na = aff.node_affinity if aff else None
-            req_pairs = [(t, 1.0) for t in (na.required if na else [])]
-            pref_pairs = [(t.preference, float(t.weight)) for t in (na.preferred if na else [])]
-            req_terms = self._compile_terms(req_pairs, (0, 0, 0))
-            pref_terms = self._compile_terms(pref_pairs, (0, 0, 0))
-            sel = [(self.keys.intern(k), self.values.intern(v))
-                   for k, v in sorted(p.spec.node_selector.items())]
-            tols = []
-            for t in p.spec.tolerations:
-                tols.append((
-                    self.keys.intern(t.key) if t.key else -1,
-                    TOLOPC_EXISTS if t.operator == TOL_OP_EXISTS else TOLOPC_EQUAL,
-                    self.values.intern(t.value) if t.value else self.values.intern(""),
-                    EFFECTC[t.effect] if t.effect else -1,
-                ))
-            ports = [(PROTOC.get(proto, 3), port, self.ips.intern(ip))
-                     for (ip, proto, port) in p.host_ports()]
-            images = []
-            for c in p.spec.containers:
-                if c.image:
-                    images.append(self._intern_image(c.image))
-            pa = aff.pod_affinity if aff else None
-            pan = aff.pod_anti_affinity if aff else None
-            own_ns = self.namespaces.intern(p.metadata.namespace)
-
-            def _term_ns(t):
-                ns_set = resolve_term_namespaces(
-                    t, p.metadata.namespace, self._namespace_labels)
-                return (None if ns_set is None else
-                        tuple(self.namespaces.intern(n) for n in sorted(ns_set)))
-
-            def _pod_terms(terms):
-                out = []
-                for t in terms:
-                    eff = affinity_term_selector(t, p.metadata.labels)
-                    valid, exprs = self._compile_selector(eff)
-                    out.append((self.keys.intern(t.topology_key), valid, exprs,
-                                _term_ns(t)))
-                return out
-
-            aff_req = _pod_terms(pa.required if pa else [])
-            anti_req = _pod_terms(pan.required if pan else [])
-            paff = []
-            for wt in (pa.preferred if pa else []):
-                kid = self.keys.intern(wt.term.topology_key)
-                eff = affinity_term_selector(wt.term, p.metadata.labels)
-                valid, exprs = self._compile_selector(eff)
-                paff.append((kid, valid, exprs, float(wt.weight),
-                             _term_ns(wt.term)))
-            for wt in (pan.preferred if pan else []):
-                kid = self.keys.intern(wt.term.topology_key)
-                eff = affinity_term_selector(wt.term, p.metadata.labels)
-                valid, exprs = self._compile_selector(eff)
-                paff.append((kid, valid, exprs, -float(wt.weight),
-                             _term_ns(wt.term)))
-            spreads = []
-            for sc in p.spec.topology_spread_constraints:
-                eff = spread_selector(sc, p.metadata.labels)
-                valid, exprs = self._compile_selector(eff)
-                spreads.append((self.keys.intern(sc.topology_key), valid, exprs,
-                                int(sc.max_skew),
-                                sc.when_unsatisfiable == "DoNotSchedule",
-                                int(sc.min_domains or 0),
-                                sc.node_affinity_policy != NODE_INCLUSION_IGNORE,
-                                sc.node_taints_policy == NODE_INCLUSION_HONOR))
-            labels = self._label_ids(p.metadata.labels)
-            # volumes: PVC groups -> (group_id, compiled term) pairs
-            from kubernetes_tpu.sched.volumebinding import compile_pod_volumes
-            vinfo = compile_pod_volumes(p, self._volumes, self._rwop_in_use)
-            vol_terms = []
-            for g_idx, group in enumerate(vinfo.groups):
-                for _w, exprs in self._compile_terms([(t, 1.0) for t in group],
-                                                     (0, 0, 0)):
-                    vol_terms.append((g_idx, exprs))
-            vol_rwo = [self.pv_names.intern(n) for n in vinfo.rwo_pv_names]
-            compiled.append(dict(
-                pod=p, req_terms=req_terms, pref_terms=pref_terms, sel=sel,
-                tols=tols, ports=ports, images=images, labels=labels, ns=own_ns,
-                aff_req=aff_req, anti_req=anti_req, paff=paff, spreads=spreads,
-                vol_terms=vol_terms, vol_groups=len(vinfo.groups),
-                vol_rwo=vol_rwo, attach_req=vinfo.attach_count,
-            ))
+            ent = self._pod_cache.get(p.key)
+            if (ent is not None and ent[0] is p
+                    and ent[1] == self._pod_epoch):
+                compiled.append(ent[2])
+                self.pod_cache_hits += 1
+                continue
+            # snapshot the epoch BEFORE compiling: a catalog change racing
+            # the compile (informer threads bump the epoch without the
+            # encode lock) must invalidate this record, not get tagged on it
+            epoch = self._pod_epoch
+            c = self._compile_pod(p)
+            compiled.append(c)
+            self.pod_cache_misses += 1
+            if cache_rows and not p.spec.volumes:
+                # failure re-pops carry the SAME Pod object back through
+                # here — cache so the retry encode is fill-only too
+                if len(self._pod_cache) >= self._pod_cache_max:
+                    self._pod_cache.clear()
+                self._pod_cache[p.key] = (p, epoch, c)
 
         K = next_bucket(len(self.keys), minimum=1)
 

@@ -96,6 +96,11 @@ class Kubelet:
         from kubernetes_tpu.utils.events import EventRecorder
         self.recorder = EventRecorder(client, f"kubelet/{node_name}")
         self.server = None  # KubeletServer once start(serve=True) runs
+        # optional status transport override: sink(ns, name, status) — the
+        # kubemark fleet batches hundreds of kubelets' status PATCHes into
+        # bulk POSTs through this (kubelet/kubemark.py _StatusBatcher);
+        # None = direct per-pod update_status as upstream
+        self.status_sink = None
 
     def _next_pod_ip(self) -> str:
         n = next(self._pod_ip_seq)
@@ -163,6 +168,11 @@ class Kubelet:
         per-kubelet loop and the kubemark driver pool."""
         if self.dead:
             return
+        from kubernetes_tpu.utils.tracing import TRACER
+        with TRACER.span("kubelet/heartbeat"):
+            self._heartbeat_inner()
+
+    def _heartbeat_inner(self):
         try:
             node = self.client.nodes().get(self.node_name)
             st = node.setdefault("status", {})
@@ -223,6 +233,10 @@ class Kubelet:
         self._static_poll_s = static_poll_s
         self._static: dict[str, tuple] = {}  # uid -> (name, digest)
         self._static_mirror_pending: set[str] = set()
+        # mirror RESYNC cadence (see _sync_static_pods): event-driven
+        # recreation backstopped by a periodic existence/hash check
+        self._static_resync_s = max(static_poll_s * 5, 0.5)
+        self._static_next_resync = 0.0
         if serve:
             from kubernetes_tpu.kubelet.server import KubeletServer
             self.server = KubeletServer(self.runtime, self._uid_of,
@@ -347,6 +361,36 @@ class Kubelet:
                         self.client.pods(ns).delete(name)
                 except ApiError:
                     pass  # retry next poll
+        # RESYNC BACKSTOP: mirror recreation is normally event-driven (the
+        # informer's DELETED event re-arms _static_mirror_pending above),
+        # but a watch gap — a relist racing the deletion, or handler
+        # starvation under full-suite load — can swallow that event, and
+        # then NOTHING would ever recreate the mirror (the source of the
+        # test_static_pod_survives_mirror_deletion flake). Periodically
+        # verify each settled mirror exists and carries the current
+        # manifest hash; re-arm the pending set when it does not.
+        now = time.monotonic()
+        if seen and now >= self._static_next_resync:
+            self._static_next_resync = now + self._static_resync_s
+            for uid, (manifest, name, digest) in seen.items():
+                if uid in self._static_mirror_pending \
+                        or uid not in self._static:
+                    continue
+                ns = ((manifest.get("metadata") or {})
+                      .get("namespace", "default") or "default")
+                try:
+                    cur = self.client.pods(ns).get(name)
+                except ApiError as e:
+                    if e.code == 404:
+                        self._static_mirror_pending.add(uid)
+                    continue
+                except Exception:
+                    continue  # transient transport error: next sweep
+                cur_hash = ((cur.get("metadata") or {})
+                            .get("annotations") or {}).get(
+                    "kubernetes.io/config.hash")
+                if cur_hash != digest:
+                    self._static_mirror_pending.add(uid)
         # stop static pods whose manifest vanished
         for uid in [u for u in self._static if u not in seen]:
             name, _digest = self._static.pop(uid)
@@ -568,9 +612,16 @@ class Kubelet:
                 and Pod.from_dict(pod).status.is_ready() == running):
             return  # no material change; skip the write (status manager dedup)
         md = pod["metadata"]
+        ns = md.get("namespace", "default")
+        if self.status_sink is not None:
+            # batched transport (kubemark): the batcher coalesces and bulk-
+            # POSTs; per-pod dedup above still bounds the write volume
+            self.status_sink(ns, md.get("name", ""), status)
+            return
+        from kubernetes_tpu.utils.tracing import TRACER
         try:
-            self.client.pods(md.get("namespace", "default")).update_status(
-                {**pod, "status": status})
+            with TRACER.span("kubelet/status_patch"):
+                self.client.pods(ns).update_status({**pod, "status": status})
         except ApiError:
             pass  # next sync retries
 

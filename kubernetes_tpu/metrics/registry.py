@@ -218,6 +218,33 @@ GANG_ROUNDS = REGISTRY.histogram(
     "scheduler_gang_rounds", "Conflict-resolution rounds per gang batch",
     buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64))
 
+# Connected-path dispatch pipeline (scheduler.py multi-deep drain queue):
+# depth/occupancy make the overlap attributable — a healthy run shows
+# inflight hovering at the configured depth while resolve_wait shrinks.
+PIPELINE_INFLIGHT = REGISTRY.gauge(
+    "scheduler_pipeline_inflight_drains",
+    "Dispatched drains awaiting device resolution (pipeline occupancy)")
+PIPELINE_DEPTH = REGISTRY.histogram(
+    "scheduler_pipeline_depth",
+    "In-flight drains observed at each dispatch (including the new one)",
+    buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16))
+
+# Incremental pod encoding (encode/snapshot.py precompile cache): hits mean
+# the drain hot path paid array-fill cost only, not selector compilation.
+ENCODE_POD_CACHE_HITS = REGISTRY.gauge(
+    "scheduler_encode_pod_cache_hits",
+    "Pod rows served from the informer-event-time compile cache")
+ENCODE_POD_CACHE_MISSES = REGISTRY.gauge(
+    "scheduler_encode_pod_cache_misses",
+    "Pod rows compiled on the batch-encode hot path")
+
+# Kubelet pod-sync health (pod_workers.go error bookkeeping analog).
+# Aggregate only — per-pod counts are PodWorkers.sync_errors(uid); a
+# per-uid label would grow one label set per failing pod forever.
+KUBELET_SYNC_ERRORS = REGISTRY.counter(
+    "kubelet_pod_sync_errors_total",
+    "Pod sync failures (retried with per-pod backoff)")
+
 # Snapshot-freshness observability (the autoscaler's overlay rides the
 # cache's encoded snapshot; staleness shows up here first).
 CACHE_GENERATION = REGISTRY.gauge(

@@ -167,11 +167,17 @@ class SchedulerCache:
                     return
             else:
                 space[key] = obj
-            self._encoder.set_dra(self._dra)
             if (kind == "ResourceClaim" and old is not None and not deleted
                     and DraCatalog.claim_demands(old)
                     == DraCatalog.claim_demands(obj)):
-                return  # status-only change: encoding-neutral
+                # status-only change: encoding-neutral. Checked BEFORE
+                # set_dra — the scheduler writes claim status on every bind
+                # of a claimed pod, and letting that bump the encoder's pod
+                # epoch would invalidate the whole precompile cache per
+                # bind (the catalog object is shared and already mutated
+                # in place above, so skipping set_dra loses nothing).
+                return
+            self._encoder.set_dra(self._dra)
             self._generation += 1
             self._needs_full = True
             self._log_locked("full", None)
@@ -271,6 +277,8 @@ class SchedulerCache:
             self._delta_upserts[pod.key] = pod
             self._delta_deletes.discard(pod.key)
             self._log_locked("pod", pod)
+            # bound: it will never pass through encode_pods again
+            self._encoder.pod_cache_discard(pod.key)
 
     def update_pod(self, pod: Pod):
         self.add_pod(pod)
@@ -305,6 +313,7 @@ class SchedulerCache:
                     return False
             del self._assumed[pod_key]
             self._pods[pod_key] = ap
+            self._encoder.pod_cache_discard(pod_key)
             return True
 
     def is_bound(self, pod_key: str) -> bool:
@@ -315,6 +324,7 @@ class SchedulerCache:
     def remove_pod(self, pod_key: str):
         with self._lock:
             existed = self._pods.pop(pod_key, None) or self._assumed.pop(pod_key, None)
+            self._encoder.pod_cache_discard(pod_key)
             if existed:
                 self._generation += 1
                 self._delta_upserts.pop(pod_key, None)
@@ -341,6 +351,10 @@ class SchedulerCache:
             self._delta_upserts[p.key] = p
             self._delta_deletes.discard(p.key)
             self._log_locked("assume", (p.key, node_name, p))
+            # placed: the record is dead unless the binding fails, and a
+            # rare bind-failure retry recompiling one pod beats keeping
+            # every placed pod's record alive (forget() keeps nothing)
+            self._encoder.pod_cache_discard(p.key)
 
     def assume_many(self, pairs: list) -> None:
         """assume() for a whole drain's winners in ONE lock pass — the gang
@@ -360,6 +374,7 @@ class SchedulerCache:
                 self._delta_upserts[p.key] = p
                 self._delta_deletes.discard(p.key)
                 self._log_locked("assume", (p.key, node_name, p))
+                self._encoder.pod_cache_discard(p.key)
             self._generation += len(pairs)
 
     def finish_binding(self, pod_key: str):
@@ -419,9 +434,13 @@ class SchedulerCache:
         from kubernetes_tpu.metrics.registry import (
             CACHE_FULL_ENCODES,
             CACHE_GENERATION,
+            ENCODE_POD_CACHE_HITS,
+            ENCODE_POD_CACHE_MISSES,
         )
         CACHE_GENERATION.set(self._generation)
         CACHE_FULL_ENCODES.set(self._full_encodes)
+        ENCODE_POD_CACHE_HITS.set(self._encoder.pod_cache_hits)
+        ENCODE_POD_CACHE_MISSES.set(self._encoder.pod_cache_misses)
 
     def _snapshot_serialized(self, pending_pods, slot_headroom):
         with self._lock:
@@ -496,9 +515,32 @@ class SchedulerCache:
                                  nom_target, nom_bucket)
 
     def encode_pods(self, pods: list[Pod], meta: SnapshotMeta,
-                    min_p: int = 1):
+                    min_p: int = 1, cache_rows: bool = True):
         with self._encode_lock:
-            return self._encoder.encode_pods(pods, meta, min_p=min_p)
+            return self._encoder.encode_pods(pods, meta, min_p=min_p,
+                                             cache_rows=cache_rows)
+
+    def precompile_pod(self, pod: Pod) -> None:
+        """Informer-event-time half of the incremental encode: compile the
+        pod's encode record NOW (watch thread) so the drain's encode_pods
+        later is array-fill only. NON-BLOCKING on the encode lock — if the
+        scheduling loop is mid-encode, skipping is strictly better than
+        convoying the watch thread behind a multi-hundred-ms encode (the
+        pod simply compiles on the hot path as before)."""
+        if not self._encode_lock.acquire(blocking=False):
+            return
+        try:
+            self._encoder.precompile_pod(pod)
+        except Exception:
+            pass  # best-effort: encode_pods compiles it authoritatively
+        finally:
+            self._encode_lock.release()
+
+    def encode_cache_stats(self) -> dict[str, int]:
+        """Hit/miss counters of the pod compile cache (benchmarks report
+        these: a healthy connected run shows hits >> misses)."""
+        return {"hits": self._encoder.pod_cache_hits,
+                "misses": self._encoder.pod_cache_misses}
 
     def overlay_nominated(self, ct, meta, entries, min_m: int = 0):
         """ct with nominated-pod reservations applied (encoder.with_nominated);

@@ -39,6 +39,16 @@ class SchedulerRunner:
         self.client = client
         if hasattr(client, "default_user_agent"):
             client.default_user_agent("kube-scheduler")
+        # GIL tuning for the connected deployment shape: informer bursts
+        # (thousands of JSON decodes) and the device tunnel share one
+        # interpreter; a finer switch interval caps how long either side
+        # can starve the other between checks. Opt-in via env so library
+        # embedders keep the interpreter default.
+        import os
+        import sys
+        si = os.environ.get("KTPU_SWITCH_INTERVAL")
+        if si:
+            sys.setswitchinterval(float(si))
 
         self.cfg = cfg or SchedulerConfiguration()
         self.cache = SchedulerCache(assume_ttl=self.cfg.assume_ttl_s)
@@ -106,6 +116,11 @@ class SchedulerRunner:
             return
         if pod.spec.scheduler_name not in self._scheduler_names:
             return
+        # incremental encode: compile the pod's encode record NOW, on the
+        # watch thread, so the drain's encode_pods is array-fill only by
+        # the time this pod pops (sched/cache.py precompile_pod never
+        # blocks behind an in-progress encode)
+        self.cache.precompile_pod(pod)
         if type_ == MODIFIED and not pod.spec.scheduling_gates:
             self.queue.activate_gated(pod)
         self.queue.add(pod)
@@ -179,6 +194,13 @@ class SchedulerRunner:
             return True
         except ApiError as e:
             self._unreserve(allocated)
+            if e.code == 404:
+                # pod deleted while the binding was in flight (churn): not a
+                # failure — tell the scheduler there is nothing to requeue,
+                # and keep the expected noise out of the logs
+                BIND_RESULTS.inc({"result": "gone"})
+                _LOG.debug("bind %s -> %s: pod gone", pod.key, node_name)
+                return None
             # 409 = another party bound it first (expected race); anything
             # else is a systemic failure worth surfacing, not swallowing.
             label = "conflict" if e.code == 409 else "error"
@@ -192,10 +214,12 @@ class SchedulerRunner:
             _LOG.warning("bind %s -> %s: API unreachable: %s", pod.key, node_name, e)
             return False
 
-    def _bind_many(self, pairs) -> list[bool]:
+    def _bind_many(self, pairs) -> list:
         """Bulk DefaultBinder: one POST pods/-/binding for a whole gang
         batch. Only plain pods reach this (the scheduler routes DRA/volume/
-        lifecycle pods through _bind); per-item 409s are expected races."""
+        lifecycle pods through _bind); per-item 409s are expected races.
+        Per-item result: True (bound), False (failed — requeue), None (pod
+        vanished mid-flight — nothing to requeue, e.g. a churn delete)."""
         try:
             errs = self.client.pods("default").bind_many(
                 [(p.metadata.namespace, p.metadata.name, node)
@@ -212,6 +236,12 @@ class SchedulerRunner:
         for (pod, node), err in zip(pairs, errs):
             if err is None:
                 out.append(True)
+            elif "not found" in err:
+                # deleted while in flight (churn teardown races the gang
+                # step's binding every cycle): expected, debug-level only
+                BIND_RESULTS.inc({"result": "gone"})
+                _LOG.debug("bind %s -> %s: pod gone", pod.key, node)
+                out.append(None)
             else:
                 label = "conflict" if "bound" in err else "error"
                 BIND_RESULTS.inc({"result": label})

@@ -18,6 +18,7 @@ import logging
 import queue as queue_mod
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional
 
 from kubernetes_tpu.api.types import Pod
@@ -27,6 +28,8 @@ from kubernetes_tpu.metrics.registry import (
     ATTEMPT_DURATION,
     BATCH_DURATION,
     GANG_ROUNDS,
+    PIPELINE_DEPTH,
+    PIPELINE_INFLIGHT,
     QUEUE_DEPTH,
     SCHEDULE_ATTEMPTS,
 )
@@ -89,8 +92,21 @@ class Scheduler:
         # t_resolve) when KTPU_CYCLE_LOG=1
         self.cycle_log: list = [] if _os.environ.get(
             "KTPU_CYCLE_LOG") else None
-        # one-deep software pipeline: the in-flight drain awaiting resolution
-        self._pending_drain = None
+        # Multi-deep software pipeline: in-flight drains awaiting resolution,
+        # oldest first (the device executes them in dispatch order). Bounded
+        # by cfg.pipeline_depth — dispatch of drain k+1..k+N overlaps the
+        # host-side resolve of drain k (schedule_one.go's async bindingCycle
+        # overlapping the next scheduling cycle, generalized to N drains).
+        self._pending: "deque[dict]" = deque()
+        # Dedicated resolver thread: device_get of each drain's results runs
+        # here the moment the device finishes, NOT on the scheduling thread —
+        # which means the scheduler never parks inside the device tunnel
+        # while informer bursts hold the GIL (the resolve_wait variance of
+        # BENCH_r05). The scheduling thread waits on a plain Event instead.
+        self._resolver_q: Optional["queue_mod.Queue"] = None
+        self._resolver_thread: Optional[threading.Thread] = None
+        self._use_resolver = _os.environ.get(
+            "KTPU_RESOLVER_THREAD", "1") != "0"
         # fragment pops parked while the device is busy (see run_once)
         self._staged: list = []
         self._staged_once = False   # a parked fragment merges at most once
@@ -126,6 +142,67 @@ class Scheduler:
                     f"profile {prof.scheduler_name!r} references "
                     f"unregistered out-of-tree plugins: {sorted(unknown)}")
 
+    # ---- dispatch pipeline ----------------------------------------------
+
+    @property
+    def _pending_drain(self) -> Optional[dict]:
+        """Oldest in-flight drain, or None when the pipeline is empty.
+        Read-only compat view (tests poll it); the pipeline itself is
+        ``self._pending``."""
+        return self._pending[0] if self._pending else None
+
+    @staticmethod
+    def _drain_ready(pend: dict) -> bool:
+        ev = pend.get("done")
+        if ev is not None:
+            return ev.is_set()
+        try:
+            return pend["assignments"].is_ready()
+        except Exception:
+            return True
+
+    def _resolve_ready(self) -> int:
+        """Land every in-flight drain whose results are already on the host
+        (no blocking) — finished work must not sit behind a pop or a deeper
+        pipeline. Returns pods bound."""
+        n = 0
+        while self._pending and self._drain_ready(self._pending[0]):
+            n += self._resolve_one()
+        return n
+
+    def _submit_resolve(self, pend: dict) -> None:
+        """Hand the drain's device handles to the resolver thread: it blocks
+        in device_get (GIL released in the runtime) and publishes numpy
+        results + sets ``pend['done']``. KTPU_RESOLVER_THREAD=0 disables the
+        thread; _resolve_one then fetches inline as before."""
+        if not self._use_resolver:
+            return
+        pend["done"] = threading.Event()
+        if self._resolver_thread is None or not self._resolver_thread.is_alive():
+            self._resolver_q = queue_mod.Queue()
+            self._resolver_thread = threading.Thread(
+                target=self._resolver_loop, args=(self._resolver_q,),
+                daemon=True, name="drain-resolver")
+            self._resolver_thread.start()
+        self._resolver_q.put(pend)
+
+    @staticmethod
+    def _resolver_loop(q: "queue_mod.Queue") -> None:
+        import jax
+        while True:
+            pend = q.get()
+            if pend is None:  # poison pill from close()
+                return
+            try:
+                pend["resolved"] = jax.device_get(
+                    (pend["assignments"], pend["rounds"]))
+            except Exception:
+                # surface on the scheduling thread: _resolve_one retries the
+                # fetch inline and propagates the real error
+                _LOG.exception("drain resolver device_get failed")
+            finally:
+                pend["done"].set()
+
     # ---- one batch iteration --------------------------------------------
 
     def run_once(self, wait: float = 0.5) -> int:
@@ -135,40 +212,31 @@ class Scheduler:
         backlog takes the fused drain path (one device program for many
         batches, models/gang.py gang_drain) while shallow pops run the
         single-batch program."""
-        # land the in-flight drain's bindings as soon as the device is done
+        # land finished drains' bindings as soon as the device is done
         # (don't let finished results sit behind a blocking pop)
-        n_early = 0
-        pend = self._pending_drain
-        if pend is not None:
-            try:
-                ready = pend["assignments"].is_ready()
-            except Exception:
-                ready = True
-            if ready:
-                n_early = self._resolve_pending()
+        n_early = self._resolve_ready()
         cap = self.cfg.batch_size * max(1, self.cfg.max_drain_batches)
         batch = self.queue.pop_batch(
             max(1, cap - len(self._staged)),
-            wait=0.05 if self._pending_drain is not None else wait)
+            wait=0.05 if self._pending else wait)
         if self._staged:
             batch = self._staged + batch
             self._staged = []
         if not batch:
             return n_early + self._resolve_pending()
         if (len(batch) < self.cfg.batch_size and not self._staged_once
-                and (self._pending_drain is not None
-                     or self._last_pop_full)):
+                and (self._pending or self._last_pop_full)):
             # A fragment pop while the device is busy or right after a
             # full-size pop — typically the middle of a creation burst,
             # when the informer thread is decoding thousands of watch
             # events and any host work crawls (single-core GIL). Park it
-            # once, settle the in-flight drain (device-bound anyway), and
-            # let the fragment merge with the arrivals that land
+            # once, settle the OLDEST in-flight drain (device-bound anyway),
+            # and let the fragment merge with the arrivals that land
             # meanwhile: tiny mid-burst drains were the connected p99
             # tail.
             self._staged = batch
             self._staged_once = True
-            return n_early + self._resolve_pending()
+            return n_early + self._resolve_one()
         self._staged_once = False
         self._last_pop_full = len(batch) >= cap
         stats = self.queue.stats()
@@ -237,7 +305,8 @@ class Scheduler:
             # buckets each recompile the gang program
             pb = self.cache.encode_pods(
                 profile.apply_added_affinity(pods), meta,
-                min_p=self.cfg.batch_size)
+                min_p=self.cfg.batch_size,
+                cache_rows=not profile.added_affinity)
         ext_mask = ext_scores = None
         ext_errors: set = set()
         if self._extenders:
@@ -362,14 +431,25 @@ class Scheduler:
                                     for k, (n, prio, _p)
                                     in nom_target.items()
                                     if k in cs.nom_applied))
+                from kubernetes_tpu.encode.patch import entries_all_folded
                 if entries is None:
                     self._ctx_reason("log_window")
-                elif not entries and not nom_dirty:
+                elif not nom_dirty and entries_all_folded(cs, entries):
+                    # Every entry is an assume of a placement this context
+                    # already folded device-side (our own resolves): advance
+                    # the cursor and dispatch WITHOUT draining the pipeline.
+                    # This is the steady-state gate of the multi-deep
+                    # pipeline — the old code compiled a no-op patch here,
+                    # which forced resolve-before-dispatch every cycle and
+                    # quietly serialized the "async" drain loop.
+                    if entries:
+                        ctx["seq"] = entries[-1][0] + 1
                     use_ctx = True
                 else:
-                    # the in-flight drain must resolve FIRST so the patch
-                    # state knows which slots its folds took (and its
-                    # assume log entries land before the re-read)
+                    # foreign churn / nominee change: EVERY in-flight drain
+                    # must resolve FIRST so the patch state knows which
+                    # slots their folds took (and their assume log entries
+                    # land before the re-read)
                     if self.cycle_log is not None:
                         self._cyc_marks.append(("resolve_prev_start",
                                                 round(time.time() - t0, 3)))
@@ -429,7 +509,8 @@ class Scheduler:
         with TRACER.span("scheduler/encode_pods", pods=len(pods)):
             pbs = [self.cache.encode_pods(
                 profile.apply_added_affinity([p for p, _ in c]),
-                meta, min_p=P) for c in chunks]
+                meta, min_p=P,
+                cache_rows=not profile.added_affinity) for c in chunks]
         # pad to the fixed drain width with all-invalid batches (their pods
         # propose nothing; the scan converges them in one dead round)
         B = max(1, self.cfg.max_drain_batches)
@@ -495,7 +576,8 @@ class Scheduler:
             self._cyc_marks.append(("dispatch_start",
                                     round(time.time() - t0, 3)))
         with TRACER.span("scheduler/gang_dispatch",
-                         pods=len(pods), nodes=len(nodes)):
+                         pods=len(pods), nodes=len(nodes),
+                         depth=len(self._pending) + 1):
             assignments, rounds, new_ct, new_fill = drain_step(
                 ctx["ct"], pb_stack, ctx["fill_dev"], e0=ctx["e0"],
                 seed=self.cfg.seed, fit_strategy=profile.fit_strategy,
@@ -506,11 +588,7 @@ class Scheduler:
         ctx["ct"] = new_ct
         ctx["fill_dev"] = new_fill
         ctx["fill_bound"] += len(pods)
-        # resolve the PREVIOUS drain now that this one is in flight (the
-        # device executes in order, so this blocks only until N-1 finishes,
-        # and its assume/bind work overlaps N's device execution)
-        n_prev += self._resolve_pending()
-        self._pending_drain = {
+        pend = {
             "assignments": assignments, "rounds": rounds,
             "chunks": chunks, "ctx": ctx,
             "meta": meta, "n_nodes": len(nodes), "profile": profile,
@@ -519,7 +597,19 @@ class Scheduler:
         if self.cycle_log is not None:
             marks = dict(self._cyc_marks)
             marks["done"] = round(time.time() - t0, 3)
-            self._pending_drain["cyc"] = (len(pods), t0, marks)
+            pend["cyc"] = (len(pods), t0, marks)
+        self._submit_resolve(pend)
+        self._pending.append(pend)
+        PIPELINE_DEPTH.observe(len(self._pending))
+        PIPELINE_INFLIGHT.set(len(self._pending))
+        # land whatever already finished, then enforce the depth bound: the
+        # oldest drain resolves (blocking) only once MORE than
+        # cfg.pipeline_depth drains are in flight — its assume/bind work
+        # overlaps the younger drains' device execution (depth 1 reproduces
+        # the old one-deep pipeline exactly)
+        n_prev += self._resolve_ready()
+        while len(self._pending) > max(1, self.cfg.pipeline_depth):
+            n_prev += self._resolve_one()
         return n_prev
 
     def _ctx_reason(self, why: str):
@@ -527,16 +617,26 @@ class Scheduler:
         r[why] = r.get(why, 0) + 1
 
     def _resolve_pending(self) -> int:
-        """Block on the in-flight drain's results and apply them host-side:
-        assume + bulk-bind the placements, requeue the failures, and record
-        the device folds in the context's patch state (the fold packs
-        committed pods into base slots [fill, fill+n) in flattened batch
-        order — mirrored here so later churn patches can address them).
-        Returns pods bound."""
-        pend = self._pending_drain
-        if pend is None:
+        """Drain the WHOLE dispatch pipeline: block on every in-flight
+        drain's results, oldest first, and apply them host-side. Returns
+        pods bound. (Patch compiles and context rebuilds call this — their
+        bookkeeping needs every fold recorded.)"""
+        n = 0
+        while self._pending:
+            n += self._resolve_one()
+        return n
+
+    def _resolve_one(self) -> int:
+        """Block on the OLDEST in-flight drain's results and apply them
+        host-side: assume + bulk-bind the placements, requeue the failures,
+        and record the device folds in the context's patch state (the fold
+        packs committed pods into base slots [fill, fill+n) in flattened
+        batch order — mirrored here so later churn patches can address
+        them). Returns pods bound."""
+        if not self._pending:
             return 0
-        self._pending_drain = None
+        pend = self._pending.popleft()
+        PIPELINE_INFLIGHT.set(len(self._pending))
         if self.cycle_log is not None and "cyc" in pend:
             n, tp, marks = pend["cyc"]
             marks["resolve_at"] = round(time.time() - tp, 3)
@@ -544,12 +644,21 @@ class Scheduler:
         import jax
         import numpy as np
         from kubernetes_tpu.utils.tracing import TRACER
-        with BATCH_DURATION.time(), TRACER.span("scheduler/resolve_wait"):
+        with BATCH_DURATION.time(), TRACER.span(
+                "scheduler/resolve_wait", depth=len(self._pending) + 1):
             # fill_bound is maintained purely by the dispatch-side
             # reservation arithmetic (adjusted below); the device fill stays
             # resident as ctx["fill_dev"] and is never fetched
-            assignments, rounds = jax.device_get(
-                (pend["assignments"], pend["rounds"]))
+            done = pend.get("done")
+            res = None
+            if done is not None:
+                # resolver thread owns the device fetch; this thread parks
+                # on a plain Event — no GIL tug-of-war inside the tunnel
+                done.wait()
+                res = pend.pop("resolved", None)
+            if res is None:  # resolver off or its fetch failed: go inline
+                res = jax.device_get((pend["assignments"], pend["rounds"]))
+            assignments, rounds = res
         ctx, meta, profile = pend["ctx"], pend["meta"], pend["profile"]
         active = self._drain_ctx is ctx
         pend_count = sum(len(c) for c in pend["chunks"])
@@ -643,7 +752,9 @@ class Scheduler:
         chunks = [sample_pods[i * P:(i + 1) * P] or sample_pods[:P]
                   for i in range(B)]
         pbs = [self.cache.encode_pods(profile.apply_added_affinity(c),
-                                      meta, min_p=P) for c in chunks]
+                                      meta, min_p=P,
+                                      cache_rows=not profile.added_affinity)
+               for c in chunks]
         pb_stack = jax.tree_util.tree_map(
             lambda *xs: np.stack(xs), *unify_batches(pbs))
         built = build_drain_context(ct, pbs, nom_bucket=DRAIN_NOM_BUCKET)
@@ -902,6 +1013,14 @@ class Scheduler:
                 self.recorder.event(
                     pod, "Normal", "Scheduled",
                     f"Successfully assigned {pod.key} to {node_name}")
+            elif ok is None:
+                # the pod vanished while its binding was in flight (e.g. a
+                # churn delete): drop the assumption quietly — requeueing
+                # would retry-404 forever with no future event to clear it,
+                # and it is not a scheduling error either. The informer's
+                # DELETED event owns the queue cleanup; deleting here by
+                # ns/name could strand a just-RE-CREATED pod's queue entry.
+                self.cache.forget(pod.key)
             else:
                 self.cache.forget(pod.key)
                 if not self.cache.is_bound(pod.key):
@@ -915,9 +1034,13 @@ class Scheduler:
         Idempotent; the runner's stop path calls this so embedders and long
         test suites don't accumulate daemon threads."""
         try:
-            self._resolve_pending()  # land the in-flight drain's bindings
+            self._resolve_pending()  # land every in-flight drain's bindings
         except Exception:
-            _LOG.exception("resolving in-flight drain at close")
+            _LOG.exception("resolving in-flight drains at close")
+        if self._resolver_q is not None:
+            self._resolver_q.put(None)  # poison pill; thread is daemon
+            self._resolver_thread = None
+            self._resolver_q = None
         if self._staged:
             # parked fragments go back to the queue, not the void — with
             # their attempt history, so backoff does not reset
@@ -957,6 +1080,10 @@ class Scheduler:
                       else delegated)
         except Exception:
             ok = False
+        # a binder returning None means the pod no longer exists (deleted
+        # while the binding was in flight — expected under churn): there is
+        # nothing to requeue and nothing failed
+        gone = ok is None
         if ok:
             fw.run_post_bind(lifecycle, pod, node_name)
             self.recorder.event(pod, "Normal", "Scheduled",
@@ -965,6 +1092,11 @@ class Scheduler:
             fw.run_unreserve(rollback, pod, node_name)
         if ok:
             self.cache.finish_binding(pod.key)
+        elif gone:
+            # deleted mid-flight: forget only — the informer's DELETED
+            # event owns queue cleanup (a delete by ns/name here could
+            # strand a just-re-created pod's queue entry)
+            self.cache.forget(pod.key)
         else:
             self.cache.forget(pod.key)
             # 409 ordering: if another party bound this pod while it was

@@ -962,6 +962,28 @@ class APIServer:
                         for e in errors]
                     return self._send_json(200, {"kind": "Status",
                                                  "results": results})
+                if sub == "status" and kind == "Pod" and name == "-":
+                    # Bulk status: one POST applies many kubelet status
+                    # writes in a single store lock pass (a hollow-kubelet
+                    # fleet emits thousands of Pending->Running transitions
+                    # in seconds; per-pod PUTs were the kubemark
+                    # bottleneck). Body: {"statuses": [{"namespace":...,
+                    # "name":..., "status": {...}}]}; response is a
+                    # per-item status array in request order.
+                    items = body.get("statuses")
+                    if not isinstance(items, list):
+                        return self._error(400, "statuses must be a list",
+                                           "BadRequest")
+                    reqs = [(it.get("namespace", ns or "default"),
+                             it.get("name", ""), it.get("status") or {})
+                            for it in items]
+                    errors = server.store.update_status_many("Pod", reqs)
+                    results = [
+                        {"code": 200} if e is None else
+                        {"code": 404, "message": e, "reason": "NotFound"}
+                        for e in errors]
+                    return self._send_json(200, {"kind": "Status",
+                                                 "results": results})
                 if sub == "binding" and kind == "Pod":
                     # BindingREST.Create: set spec.nodeName if not already set.
                     target = body.get("target", {}).get("name", "")

@@ -533,6 +533,43 @@ class ObjectStore:
                 out.append(None)
         return out
 
+    def update_status_many(self, kind: str, items: list[tuple[str, str, dict]]
+                           ) -> list[Optional[str]]:
+        """Apply many STATUS updates in ONE lock pass: for each
+        ``(namespace, name, status)`` replace the object's status subtree.
+        Returns a per-item error string (or None on success); successes
+        commit even when siblings fail, exactly like N independent status
+        PUTs minus N-1 round trips and lock acquisitions.
+
+        No rv precondition: the kubelet owns its pods' status and already
+        serializes per-pod writes (PodWorkers), so last-write-wins within
+        one owner is the reference's status-manager semantics. This is the
+        storage half of the kubemark status batcher — 500 hollow kubelets
+        each PUTting Pending->Running transitions one at a time were the
+        kubemark bottleneck."""
+        out: list[Optional[str]] = []
+        with self._lock:
+            space = self._data.setdefault(kind, {})
+            for ns, name, status in items:
+                k = (ns or "", name)
+                cur = space.get(k)
+                if cur is None:
+                    out.append(f"{kind} {ns}/{name} not found")
+                    continue
+                rv = self._bump_locked()
+                obj = fastcopy(cur)
+                # detach from the caller's dict: DirectClient callers may
+                # reuse/mutate their status template after the call, and the
+                # stored object + emitted event must not change under them
+                obj["status"] = fastcopy(status)
+                obj["metadata"]["resourceVersion"] = str(rv)
+                space[k] = obj
+                self._journal_locked({"op": "set", "kind": kind, "ns": k[0],
+                                      "name": k[1], "rv": rv, "obj": obj})
+                self._emit_locked(kind, Event(MODIFIED, obj, rv))
+                out.append(None)
+        return out
+
     def delete(self, kind: str, namespace: str, name: str) -> dict:
         """Finalizer-aware deletion (apimachinery's graceful-deletion
         contract, ``registry.Store.Delete``): an object carrying

@@ -21,8 +21,10 @@ def test_connected_churn_loses_no_pods():
     # bench watcher is tolerated — it falls back to polling the store; the
     # scheduler's own informers are what's under test)
     assert out["bound"] == 200, out
-    # the churn loop really ran API mutations during the window
-    assert out["churn_api_ops"] > 0, out
+    # the churn loop really ran API mutations — and met its fixed op
+    # budget even though the measured drain finishes in ~a second (the
+    # budget is decoupled from drain duration)
+    assert out["churn_api_ops"] >= 500, out
 
 
 def test_churn_opcode_routes_to_connected():

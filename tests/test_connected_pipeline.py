@@ -1,0 +1,391 @@
+"""Connected-path pipeline: incremental encode + multi-deep dispatch.
+
+Three properties hold the north star together and are pinned here:
+
+1. ENCODE PARITY — pod rows compiled at informer-event time
+   (``SnapshotEncoder.precompile_pod``) must yield byte-identical batch
+   tensors to hot-path compilation, and the cache's patch-encoded
+   snapshots must stay semantically equal to a full re-encode under
+   randomized add/update/delete churn (Cache.UpdateSnapshot contract).
+2. PIPELINE PARITY — with N drains in flight (cfg.pipeline_depth > 1)
+   the placements must equal the one-deep pipeline's, every placement
+   must be feasible per the serial oracle, and the fold-region /
+   fill-bound reservation arithmetic must reconcile once the pipeline
+   drains.
+3. FAILURE BOOKKEEPING — kubelet pod workers record + retry sync errors
+   with backoff instead of swallowing them, and the static-pod mirror
+   resync backstop recreates mirrors even when the DELETED watch event
+   is lost.
+"""
+
+import json
+import random
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.config.types import SchedulerConfiguration
+from kubernetes_tpu.encode.snapshot import SnapshotEncoder
+from kubernetes_tpu.sched.cache import SchedulerCache
+from kubernetes_tpu.sched.queue import SchedulingQueue
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _nodes(n, cpu="4", prefix="n"):
+    return [make_node(f"{prefix}{i}")
+            .capacity({"cpu": cpu, "memory": "8Gi", "pods": "32"})
+            .label("kubernetes.io/hostname", f"{prefix}{i}")
+            .label("topology.kubernetes.io/zone", f"z{i % 3}")
+            .obj() for i in range(n)]
+
+
+def _rich_pod(i, anti=False, spread=False):
+    b = (make_pod(f"p{i}").req({"cpu": "250m", "memory": "128Mi"})
+         .label("app", f"g{i % 3}"))
+    if anti:
+        b = b.pod_anti_affinity("kubernetes.io/hostname",
+                                {"app": f"g{i % 3}"})
+    if spread:
+        b = b.spread(2, "topology.kubernetes.io/zone", "DoNotSchedule",
+                     {"app": f"g{i % 3}"})
+    return b.obj()
+
+
+# ---- 1a. precompiled rows == hot-path rows (exact array parity) ----------
+
+def test_precompiled_encode_pods_matches_inline():
+    import jax
+    nodes = _nodes(6)
+    pods = [_rich_pod(i, anti=(i % 2 == 0), spread=(i % 3 == 0))
+            for i in range(8)]
+    enc_a, enc_b = SnapshotEncoder(), SnapshotEncoder()
+    _, meta_a = enc_a.encode_cluster(nodes, [], pending_pods=pods)
+    _, meta_b = enc_b.encode_cluster(nodes, [], pending_pods=pods)
+    # A precompiles at "informer-event time" (same intern order as the
+    # inline path would produce), B compiles on the hot path
+    for p in pods:
+        assert enc_a.precompile_pod(p)
+    pb_a = enc_a.encode_pods(pods, meta_a, min_p=8)
+    pb_b = enc_b.encode_pods(pods, meta_b, min_p=8)
+    assert enc_a.pod_cache_hits == len(pods)
+    assert enc_b.pod_cache_hits == 0
+    for la, lb in zip(jax.tree_util.tree_leaves(pb_a),
+                      jax.tree_util.tree_leaves(pb_b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_precompile_cache_invalidated_by_catalog_epoch():
+    nodes = _nodes(2)
+    pods = [_rich_pod(0)]
+    enc = SnapshotEncoder()
+    _, meta = enc.encode_cluster(nodes, [], pending_pods=pods)
+    assert enc.precompile_pod(pods[0])
+    enc.set_namespaces({"default": {"team": "a"}})  # bumps the epoch
+    enc.encode_pods(pods, meta)
+    assert enc.pod_cache_hits == 0  # stale record must NOT be served
+    assert enc.pod_cache_misses == 1
+
+
+def test_precompile_cache_requires_object_identity():
+    """A fresh watch object (new Pod instance, same key) must miss."""
+    nodes = _nodes(2)
+    p1 = _rich_pod(0)
+    p2 = _rich_pod(0)  # same key, different object
+    enc = SnapshotEncoder()
+    _, meta = enc.encode_cluster(nodes, [], pending_pods=[p1])
+    assert enc.precompile_pod(p1)
+    enc.encode_pods([p2], meta)
+    assert enc.pod_cache_hits == 0
+
+
+# ---- 1b. randomized delta churn: patch path == full re-encode ------------
+
+def test_randomized_incremental_encode_parity():
+    """Property-style: after random pod assume/update/delete and node
+    add/remove sequences, the cache's (possibly patch-encoded) snapshot is
+    semantically identical to a fresh full encode of the same state —
+    same per-node requested vectors, same existing-pod placement
+    multiset."""
+    rng = random.Random(1234)
+    cache = SchedulerCache()
+    for n in _nodes(6):
+        cache.add_node(n)
+    live: dict[str, object] = {}   # key -> bound pod
+    node_names = [f"n{i}" for i in range(6)]
+    extra_nodes = 0
+    cache.snapshot()  # seed the cached encoding
+
+    for step in range(40):
+        op = rng.random()
+        if op < 0.55 or not live:
+            i = rng.randrange(10_000)
+            p = _rich_pod(i, anti=rng.random() < 0.3,
+                          spread=rng.random() < 0.2)
+            cache.assume(p, rng.choice(node_names))
+            live[p.key] = p
+        elif op < 0.80:
+            key = rng.choice(list(live))
+            cache.remove_pod(key)
+            del live[key]
+        elif op < 0.92 and live:
+            # rebind an existing pod elsewhere (update path)
+            key = rng.choice(list(live))
+            p = live[key]
+            cache.remove_pod(key)
+            cache.assume(p, rng.choice(node_names))
+        else:
+            extra_nodes += 1
+            name = f"x{extra_nodes}"
+            cache.add_node(make_node(name)
+                           .capacity({"cpu": "2", "memory": "4Gi",
+                                      "pods": "8"})
+                           .label("kubernetes.io/hostname", name)
+                           .obj())
+            node_names.append(name)
+
+        if step % 5 != 4:
+            continue
+        nodes_now, ct, meta = cache.snapshot()
+        bound = cache.bound_pods()
+        fresh = SnapshotEncoder()
+        ct_ref, meta_ref = fresh.encode_cluster(nodes_now, bound)
+        # per-node requested parity, matched BY NAME (row order may differ
+        # only if node sets diverged — they must not)
+        assert meta.node_names == meta_ref.node_names
+        n_live = len(nodes_now)
+        req = np.asarray(ct.requested)
+        req_ref = np.asarray(ct_ref.requested)
+        shared = min(req.shape[1], req_ref.shape[1])
+        np.testing.assert_array_equal(req[:n_live, :shared],
+                                      req_ref[:n_live, :shared])
+        if req.shape[1] > shared:
+            assert not np.asarray(req[:n_live, shared:]).any()
+        # existing-pod placement multiset parity
+        def placements(ct_x):
+            v = np.asarray(ct_x.epod_valid)
+            return sorted(np.asarray(ct_x.epod_node)[v].tolist())
+        assert placements(ct) == placements(ct_ref), f"step {step}"
+
+
+# ---- 2. pipeline depth > 1: parity + invariants --------------------------
+
+def _pipelined_sched(nodes, depth, batch_size=4, drain_batches=2):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    queue = SchedulingQueue(backoff_initial=0.05)
+    log = []
+    cfg = SchedulerConfiguration(batch_size=batch_size,
+                                 max_drain_batches=drain_batches,
+                                 pipeline_depth=depth)
+    sched = Scheduler(cfg, cache, queue,
+                      lambda pod, node: log.append(
+                          (pod.metadata.name, node)) or True)
+    warm = [make_pod(f"__warm{i}").req({"cpu": "100m"}).obj()
+            for i in range(batch_size)]
+    assert sched.warm_drain(warm, slot_headroom=64), "context failed to arm"
+    return sched, cache, queue, log
+
+
+def _run_to_empty(sched, queue, pods, rounds=24):
+    for p in pods:
+        queue.add(p)
+    bound = 0
+    for _ in range(rounds):
+        bound += sched.run_once(wait=0.01)
+        if not sched._pending and not queue.stats()["active"]:
+            break
+    bound += sched._resolve_pending()
+    sched.wait_for_bindings()
+    return bound
+
+
+def test_pipeline_depth_parity_invariants_and_oracle_feasibility():
+    """Identical workload through depth=1 and depth=3 pipelines must place
+    every pod identically — overlap changes WHEN host work happens, never
+    WHAT the device computes. On the pipelined leg, the fold-region /
+    fill-bound reservation arithmetic must reconcile once the pipeline
+    drains, and every placement must be feasible per the serial oracle."""
+    import copy
+    from kubernetes_tpu.sched.oracle import OracleScheduler
+    placements = {}
+    nodes = _nodes(8)
+    for depth in (1, 3):
+        pods = [_rich_pod(i, anti=(i % 5 == 0), spread=(i % 4 == 0))
+                for i in range(32)]
+        sched, cache, queue, log = _pipelined_sched(nodes, depth)
+        bound = _run_to_empty(sched, queue, pods)
+        assert bound == 32, f"depth {depth} lost pods: {bound}"
+        placements[depth] = dict(log)
+        if depth == 3:
+            # fold-region invariants after the pipeline drained: every
+            # dispatch-side reservation either folded (fill_host) or was
+            # released, and the fold never crossed the patch cursor
+            ctx = sched._drain_ctx
+            assert ctx is not None and not sched._pending
+            cs = ctx["cs"]
+            assert ctx["fill_bound"] == cs.fill_host
+            assert cs.fill_host <= cs.top
+            assert cs.fill_host == bound
+        sched.close()
+    assert placements[1] == placements[3]
+    # serial-oracle feasibility of each pipelined placement, given all the
+    # other placements bound
+    pods = [_rich_pod(i, anti=(i % 5 == 0), spread=(i % 4 == 0))
+            for i in range(32)]
+    placed = []
+    for p in pods:
+        q = copy.deepcopy(p)
+        q.spec.node_name = placements[3][p.metadata.name]
+        placed.append(q)
+    name_to_idx = {n.metadata.name: i for i, n in enumerate(nodes)}
+    for i, q in enumerate(placed):
+        others = [x for j, x in enumerate(placed) if j != i]
+        orc = OracleScheduler(nodes, others)
+        unbound = copy.deepcopy(q)
+        ni = name_to_idx[q.spec.node_name]
+        unbound.spec.node_name = ""
+        mask, reasons = orc.feasible(unbound)
+        assert mask[ni], (f"{q.key} infeasible on {q.spec.node_name}: "
+                          f"{reasons.get(q.spec.node_name)}")
+
+
+def test_pipeline_overlap_really_happens():
+    """With depth=3 and a full backlog, dispatches must stack to the
+    configured bound: suppress early-resolution (as if the device were
+    still busy — deterministic on a fast CPU) and require the pipeline to
+    reach 3 in flight while still binding every pod."""
+    nodes = _nodes(8)
+    pods = [_rich_pod(i) for i in range(32)]
+    sched, cache, queue, log = _pipelined_sched(nodes, 3)
+    sched._drain_ready = lambda pend: False  # never resolve early
+    max_depth = 0
+    for p in pods:
+        queue.add(p)
+    bound = 0
+    for _ in range(24):
+        bound += sched.run_once(wait=0.01)
+        max_depth = max(max_depth, len(sched._pending))
+        if not queue.stats()["active"] and len(sched._pending) == 0:
+            break
+    bound += sched._resolve_pending()
+    sched.wait_for_bindings()
+    sched.close()
+    assert bound == 32
+    assert max_depth == 3, max_depth  # the bound was reached, not exceeded
+
+
+# ---- 3a. pod workers: sync errors recorded + retried with backoff --------
+
+def test_pod_workers_record_and_retry_sync_errors():
+    from kubernetes_tpu.kubelet.pod_workers import PodWorkers
+    from kubernetes_tpu.metrics.registry import KUBELET_SYNC_ERRORS
+    calls = []
+
+    def sync(uid, pod):
+        calls.append(pod)
+        if len(calls) <= 2:
+            raise RuntimeError("transient sync failure")
+
+    pw = PodWorkers(sync, backoff_initial=0.02, backoff_max=0.1)
+    before = KUBELET_SYNC_ERRORS.get()
+    pw.update_pod("u1", {"metadata": {"name": "p1"}})
+    deadline = time.time() + 5.0
+    while time.time() < deadline and len(calls) < 3:
+        time.sleep(0.01)
+    assert len(calls) >= 3, "failed sync was not retried"
+    time.sleep(0.05)
+    assert pw.sync_errors("u1") == 0, "success did not clear the counter"
+    assert KUBELET_SYNC_ERRORS.get() - before >= 2
+    pw.stop()
+
+
+def test_pod_workers_latest_update_wins_during_backoff():
+    from kubernetes_tpu.kubelet.pod_workers import PodWorkers
+    seen = []
+
+    def sync(uid, pod):
+        seen.append(pod["v"])
+        if pod["v"] == 1:
+            raise RuntimeError("v1 always fails")
+
+    pw = PodWorkers(sync, backoff_initial=0.05, backoff_max=0.1)
+    pw.update_pod("u1", {"v": 1})
+    time.sleep(0.02)          # v1 fails, worker parks in backoff
+    pw.update_pod("u1", {"v": 2})  # newer update supersedes the retry
+    deadline = time.time() + 5.0
+    while time.time() < deadline and 2 not in seen:
+        time.sleep(0.01)
+    assert 2 in seen
+    pw.stop()
+
+
+# ---- 3b. static-pod mirror resync backstop -------------------------------
+
+def test_static_pod_mirror_resync_survives_lost_delete_event(tmp_path):
+    """Kill the kubelet's informer (simulating a swallowed DELETED event —
+    the starvation mode behind the old flake), delete the mirror via the
+    API, and require the periodic resync backstop to recreate it."""
+    from kubernetes_tpu.client.clientset import HTTPClient
+    from kubernetes_tpu.kubelet.kubelet import HollowNode
+    from kubernetes_tpu.store.apiserver import APIServer
+    server = APIServer().start()
+    node = None
+    try:
+        client = HTTPClient(server.url)
+        manifest_dir = tmp_path / "m"
+        manifest_dir.mkdir()
+        node = HollowNode(client, "rsb-1")
+        node.kubelet.start(static_pod_path=str(manifest_dir),
+                           static_poll_s=0.05)
+        (manifest_dir / "kapi.json").write_text(json.dumps({
+            "kind": "Pod", "metadata": {"name": "kapi"},
+            "spec": {"containers": [{"name": "c", "image": "api:v1"}]}}))
+
+        def mirror():
+            try:
+                return client.pods("default").get("kapi-rsb-1")
+            except Exception:
+                return None
+
+        deadline = time.time() + 20
+        while time.time() < deadline and mirror() is None:
+            time.sleep(0.05)
+        assert mirror() is not None
+        # no informer => the DELETED event below is never delivered
+        node.kubelet._informer.stop()
+        time.sleep(0.2)
+        client.pods("default").delete("kapi-rsb-1")
+        deadline = time.time() + 20
+        while time.time() < deadline and mirror() is None:
+            time.sleep(0.05)
+        assert mirror() is not None, \
+            "resync backstop did not recreate the mirror"
+    finally:
+        if node is not None:
+            node.stop()
+        server.stop()
+
+
+# ---- bulk status transport (kubemark batcher's storage half) -------------
+
+def test_bulk_pod_status_roundtrip():
+    from kubernetes_tpu.client.clientset import HTTPClient
+    from kubernetes_tpu.store.apiserver import APIServer
+    server = APIServer().start()
+    try:
+        client = HTTPClient(server.url)
+        client.pods("default").create_many(
+            [make_pod(f"s{i}", "default").obj().to_dict() for i in range(4)])
+        errs = client.pods("default").update_status_many(
+            [("default", f"s{i}", {"phase": "Running"}) for i in range(3)]
+            + [("default", "missing", {"phase": "Running"})])
+        assert errs[:3] == [None, None, None]
+        assert "not found" in errs[3]
+        for i in range(3):
+            assert client.pods("default").get(
+                f"s{i}")["status"]["phase"] == "Running"
+    finally:
+        server.stop()
