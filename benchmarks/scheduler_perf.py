@@ -84,8 +84,8 @@ def materialize(case: dict, params: dict):
                 md = d.setdefault("metadata", {})
                 md["name"] = f"{md.pop('generateName', 'pod-')}{len(out)}-{i}"
                 out.append(Pod.from_dict(d))
-        elif code == "simulateAutoscale":
-            pass  # handled by _run_autoscaler_workload after materialize
+        elif code in ("simulateAutoscale", "simulateDefrag"):
+            pass  # handled by the dedicated workload runner after materialize
         elif code == "generateWorkload":
             from benchmarks.workloads import WORKLOADS
             gen = WORKLOADS[op["generator"]]
@@ -116,6 +116,11 @@ def run_workload(case: dict, workload: dict, scale: float = 1.0,
     if autoscale_op is not None:
         return _run_autoscaler_workload(case, workload, params,
                                         autoscale_op, log, scale=scale)
+    defrag_op = next((op for op in case["workloadTemplate"]
+                      if op["opcode"] == "simulateDefrag"), None)
+    if defrag_op is not None:
+        return _run_descheduler_workload(case, workload, params,
+                                         defrag_op, log, scale=scale)
     nodes, measured, warm = materialize(case, params)
     log(f"  materialized {len(nodes)} nodes, {len(measured)} measured pods")
 
@@ -222,6 +227,58 @@ def _run_autoscaler_workload(case: dict, workload: dict, params: dict,
         "pods_placed": placed, "pods": len(measured), "nodes": len(nodes),
         "chosen_group": choice.group.name if choice else None,
         "nodes_needed": choice.nodes_needed if choice else 0,
+        "thresholds": thresholds, "passed": passed,
+    }
+
+
+def _run_descheduler_workload(case: dict, workload: dict, params: dict,
+                              op: dict, log, scale: float = 1.0) -> dict:
+    """The ``simulateDefrag`` opcode: a deliberately fragmented cluster
+    (warm pods scattered one per node so no node can host a gang member)
+    plus a pending gang — the measured quantity is the gang-defrag PLAN
+    latency: one batched ``run_filters`` over every candidate drain prefix
+    AND the gang, then the host-side fewest-evictions ledger scan
+    (kubernetes_tpu/descheduler/planner.py plan_gang_defrag)."""
+    from kubernetes_tpu.descheduler import (
+        gang_consolidation_candidates,
+        plan_gang_defrag,
+    )
+
+    nodes, measured, warm = materialize(case, params)
+    for i, p in enumerate(warm):
+        p.spec.node_name = nodes[i % len(nodes)].metadata.name
+    max_nodes = int(_sub(op.get("maxDrainNodesParam",
+                                op.get("maxDrainNodes", len(nodes))),
+                         params))
+    log(f"  {len(nodes)} fragmented nodes, {len(measured)} gang pods, "
+        f"drain prefixes capped at {max_nodes}")
+
+    def _plan():
+        cands = gang_consolidation_candidates(nodes, warm,
+                                              max_nodes=max_nodes)
+        return plan_gang_defrag(nodes, warm, measured, "bench", cands)
+
+    # warmup excluded (JIT compile of the filter program), as everywhere
+    t0 = time.time()
+    _plan()
+    compile_s = time.time() - t0
+    t0 = time.time()
+    plan = _plan()
+    plan_s = time.time() - t0
+
+    seated = len(plan.gang_moves)
+    thresholds = workload.get("thresholds") or {}
+    passed = seated >= len(measured)
+    if "DefragPlanSeconds" in thresholds:
+        passed = passed and plan_s <= thresholds["DefragPlanSeconds"]
+    return {
+        "case": case["name"], "workload": workload["name"],
+        "DefragPlanSeconds": round(plan_s, 4),
+        "compile_s": round(compile_s, 2),
+        "batch_victims": plan.batch_victims,
+        "candidate_sets": plan.batch_sets,
+        "evictions": plan.evictions,
+        "gang_seated": seated, "pods": len(measured), "nodes": len(nodes),
         "thresholds": thresholds, "passed": passed,
     }
 

@@ -85,3 +85,34 @@ def disruptions_allowed_for(pod: dict, pdbs: list[dict],
         if best is None or allowed < best:
             best, governing = allowed, pdb
     return (best if best is not None else 1 << 30), governing
+
+
+def pdb_budgets(pdbs: Optional[list[dict]], pod_dicts: Optional[list[dict]],
+                ) -> list[tuple[dict, str, str, int]]:
+    """-> one (pdb, namespace, name, disruptionsAllowed) per PDB, with
+    ``disruptionsAllowed`` live-computed against the namespace's pods.
+    Compute ONCE, then charge per approved eviction — every consumer that
+    gates multiple evictions against one budget (the descheduler planner's
+    ledger, the gang-defrag candidate screen) must share this arithmetic,
+    or N victims against a budget with one disruption left each see
+    "1 remaining" and all pass."""
+    out = []
+    for pdb in (pdbs or []):
+        pmd = pdb.get("metadata") or {}
+        pns = pmd.get("namespace", "")
+        ns_pods = [p for p in (pod_dicts or [])
+                   if (p.get("metadata") or {}).get("namespace", "") == pns]
+        allowed = compute_pdb_status(pdb, ns_pods)["disruptionsAllowed"]
+        out.append((pdb, pns, pmd.get("name", ""), allowed))
+    return out
+
+
+def list_pdbs(client) -> list[dict]:
+    """Every PDB in the cluster, or [] when the store has no such resource
+    (older servers, bare DirectClient fixtures) — disruption math degrades
+    to "no budgets" rather than taking the caller's loop down. Shared by
+    the autoscaler's scale-down proof and the descheduler's planner."""
+    try:
+        return list(client.resource("poddisruptionbudgets", None).list())
+    except Exception:
+        return []

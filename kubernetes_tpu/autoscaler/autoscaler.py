@@ -229,12 +229,18 @@ class ClusterAutoscaler:
                     "group": g, "node": c, "at": rfc3339_from_epoch(now)}
         return reclaimed, dict(plan.blocked)
 
+    def note_drained(self, node_names: list[str]) -> None:
+        """Descheduler handoff: a defrag cycle fully drained these nodes,
+        so start their scale-down-unneeded window NOW instead of at this
+        loop's next observation — consolidation and reclaim compose into
+        one convergence step instead of two full loop periods."""
+        now = self.clock.now()
+        for n in node_names:
+            self._unneeded_since.setdefault(n, now)
+
     def _list_pdbs(self) -> list[dict]:
-        try:
-            return list(self.client.resource(
-                "poddisruptionbudgets", None).list())
-        except Exception:
-            return []
+        from kubernetes_tpu.api.policy import list_pdbs
+        return list_pdbs(self.client)
 
     def _reclaim(self, node_name: str, group_name: str) -> bool:
         """Cordon -> drain (Eviction API, PDB-honoring) -> delete. A 429

@@ -619,6 +619,76 @@ def cmd_autoscale(client: HTTPClient, args, out) -> int:
     return 0
 
 
+def cmd_deschedule(client: HTTPClient, args, out) -> int:
+    """ktpu deschedule run|status: drive one descheduler cycle in-process
+    (run) or read the loop's published ``descheduler-status`` ConfigMap
+    (status) — same surface split as ``autoscale status``."""
+    from kubernetes_tpu.descheduler import (
+        STATUS_CONFIGMAP as DESCHED_CM,
+        Descheduler,
+        DeschedulerConfiguration,
+    )
+    if args.action == "run":
+        cfg = (DeschedulerConfiguration.from_yaml(args.policy)
+               if args.policy else DeschedulerConfiguration())
+        if args.max_evictions is not None:
+            cfg.max_evictions_per_cycle = args.max_evictions
+        summary = Descheduler(client, cfg).run_once(dry_run=args.dry_run)
+        if args.output == "json":
+            out.write(json.dumps(summary, indent=1) + "\n")
+            return 0
+        verb = "would evict" if args.dry_run else "evicted"
+        for s in summary["planned"]:
+            out.write(f"{s['strategy']}: {s['set']} -> "
+                      f"{s['evictions']} eviction(s)\n")
+            for key, target in s["moves"]:
+                out.write(f"  {key} -> {target}\n")
+        for g in summary["gangs"]:
+            state = ("fits without evictions" if g["fitsWithoutEvictions"]
+                     else f"{g['evictions']} eviction(s) via {g['set']}"
+                     if g["set"] else "no feasible consolidation")
+            out.write(f"gang {g['gang']}: {state}\n")
+        for name, why in sorted(summary["blocked"].items()):
+            out.write(f"blocked {name}: {why}\n")
+        if args.dry_run:
+            # planned totals include gang-defrag victims, matching what a
+            # wet run's `evicted` list would contain for the same plan
+            n = (sum(s["evictions"] for s in summary["planned"])
+                 + sum(g["evictions"] for g in summary["gangs"]))
+        else:
+            n = len(summary.get("evicted", []))
+        out.write(f"{verb} {n} pod(s)\n")
+        return 0
+    # status
+    try:
+        cm = client.resource("configmaps", args.namespace).get(DESCHED_CM)
+    except ApiError as e:
+        if e.code != 404:
+            raise
+        out.write("error: no descheduler status published "
+                  f"(configmap {DESCHED_CM!r} not found in "
+                  f"{args.namespace!r})\n")
+        return 1
+    data = cm.get("data") or {}
+    if args.output == "json":
+        out.write(data.get("status", "{}") + "\n")
+        return 0
+    st = json.loads(data.get("status", "{}") or "{}")
+    out.write(f"Last probe:   {data.get('lastProbeTime', '<unknown>')}\n")
+    out.write(f"Strategies:   {', '.join(st.get('strategies') or [])}\n")
+    out.write(f"Gang defrag:  "
+              f"{'on' if st.get('gangDefrag') else 'off'}\n")
+    out.write(f"Max/cycle:    {st.get('maxEvictionsPerCycle')}\n")
+    last = st.get("lastCycle") or {}
+    if last:
+        out.write(f"Last cycle:   planned={last.get('planned', 0)} "
+                  f"evicted={last.get('evicted', 0)} at={last.get('at')}\n")
+    loop = st.get("lastLoop") or {}
+    for name, why in sorted((loop.get("blocked") or {}).items()):
+        out.write(f"  blocked {name}: {why}\n")
+    return 0
+
+
 REVISION_ANNOTATION = "deployment.kubernetes.io/revision"
 
 
@@ -779,6 +849,16 @@ def build_parser() -> argparse.ArgumentParser:
     asc.add_argument("action", choices=["status"])
     asc.add_argument("-o", "--output", choices=["table", "json"],
                      default="table")
+
+    ds = sub.add_parser("deschedule")
+    ds.add_argument("action", choices=["run", "status"])
+    ds.add_argument("--policy", default=None,
+                    help="DeschedulerConfiguration YAML (profiles/knobs)")
+    ds.add_argument("--dry-run", action="store_true",
+                    help="plan and print, evict nothing")
+    ds.add_argument("--max-evictions", type=int, default=None)
+    ds.add_argument("-o", "--output", choices=["table", "json"],
+                    default="table")
     return ap
 
 
@@ -842,6 +922,8 @@ def main(argv=None, out=None) -> int:
             return cmd_rollout(client, args, out)
         if args.cmd == "autoscale":
             return cmd_autoscale(client, args, out)
+        if args.cmd == "deschedule":
+            return cmd_deschedule(client, args, out)
     except ApiError as e:
         out.write(f"Error from server ({e.reason or e.code}): {e}\n")
         return 1

@@ -64,12 +64,20 @@ class WorkQueue:
 
 
 class RateLimitingQueue(WorkQueue):
-    """WorkQueue + per-item exponential failure backoff (AddRateLimited)."""
+    """WorkQueue + per-item exponential failure backoff (AddRateLimited).
 
-    def __init__(self, base_delay: float = 0.005, max_delay: float = 10.0):
+    ``clock``: injectable ``utils/clock.Clock`` — delay expiry is measured
+    on it, so tests drive backoff windows with a ``FakeClock`` instead of
+    sleeping through real ones (k8s.io/utils/clock, the same seam the HPA
+    stabilization window uses)."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 10.0,
+                 clock=None):
         super().__init__()
+        from kubernetes_tpu.utils.clock import REAL_CLOCK
         self.base_delay = base_delay
         self.max_delay = max_delay
+        self.clock = clock or REAL_CLOCK
         self._failures: dict = {}
         self._delayed: list[tuple[float, int, Hashable]] = []
         self._seq = 0
@@ -94,14 +102,15 @@ class RateLimitingQueue(WorkQueue):
     def add_after(self, item: Hashable, delay: float):
         with self._lock:
             self._seq += 1
-            heapq.heappush(self._delayed, (time.time() + delay, self._seq, item))
+            heapq.heappush(self._delayed,
+                           (self.clock.now() + delay, self._seq, item))
 
     def _pump(self):
         while True:
             with self._lock:
                 if self._closed:
                     return
-                now = time.time()
+                now = self.clock.now()
                 due = []
                 while self._delayed and self._delayed[0][0] <= now:
                     due.append(heapq.heappop(self._delayed)[2])

@@ -20,6 +20,16 @@ from kubernetes_tpu.controllers.base import Controller
 
 SIGNER_KUBE_APISERVER_CLIENT = "kubernetes.io/kube-apiserver-client"
 
+# ``cryptography`` is an optional dependency: every X.509 operation below
+# imports it lazily, and components that can run without a signer (the
+# controller manager, tests) consult this flag instead of crashing on
+# construction. Skip-marked tests key off it too.
+try:
+    import cryptography  # noqa: F401
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover - depends on environment
+    HAVE_CRYPTOGRAPHY = False
+
 _USAGE_MAP = {  # CSR usages -> x509 KeyUsage flag names
     "digital signature": "digital_signature",
     "key encipherment": "key_encipherment",

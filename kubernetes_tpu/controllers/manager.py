@@ -7,6 +7,7 @@ SharedInformerFactory; active-passive via leader election).
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Optional
 
@@ -54,6 +55,8 @@ from kubernetes_tpu.controllers.route import RouteController
 from kubernetes_tpu.controllers.servicelb import ServiceLBController
 from kubernetes_tpu.controllers.ttl import TTLController
 from kubernetes_tpu.controllers.ttlafterfinished import TTLAfterFinishedController
+
+_LOG = logging.getLogger(__name__)
 
 DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "daemonset",
                        "statefulset", "endpoints", "endpointslice",
@@ -119,6 +122,17 @@ class ControllerManager:
             "service-lb": ServiceLBController,
             "route": RouteController,
         }
+        from kubernetes_tpu.controllers.certificates import HAVE_CRYPTOGRAPHY
+        if not HAVE_CRYPTOGRAPHY:
+            # X.509-backed loops need the optional ``cryptography`` package;
+            # run the rest of the manager rather than refusing to start
+            # (upstream kcm likewise runs with individual loops disabled)
+            needs_x509 = {"csrsigning", "root-ca-cert-publisher"}
+            dropped = [n for n in controllers if n in needs_x509]
+            if dropped:
+                _LOG.warning("cryptography not installed; disabling "
+                             "controllers: %s", ", ".join(dropped))
+            controllers = [n for n in controllers if n not in needs_x509]
         self.controllers = [ctors[n](client) for n in controllers]
         self.gc = GarbageCollector(client) if gc_enabled else None
         self.leader_elect = leader_elect

@@ -741,6 +741,36 @@ class SnapshotEncoder:
             attach_used=attach_used, attach_limit=attach_limit,
         ), rows
 
+    def without_pods(self, ct: ClusterTensors, meta: "SnapshotMeta",
+                     pod_keys: list[str]) -> Optional[ClusterTensors]:
+        """``with_hypothetical`` in reverse: mask bound pods OUT of an
+        encoded snapshot — the descheduler's "what does the cluster look
+        like after these evictions?" question. The victims' epod rows
+        invalidate (their relational footprint — anti-affinity symmetry,
+        spread counts — disappears) and their request vectors leave
+        ``requested``; everything else is shared with the source encoding.
+
+        Ephemeral and copy-on-write like the other overlays: the
+        incremental-patch bookkeeping still considers the pods resident
+        (use ``apply_pod_deltas`` for a real delete). Returns None when a
+        key is outside the current patch state or carries port/volume node
+        state an overlay cannot reconstruct — callers fall back to a full
+        re-encode without the victims.
+        """
+        st = self._patch
+        if st is None or st.generation != meta.generation:
+            return None
+        if any(k in st.unpatchable for k in pod_keys):
+            return None
+        if any(k not in st.slot_of for k in pod_keys):
+            return None
+        requested = np.array(ct.requested)
+        epod_valid = np.array(ct.epod_valid)
+        for k in set(pod_keys):
+            requested[st.slot_node[k]] -= st.slot_req[k]
+            epod_valid[st.slot_of[k]] = False
+        return ct.replace(requested=requested, epod_valid=epod_valid)
+
     def with_nominated(self, ct: ClusterTensors, meta: "SnapshotMeta",
                        nominated: list, min_m: int = 0) -> ClusterTensors:
         """Overlay nominated-pod reservations onto an encoded snapshot.

@@ -269,3 +269,16 @@ AUTOSCALER_UNSCHEDULABLE = REGISTRY.gauge(
     "Pending pods the last loop saw as unschedulable")
 AUTOSCALER_GROUP_SIZE = REGISTRY.gauge(
     "cluster_autoscaler_node_group_size", "Current size by node group")
+
+# Descheduler SLIs (kubernetes-sigs/descheduler pkg/descheduler/metrics
+# analogs, plus the batching figure unique to the tensor path).
+DESCHEDULER_EVICTIONS = REGISTRY.counter(
+    "descheduler_evictions_total",
+    "Evictions by strategy and result (evicted|refused|gone)")
+DESCHEDULER_PLAN_BATCH = REGISTRY.gauge(
+    "descheduler_plan_batch_size",
+    "Victim rows validated by the last single batched re-placement "
+    "simulation, by phase (strategies|gangDefrag)")
+DESCHEDULER_LOOP_DURATION = REGISTRY.histogram(
+    "descheduler_loop_duration_seconds",
+    "One descheduler cycle by phase (plan|evict)")

@@ -116,6 +116,22 @@ class SchedulerRunner:
             return
         if pod.spec.scheduler_name not in self._scheduler_names:
             return
+        if pod.status.nominated_node_name:
+            # another component reserved capacity for this pending pod via
+            # the API (descheduler gang defrag); honor it like our own
+            # preemption nominations (eventhandlers.go addNominatedPod)
+            self.scheduler.nominate_external(
+                pod, pod.status.nominated_node_name)
+        elif type_ == MODIFIED and ((old or {}).get("status") or {}) \
+                .get("nominatedNodeName"):
+            # field removed (aborted gang plan): clear the API-origin
+            # reservation instead of pinning the node for the full TTL.
+            # Only when the PREVIOUS object carried one — most pending-pod
+            # MODIFIED events never had a nomination, and staging a
+            # tombstone for each would take the staging lock on every such
+            # event just for the fold to discard it (ADDED pods are skipped
+            # for the same reason).
+            self.scheduler.nominate_external(pod, "")
         # incremental encode: compile the pod's encode record NOW, on the
         # watch thread, so the drain's encode_pods is array-fill only by
         # the time this pod pops (sched/cache.py precompile_pod never

@@ -117,6 +117,18 @@ class Scheduler:
         # backstops pods deleted while nominated.
         self._nominated: dict[str, tuple] = {}
         self._nominated_ttl = 300.0
+        # API-visible nominations set by OTHER components (the descheduler's
+        # gang defrag writes status.nominatedNodeName after draining nodes
+        # for a gang). Staged under a lock by the informer thread and folded
+        # into _nominated on the scheduling thread each cycle — _nominated
+        # itself is single-thread state.
+        self._nominated_staged: dict[str, Optional[tuple]] = {}
+        self._nominated_staged_lock = threading.Lock()
+        # keys whose _nominated entry came from the API: only those may be
+        # cleared by an API-side removal (tombstone) — the scheduler's own
+        # preemption nominations are in-memory only and must survive
+        # unrelated MODIFIED events that naturally carry no nominatedNodeName
+        self._nominated_external: set[str] = set()
         # PDBs for preemption victim selection; the runner wires this to its
         # poddisruptionbudgets informer
         self.pdb_lister: Callable[[], list] = lambda: []
@@ -141,6 +153,46 @@ class Scheduler:
                 raise ValueError(
                     f"profile {prof.scheduler_name!r} references "
                     f"unregistered out-of-tree plugins: {sorted(unknown)}")
+
+    # ---- external nominations -------------------------------------------
+
+    def nominate_external(self, pod: Pod, node_name: str) -> None:
+        """Register a nominatedNodeName another component wrote to the API
+        (schedule_one.go honors these the same way it honors its own
+        preemption nominations). The reservation shields the node's
+        capacity from lower-priority pods until the nominee binds — without
+        it, a descheduler gang-defrag race is lost to whichever replacement
+        pod reaches the activeQ first. Safe to call from the informer
+        thread; entries fold into _nominated on the scheduling thread.
+        An empty ``node_name`` stages a CLEAR: the API removed the field
+        (e.g. the descheduler aborted a half-executed gang set), so the
+        reservation must not pin capacity for the rest of its TTL. Clears
+        only touch API-origin entries — the scheduler's own preemption
+        nominations are in-memory only and must survive unrelated MODIFIED
+        events that naturally carry no nominatedNodeName."""
+        with self._nominated_staged_lock:
+            if node_name:
+                self._nominated_staged[pod.key] = (
+                    node_name, pod.spec.priority, pod, time.time())
+            else:
+                self._nominated_staged[pod.key] = None
+
+    def _fold_staged_nominations(self) -> None:
+        if not self._nominated_staged:
+            return
+        with self._nominated_staged_lock:
+            staged, self._nominated_staged = self._nominated_staged, {}
+        # entries pruned since registration (bound / TTL) drop out of the
+        # external set too, keeping it bounded by live nominations
+        self._nominated_external &= set(self._nominated)
+        for k, e in staged.items():
+            if e is None:
+                if k in self._nominated_external:
+                    self._nominated.pop(k, None)
+                    self._nominated_external.discard(k)
+            elif not self.cache.is_bound(k):
+                self._nominated[k] = e
+                self._nominated_external.add(k)
 
     # ---- dispatch pipeline ----------------------------------------------
 
@@ -212,6 +264,7 @@ class Scheduler:
         backlog takes the fused drain path (one device program for many
         batches, models/gang.py gang_drain) while shallow pops run the
         single-batch program."""
+        self._fold_staged_nominations()
         # land finished drains' bindings as soon as the device is done
         # (don't let finished results sit behind a blocking pop)
         n_early = self._resolve_ready()
@@ -290,6 +343,10 @@ class Scheduler:
             if now - e[3] < self._nominated_ttl and not self.cache.is_bound(k)}
         entries = [(n, prio, p) for k, (n, prio, p, _ts)
                    in self._nominated.items() if k not in batch_keys]
+        # nominations the snapshot is about to reserve resource-accurately
+        # (overlay below); only arrivals AFTER this point need the coarse
+        # assume-time re-check
+        overlaid_noms = set(self._nominated)
         if entries:
             # nominees OUTSIDE this batch hold their reservation tensor-side;
             # nominees inside it are protected by the gang rank order instead
@@ -347,6 +404,21 @@ class Scheduler:
             for problem in sanity.check_assignment(assignment, len(nodes)):
                 _LOG.error("KTPU_CHECK: %s (batch of %d)", problem, len(pods))
 
+        # Nominations that arrived while this cycle's snapshot was in
+        # flight (the descheduler writes status.nominatedNodeName right
+        # before evicting): the snapshot could not reserve them, so winners
+        # re-check against them before the assume. ONLY the mid-cycle
+        # arrivals — nominations the snapshot already overlaid were
+        # reserved resource-accurately, and a node-level deny for those
+        # would lock out pods that provably fit beside the nominee.
+        # Losing a node to a fresh reservation costs one backoff; binding
+        # over it costs the reservation its meaning.
+        self._fold_staged_nominations()
+        reserved: dict[str, int] = {}
+        for k, (n, prio, _p, _ts) in self._nominated.items():
+            if k not in batch_keys and k not in overlaid_noms:
+                reserved[n] = max(prio, reserved.get(n, prio))
+
         n_bound = n_err = n_unsched = 0
         to_bind: list[tuple[Pod, str]] = []
         failures: list[tuple[Pod, int]] = []
@@ -359,6 +431,15 @@ class Scheduler:
                 continue
             if a >= 0:
                 node_name = meta.node_names[int(a)]
+                rp = reserved.get(node_name)
+                # >=: equal-priority nominees shield too, matching the
+                # device-side fit_mask (prio_s >= pb.priority) and upstream's
+                # RunFilterPluginsWithNominatedPods — default-priority gangs
+                # (0) must still beat their victims' replacements (also 0)
+                if rp is not None and rp >= pod.spec.priority:
+                    failures.append((pod, attempts))
+                    n_unsched += 1
+                    continue
                 self._nominated.pop(pod.key, None)
                 self.cache.assume(pod, node_name)
                 to_bind.append((pod, node_name))
@@ -593,6 +674,10 @@ class Scheduler:
             "chunks": chunks, "ctx": ctx,
             "meta": meta, "n_nodes": len(nodes), "profile": profile,
             "t0": t0,
+            # nominations the dispatched program already respects (resident
+            # reservation slots); resolve re-checks winners only against
+            # nominations that arrive AFTER this point
+            "nom_keys": set(nom_target),
         }
         if self.cycle_log is not None:
             marks = dict(self._cyc_marks)
@@ -663,6 +748,20 @@ class Scheduler:
         active = self._drain_ctx is ctx
         pend_count = sum(len(c) for c in pend["chunks"])
         GANG_ROUNDS.observe(int(np.sum(rounds)))
+        # nominations that arrived while this drain was on the device (the
+        # descheduler writes them right before evicting): the dispatched
+        # program could not reserve them, so winners re-check here — same
+        # contract as _schedule_group's assume-time re-check
+        self._fold_staged_nominations()
+        fresh: dict[str, int] = {}
+        if self._nominated:
+            known = pend.get("nom_keys", set())
+            drain_keys = {pod.key for chunk in pend["chunks"]
+                          for pod, _ in chunk}
+            for k, (n, prio, _p, _ts) in self._nominated.items():
+                if k not in known and k not in drain_keys:
+                    fresh[n] = max(prio, fresh.get(n, prio))
+        lost_races = 0
         to_bind: list[tuple[Pod, str]] = []
         bound_rows: list[int] = []  # node index per to_bind entry
         failures: list[tuple[Pod, int]] = []
@@ -678,10 +777,22 @@ class Scheduler:
                 for (pod, attempts), a in zip(chunk,
                                               assignment[:len(chunk)]):
                     if a >= 0:
-                        to_bind.append((pod, node_names[int(a)]))
+                        node_name = node_names[int(a)]
+                        rp = fresh.get(node_name)
+                        if rp is not None and rp >= pod.spec.priority:
+                            failures.append((pod, attempts))
+                            lost_races += 1
+                            continue
+                        to_bind.append((pod, node_name))
                         bound_rows.append(int(a))
                     else:
                         failures.append((pod, attempts))
+            if lost_races and active:
+                # the device fold already committed the rejected winners
+                # into the resident encoding: it is now approximate —
+                # rebuild at next dispatch (rare; only when a nomination
+                # raced an in-flight drain)
+                ctx["cs"].tainted = True
             if to_bind:
                 # one lock pass for the whole drain's winners; failures are
                 # handled AFTER so their preemption dry-runs see every winner
@@ -863,6 +974,12 @@ class Scheduler:
             pod.status.nominated_node_name = nominated
             self._nominated[pod.key] = (nominated, pod.spec.priority, pod,
                                         time.time())
+            # this entry is in-memory, whatever the key's history: a stale
+            # external flag left by an earlier API nomination of the same
+            # key (pruned from _nominated without a fold running since)
+            # would let an unrelated no-nomination MODIFIED tombstone clear
+            # the preemption reservation
+            self._nominated_external.discard(pod.key)
             self.queue.add(pod)
         else:
             self.queue.add_unschedulable(pod, attempts + 1)

@@ -30,10 +30,14 @@ class EventRecorder:
     queue to drain (tests / shutdown)."""
 
     def __init__(self, client, component: str,
-                 aggregate_window_s: float = 600.0):
+                 aggregate_window_s: float = 600.0, clock=None):
+        from kubernetes_tpu.utils.clock import REAL_CLOCK
         self.client = client
         self.component = component
         self.aggregate_window_s = aggregate_window_s
+        # event timestamps + the aggregation/prune windows read this clock,
+        # so tests drive window expiry with a FakeClock instead of sleeping
+        self.clock = clock or REAL_CLOCK
         self._lock = threading.Lock()
         # (ns, involved name, reason, message) -> (event name, count, ts)
         self._seen: dict[tuple, tuple[str, int, float]] = {}
@@ -55,7 +59,7 @@ class EventRecorder:
         ns = md.get("namespace") or "default"
         name = md.get("name", "")
         key = (ns, name, reason, message)
-        now = time.time()
+        now = self.clock.now()
         with self._lock:
             # prune entries too old to ever aggregate again (leak guard);
             # at most once per minute — event() runs on the scheduling loop,

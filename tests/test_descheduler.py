@@ -1,0 +1,748 @@
+"""Descheduler: batched eviction planning, strategies, PDB enforcement,
+and the gang-defragmentation end-to-end.
+
+Reference: ``kubernetes-sigs/descheduler`` (strategy plugins + the eviction
+framework), with the per-candidate simulation replaced by one batched
+``run_filters`` pass (descheduler/planner.py).
+"""
+
+import io
+import json
+import time
+
+import pytest
+
+from kubernetes_tpu.descheduler import planner as planner_mod
+from kubernetes_tpu.descheduler import (
+    CandidateSet,
+    Descheduler,
+    DeschedulerConfiguration,
+    GANG_LABEL,
+    gang_consolidation_candidates,
+    plan_evictions,
+    plan_evictions_naive,
+    plan_gang_defrag,
+)
+from kubernetes_tpu.descheduler.strategies import (
+    high_node_utilization,
+    low_node_utilization,
+    pods_violating_node_affinity,
+    pods_violating_topology_spread,
+    remove_duplicates,
+)
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+pytestmark = pytest.mark.descheduler
+
+
+def wait_for(pred, timeout=30.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _fragmented(n=4, node_cpu="2", filler_cpu="1"):
+    """n nodes, each holding one filler pod — scattered load no single node
+    can absorb a big pod through."""
+    nodes = [make_node(f"n{i}")
+             .capacity({"cpu": node_cpu, "memory": "4Gi", "pods": "10"})
+             .obj() for i in range(n)]
+    bound = [make_pod(f"filler-{i}").req({"cpu": filler_cpu})
+             .node(f"n{i}").obj() for i in range(n)]
+    return nodes, bound
+
+
+# --------------------------------------------------------------- planner
+
+def test_plan_is_one_batched_evaluation(monkeypatch):
+    """Acceptance: K candidate sets validate via ONE run_filters call over
+    the shared victim batch — no per-candidate loop on the hot path."""
+    calls = {"n": 0}
+    real = planner_mod.run_filters
+
+    def counting(ct, pb, enabled=None):
+        calls["n"] += 1
+        return real(ct, pb, enabled)
+
+    monkeypatch.setattr(planner_mod, "run_filters", counting)
+    nodes, bound = _fragmented()
+    sets = high_node_utilization(nodes, bound, threshold=0.6)
+    assert len(sets) == 4
+    plan = plan_evictions(nodes, bound, sets)
+    assert calls["n"] == 1, "candidate validation must be one batched call"
+    assert plan.batch_sets == 4 and plan.batch_victims == 4
+
+
+def test_batched_vs_naive_parity():
+    """The one-call path and the per-candidate reference loop must agree on
+    accepted sets, proof moves, and blocking reasons."""
+    nodes, bound = _fragmented(n=6)
+    # mixed candidates: drains + a single-pod set with no exclusions
+    sets = high_node_utilization(nodes, bound, threshold=0.6)
+    sets.append(CandidateSet(name="solo", strategy="Test",
+                             victims=[bound[5]], exclude_targets=set()))
+    batched = plan_evictions(nodes, bound, sets)
+    naive = plan_evictions_naive(nodes, bound, sets)
+    assert [(s.name, s.moves) for s in batched.accepted] == \
+        [(s.name, s.moves) for s in naive.accepted]
+    assert batched.blocked == naive.blocked
+    # and the batched path really did fold every victim into one batch
+    assert batched.batch_victims == 6
+
+
+def test_shared_ledger_no_double_booking():
+    """Two drains approved in one cycle must not both park their victim in
+    the same last slot of a survivor node."""
+    nodes = [make_node(f"n{i}").capacity({"cpu": "2", "pods": "10"}).obj()
+             for i in range(3)]
+    # n2 has exactly one victim's worth of room; n0+n1 each hold a 1cpu pod
+    bound = [make_pod("a").req({"cpu": "1"}).node("n0").obj(),
+             make_pod("b").req({"cpu": "1"}).node("n1").obj(),
+             make_pod("c").req({"cpu": "1"}).node("n2").obj()]
+    sets = [CandidateSet("drain/n0", "T", [bound[0]], {"n0"}),
+            CandidateSet("drain/n1", "T", [bound[1]], {"n1"})]
+    plan = plan_evictions(nodes, bound, sets)
+    # first drain's victim takes n2's room (n1 would be drained next);
+    # whichever second set runs, the survivors can't absorb both
+    assert len(plan.accepted) == 1
+    assert len(plan.blocked) == 1
+
+
+def test_pdb_blocks_eviction():
+    nodes, bound = _fragmented()
+    for p in bound:
+        p.metadata.labels["app"] = "guarded"
+    pdb = {"apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+           "metadata": {"name": "pdb", "namespace": "default"},
+           "spec": {"minAvailable": 4,
+                    "selector": {"matchLabels": {"app": "guarded"}}}}
+    sets = high_node_utilization(nodes, bound, threshold=0.6)
+    plan = plan_evictions(nodes, bound, sets, pdbs=[pdb])
+    assert not plan.accepted
+    assert all("PDB" in why for why in plan.blocked.values())
+
+
+def test_pdb_budget_charges_across_sets():
+    """One disruption left in the budget: the first covered eviction takes
+    it, every later covered eviction must see an empty budget."""
+    nodes, bound = _fragmented()
+    for p in bound:
+        p.metadata.labels["app"] = "guarded"
+    pdb = {"metadata": {"name": "pdb", "namespace": "default"},
+           "spec": {"minAvailable": 3,
+                    "selector": {"matchLabels": {"app": "guarded"}}}}
+    sets = high_node_utilization(nodes, bound, threshold=0.6)
+    plan = plan_evictions(nodes, bound, sets, pdbs=[pdb])
+    assert len(plan.accepted) == 1 and plan.evictions == 1
+    # every other set blocks: the budget is spent (the receiver of the one
+    # approved move additionally blocks as a drain target)
+    assert len(plan.blocked) == 3
+    assert sum("PDB" in why for why in plan.blocked.values()) >= 2
+
+
+def test_max_evictions_budget():
+    nodes, bound = _fragmented(n=4)
+    sets = high_node_utilization(nodes, bound, threshold=0.6)
+    plan = plan_evictions(nodes, bound, sets, max_evictions=1)
+    assert plan.evictions == 1
+    assert sum("budget" in why for why in plan.blocked.values()) >= 1
+
+
+def test_victims_fitting_nowhere_block_the_set():
+    nodes = [make_node("n0").capacity({"cpu": "2", "pods": "10"}).obj(),
+             make_node("n1").capacity({"cpu": "2", "pods": "10"}).obj()]
+    bound = [make_pod("big-a").req({"cpu": "1500m"}).node("n0").obj(),
+             make_pod("big-b").req({"cpu": "1500m"}).node("n1").obj()]
+    plan = plan_evictions(nodes, bound, [
+        CandidateSet("drain/n0", "T", [bound[0]], {"n0"})])
+    assert not plan.accepted
+    assert "fits nowhere else" in plan.blocked["drain/n0"]
+
+
+def test_daemonset_and_mirror_pods_are_not_victims():
+    nodes, bound = _fragmented()
+    ds = make_pod("ds-0").req({"cpu": "100m"}).node("n0").obj()
+    ds.metadata.owner_references = [{"kind": "DaemonSet", "name": "d",
+                                     "controller": True}]
+    mirror = make_pod("mirror-0").req({"cpu": "100m"}).node("n1").obj()
+    mirror.metadata.annotations["kubernetes.io/config.mirror"] = "x"
+    sets = high_node_utilization(nodes, bound + [ds, mirror], threshold=0.9)
+    victims = {p.key for cs in sets for p in cs.victims}
+    assert "default/ds-0" not in victims
+    assert "default/mirror-0" not in victims
+
+
+def test_without_pods_overlay_validates_the_ledger_proof():
+    """Soundness: every move the host ledger approved must be feasible per
+    the FULL filter set (resource fit included) against the device-side
+    reverse overlay (``SnapshotEncoder.without_pods``) of the post-eviction
+    cluster — the two formulations of "cluster minus victims" must agree."""
+    import numpy as np
+    from kubernetes_tpu.encode.snapshot import SnapshotEncoder
+    from kubernetes_tpu.ops.filters import run_filters
+
+    nodes, bound = _fragmented()
+    sets = high_node_utilization(nodes, bound, threshold=0.6)
+    plan = plan_evictions(nodes, bound, sets)
+    assert plan.accepted
+    victims = [p for s in plan.accepted for p in s.victims]
+    enc = SnapshotEncoder()
+    ct, meta = enc.encode_cluster(nodes, bound, pending_pods=victims,
+                                  pending_slots=False)
+    masked = enc.without_pods(ct, meta, [p.key for p in victims])
+    assert masked is not None
+    # requested matches a from-scratch encode of the surviving pods
+    survivors = [p for p in bound
+                 if p.key not in {v.key for v in victims}]
+    ct2, meta2 = SnapshotEncoder().encode_cluster(nodes, survivors,
+                                                  pending_pods=victims,
+                                                  pending_slots=False)
+    real_n = len(meta.node_names)
+    np.testing.assert_array_equal(
+        np.asarray(masked.requested)[:real_n],
+        np.asarray(ct2.requested)[:real_n])
+    # every approved move is feasible on the masked cluster, fit included
+    import dataclasses
+    unpinned = [dataclasses.replace(
+        p, spec=dataclasses.replace(p.spec, node_name="")) for p in victims]
+    mask = np.asarray(run_filters(masked, enc.encode_pods(unpinned, meta)))
+    key_to_row = {p.key: i for i, p in enumerate(victims)}
+    for s in plan.accepted:
+        for key, target in s.moves:
+            assert mask[key_to_row[key], meta.node_index[target]], \
+                f"ledger parked {key} on {target} but the overlay refuses"
+    # unknown keys and port/volume pods refuse the overlay
+    assert enc.without_pods(ct, meta, ["default/never-heard-of-it"]) is None
+
+
+# ------------------------------------------------------------ strategies
+
+def test_low_node_utilization_rebalances_hot_nodes():
+    nodes = [make_node(f"n{i}").capacity({"cpu": "2", "pods": "10"}).obj()
+             for i in range(3)]
+    bound = ([make_pod(f"hot-{i}").req({"cpu": "600m"}).node("n0").obj()
+              for i in range(3)]
+             + [make_pod("lukewarm").req({"cpu": "1"}).node("n1").obj()])
+    # n0 at 0.9, n1 at 0.5, n2 empty -> evict from n0 until it's at <= 0.8
+    sets = low_node_utilization(nodes, bound, low=0.2, high=0.8)
+    assert [cs.name for cs in sets] == ["rebalance/n0"]
+    assert len(sets[0].victims) == 1 and sets[0].exclude_targets == {"n0"}
+    plan = plan_evictions(nodes, bound, sets)
+    assert plan.accepted and plan.accepted[0].moves[0][1] in ("n1", "n2")
+    # no cold node -> nothing to rebalance toward -> no candidates
+    warm = bound + [make_pod("warm").req({"cpu": "1"}).node("n2").obj()]
+    assert low_node_utilization(nodes, warm, low=0.2, high=0.8) == []
+
+
+def test_node_affinity_violation_detected_in_one_pass():
+    nodes = [make_node("ssd").capacity({"cpu": "4", "pods": "10"})
+             .label("disk", "ssd").obj(),
+             make_node("hdd").capacity({"cpu": "4", "pods": "10"}).obj()]
+    stale = (make_pod("wants-ssd").req({"cpu": "100m"})
+             .node_selector({"disk": "ssd"}).node("hdd").obj())
+    fine = (make_pod("placed-right").req({"cpu": "100m"})
+            .node_selector({"disk": "ssd"}).node("ssd").obj())
+    plain = make_pod("plain").req({"cpu": "100m"}).node("hdd").obj()
+    sets = pods_violating_node_affinity(nodes, [stale, fine, plain])
+    assert [cs.victims[0].key for cs in sets] == ["default/wants-ssd"]
+    plan = plan_evictions(nodes, [stale, fine, plain], sets)
+    # the proof must move it to the node its affinity demands
+    assert plan.accepted and plan.accepted[0].moves == [
+        ("default/wants-ssd", "ssd")]
+
+
+def test_topology_spread_violation_sheds_excess():
+    nodes = [make_node(f"n{i}")
+             .capacity({"cpu": "8", "pods": "20"})
+             .label("topology.kubernetes.io/zone", f"z{i % 2}")
+             .obj() for i in range(4)]
+    pods = []
+    for i in range(5):  # 5 in z0 (n0), 1 in z1 (n1): skew 4, maxSkew 1
+        pods.append(make_pod(f"s{i}").label("app", "web")
+                    .req({"cpu": "100m"})
+                    .spread(1, "topology.kubernetes.io/zone",
+                            "DoNotSchedule", {"app": "web"})
+                    .node("n0" if i < 5 else "n1").obj())
+    pods.append(make_pod("s-z1").label("app", "web").req({"cpu": "100m"})
+                .spread(1, "topology.kubernetes.io/zone", "DoNotSchedule",
+                        {"app": "web"})
+                .node("n1").obj())
+    sets = pods_violating_topology_spread(nodes, pods)
+    assert len(sets) == 1
+    cs = sets[0]
+    assert cs.name == "spread/topology.kubernetes.io/zone=z0"
+    assert len(cs.victims) == 3              # 5 - (1 floor + 1 maxSkew)
+    assert cs.exclude_targets == {"n0", "n2"}  # the whole z0 domain
+    plan = plan_evictions(nodes, pods, sets)
+    assert plan.accepted
+    assert all(t in ("n1", "n3") for _, t in plan.accepted[0].moves)
+
+
+def test_remove_duplicates():
+    nodes = [make_node(f"n{i}").capacity({"cpu": "4", "pods": "10"}).obj()
+             for i in range(2)]
+    ref = [{"kind": "ReplicaSet", "name": "web-abc", "controller": True}]
+    dup = []
+    for i in range(3):
+        p = make_pod(f"web-{i}").req({"cpu": "100m"}).node("n0").obj()
+        p.metadata.owner_references = list(ref)
+        dup.append(p)
+    bare = make_pod("solo").req({"cpu": "100m"}).node("n0").obj()
+    sets = remove_duplicates(nodes, dup + [bare])
+    assert len(sets) == 1
+    assert len(sets[0].victims) == 2         # keep one replica on n0
+    assert sets[0].exclude_targets == {"n0"}
+    plan = plan_evictions(nodes, dup + [bare], sets)
+    assert plan.accepted and \
+        all(t == "n1" for _, t in plan.accepted[0].moves)
+
+
+# ----------------------------------------------------------- gang defrag
+
+def test_gang_defrag_picks_fewest_evictions(monkeypatch):
+    """A 2-member gang needing near-full nodes on a 4-node half-full
+    cluster: the cheapest working consolidation drains exactly 2 nodes —
+    and the whole search (empty probe + 4 prefixes + gang) is ONE
+    run_filters call."""
+    calls = {"n": 0}
+    real = planner_mod.run_filters
+
+    def counting(ct, pb, enabled=None):
+        calls["n"] += 1
+        return real(ct, pb, enabled)
+
+    monkeypatch.setattr(planner_mod, "run_filters", counting)
+    nodes, bound = _fragmented()
+    gang = [make_pod(f"g{i}").label(GANG_LABEL, "train")
+            .req({"cpu": "1500m"}).obj() for i in range(2)]
+    cands = gang_consolidation_candidates(nodes, bound)
+    gp = plan_gang_defrag(nodes, bound, gang, "train", cands)
+    assert calls["n"] == 1
+    assert not gp.fits_without_evictions
+    assert gp.accepted is not None and len(gp.accepted.victims) == 2
+    assert len(gp.gang_moves) == 2
+    # the two cheapest-to-drain nodes, victims re-placed on survivors
+    drained = {m[1] for m in gp.gang_moves}
+    replaced = {t for _, t in gp.accepted.moves}
+    assert drained.isdisjoint(replaced)
+    # blocked prefixes explain themselves
+    assert "no-evictions" in gp.blocked
+
+
+def test_gang_that_fits_needs_no_evictions():
+    nodes, bound = _fragmented()
+    gang = [make_pod("g0").label(GANG_LABEL, "small")
+            .req({"cpu": "500m"}).obj()]
+    gp = plan_gang_defrag(nodes, bound, gang, "small",
+                          gang_consolidation_candidates(nodes, bound))
+    assert gp.fits_without_evictions and gp.accepted is None
+
+
+def test_gang_defrag_respects_eviction_budget():
+    nodes, bound = _fragmented()
+    gang = [make_pod(f"g{i}").label(GANG_LABEL, "train")
+            .req({"cpu": "1500m"}).obj() for i in range(2)]
+    gp = plan_gang_defrag(nodes, bound, gang, "train",
+                          gang_consolidation_candidates(nodes, bound),
+                          max_evictions=1)
+    assert gp.accepted is None
+    assert any("over budget" in why for why in gp.blocked.values())
+
+
+def test_two_gangs_share_one_cycle_ledger():
+    """Two gangs whose only consolidation frees capacity for ONE of them:
+    chaining the cycle's ledger through both plans must seat exactly one
+    gang — a fresh ledger per gang would prove both onto the same vacated
+    capacity (double-booking) and evict for a gang that cannot land."""
+    nodes, bound = _fragmented()  # 4 nodes x 2cpu, one 1cpu filler each
+    gang_a = [make_pod(f"a{i}").label(GANG_LABEL, "a")
+              .req({"cpu": "1500m"}).obj() for i in range(2)]
+    gang_b = [make_pod(f"b{i}").label(GANG_LABEL, "b")
+              .req({"cpu": "1500m"}).obj() for i in range(2)]
+    cands = gang_consolidation_candidates(nodes, bound)
+    gp_a = plan_gang_defrag(nodes, bound, gang_a, "a", cands)
+    assert gp_a.accepted is not None and len(gp_a.gang_moves) == 2
+    # gang B plans against A's committed ledger: A drained 2 nodes and
+    # parked its victims on the other 2 (now full) — nothing is left
+    gp_b = plan_gang_defrag(nodes, bound, gang_b, "b", cands,
+                            ledger=gp_a.ledger)
+    assert gp_b.accepted is None and not gp_b.fits_without_evictions
+    a_seats = {t for _, t in gp_a.gang_moves}
+    assert len(a_seats) == 2
+    # and WITHOUT the shared ledger the double-booking is real: B would
+    # claim the same vacated nodes A already owns
+    gp_b_alone = plan_gang_defrag(nodes, bound, gang_b, "b", cands)
+    assert {t for _, t in gp_b_alone.gang_moves} & a_seats
+
+
+def test_gang_consolidation_skips_protected_nodes():
+    """A node holding a pod that OUTRANKS the gang can't fully drain, so
+    it never enters the prefix ranking; a peer-priority resident is fair
+    game (consolidation preserves victims, and the scheduler's nomination
+    shield covers equal-priority replacements)."""
+    nodes, bound = _fragmented()
+    bound[0].spec.priority = 150           # outranks the gang: protected
+    bound[1].spec.priority = 100           # peer: evictable
+    cands = gang_consolidation_candidates(nodes, bound,
+                                          max_victim_priority=100)
+    assert all("n0" not in cs.exclude_targets for cs in cands)
+    assert any("n1" in cs.exclude_targets for cs in cands)
+    assert len(cands) == 3
+
+
+def test_gang_consolidation_never_evicts_placed_gang_members():
+    """A bound pod carrying the gang label is a seat of an already-placed
+    gang: draining it fragments that gang, and for the planning gang's OWN
+    members it is endless musical chairs (evict gang-0 to seat gang-1,
+    repeat forever) — so such nodes never enter the prefix ranking, even
+    at equal priority."""
+    nodes, bound = _fragmented()
+    bound[0].metadata.labels[GANG_LABEL] = "train"
+    bound[0].spec.priority = 100     # a seated member of the same gang
+    cands = gang_consolidation_candidates(nodes, bound,
+                                          max_victim_priority=100)
+    assert cands and all("n0" not in cs.exclude_targets for cs in cands)
+    assert all(GANG_LABEL not in p.metadata.labels
+               for cs in cands for p in cs.victims)
+
+
+def test_cycle_threads_claimed_victims_through_gang_plans(monkeypatch):
+    """One cycle, one claimed set: every gang plan must see the victim
+    keys prior plans in the cycle already evict (the strategy plan's, then
+    each earlier gang's) — otherwise a shared victim is planned, PDB-
+    charged, and evicted twice."""
+    from kubernetes_tpu.descheduler import descheduler as dmod
+    from kubernetes_tpu.descheduler.planner import AcceptedSet
+
+    client = _direct()
+    nodes, bound = _fragmented()
+    _populate(client, nodes, bound)
+    for g, i in (("a", 0), ("a", 1), ("b", 0)):
+        client.pods("default").create(
+            make_pod(f"{g}{i}").label(GANG_LABEL, g)
+            .req({"cpu": "1500m"}).obj().to_dict())
+    calls = []
+
+    def fake_gang_plan(nodes, bound, members, gang, cands, **kw):
+        calls.append((gang, set(kw.get("claimed") or ())))
+        gp = planner_mod.GangDefragPlan(gang=gang)
+        if gang == "a":   # pretend gang a's plan evicts filler-1
+            gp.accepted = AcceptedSet(name="consolidate/1-nodes",
+                                      strategy="GangDefrag",
+                                      victims=[bound[1]])
+        return gp
+
+    monkeypatch.setattr(dmod, "plan_gang_defrag", fake_gang_plan)
+    d = Descheduler(client, DeschedulerConfiguration(
+        strategies={"HighNodeUtilization": {"threshold": 0.6}}))
+    plan, _gang_plans = d.plan()
+    strategy_victims = {p.key for s in plan.accepted for p in s.victims}
+    assert strategy_victims   # the fixture must exercise the handoff
+    assert [g for g, _ in calls] == ["a", "b"]
+    assert calls[0][1] == strategy_victims
+    assert calls[1][1] == strategy_victims | {bound[1].key}
+
+
+def test_gang_defrag_never_inverts_priority():
+    """A default-priority (0) gang must not evict higher-priority pods:
+    the Descheduler caps victims at the gang's own priority — unrestricted
+    candidates would be the priority inversion upstream never allows, and
+    the nomination shield (rp >= priority) couldn't even hold the seats
+    against the outranking replacements."""
+    client = _direct()
+    nodes, bound = _fragmented()
+    for p in bound:
+        p.spec.priority = 1000
+    _populate(client, nodes, bound)
+    for i in range(2):     # gang at default priority 0
+        client.pods("default").create(
+            make_pod(f"g{i}").label(GANG_LABEL, "train")
+            .req({"cpu": "1500m"}).obj().to_dict())
+    d = Descheduler(client, DeschedulerConfiguration(strategies={}))
+    _plan, gang_plans = d.plan()
+    assert len(gang_plans) == 1
+    gp = gang_plans[0]
+    assert gp.accepted is None and not gp.fits_without_evictions
+
+
+# ------------------------------------------------- control loop + CLI
+
+def _direct():
+    from kubernetes_tpu.client.clientset import DirectClient
+    from kubernetes_tpu.store.store import ObjectStore
+    return DirectClient(ObjectStore())
+
+
+def _populate(client, nodes, pods):
+    for n in nodes:
+        client.nodes().create(n.to_dict())
+    for p in pods:
+        client.pods(p.metadata.namespace).create(p.to_dict())
+
+
+def test_run_once_evicts_and_requeues_bare_pods():
+    client = _direct()
+    nodes, bound = _fragmented()
+    gang = [make_pod(f"g{i}").label(GANG_LABEL, "train")
+            .req({"cpu": "1500m"}).obj() for i in range(2)]
+    _populate(client, nodes, bound + gang)
+    d = Descheduler(client, DeschedulerConfiguration(strategies={}))
+    summary = d.run_once()
+    assert len(summary["evicted"]) == 2
+    assert summary["gangs"][0]["evictions"] == 2
+    # bare victims were re-created unbound -> back into the queue's world
+    names = {(p["metadata"]["name"], (p["spec"] or {}).get("nodeName"))
+             for p in client.pods("default").list()}
+    evicted_names = {k.split("/", 1)[1] for k in summary["evicted"]}
+    for name in evicted_names:
+        assert (name, None) in names or (name, "") in names
+    # metrics moved
+    from kubernetes_tpu.metrics.registry import (
+        DESCHEDULER_EVICTIONS,
+        DESCHEDULER_PLAN_BATCH,
+    )
+    assert DESCHEDULER_EVICTIONS.get(
+        {"strategy": "GangDefrag", "result": "evicted"}) >= 2
+    assert DESCHEDULER_PLAN_BATCH.get({"phase": "gangDefrag"}) >= 2
+    # status ConfigMap published
+    cm = client.resource("configmaps", "default").get("descheduler-status")
+    st = json.loads(cm["data"]["status"])
+    assert st["lastLoop"]["gangs"][0]["gang"] == "train"
+
+
+def test_gang_defrag_nominates_gang_pods():
+    """Executing a gang plan reserves the drained capacity: every gang
+    member's status.nominatedNodeName is written from the proof's
+    placement (before the victims' replacements exist), so the scheduler
+    shields those nodes from the re-created victims. A cleared plan
+    (_unnominate_gang) removes the reservations again."""
+    client = _direct()
+    nodes, bound = _fragmented()
+    gang = [make_pod(f"g{i}").label(GANG_LABEL, "train").priority(100)
+            .req({"cpu": "1500m"}).obj() for i in range(2)]
+    _populate(client, nodes, bound + gang)
+    d = Descheduler(client, DeschedulerConfiguration(strategies={}))
+    plan, gang_plans = d.plan()
+    assert gang_plans and gang_plans[0].accepted is not None
+    moves = dict(gang_plans[0].gang_moves)
+    summary = d._execute(plan, gang_plans)
+    assert len(summary["evicted"]) == 2
+    by_name = {p["metadata"]["name"]: p
+               for p in client.pods("default").list()}
+    nominated = {f"default/{n}": (by_name[n].get("status") or {})
+                 .get("nominatedNodeName")
+                 for n in ("g0", "g1")}
+    assert nominated == moves
+    # distinct reservations: one drained node per gang member
+    assert len(set(moves.values())) == 2
+    # a cleared plan removes the reservations (abort path)
+    d._unnominate_gang(gang_plans[0])
+    for n in ("g0", "g1"):
+        cur = client.pods("default").get(n)
+        assert not (cur.get("status") or {}).get("nominatedNodeName")
+
+
+def test_dry_run_plans_but_does_not_evict():
+    client = _direct()
+    nodes, bound = _fragmented()
+    _populate(client, nodes, bound)
+    cfg = DeschedulerConfiguration(
+        strategies={"HighNodeUtilization": {"threshold": 0.6}},
+        gang_defrag=False)
+    summary = Descheduler(client, cfg).run_once(dry_run=True)
+    assert summary["planned"] and "evicted" not in summary
+    assert len(client.pods("default").list()) == 4
+
+
+def test_autoscaler_handoff_seeds_unneeded_window():
+    class FakeAutoscaler:
+        def __init__(self):
+            self.noted = []
+
+        def note_drained(self, names):
+            self.noted.extend(names)
+
+    client = _direct()
+    nodes, bound = _fragmented()
+    gang = [make_pod(f"g{i}").label(GANG_LABEL, "t").req({"cpu": "1500m"})
+            .obj() for i in range(2)]
+    _populate(client, nodes, bound + gang)
+    ca = FakeAutoscaler()
+    d = Descheduler(client, DeschedulerConfiguration(strategies={}),
+                    autoscaler=ca)
+    d.run_once()
+    # NOTE: gang members will claim these nodes, but at eviction time they
+    # are empty — the handoff fires and the autoscaler's own re-check
+    # decides whether reclaim survives the gang's arrival
+    assert len(ca.noted) == 2
+
+
+def test_cluster_autoscaler_note_drained():
+    from kubernetes_tpu.autoscaler import ClusterAutoscaler, NodeGroup
+    from kubernetes_tpu.autoscaler.nodegroup import StaticNodeGroupProvider
+    from kubernetes_tpu.utils.clock import FakeClock
+    client = _direct()
+    tpl = make_node("tpl").capacity({"cpu": "2"})
+    provider = StaticNodeGroupProvider(
+        client, [NodeGroup("g", 0, 4, tpl.obj())])
+    clock = FakeClock(500.0)
+    ca = ClusterAutoscaler(client, provider, clock=clock)
+    ca.note_drained(["n0", "n1"])
+    assert ca._unneeded_since == {"n0": 500.0, "n1": 500.0}
+    clock.advance(10.0)
+    ca.note_drained(["n0"])          # idempotent: window start is kept
+    assert ca._unneeded_since["n0"] == 500.0
+
+
+def test_configuration_from_yaml(tmp_path):
+    p = tmp_path / "policy.yaml"
+    p.write_text("""
+deschedulerInterval: 30
+maxEvictionsPerCycle: 5
+gangDefrag: true
+profiles:
+- name: defrag
+  strategies:
+    HighNodeUtilization: {threshold: 0.4}
+    RemoveDuplicates: {}
+""")
+    cfg = DeschedulerConfiguration.from_yaml(str(p))
+    assert cfg.interval_s == 30.0
+    assert cfg.max_evictions_per_cycle == 5
+    assert cfg.strategies == {"HighNodeUtilization": {"threshold": 0.4},
+                              "RemoveDuplicates": {}}
+
+
+def test_ktpu_deschedule_run_and_status():
+    from kubernetes_tpu.cli.ktpu import main as ktpu_main
+    from kubernetes_tpu.store.apiserver import APIServer
+    server = APIServer().start()
+    try:
+        from kubernetes_tpu.client.clientset import HTTPClient
+        client = HTTPClient(server.url)
+        nodes, bound = _fragmented()
+        _populate(client, nodes, bound)
+        for i in range(2):
+            client.pods("default").create(
+                make_pod(f"g{i}").label(GANG_LABEL, "train")
+                .req({"cpu": "1500m"}).obj().to_dict())
+        out = io.StringIO()
+        rc = ktpu_main(["--server", server.url, "deschedule", "run",
+                        "--dry-run"], out=out)
+        assert rc == 0
+        assert "gang train: 2 eviction(s)" in out.getvalue()
+        # dry-run totals must match what the wet run will report: gang
+        # victims count, not just strategy-set moves
+        assert "would evict 2 pod(s)" in out.getvalue()
+        # a real run evicts and publishes status
+        out = io.StringIO()
+        assert ktpu_main(["--server", server.url, "deschedule", "run"],
+                         out=out) == 0
+        assert "evicted 2 pod(s)" in out.getvalue()
+        out = io.StringIO()
+        assert ktpu_main(["--server", server.url, "deschedule", "status"],
+                         out=out) == 0
+        text = out.getvalue()
+        assert "Gang defrag:  on" in text and "evicted=2" in text
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------------ e2e
+
+def test_gang_defrag_e2e_schedules_gang_without_scale_up():
+    """Acceptance e2e: a fragmented cluster (every node half-full), a
+    pending gang no node can host — descheduler evictions consolidate the
+    fillers, the gang binds, the fillers re-bind, and the node set never
+    grows (defragmentation instead of scale-up)."""
+    from kubernetes_tpu.client.clientset import HTTPClient
+    from kubernetes_tpu.config.features import DEFAULT_FEATURE_GATE
+    from kubernetes_tpu.config.types import SchedulerConfiguration
+    from kubernetes_tpu.sched.runner import SchedulerRunner
+    from kubernetes_tpu.store.apiserver import APIServer
+
+    server = APIServer().start()
+    runner = None
+    # preemption off: the gang outranks the fillers, and with preemption on
+    # the scheduler would DELETE the fillers itself (bare pods: work lost).
+    # The descheduler's whole value here is consolidation that preserves
+    # the victims — so the e2e must prove evictions alone suffice.
+    DEFAULT_FEATURE_GATE.set("PreemptionSimulation", False)
+    try:
+        client = HTTPClient(server.url)
+        nodes, _ = _fragmented(n=4, node_cpu="2")
+        for n in nodes:
+            client.nodes().create(n.to_dict())
+        # tight backoff/assume-ttl: evict-then-recreate races a bind RPC by
+        # design here, and a lost bind otherwise parks phantom capacity in
+        # the cache for the full default 30s TTL
+        runner = SchedulerRunner(
+            HTTPClient(server.url),
+            SchedulerConfiguration(backoff_initial_s=0.05,
+                                   backoff_max_s=0.2,
+                                   assume_ttl_s=2.0))
+        # a pod parked unschedulable right as an eviction lands would
+        # otherwise wait out the full 60s timeout sweep mid-test
+        runner.queue.unschedulable_timeout = 1.0
+        runner.start()
+        # fillers pre-bound one per node: the fragmentation is the fixture,
+        # not a scheduler outcome the test races (a 2+2 scatter would leave
+        # empty nodes and nothing for the descheduler to prove)
+        pods = client.pods("default")
+        for i in range(4):
+            pods.create(make_pod(f"filler-{i}").req({"cpu": "1"})
+                        .node(f"n{i}").obj().to_dict())
+        gang = [make_pod(f"gang-{i}").label(GANG_LABEL, "train")
+                .priority(100).req({"cpu": "2"}).obj().to_dict()
+                for i in range(2)]
+        pods.create_many(gang)
+        # the gang is genuinely stuck: nothing binds without evictions
+        time.sleep(1.0)
+        assert not any(p["spec"].get("nodeName") for p in pods.list()
+                       if p["metadata"]["name"].startswith("gang-"))
+
+        d = Descheduler(HTTPClient(server.url),
+                        DeschedulerConfiguration(strategies={}))
+
+        # Throttle descheduler cycles: each run_once is a full plan pass
+        # (encode + run_filters, JIT on first use) that fights the
+        # scheduler loop for the GIL on small CI boxes — and a cycle that
+        # lands while re-binds are in flight can evict a just-rebound
+        # filler and restart convergence. Plan every ~2s; in between, poll
+        # only the cheap list so the scheduler gets the CPU to converge.
+        last_cycle = [0.0]
+
+        def converged():
+            live = pods.list()
+            done = (sum(1 for p in live
+                        if p["metadata"]["name"].startswith("gang-")
+                        and p["spec"].get("nodeName")) == 2
+                    and sum(1 for p in live
+                            if p["metadata"]["name"].startswith("filler-")
+                            and p["spec"].get("nodeName")) == 4)
+            if not done and time.time() - last_cycle[0] > 2.0:
+                last_cycle[0] = time.time()
+                d.run_once()
+            return done
+
+        assert wait_for(converged, 150.0, interval=0.25), [
+            (p["metadata"]["name"], p["spec"].get("nodeName"))
+            for p in pods.list()]
+        # defrag, not scale-up: same 4 nodes, gang members own whole nodes
+        assert {n["metadata"]["name"] for n in client.nodes().list()} == \
+            {f"n{i}" for i in range(4)}
+        by_node = {}
+        for p in pods.list():
+            by_node.setdefault(p["spec"]["nodeName"], []).append(
+                p["metadata"]["name"])
+        for names in by_node.values():
+            if any(n.startswith("gang-") for n in names):
+                assert len(names) == 1   # a 2cpu gang pod fills its node
+    finally:
+        DEFAULT_FEATURE_GATE._overrides.pop("PreemptionSimulation", None)
+        if runner is not None:
+            runner.stop()
+        server.stop()

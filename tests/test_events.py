@@ -17,10 +17,17 @@ def pod_obj(name="p0"):
 
 
 def test_recorder_writes_and_aggregates():
+    # FakeClock pins every timestamp and the aggregation window: whether the
+    # two FailedScheduling events aggregate is decided by fake time the test
+    # controls, never by how long the sink thread took on a loaded runner
+    from kubernetes_tpu.utils.clock import FakeClock
+    clock = FakeClock(1000.0)
     client = DirectClient(ObjectStore())
-    rec = EventRecorder(client, "test-component")
+    rec = EventRecorder(client, "test-component", clock=clock)
     rec.event(pod_obj(), "Warning", "FailedScheduling", "no nodes")
+    clock.advance(1.0)  # inside the window: must aggregate, not duplicate
     rec.event(pod_obj(), "Warning", "FailedScheduling", "no nodes")
+    clock.advance(1.0)
     rec.event(pod_obj(), "Normal", "Scheduled", "assigned to n0")
     rec.flush()  # recording is async (broadcaster-style); settle before reading
     evs = events_for(client, "default", "p0")
@@ -29,14 +36,21 @@ def test_recorder_writes_and_aggregates():
     assert by_reason["Scheduled"]["count"] == 1
     assert by_reason["Scheduled"]["source"]["component"] == "test-component"
     assert by_reason["FailedScheduling"]["involvedObject"]["name"] == "p0"
+    assert by_reason["FailedScheduling"]["firstTimestamp"] == 1000.0
+    assert by_reason["FailedScheduling"]["lastTimestamp"] == 1001.0
 
 
 def test_scheduler_emits_scheduling_events():
+    from kubernetes_tpu.config.types import SchedulerConfiguration
     from kubernetes_tpu.sched.runner import SchedulerRunner
     server = APIServer().start()
     try:
         client = HTTPClient(server.url)
-        runner = SchedulerRunner(client)
+        # tight backoff: with the defaults, the stuck pod can sit out a
+        # multi-second exponential backoff right when the node appears,
+        # racing the Scheduled-event deadline on a loaded runner
+        runner = SchedulerRunner(client, SchedulerConfiguration(
+            backoff_initial_s=0.05, backoff_max_s=0.2))
         runner.start()
         # unschedulable pod (no nodes) -> FailedScheduling event
         client.pods().create({"apiVersion": "v1", "kind": "Pod",

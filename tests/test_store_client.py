@@ -208,13 +208,26 @@ def test_workqueue_dedup_and_reprocess():
 
 
 def test_rate_limited_backoff():
-    q = RateLimitingQueue(base_delay=0.02, max_delay=0.1)
+    # FakeClock owns delay expiry: the item CANNOT become due until the
+    # test advances time, so "still delayed" is a fact, not a race against
+    # the wall clock (the 20ms-delay version flaked whenever the runner
+    # stalled longer than the delay between add and get).
+    from kubernetes_tpu.utils.clock import FakeClock
+    clock = FakeClock(100.0)
+    q = RateLimitingQueue(base_delay=5.0, max_delay=30.0, clock=clock)
     q.add_rate_limited("x")
-    assert q.get(0.01) is None       # delayed
-    item = q.get(0.5)
+    assert q.get(0.05) is None       # delayed: fake time has not moved
+    clock.advance(5.0)
+    item = q.get(2.0)                # pump notices fake expiry within ~10ms
     assert item == "x"
     q.done("x")
     assert q.num_requeues("x") == 1
+    q.add_rate_limited("x")          # second failure: exponential delay
+    clock.advance(5.0)
+    assert q.get(0.05) is None       # 10s due now, only 5 elapsed
+    clock.advance(5.0)
+    assert q.get(2.0) == "x"
+    q.done("x")
     q.forget("x")
     assert q.num_requeues("x") == 0
     q.close()
