@@ -74,6 +74,57 @@ def main():
             log=log)
         log("[bench] " + json.dumps(connected))
 
+    connected_mesh = None
+    shape = ()
+    if os.environ.get("BENCH_MESH", "1") != "0" and not only_case:
+        # runs in a SUBPROCESS with a forced multi-device CPU host platform:
+        # this process owns the single real TPU chip, and the mesh case
+        # needs >= 2 devices to shard over (same trick as the driver's
+        # multichip dry-run). The subprocess runs the deterministic
+        # sharded-vs-unsharded drain parity gate, then the live
+        # hollow-kubelet legs with the mesh off and on.
+        import subprocess
+        from kubernetes_tpu.parallel.mesh import parse_mesh_shape
+        shape_s = os.environ.get("BENCH_MESH_SHAPE", "1x2")
+        # "off"/"none" (parse -> None) or an unparseable value disables the
+        # case — never silently substitutes a default shape
+        try:
+            shape = parse_mesh_shape(shape_s) or ()
+        except ValueError as e:
+            log(f"[bench] bad BENCH_MESH_SHAPE={shape_s!r} ({e}); "
+                "skipping mesh case")
+            shape = ()
+    if shape:
+        log(f"[bench] connected mesh run ({shape_s}) ...")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        # append, don't clobber: the operator's own XLA flags (dump/tuning)
+        # must survive in the subprocess
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{shape[0] * shape[1]}").strip()
+        env["BENCH_MESH_SHAPE"] = shape_s
+        # an exported KTPU_MESH would override BOTH legs' mesh_shape config
+        # (including the unsharded leg's explicit None), silently turning
+        # the A/B into sharded-vs-sharded
+        env.pop("KTPU_MESH", None)
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "benchmarks",
+                                              "connected.py"), "mesh"],
+                env=env, capture_output=True, text=True, timeout=1800)
+            sys.stderr.write(proc.stderr[-4000:])
+            connected_mesh = json.loads(
+                proc.stdout.strip().splitlines()[-1])
+        except Exception as e:
+            # NO parity verdict — the subprocess died/timed out before the
+            # comparison ran. Distinct from parity ok=False (real
+            # divergence): only the latter may fail the bench.
+            connected_mesh = {"case": "ConnectedMesh", "error": str(e)}
+        log("[bench] " + json.dumps(connected_mesh))
+        _write_multichip(here, connected_mesh, log)
+
     preemption = None
     if os.environ.get("BENCH_PREEMPTION", "1") != "0" and not only_case:
         from benchmarks.preemption_bench import run_preemption
@@ -127,7 +178,7 @@ def main():
         head = results[-1] if results else {"SchedulingThroughput": 0.0,
                                             "pods": 0, "nodes": 0,
                                             "case": "none", "workload": ""}
-    throughput = head["SchedulingThroughput"]
+    throughput = head.get("SchedulingThroughput") or 0.0
     out = {
         "metric": (f"scheduling throughput ({head['case']} "
                    f"{head.get('pods', 0)}x{head.get('nodes', 0)})"),
@@ -140,19 +191,50 @@ def main():
         "p99_schedule_latency_s": head.get("p99_schedule_latency_s"),
         "all_passed": all(r["passed"] for r in results) if results else False,
         "workloads": [
+            # decision-latency cases (ClusterAutoscalerScaleUp,
+            # DeschedulerDefrag) carry no SchedulingThroughput — a KeyError
+            # here used to abort the whole summary (and the divergence gate
+            # below) after every case had already passed
             {"case": r["case"], "workload": r["workload"],
-             "pods_per_sec": r["SchedulingThroughput"],
+             "pods_per_sec": r.get("SchedulingThroughput"),
              "p99_s": r.get("p99_schedule_latency_s"),
              "passed": r["passed"],
              **({"churn_api_ops": r["churn_api_ops"], "connected": True}
                 if "churn_api_ops" in r else {})} for r in results],
         "connected": connected,
+        "connected_mesh": connected_mesh,
         "preemption": preemption,
         "connected_preemption": connected_preemption,
         "kubemark": kubemark,
         "pallas": pallas,
     }
     print(json.dumps(out))
+    if (connected_mesh is not None
+            and connected_mesh.get("parity") is not None
+            and not connected_mesh["parity"].get("ok")):
+        # hard gate: a mesh whose placements diverge from single-device is
+        # a miscompile or a sharding bug, never a tolerable perf variance.
+        # (A subprocess error/timeout carries no parity verdict and is
+        # reported above, not failed here.)
+        print("[bench] FATAL: ConnectedMesh sharded placements diverge "
+              "from unsharded", file=sys.stderr)
+        sys.exit(1)
+
+
+def _write_multichip(here: str, result: dict, log) -> None:
+    """Record the ConnectedMesh case in the next free MULTICHIP_r*.json
+    (same series the driver's dry-run writes)."""
+    import re
+    try:
+        ns = [int(m.group(1)) for m in
+              (re.match(r"MULTICHIP_r(\d+)\.json$", f)
+               for f in os.listdir(here)) if m]
+        path = os.path.join(here, f"MULTICHIP_r{max(ns, default=0) + 1:02d}.json")
+        with open(path, "w") as f:
+            json.dump(result, f, indent=1)
+        log(f"[bench] wrote {os.path.basename(path)}")
+    except Exception as e:
+        log(f"[bench] MULTICHIP write failed: {e}")
 
 
 if __name__ == "__main__":

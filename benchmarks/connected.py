@@ -287,6 +287,213 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
             server.terminate()
 
 
+def drain_parity_check(mesh_shape: tuple[int, int], n_nodes: int = 1024,
+                       P: int = 128, B: int = 2) -> dict:
+    """Deterministic mesh acceptance gate: the FULL fused drain over the
+    bench workload, sharded vs unsharded, must produce bit-identical
+    placements and fold arithmetic (same check as __graft_entry__'s
+    multichip dry-run, at the live path's shapes). bench.py exits non-zero
+    when this reports ok=False."""
+    import jax
+    import numpy as np
+    from benchmarks.workloads import mixed_heterogeneous
+    from kubernetes_tpu.encode.snapshot import SnapshotEncoder
+    from kubernetes_tpu.models.gang import (drain_step, extend_cluster_drain,
+                                            unify_batches)
+    from kubernetes_tpu.parallel.mesh import mesh_from_shape, shard_drain
+
+    n_pods = P * B
+    nodes, pods = mixed_heterogeneous(pods=n_pods, nodes=n_nodes)
+    enc = SnapshotEncoder()
+    ct, meta = enc.encode_cluster(nodes, [], pending_pods=pods)
+    chunks = [pods[i:i + P] for i in range(0, n_pods, P)]
+    pbs = unify_batches([enc.encode_pods(c, meta, min_p=P) for c in chunks])
+    ct_all, e0 = extend_cluster_drain(ct, pbs)
+    pb_stack = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *pbs)
+    kw = dict(e0=e0, seed=0, fit_strategy="LeastAllocated",
+              topo_keys=meta.topo_keys, weights=(), enabled_filters=(),
+              max_rounds=64)
+    a_u, _, _, fill_u = drain_step(ct_all, pb_stack, 0, **kw)
+    a_u, fill_u = jax.device_get((a_u, fill_u))
+    mesh = mesh_from_shape(mesh_shape)
+    ct_all2, _ = extend_cluster_drain(ct, pbs)
+    with mesh:
+        ct_s, pb_s = shard_drain(mesh, ct_all2, pb_stack)
+        a_s, _, _, fill_s = drain_step(ct_s, pb_s, 0, **kw)
+        a_s, fill_s = jax.device_get((a_s, fill_s))
+    a_u, a_s = np.asarray(a_u), np.asarray(a_s)
+    mism = int((a_u != a_s).sum())
+    return {"ok": bool(mism == 0 and int(fill_u) == int(fill_s)
+                       and int(fill_u) > 0),
+            "mismatches": mism, "placed": int(fill_u),
+            "pods": n_pods, "nodes": n_nodes,
+            "mesh": f"{mesh_shape[0]}x{mesh_shape[1]}"}
+
+
+def _run_mesh_leg(mesh_shape, n_pods: int, n_nodes: int, batch_size: int,
+                  drain_batches: int, timeout: float, log) -> dict:
+    """One live leg of the ConnectedMesh case: separate-process apiserver,
+    a HOLLOW-KUBELET node fleet (kubemark nodes registering + syncing pods
+    over HTTP), and the connected scheduler — mesh on or off per
+    ``mesh_shape``. Measured window matches run_connected: pod creation to
+    last binding visible."""
+    from kubernetes_tpu.client.clientset import HTTPClient
+    from kubernetes_tpu.config.types import SchedulerConfiguration
+    from kubernetes_tpu.kubelet.kubemark import HollowCluster
+    from kubernetes_tpu.sched.runner import SchedulerRunner
+    from kubernetes_tpu.testing.wrappers import make_pod
+
+    ctx = mp.get_context("spawn")
+    parent, child = ctx.Pipe()
+    server = ctx.Process(target=_serve, args=(child,), daemon=True)
+    server.start()
+    port = parent.recv()
+    url = f"http://127.0.0.1:{port}"
+    cluster = None
+    runner = None
+    try:
+        seed_client = HTTPClient(url, timeout=120.0)
+        t0 = time.time()
+        cluster = HollowCluster(HTTPClient(url, timeout=60.0), n_nodes,
+                                heartbeat_period=30.0).start()
+        log(f"  {n_nodes} hollow kubelets up in {time.time()-t0:.1f}s")
+        pods = [make_pod(f"mp{i:05d}", "default")
+                .req({"cpu": "500m", "memory": "256Mi"}).obj()
+                for i in range(n_pods)]
+        runner = SchedulerRunner(
+            HTTPClient(url),
+            SchedulerConfiguration(batch_size=batch_size,
+                                   max_drain_batches=drain_batches,
+                                   mesh_shape=mesh_shape))
+        runner.start(wait_sync=30.0, start_loop=False)
+        armed = _warm_jit(runner, pods, batch_size, n_pods, log)
+        mesh = runner.scheduler._mesh
+
+        _, rv0 = seed_client.pods("default").list_rv()
+        count = ctx.Value("i", 0)
+        all_bound, watch_dead, ready = ctx.Event(), ctx.Event(), ctx.Event()
+        watcher = ctx.Process(target=_watch_bound,
+                              args=(url, "default", rv0, n_pods,
+                                    count, all_bound, watch_dead, ready),
+                              daemon=True)
+        watcher.start()
+        ready.wait(30.0)
+
+        _trace_window()
+        from kubernetes_tpu.metrics.registry import ATTEMPT_DURATION
+        ATTEMPT_DURATION.reset()
+        t_start = time.time()
+        objs = [p.to_dict() for p in pods]
+        CHUNK = 2500
+        for i in range(0, len(objs), CHUNK):
+            seed_client.pods("default").create_many(objs[i:i + CHUNK])
+        runner.start_loop()
+        deadline = t_start + timeout
+        completed = False
+        while time.time() < deadline:
+            if all_bound.wait(timeout=0.05):
+                completed = True
+                break
+            if watch_dead.is_set():
+                n = sum(1 for p in seed_client.pods("default").list()
+                        if p["spec"].get("nodeName"))
+                count.value = n
+                if n >= n_pods:
+                    completed = True
+                    break
+                time.sleep(0.2)
+        dt = time.time() - t_start
+        bound = count.value
+        if not completed:
+            bound = sum(1 for p in seed_client.pods("default").list()
+                        if p["spec"].get("nodeName"))
+        p99 = ATTEMPT_DURATION.percentile(0.99, {"result": "scheduled"})
+        span_ms = _span_totals()
+        encode_cache = runner.cache.encode_cache_stats()
+        log(f"  mesh={mesh_shape}: {bound}/{n_pods} bound at +{dt:.1f}s")
+        return {
+            "mesh": (f"{mesh_shape[0]}x{mesh_shape[1]}"
+                     if mesh_shape else "off"),
+            "mesh_active": mesh is not None,
+            "SchedulingThroughput": round(bound / dt, 1) if dt > 0 else 0.0,
+            "bound": bound, "pods": n_pods, "hollow_nodes": n_nodes,
+            "measure_s": round(dt, 2),
+            "p99_attempt_latency_s": p99,
+            "span_ms": span_ms,
+            "encode_cache": encode_cache,
+            "jit_warmed": armed,
+        }
+    finally:
+        try:
+            if runner is not None:
+                runner.stop()
+        except Exception:
+            pass
+        try:
+            if cluster is not None:
+                cluster.stop()
+        except Exception:
+            pass
+        try:
+            parent.send("stop")
+        except Exception:
+            pass
+        server.join(timeout=5.0)
+        if server.is_alive():
+            server.terminate()
+
+
+def run_connected_mesh(mesh_shape: tuple[int, int] = (1, 2),
+                       n_pods: int = 1024, n_nodes: int = 96,
+                       batch_size: int = 128, drain_batches: int = 2,
+                       timeout: float = 300.0,
+                       log=lambda *a: None) -> dict:
+    """ConnectedMesh case: the deterministic sharded-vs-unsharded drain
+    parity gate, then the SAME live workload (connected apiserver + hollow
+    kubelets) through the single-device and mesh schedulers, reporting the
+    throughput ratio and per-phase spans of each leg.
+
+    Needs a backend with >= pods*nodes mesh devices — bench.py launches it
+    in a subprocess with a forced multi-device CPU host platform, since the
+    benchmark box exposes one real TPU chip."""
+    import jax
+    want = mesh_shape[0] * mesh_shape[1]
+    if jax.device_count() < want:
+        return {"case": "ConnectedMesh", "skipped": True,
+                "reason": f"needs {want} devices, have {jax.device_count()}"}
+    log(f"  parity gate (drain sharded {mesh_shape} vs unsharded) ...")
+    parity = drain_parity_check(mesh_shape)
+    log("  parity: " + str(parity))
+    out = {"case": "ConnectedMesh",
+           "workload": f"{n_pods}x{n_nodes}hollow",
+           "parity": parity}
+    if not parity["ok"]:
+        # live legs would measure a miscompiling backend; report and stop
+        return out
+    legs = {}
+    for name, shape in (("unsharded", None), ("sharded", mesh_shape)):
+        log(f"  live leg: {name} ...")
+        try:
+            legs[name] = _run_mesh_leg(shape, n_pods, n_nodes, batch_size,
+                                       drain_batches, timeout, log)
+        except Exception as e:
+            # a backend crash here is ENVIRONMENTAL (the virtual-CPU GSPMD
+            # lowering miscompiles some program widths — batch 256 on the
+            # current jaxlib), not placement divergence: record it, keep
+            # the parity verdict as the exit-code gate
+            log(f"  live leg {name} crashed: {type(e).__name__}")
+            legs[name] = {"error": f"{type(e).__name__}: {e}"[:300],
+                          "mesh": (f"{shape[0]}x{shape[1]}"
+                                   if shape else "off")}
+    out.update(legs)
+    un = legs["unsharded"].get("SchedulingThroughput")
+    sh = legs["sharded"].get("SchedulingThroughput")
+    out["throughput_ratio"] = round(sh / un, 3) if un and sh else None
+    out["all_bound"] = (legs["unsharded"].get("bound") == n_pods
+                        and legs["sharded"].get("bound") == n_pods)
+    return out
+
+
 def run_connected_preemption(n_nodes: int = 5000, n_high: int = 128,
                              pods_per_node: int = 2, timeout: float = 300.0,
                              log=lambda *a: None) -> dict:
@@ -466,6 +673,24 @@ if __name__ == "__main__":
     import os
     import sys
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if len(sys.argv) > 1 and sys.argv[1] == "mesh":
+        # ConnectedMesh entry: bench.py launches this in a subprocess with
+        # JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count so the
+        # mesh has devices to span (the bench box has one real chip).
+        # Each leg pins its own mesh via cfg.mesh_shape; a leaked KTPU_MESH
+        # would override BOTH legs and corrupt the A/B
+        os.environ.pop("KTPU_MESH", None)
+        from kubernetes_tpu.parallel.mesh import parse_mesh_shape
+        shape = parse_mesh_shape(
+            os.environ.get("BENCH_MESH_SHAPE", "1x2")) or (1, 2)
+        res = run_connected_mesh(
+            mesh_shape=shape,
+            n_pods=int(os.environ.get("BENCH_MESH_PODS", "1024")),
+            n_nodes=int(os.environ.get("BENCH_MESH_NODES", "96")),
+            batch_size=int(os.environ.get("BENCH_MESH_BATCH", "128")),
+            log=lambda *a: print(*a, file=sys.stderr))
+        print(json.dumps(res))
+        sys.exit(0 if res.get("parity", {}).get("ok") else 1)
     _pipe = os.environ.get("BENCH_CONNECTED_PIPELINE")
     res = run_connected(
         n_pods=int(os.environ.get("BENCH_CONNECTED_PODS", "2000")),

@@ -577,6 +577,44 @@ def cmd_api_resources(client: HTTPClient, args, out) -> int:
     return 0
 
 
+def cmd_status(client: HTTPClient, args, out) -> int:
+    """ktpu status: the connected scheduler's published deployment shape
+    (the ``kubernetes-tpu-scheduler-status`` ConfigMap) — most importantly
+    the active device mesh the drain/dispatch path runs under."""
+    from kubernetes_tpu.sched.runner import STATUS_CONFIGMAP
+    try:
+        cm = client.resource("configmaps", args.namespace).get(
+            STATUS_CONFIGMAP)
+    except ApiError as e:
+        if e.code != 404:
+            raise
+        out.write("error: no scheduler status published "
+                  f"(configmap {STATUS_CONFIGMAP!r} not found in "
+                  f"{args.namespace!r})\n")
+        return 1
+    data = cm.get("data") or {}
+    if args.output == "json":
+        out.write(data.get("status", "{}") + "\n")
+        return 0
+    st = json.loads(data.get("status", "{}") or "{}")
+    mesh = st.get("mesh")
+    if mesh:
+        shape = mesh.get("shape") or {}
+        dims = "x".join(str(shape[a]) for a in ("pods", "nodes")
+                        if a in shape) or "?"
+        out.write(f"Mesh:          {dims} ({mesh.get('devices', '?')} "
+                  "devices, pods x nodes)\n")
+        out.write(f"Device ids:    {mesh.get('deviceIds')}\n")
+    else:
+        out.write("Mesh:          off (single-device)\n")
+    out.write(f"Identity:      {st.get('identity', '<unknown>')}\n")
+    out.write(f"Batch size:    {st.get('batchSize', '?')}\n")
+    out.write(f"Drain batches: {st.get('maxDrainBatches', '?')}\n")
+    out.write(f"Pipeline:      {st.get('pipelineDepth', '?')} deep\n")
+    out.write(f"Profiles:      {', '.join(st.get('profiles') or [])}\n")
+    return 0
+
+
 def cmd_autoscale(client: HTTPClient, args, out) -> int:
     """ktpu autoscale status: the cluster-autoscaler's published status
     (the ``cluster-autoscaler-status`` ConfigMap, same surface as the
@@ -845,6 +883,10 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["status", "history", "undo", "restart"])
     ro.add_argument("kind_name", help="deployment/<name>")
 
+    st = sub.add_parser("status")
+    st.add_argument("-o", "--output", choices=["table", "json"],
+                    default="table")
+
     asc = sub.add_parser("autoscale")
     asc.add_argument("action", choices=["status"])
     asc.add_argument("-o", "--output", choices=["table", "json"],
@@ -920,6 +962,8 @@ def main(argv=None, out=None) -> int:
         if args.cmd == "rollout":
             args.name = args.kind_name.split("/", 1)[-1]
             return cmd_rollout(client, args, out)
+        if args.cmd == "status":
+            return cmd_status(client, args, out)
         if args.cmd == "autoscale":
             return cmd_autoscale(client, args, out)
         if args.cmd == "deschedule":

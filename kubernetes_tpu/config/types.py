@@ -125,6 +125,13 @@ class SchedulerConfiguration:
     # host-side apply/bind work behind device execution. jax dispatch is
     # asynchronous, so deeper pipelines cost HBM for queued programs only.
     pipeline_depth: int = 2
+    # Device-mesh shape (pods_axis, nodes_axis) for the live scheduling
+    # path: cluster tensors shard over "nodes", pod batches over "pods",
+    # and the drain/preemption programs run under GSPMD with ICI
+    # collectives (parallel/mesh.py). None = single-device (default; tier-1
+    # CPU runs are unchanged). YAML ``meshShape: [1, 2]`` or ``"1x2"``; the
+    # KTPU_MESH env var overrides at scheduler construction.
+    mesh_shape: Optional[tuple] = None
     max_gang_rounds: int = 64
     seed: int = 0
     backoff_initial_s: float = 1.0
@@ -161,6 +168,12 @@ class SchedulerConfiguration:
         ]:
             if yaml_key in d:
                 setattr(cfg, attr, type(getattr(cfg, attr))(d[yaml_key]))
+        if "meshShape" in d:
+            from kubernetes_tpu.parallel.mesh import parse_mesh_shape
+            try:
+                cfg.mesh_shape = parse_mesh_shape(d["meshShape"])
+            except (ValueError, TypeError) as e:
+                raise ValidationError(f"bad meshShape: {e}")
         return cfg
 
     @classmethod
@@ -206,3 +219,20 @@ def validate(cfg: SchedulerConfiguration):
         raise ValidationError("pipelineDepth must be >= 1")
     if cfg.bind_workers < 1:
         raise ValidationError("bindWorkers must be >= 1")
+    if cfg.mesh_shape is not None:
+        if len(cfg.mesh_shape) != 2:
+            raise ValidationError(
+                f"meshShape must be (pods, nodes), got {cfg.mesh_shape}")
+        pods_axis, nodes_axis = cfg.mesh_shape
+        for ax in (pods_axis, nodes_axis):
+            # every tensor bucket is a power of two (encode/dictionary.py
+            # next_bucket), so power-of-two axes always divide evenly and
+            # shards stay layout-uniform
+            if ax < 1 or ax & (ax - 1):
+                raise ValidationError(
+                    f"meshShape axes must be powers of two, got {cfg.mesh_shape}")
+        if cfg.batch_size % pods_axis:
+            raise ValidationError(
+                f"batchSize ({cfg.batch_size}) must be divisible by the "
+                f"meshShape pods axis ({pods_axis}) so pod padding shards "
+                "evenly")

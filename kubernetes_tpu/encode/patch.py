@@ -81,6 +81,13 @@ class CtxPatchState:
     I: int
     IMG: int
     E: int
+    # Pod-side label width of the RESIDENT epod arrays. extend_cluster
+    # unifies epod_labels/ea_* to max(cluster, batch) widths, so a batch
+    # whose label keys crossed a bucket AFTER the cluster encode leaves the
+    # context wider than the encoder's K — patches write at EK (and the
+    # scheduler re-syncs ET/EAX/EAV/NSB from the staged arrays) or the
+    # scatter rows would not broadcast. K keeps addressing the node rows.
+    EK: int = 0
     slot_of: dict[str, int] = dc_field(default_factory=dict)
     slot_node: dict[str, int] = dc_field(default_factory=dict)
     slot_req: dict[str, Any] = dc_field(default_factory=dict)
@@ -123,7 +130,7 @@ def fork_patch_state(pstate) -> Optional[CtxPatchState]:
         node_index=dict(pstate.node_index),
         K=pstate.K, ET=pstate.ET, EAX=pstate.EAX, EAV=pstate.EAV,
         NSB=pstate.NSB, N=pstate.N, V=pstate.V, T=pstate.T, I=pstate.I,
-        IMG=pstate.IMG, E=e0,
+        IMG=pstate.IMG, E=e0, EK=pstate.K,
         slot_of=dict(pstate.slot_of), slot_node=dict(pstate.slot_node),
         slot_req={k: np.array(v) for k, v in pstate.slot_req.items()},
         unpatchable=set(pstate.unpatchable),
@@ -131,6 +138,22 @@ def fork_patch_state(pstate) -> Optional[CtxPatchState]:
         node_free=list(pstate.node_free),
         row_pods=dict(pstate.row_pods),
     )
+
+
+def sync_resident_widths(cs: CtxPatchState, ct_all) -> CtxPatchState:
+    """Align the patch state's POD-SIDE bucket widths with the staged drain
+    context's actual arrays. extend_cluster unifies epod/anti-term widths to
+    max(cluster, batch); when a batch's label keys or anti terms crossed a
+    bucket after the cluster encode, the resident arrays are wider than the
+    encoder's post-encode widths — patches compiled at the narrow widths
+    would fail to broadcast at apply time (and reject pods the resident
+    buckets can in fact hold)."""
+    cs.EK = int(ct_all.epod_labels.shape[1])
+    cs.ET = int(ct_all.ea_valid.shape[1])
+    cs.EAX = int(ct_all.ea_sel.key.shape[2])
+    cs.EAV = int(ct_all.ea_sel.vals.shape[3])
+    cs.NSB = int(ct_all.ea_ns_mask.shape[2])
+    return cs
 
 
 def fork_meta(meta: SnapshotMeta) -> SnapshotMeta:
@@ -237,7 +260,7 @@ def _compile(encoder, meta, cs, entries, nom_target, nom_bucket):
         if ns_id >= cs.NSB:
             raise _Unfit  # candidate-pod ns indexes [*,NSB] term masks
         label_ids = encoder._label_ids(p.metadata.labels)
-        if any(kid >= cs.K for kid in label_ids):
+        if any(kid >= cs.EK for kid in label_ids):
             raise _Unfit
         aff = p.spec.affinity
         pan = aff.pod_anti_affinity if aff else None
@@ -424,7 +447,7 @@ def _compile(encoder, meta, cs, entries, nom_target, nom_bucket):
         "pod_slot": np.full(MP, -1, np.int32),
         "pod_node": np.full(MP, -1, np.int32),
         "pod_ns": np.full(MP, -1, np.int32),
-        "pod_labels": np.full((MP, cs.K), -1, np.int32),
+        "pod_labels": np.full((MP, cs.EK), -1, np.int32),
         "pod_valid": np.zeros(MP, bool),
         "ea_topo": np.full((MP, cs.ET), -1, np.int32),
         "ea_valid": np.zeros((MP, cs.ET), bool),

@@ -76,6 +76,11 @@ NODE_NAME_KEY_ID = 0
 UNSCHED_TAINT_KEY_ID = 1
 EMPTY_VALUE_ID = 0  # "" pre-interned: empty taint values / tolerations compare to it
 
+# batch-derived bucket dims of a PodBatch, in row-signature order (the
+# row-pack cache keys on (resources, K, NSB) + these widths)
+_ROW_DIMS = ("TREQ", "TPREF", "VT", "VG", "VB", "X", "VV", "S", "TOL",
+             "PP", "CI", "AT", "BT", "CT", "SC", "AX", "AV")
+
 
 class TermSet(struct.PyTreeNode):
     """Compiled node-selector terms: OR over terms, AND over exprs within a term.
@@ -336,15 +341,29 @@ class SnapshotEncoder:
         self.value_headroom = 0
         self.ns_headroom = 0
         # informer-event-time pod compile cache (precompile_pod): key ->
-        # (pod object, epoch, compiled record). Hits are validated by OBJECT
-        # IDENTITY (informers build a fresh Pod per event, so a new version
-        # never aliases a cached one) and by the catalog epoch below — any
-        # volume/namespace/DRA catalog change invalidates every record.
-        self._pod_cache: dict[str, tuple] = {}
+        # [pod object, epoch, compiled record, row sig, row pack]. Hits are
+        # validated by OBJECT IDENTITY (informers build a fresh Pod per
+        # event, so a new version never aliases a cached one) and by the
+        # catalog epoch below — any volume/namespace/DRA catalog change
+        # invalidates every record. The row pack is the pod's PRE-FILLED
+        # numpy rows at the current bucket signature: encode_pods then
+        # assembles the batch with one np.stack per field instead of the
+        # per-pod Python fill loop (the 1136 ms encode residual the churn
+        # bench showed with the compile cache already hot).
+        self._pod_cache: dict[str, list] = {}
         self._pod_cache_max = 65536
         self._pod_epoch = 0
         self.pod_cache_hits = 0
         self.pod_cache_misses = 0
+        # sticky batch bucket widths (monotone max across encodes) so row
+        # packs prebuilt at informer time keep matching the batch signature;
+        # power-of-two buckets only ever grow, exactly like the intern
+        # tables, so stickiness costs padding, never correctness
+        self._row_widths: dict[str, int] = {}
+        self._row_sig: Optional[tuple] = None
+        self._row_env: Optional[tuple] = None  # (resources, K, NSB, widths)
+        self.pod_rows_stacked = 0  # rows bulk-assembled from prebuilt packs
+        self.pod_rows_filled = 0   # rows built by the per-pod fill loop
 
     def set_volumes(self, catalog) -> None:
         """Attach the PVC/PV/StorageClass catalog consulted by the next
@@ -1078,11 +1097,12 @@ class SnapshotEncoder:
         )
 
     def precompile_pod(self, p: Pod) -> bool:
-        """Compile a pod's encode record AHEAD of batch-encode time — the
-        informer layer calls this per watch event, so by the time the drain
-        pops the pod, ``encode_pods`` pays array-fill cost only (the
-        incremental-encode half of the connected-path pipeline; see
-        sched/cache.py precompile_pod for the locking discipline).
+        """Compile a pod's encode record AND its numpy row pack AHEAD of
+        batch-encode time — the informer layer calls this per watch event,
+        so by the time the drain pops the pod, ``encode_pods`` pays one
+        np.stack per field, zero per-pod fill work (the incremental-encode
+        half of the connected-path pipeline; see sched/cache.py
+        precompile_pod for the locking discipline).
 
         Volume-carrying pods are skipped: their compile reads catalog state
         (``_rwop_in_use``) that every cluster encode rewrites. Returns True
@@ -1091,7 +1111,22 @@ class SnapshotEncoder:
             return False
         if len(self._pod_cache) >= self._pod_cache_max:
             self._pod_cache.clear()  # backstop; steady state evicts per key
-        self._pod_cache[p.key] = (p, self._pod_epoch, self._compile_pod(p))
+        epoch = self._pod_epoch
+        c = self._compile_pod(p)
+        sig = pack = None
+        if self._row_sig is not None:
+            resources, K, NSB, w = self._row_env
+            res_index = {r: i for i, r in enumerate(resources)}
+            if all(r in res_index for r in self._effective_requests(p)):
+                try:
+                    pack = self._build_rows(c, resources, K, NSB, w)
+                    sig = self._row_sig
+                except IndexError:
+                    # the pod outgrows the current buckets (wider terms, a
+                    # key past K, ...): encode_pods promotes the signature
+                    # when this pod actually pops, and fills its rows then
+                    pack = None
+        self._pod_cache[p.key] = [p, epoch, c, sig, pack]
         return True
 
     def pod_cache_discard(self, key: str) -> None:
@@ -1114,18 +1149,21 @@ class SnapshotEncoder:
         P = next_bucket(len(pods), minimum=min_p)
         R = len(meta.resources)
         meta.pod_keys = [p.key for p in pods]
+        n = len(pods)
 
         # First pass: compile everything host-side, find bucket sizes.
         # Pods precompiled at informer-event time (``precompile_pod``) skip
-        # the compile entirely — the drain hot path then pays array-fill
-        # cost only. Identity + epoch guard staleness: a new watch object
-        # or any catalog change (volumes/namespaces/DRA) misses the cache.
+        # the compile entirely — the drain hot path then assembles their
+        # PREBUILT rows. Identity + epoch guard staleness: a new watch
+        # object or any catalog change (volumes/namespaces/DRA) misses.
         compiled = []
+        entries: list[Optional[list]] = []  # live cache record per pod
         for p in pods:
             ent = self._pod_cache.get(p.key)
             if (ent is not None and ent[0] is p
                     and ent[1] == self._pod_epoch):
                 compiled.append(ent[2])
+                entries.append(ent)
                 self.pod_cache_hits += 1
                 continue
             # snapshot the epoch BEFORE compiling: a catalog change racing
@@ -1135,36 +1173,40 @@ class SnapshotEncoder:
             c = self._compile_pod(p)
             compiled.append(c)
             self.pod_cache_misses += 1
+            ent = None
             if cache_rows and not p.spec.volumes:
                 # failure re-pops carry the SAME Pod object back through
-                # here — cache so the retry encode is fill-only too
+                # here — cache so the retry encode is stack-only too
                 if len(self._pod_cache) >= self._pod_cache_max:
                     self._pod_cache.clear()
-                self._pod_cache[p.key] = (p, epoch, c)
+                ent = [p, epoch, c, None, None]
+                self._pod_cache[p.key] = ent
+            entries.append(ent)
 
         K = next_bucket(len(self.keys), minimum=1)
 
         def _bucket(fn, minimum=0):
             return next_bucket(max((fn(c) for c in compiled), default=0), minimum=minimum)
 
-        TREQ = _bucket(lambda c: len(c["req_terms"]))
-        TPREF = _bucket(lambda c: len(c["pref_terms"]))
-        VT = _bucket(lambda c: len(c["vol_terms"]))
-        VG = _bucket(lambda c: c["vol_groups"])
-        VB = _bucket(lambda c: len(c["vol_rwo"]))
-        X = _bucket(lambda c: max((len(e) for _, e in c["req_terms"] + c["pref_terms"]
-                                   + c["vol_terms"]), default=0))
-        VV = _bucket(lambda c: max((len(v) for _, ex in c["req_terms"] + c["pref_terms"]
-                                    + c["vol_terms"]
-                                    for (_, _, v, _) in ex), default=0))
-        S = _bucket(lambda c: len(c["sel"]))
-        TOL = _bucket(lambda c: len(c["tols"]))
-        PP = _bucket(lambda c: len(c["ports"]))
-        CI = _bucket(lambda c: len(c["images"]))
-        AT = _bucket(lambda c: len(c["aff_req"]))
-        BT = _bucket(lambda c: len(c["anti_req"]))
-        CT = _bucket(lambda c: len(c["paff"]))
-        SC = _bucket(lambda c: len(c["spreads"]))
+        w = {}
+        w["TREQ"] = _bucket(lambda c: len(c["req_terms"]))
+        w["TPREF"] = _bucket(lambda c: len(c["pref_terms"]))
+        w["VT"] = _bucket(lambda c: len(c["vol_terms"]))
+        w["VG"] = _bucket(lambda c: c["vol_groups"])
+        w["VB"] = _bucket(lambda c: len(c["vol_rwo"]))
+        w["X"] = _bucket(lambda c: max((len(e) for _, e in c["req_terms"] + c["pref_terms"]
+                                        + c["vol_terms"]), default=0))
+        w["VV"] = _bucket(lambda c: max((len(v) for _, ex in c["req_terms"] + c["pref_terms"]
+                                         + c["vol_terms"]
+                                         for (_, _, v, _) in ex), default=0))
+        w["S"] = _bucket(lambda c: len(c["sel"]))
+        w["TOL"] = _bucket(lambda c: len(c["tols"]))
+        w["PP"] = _bucket(lambda c: len(c["ports"]))
+        w["CI"] = _bucket(lambda c: len(c["images"]))
+        w["AT"] = _bucket(lambda c: len(c["aff_req"]))
+        w["BT"] = _bucket(lambda c: len(c["anti_req"]))
+        w["CT"] = _bucket(lambda c: len(c["paff"]))
+        w["SC"] = _bucket(lambda c: len(c["spreads"]))
         AX = _bucket(lambda c: max((len(e) for (_, _, e, _) in c["aff_req"] + c["anti_req"]), default=0))
         AX = max(AX, _bucket(lambda c: max((len(e) for (_, _, e, _, _) in c["paff"]), default=0)))
         AX = max(AX, _bucket(lambda c: max((len(t[2]) for t in c["spreads"]), default=0)))
@@ -1174,8 +1216,64 @@ class SnapshotEncoder:
                                             for (_, _, v, _) in e), default=0)))
         AV = max(AV, _bucket(lambda c: max((len(v) for t in c["spreads"]
                                             for (_, _, v, _) in t[2]), default=0)))
+        w["AX"], w["AV"] = AX, AV
+        # sticky promotion: widths never shrink across encodes, so a pod's
+        # prebuilt row pack stays valid batch to batch (padding is inert
+        # behind validity flags; stable widths also mean stable compiled
+        # program shapes — unify_batches/pad_batch_to become no-ops in
+        # steady state)
+        for k in _ROW_DIMS:
+            w[k] = max(w[k], self._row_widths.get(k, 0))
+        self._row_widths = {k: w[k] for k in _ROW_DIMS}
         # namespace-mask width: all term ns sets are already interned above
         NSB = next_bucket(len(self.namespaces) + self.ns_headroom, minimum=1)
+        sig = (tuple(meta.resources), K, NSB) + tuple(w[k] for k in _ROW_DIMS)
+        self._row_sig = sig
+        self._row_env = (list(meta.resources), K, NSB, dict(w))
+
+        # Second pass: one row pack per pod — PREBUILT at informer-event
+        # time when the signature matches (the steady state: zero per-pod
+        # fill work on this path), built here otherwise and cached back so
+        # failure re-pops stack too.
+        packs = []
+        forced = []
+        image_bytes_v = []
+        for (c, ent) in zip(compiled, entries):
+            if ent is not None and ent[3] == sig and ent[4] is not None:
+                packs.append(ent[4])
+                self.pod_rows_stacked += 1
+            else:
+                pk = self._build_rows(c, meta.resources, K, NSB, w)
+                self.pod_rows_filled += 1
+                if ent is not None:
+                    ent[3], ent[4] = sig, pk
+                packs.append(pk)
+            p: Pod = c["pod"]
+            # scalars a cached pack must not freeze: node pinning reads the
+            # CURRENT node_index and DRA allocation state; image bytes read
+            # the live size table (node status may raise a size later)
+            fn = -1
+            if p.spec.node_name:
+                fn = meta.node_index.get(p.spec.node_name, -2)
+            if self._dra is not None and p.spec.resource_claims:
+                if not self._dra.pod_claims_ready(p):
+                    # referenced claim doesn't exist yet (template race):
+                    # hold unschedulable, never drop the device demand
+                    fn = -2
+                else:
+                    # an already-allocated claim pins the pod to its node
+                    # (dynamicresources.go Filter on claim.status.allocation)
+                    alloc_node = self._dra.pod_allocated_node(p)
+                    if alloc_node and not p.spec.node_name:
+                        fn = meta.node_index.get(alloc_node, -2)
+            forced.append(fn)
+            image_bytes_v.append(
+                float(sum(self._image_sizes[im] for im in c["images"]))
+                if c["images"] else 0.0)
+
+        TREQ, TPREF, VT, VG, VB = w["TREQ"], w["TPREF"], w["VT"], w["VG"], w["VB"]
+        X, VV, S, TOL, PP, CI = w["X"], w["VV"], w["S"], w["TOL"], w["PP"], w["CI"]
+        AT, BT, CT, SC = w["AT"], w["BT"], w["CT"], w["SC"]
 
         def _new_termset(T):
             return dict(
@@ -1198,23 +1296,8 @@ class SnapshotEncoder:
         rwo_valid = np.zeros((P, VB), bool)
         attach_req = np.zeros(P, np.int32)
 
-        def _fill_terms(arrs, p_idx, terms):
-            arrs["has_any"][p_idx] = len(terms) > 0
-            for t_idx, (weight, exprs) in enumerate(terms):
-                arrs["term_valid"][p_idx, t_idx] = True
-                arrs["weight"][p_idx, t_idx] = weight
-                for x_idx, (kid, opc, vals, num) in enumerate(exprs):
-                    arrs["key"][p_idx, t_idx, x_idx] = kid
-                    arrs["op"][p_idx, t_idx, x_idx] = opc
-                    arrs["num"][p_idx, t_idx, x_idx] = num
-                    arrs["expr_valid"][p_idx, t_idx, x_idx] = True
-                    for v_idx, v in enumerate(vals):
-                        arrs["vals"][p_idx, t_idx, x_idx, v_idx] = v
-
         def _new_selset(shape_prefix):
             return _selset_arrays(shape_prefix, AX, AV)
-
-        _fill_sel = _selset_fill
 
         requests = np.zeros((P, R), np.int32)
         pod_valid = np.zeros(P, bool)
@@ -1261,90 +1344,68 @@ class SnapshotEncoder:
         sc_honor_affinity = np.zeros((P, SC), bool)
         sc_honor_taints = np.zeros((P, SC), bool)
 
-        def _fill_ns(explicit, mask, p_idx, t_idx, ns_ids):
-            if ns_ids is not None:
-                explicit[p_idx, t_idx] = True
-                for nid in ns_ids:
-                    mask[p_idx, t_idx, nid] = True
+        # ---- assembly: one bulk np.stack per field (no per-pod fill) -----
+        if n:
+            def put(dst, key):
+                dst[:n] = np.stack([pk[key] for pk in packs])
 
-        for i, c in enumerate(compiled):
-            p: Pod = c["pod"]
-            pod_valid[i] = True
-            priority[i] = p.spec.priority
-            pod_ns[i] = c["ns"]
-            if p.spec.node_name:
-                forced_node[i] = meta.node_index.get(p.spec.node_name, -2)
-            if self._dra is not None and p.spec.resource_claims:
-                if not self._dra.pod_claims_ready(p):
-                    # referenced claim doesn't exist yet (template race):
-                    # hold unschedulable, never drop the device demand
-                    forced_node[i] = -2
-                else:
-                    # an already-allocated claim pins the pod to its node
-                    # (dynamicresources.go Filter on claim.status.allocation)
-                    alloc_node = self._dra.pod_allocated_node(p)
-                    if alloc_node and not p.spec.node_name:
-                        forced_node[i] = meta.node_index.get(alloc_node, -2)
-            vec = self._request_vector(p, meta.resources)
-            requests[i, :len(meta.resources)] = vec
-            for kid, vid in c["labels"].items():
-                pod_labels[i, kid] = vid
-            for t_idx, (kid, opc, vid, eff) in enumerate(c["tols"]):
-                tol_key[i, t_idx] = kid
-                tol_op[i, t_idx] = opc
-                tol_val[i, t_idx] = vid
-                tol_effect[i, t_idx] = eff
-                tol_valid[i, t_idx] = True
-            for s_idx, (kid, vid) in enumerate(c["sel"]):
-                sel_key[i, s_idx] = kid
-                sel_val[i, s_idx] = vid
-                sel_valid[i, s_idx] = True
-            _fill_terms(req_a, i, c["req_terms"])
-            _fill_terms(pref_a, i, c["pref_terms"])
-            # vol terms reuse the TermSet fill with group id in place of
-            # weight, then split the group id out into vol_group
-            _fill_terms(vol_a, i, [(float(g), e) for g, e in c["vol_terms"]])
-            for t_idx, (g, _e) in enumerate(c["vol_terms"]):
-                vol_group[i, t_idx] = g
-            vol_group_valid[i, :c["vol_groups"]] = True
-            for b_idx, pvid in enumerate(c["vol_rwo"]):
-                rwo_pv[i, b_idx] = pvid
-                rwo_valid[i, b_idx] = True
-            attach_req[i] = c["attach_req"]
-            for pt_idx, (proto, port, ip) in enumerate(c["ports"]):
-                pport_proto[i, pt_idx] = proto
-                pport_port[i, pt_idx] = port
-                pport_ip[i, pt_idx] = ip
-                pport_valid[i, pt_idx] = True
-            for ci_idx, img in enumerate(c["images"]):
-                pod_images[i, ci_idx] = img
-                image_bytes[i] += self._image_sizes[img]
-            for a_idx, (topo, valid, exprs, ns_ids) in enumerate(c["aff_req"]):
-                aff_topo[i, a_idx] = topo
-                aff_valid[i, a_idx] = True
-                _fill_sel(aff_sel, (i, a_idx), valid, exprs)
-                _fill_ns(aff_ns_explicit, aff_ns_mask, i, a_idx, ns_ids)
-            for a_idx, (topo, valid, exprs, ns_ids) in enumerate(c["anti_req"]):
-                anti_topo[i, a_idx] = topo
-                anti_valid[i, a_idx] = True
-                _fill_sel(anti_sel, (i, a_idx), valid, exprs)
-                _fill_ns(anti_ns_explicit, anti_ns_mask, i, a_idx, ns_ids)
-            for a_idx, (topo, valid, exprs, w, ns_ids) in enumerate(c["paff"]):
-                paff_topo[i, a_idx] = topo
-                paff_weight[i, a_idx] = w
-                paff_valid[i, a_idx] = True
-                _fill_sel(paff_sel, (i, a_idx), valid, exprs)
-                _fill_ns(paff_ns_explicit, paff_ns_mask, i, a_idx, ns_ids)
-            for a_idx, (topo, valid, exprs, skew, hard, mind, haff, htaint) \
-                    in enumerate(c["spreads"]):
-                sc_topo[i, a_idx] = topo
-                sc_maxskew[i, a_idx] = skew
-                sc_hard[i, a_idx] = hard
-                sc_valid[i, a_idx] = True
-                sc_min_domains[i, a_idx] = mind
-                sc_honor_affinity[i, a_idx] = haff
-                sc_honor_taints[i, a_idx] = htaint
-                _fill_sel(sc_sel, (i, a_idx), valid, exprs)
+            def put_scalar(dst, key, dtype):
+                dst[:n] = np.fromiter((pk[key] for pk in packs), dtype, n)
+
+            pod_valid[:n] = True
+            forced_node[:n] = forced
+            image_bytes[:n] = image_bytes_v
+            put(requests, "requests")
+            put_scalar(priority, "priority", np.int32)
+            put_scalar(pod_ns, "ns", np.int32)
+            put_scalar(attach_req, "attach_req", np.int32)
+            put(pod_labels, "labels")
+            for dst, f in ((tol_key, "tol_key"), (tol_op, "tol_op"),
+                           (tol_val, "tol_val"), (tol_effect, "tol_effect"),
+                           (tol_valid, "tol_valid")):
+                put(dst, f)
+            put(sel_key, "sel_key")
+            put(sel_val, "sel_val")
+            put(sel_valid, "sel_valid")
+            for prefix, arrs in (("req", req_a), ("pref", pref_a),
+                                 ("vol", vol_a)):
+                for f in ("key", "op", "vals", "num", "expr_valid",
+                          "term_valid", "weight"):
+                    put(arrs[f], f"{prefix}_{f}")
+                put_scalar(arrs["has_any"], f"{prefix}_has_any", bool)
+            put(vol_group, "vol_group")
+            put(vol_group_valid, "vol_group_valid")
+            put(rwo_pv, "rwo_pv")
+            put(rwo_valid, "rwo_valid")
+            put(pport_proto, "port_proto")
+            put(pport_port, "port_port")
+            put(pport_ip, "port_ip")
+            put(pport_valid, "port_valid")
+            put(pod_images, "images")
+            for prefix, selset, extras in (
+                    ("aff", aff_sel,
+                     ((aff_topo, "topo"), (aff_valid, "valid"),
+                      (aff_ns_explicit, "ns_explicit"),
+                      (aff_ns_mask, "ns_mask"))),
+                    ("anti", anti_sel,
+                     ((anti_topo, "topo"), (anti_valid, "valid"),
+                      (anti_ns_explicit, "ns_explicit"),
+                      (anti_ns_mask, "ns_mask"))),
+                    ("paff", paff_sel,
+                     ((paff_topo, "topo"), (paff_valid, "valid"),
+                      (paff_weight, "weight"),
+                      (paff_ns_explicit, "ns_explicit"),
+                      (paff_ns_mask, "ns_mask"))),
+                    ("sc", sc_sel,
+                     ((sc_topo, "topo"), (sc_valid, "valid"),
+                      (sc_maxskew, "maxskew"), (sc_hard, "hard"),
+                      (sc_min_domains, "min_domains"),
+                      (sc_honor_affinity, "honor_affinity"),
+                      (sc_honor_taints, "honor_taints")))):
+                for f in ("key", "op", "vals", "expr_valid", "valid"):
+                    put(selset[f], f"{prefix}_sel_{f}")
+                for dst, f in extras:
+                    put(dst, f"{prefix}_{f}")
 
         batch_topo = {int(k) for k in np.concatenate([
             aff_topo[aff_valid], anti_topo[anti_valid],
@@ -1376,3 +1437,150 @@ class SnapshotEncoder:
             vol_group_valid=vol_group_valid,
             rwo_pv=rwo_pv, rwo_valid=rwo_valid, attach_req=attach_req,
         )
+
+    def _build_rows(self, c: dict, resources: list[str], K: int, NSB: int,
+                    w: dict) -> dict:
+        """ONE pod's PodBatch rows as small numpy arrays at the bucket
+        signature ``(resources, K, NSB, w)`` — the per-pod half of the
+        vectorized ``encode_pods`` assembly. Runs at informer-event time
+        (``precompile_pod``) in the steady state; the batch hot path then
+        does one np.stack per field and no per-pod fill work. Raises
+        IndexError when the pod outgrows the widths (callers treat that as
+        "no pack"; encode_pods always passes covering widths)."""
+        X, VV, AX, AV = w["X"], w["VV"], w["AX"], w["AV"]
+        p: Pod = c["pod"]
+        rows: dict = {
+            "priority": int(p.spec.priority), "ns": int(c["ns"]),
+            "attach_req": int(c["attach_req"]),
+        }
+
+        rows["requests"] = self._request_vector(p, resources)
+
+        labels = np.full(K, -1, np.int32)
+        for kid, vid in c["labels"].items():
+            labels[kid] = vid
+        rows["labels"] = labels
+
+        tol_key = np.full(w["TOL"], -1, np.int32)
+        tol_op = np.zeros(w["TOL"], np.int32)
+        tol_val = np.full(w["TOL"], -1, np.int32)
+        tol_effect = np.full(w["TOL"], -1, np.int32)
+        tol_valid = np.zeros(w["TOL"], bool)
+        for t_idx, (kid, opc, vid, eff) in enumerate(c["tols"]):
+            tol_key[t_idx], tol_op[t_idx] = kid, opc
+            tol_val[t_idx], tol_effect[t_idx] = vid, eff
+            tol_valid[t_idx] = True
+        rows.update(tol_key=tol_key, tol_op=tol_op, tol_val=tol_val,
+                    tol_effect=tol_effect, tol_valid=tol_valid)
+
+        sel_key = np.full(w["S"], -1, np.int32)
+        sel_val = np.full(w["S"], -1, np.int32)
+        sel_valid = np.zeros(w["S"], bool)
+        for s_idx, (kid, vid) in enumerate(c["sel"]):
+            sel_key[s_idx], sel_val[s_idx] = kid, vid
+            sel_valid[s_idx] = True
+        rows.update(sel_key=sel_key, sel_val=sel_val, sel_valid=sel_valid)
+
+        def termset_rows(prefix, T, terms):
+            a = dict(
+                key=np.full((T, X), -1, np.int32),
+                op=np.zeros((T, X), np.int32),
+                vals=np.full((T, X, VV), -1, np.int32),
+                num=np.full((T, X), np.nan, np.float32),
+                expr_valid=np.zeros((T, X), bool),
+                term_valid=np.zeros(T, bool),
+                weight=np.zeros(T, np.float32),
+            )
+            for t_idx, (weight, exprs) in enumerate(terms):
+                a["term_valid"][t_idx] = True
+                a["weight"][t_idx] = weight
+                for x_idx, (kid, opc, vals, num) in enumerate(exprs):
+                    a["key"][t_idx, x_idx] = kid
+                    a["op"][t_idx, x_idx] = opc
+                    a["num"][t_idx, x_idx] = num
+                    a["expr_valid"][t_idx, x_idx] = True
+                    for v_idx, v in enumerate(vals):
+                        a["vals"][t_idx, x_idx, v_idx] = v
+            for f, arr in a.items():
+                rows[f"{prefix}_{f}"] = arr
+            rows[f"{prefix}_has_any"] = len(terms) > 0
+
+        vol_terms = [(float(g), e) for g, e in c["vol_terms"]]
+        termset_rows("req", w["TREQ"], c["req_terms"])
+        termset_rows("pref", w["TPREF"], c["pref_terms"])
+        # vol terms reuse the TermSet layout with group id in place of
+        # weight, then split the group id out into vol_group
+        termset_rows("vol", w["VT"], vol_terms)
+        vol_group = np.full(w["VT"], -1, np.int32)
+        for t_idx, (g, _e) in enumerate(c["vol_terms"]):
+            vol_group[t_idx] = g
+        vol_group_valid = np.zeros(w["VG"], bool)
+        vol_group_valid[:c["vol_groups"]] = True
+        rwo_pv = np.full(w["VB"], -1, np.int32)
+        rwo_valid = np.zeros(w["VB"], bool)
+        for b_idx, pvid in enumerate(c["vol_rwo"]):
+            rwo_pv[b_idx] = pvid
+            rwo_valid[b_idx] = True
+        rows.update(vol_group=vol_group, vol_group_valid=vol_group_valid,
+                    rwo_pv=rwo_pv, rwo_valid=rwo_valid)
+
+        port_proto = np.full(w["PP"], -1, np.int32)
+        port_port = np.full(w["PP"], -1, np.int32)
+        port_ip = np.full(w["PP"], -1, np.int32)
+        port_valid = np.zeros(w["PP"], bool)
+        for pt_idx, (proto, port, ip) in enumerate(c["ports"]):
+            port_proto[pt_idx], port_port[pt_idx] = proto, port
+            port_ip[pt_idx] = ip
+            port_valid[pt_idx] = True
+        rows.update(port_proto=port_proto, port_port=port_port,
+                    port_ip=port_ip, port_valid=port_valid)
+
+        images = np.full(w["CI"], -1, np.int32)
+        for ci_idx, img in enumerate(c["images"]):
+            images[ci_idx] = img
+        rows["images"] = images
+
+        def selset_rows(prefix, T, items, scalars):
+            """items: [(topo, valid, exprs, *extras, ns_ids)] with extras
+            per ``scalars``: [(name, dtype, default)]."""
+            a = _selset_arrays((T,), AX, AV)
+            topo = np.full(T, -1, np.int32)
+            valid = np.zeros(T, bool)
+            ns_explicit = np.zeros(T, bool)
+            ns_mask = np.zeros((T, NSB), bool)
+            extra_arrs = {nm: np.full(T, dflt, dt)
+                          for nm, dt, dflt in scalars}
+            for t_idx, item in enumerate(items):
+                tk, sv, exprs = item[0], item[1], item[2]
+                ns_ids = item[-1]
+                topo[t_idx] = tk
+                valid[t_idx] = True
+                _selset_fill(a, (t_idx,), sv, exprs)
+                for (nm, _dt, _df), val in zip(scalars, item[3:-1]):
+                    extra_arrs[nm][t_idx] = val
+                if ns_ids is not None:
+                    ns_explicit[t_idx] = True
+                    for nid in ns_ids:
+                        ns_mask[t_idx, nid] = True
+            for f, arr in a.items():
+                rows[f"{prefix}_sel_{f}"] = arr
+            rows[f"{prefix}_topo"] = topo
+            rows[f"{prefix}_valid"] = valid
+            rows[f"{prefix}_ns_explicit"] = ns_explicit
+            rows[f"{prefix}_ns_mask"] = ns_mask
+            for nm, arr in extra_arrs.items():
+                rows[f"{prefix}_{nm}"] = arr
+
+        selset_rows("aff", w["AT"], c["aff_req"], [])
+        selset_rows("anti", w["BT"], c["anti_req"], [])
+        selset_rows("paff", w["CT"], c["paff"],
+                    [("weight", np.float32, 0.0)])
+        # spreads: (topo, valid, exprs, skew, hard, mind, haff, htaint) —
+        # no ns_ids slot, so append a None sentinel for the shared driver
+        selset_rows("sc", w["SC"],
+                    [t + (None,) for t in c["spreads"]],
+                    [("maxskew", np.int32, 1), ("hard", bool, False),
+                     ("min_domains", np.int32, 0),
+                     ("honor_affinity", bool, False),
+                     ("honor_taints", bool, False)])
+        return rows

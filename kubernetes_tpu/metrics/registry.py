@@ -237,6 +237,31 @@ ENCODE_POD_CACHE_HITS = REGISTRY.gauge(
 ENCODE_POD_CACHE_MISSES = REGISTRY.gauge(
     "scheduler_encode_pod_cache_misses",
     "Pod rows compiled on the batch-encode hot path")
+# Row-pack vectorized batch assembly (encode/snapshot.py encode_pods):
+# stacked rows arrived prebuilt (informer-time) and were bulk np.stack'ed;
+# filled rows paid the per-pod Python array-fill loop on the hot path. A
+# healthy connected run shows stacked >> filled (fill-only cycles do no
+# per-pod fill work at all).
+ENCODE_POD_ROWS_STACKED = REGISTRY.gauge(
+    "scheduler_encode_pod_rows_stacked",
+    "Pod rows bulk-assembled from prebuilt row packs (no per-pod fill)")
+ENCODE_POD_ROWS_FILLED = REGISTRY.gauge(
+    "scheduler_encode_pod_rows_filled",
+    "Pod rows built by the per-pod array-fill loop on the encode hot path")
+
+# Multi-chip scheduling (parallel/mesh.py wired into the live drain path).
+MESH_DEVICES = REGISTRY.gauge(
+    "scheduler_mesh_devices",
+    "Devices in the active scheduling mesh (1 = single-device, mesh off)")
+DRAIN_SHARD_MS = REGISTRY.gauge(
+    "scheduler_drain_shard_ms",
+    "Wall ms of the last resolved drain across the mesh (one SPMD "
+    "program: every shard runs it lock-step, so one number covers all "
+    "shards; straggler collectives are included in it)")
+RESOLVE_BYTES = REGISTRY.gauge(
+    "scheduler_resolve_bytes",
+    "Bytes device_get moved host-side for the last drain's compact "
+    "winners view (assignments + rounds; O(P), never sharded intermediates)")
 
 # Kubelet pod-sync health (pod_workers.go error bookkeeping analog).
 # Aggregate only — per-pod counts are PodWorkers.sync_errors(uid); a

@@ -498,24 +498,32 @@ def drain_widths_fit(ct_all: ClusterTensors, pb_stack: PodBatch) -> bool:
             and pb_stack.requests.shape[2] == ct_all.requested.shape[1])
 
 
-@partial(jax.jit, donate_argnums=(0,),
+@partial(jax.jit, donate_argnums=(0, 2),
          static_argnames=("e0", "seed", "fit_strategy", "topo_keys",
                           "weights", "enabled_filters", "max_rounds",
-                          "plugins"))
+                          "plugins", "winners_sharding"))
 def drain_step(ct_all: ClusterTensors, pb_stack: PodBatch, fill,
                e0: int, seed: int, fit_strategy: str,
                topo_keys: tuple[int, ...], weights: tuple,
                enabled_filters: tuple, max_rounds: int,
-               plugins: tuple = ()):
+               plugins: tuple = (), winners_sharding=None):
     """One fused drain over a DEVICE-RESIDENT cluster encoding.
 
     ``ct_all``: donated; rows [0,e0) are base existing-pod slots (``fill`` of
     them occupied, packed), rows [e0,e0+B*P) are extension slots whose content
-    this call overwrites from ``pb_stack``. Returns
+    this call overwrites from ``pb_stack``. ``fill`` is donated too — in
+    steady state it is the previous call's device-resident ``new_fill`` and
+    the scalar aliases in place instead of allocating per drain. Returns
     ``(assignments [B,P], rounds [B], new_ct_all, new_fill)`` where
     ``new_ct_all`` has every committed pod folded into base slots
     [fill, fill+n) and the extension region invalidated — ready to be the
     next call's ``ct_all`` with zero host↔device traffic.
+
+    ``winners_sharding``: optional (hashable) NamedSharding the compact
+    winners view (assignments + rounds + new_fill) is constrained to. Under
+    a device mesh the cluster encoding stays sharded in HBM, and pinning
+    the winners replicated means the resolver's device_get moves O(B*P)
+    int32s — never a gathered sharded intermediate.
     """
     B, P = pb_stack.pod_valid.shape
     K = ct_all.epod_labels.shape[1]
@@ -613,6 +621,11 @@ def drain_step(ct_all: ClusterTensors, pb_stack: PodBatch, fill,
         ea_ns_mask=fold(ct_r.ea_ns_mask),
     )
     new_fill = fill + jnp.sum(flags, dtype=jnp.int32)
+    if winners_sharding is not None:
+        constrain = partial(jax.lax.with_sharding_constraint,
+                            shardings=winners_sharding)
+        assignments, rounds, new_fill = (
+            constrain(assignments), constrain(rounds), constrain(new_fill))
     return assignments, rounds, ct_out, new_fill
 
 
@@ -648,7 +661,7 @@ def batch_shapes(pb_stack: PodBatch) -> list[tuple]:
 
 
 def build_drain_context(ct: ClusterTensors, pbs: list[PodBatch],
-                        nom_bucket: int = 0):
+                        nom_bucket: int = 0, mesh=None):
     """Host-side one-time prep for the device-resident drain: unify the batch
     buckets, chain extension slots (content is placeholder — drain_step
     refills it), stage everything into HBM. Returns
@@ -659,7 +672,13 @@ def build_drain_context(ct: ClusterTensors, pbs: list[PodBatch],
     ``nom_bucket``: size of the RESIDENT nominee-reservation tensors. The
     base encode carries zero nominees; giving the context a fixed M lets
     preemption storms patch reservations device-side (apply_ctx_patch)
-    instead of dropping to the per-batch overlay path."""
+    instead of dropping to the per-batch overlay path.
+
+    ``mesh``: optional ("pods","nodes") Mesh — the encoding is device_put
+    SHARDED (node-axis arrays split over "nodes", everything else
+    replicated; parallel/mesh.py cluster_shardings) so drain_step lowers to
+    GSPMD collectives and the resident context lives distributed across the
+    mesh's HBM instead of one chip's."""
     pbs_u = unify_batches(pbs)
     ct_all, e0 = extend_cluster_drain(ct, pbs_u)
     valid = np.asarray(ct_all.epod_valid)[:e0]
@@ -673,7 +692,11 @@ def build_drain_context(ct: ClusterTensors, pbs: list[PodBatch],
             nom_prio=np.zeros(nom_bucket, np.int32),
             nom_req=np.zeros((nom_bucket, R), np.int32),
             nom_valid=np.zeros(nom_bucket, bool))
-    ct_dev = _stage(ct_all)
+    if mesh is not None:
+        from kubernetes_tpu.parallel.mesh import shard_cluster
+        ct_dev = shard_cluster(mesh, ct_all)
+    else:
+        ct_dev = _stage(ct_all)
     return ct_dev, e0, fill0
 
 

@@ -38,6 +38,72 @@ def make_mesh(devices=None, pods_axis: int = 1) -> Mesh:
     return Mesh(arr, ("pods", "nodes"))
 
 
+def parse_mesh_shape(value) -> "tuple[int, int] | None":
+    """Mesh-shape wire forms -> (pods_axis, nodes_axis) | None.
+
+    Accepted: None/""/"off" (disabled), "PxN" / "P,N" strings (KTPU_MESH
+    env), a bare int/"N" (1 x N: node-axis only, the common single-host
+    case), or a 2-sequence (YAML ``meshShape: [1, 2]``)."""
+    if value is None:
+        return None
+    if isinstance(value, str):
+        s = value.strip().lower()
+        if s in ("", "0", "off", "none"):
+            return None
+        for sep in ("x", ","):
+            if sep in s:
+                p, n = s.split(sep, 1)
+                return (int(p), int(n))
+        return (1, int(s))
+    if isinstance(value, int):
+        return None if value <= 1 else (1, value)
+    if len(value) != 2:
+        raise ValueError(f"mesh shape must be (pods, nodes), got {value!r}")
+    p, n = value
+    return (int(p), int(n))
+
+
+def mesh_from_shape(shape: tuple[int, int], devices=None) -> Mesh:
+    """An EXACT (pods, nodes) mesh from the first pods*nodes devices —
+    the live scheduler's configured shape, unlike make_mesh's best-fit.
+    Raises ValueError when the backend has too few devices (callers decide
+    whether that degrades to single-device or aborts)."""
+    pods_axis, nodes_axis = int(shape[0]), int(shape[1])
+    want = pods_axis * nodes_axis
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) < want:
+        raise ValueError(
+            f"mesh shape {pods_axis}x{nodes_axis} needs {want} devices, "
+            f"backend has {len(devices)}")
+    arr = np.asarray(devices[:want]).reshape(pods_axis, nodes_axis)
+    return Mesh(arr, ("pods", "nodes"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding on the mesh — the drain's compact winners
+    view (assignment rows + fill scalar) is constrained to this so the
+    resolver thread's device_get pulls O(P) bytes from one shard instead of
+    gathering whole sharded intermediates."""
+    return NamedSharding(mesh, P())
+
+
+def _split_or_replicate(mesh: Mesh, leaf, axis_index: int,
+                        axis_name: str) -> NamedSharding:
+    """Split ``leaf`` on ``axis_name`` at ``axis_index`` — or REPLICATE when
+    the dim isn't divisible by the mesh axis. Live encodes bucket to powers
+    of two, but a bucket can shrink below the axis size (a scaled-down
+    cluster's N=4 under a 1x8 mesh): device_put with a non-divisible split
+    raises, and an uncaught raise here kills the scheduling loop thread.
+    Replication is always semantics-preserving — the mesh stays a
+    throughput knob, never a crash."""
+    size = mesh.shape[axis_name]
+    if axis_index < leaf.ndim and leaf.shape[axis_index] % size == 0:
+        spec = [None] * leaf.ndim
+        spec[axis_index] = axis_name
+        return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, P())
+
+
 def cluster_shardings(mesh: Mesh, ct: ClusterTensors) -> ClusterTensors:
     """Sharding pytree for ClusterTensors: node-leading arrays split on "nodes"."""
     node_dim = {"allocatable", "requested", "node_valid", "unschedulable",
@@ -48,7 +114,7 @@ def cluster_shardings(mesh: Mesh, ct: ClusterTensors) -> ClusterTensors:
     def spec(path, leaf):
         name = path[-1].name if hasattr(path[-1], "name") else str(path[-1])
         if name in node_dim:
-            return NamedSharding(mesh, P("nodes", *([None] * (leaf.ndim - 1))))
+            return _split_or_replicate(mesh, leaf, 0, "nodes")
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map_with_path(spec, ct)
@@ -57,7 +123,7 @@ def cluster_shardings(mesh: Mesh, ct: ClusterTensors) -> ClusterTensors:
 def batch_shardings(mesh: Mesh, pb: PodBatch) -> PodBatch:
     """Sharding pytree for PodBatch: every pod-leading array splits on "pods"."""
     def spec(leaf):
-        return NamedSharding(mesh, P("pods", *([None] * (leaf.ndim - 1))))
+        return _split_or_replicate(mesh, leaf, 0, "pods")
     return jax.tree_util.tree_map(spec, pb)
 
 
@@ -74,7 +140,7 @@ def stack_shardings(mesh: Mesh, pb_stack: PodBatch) -> PodBatch:
     (axis 1) splits over "pods"; the scan axis B stays replicated (the
     drain scans batches sequentially — capacity carries batch to batch)."""
     def spec(leaf):
-        return NamedSharding(mesh, P(None, "pods", *([None] * (leaf.ndim - 2))))
+        return _split_or_replicate(mesh, leaf, 1, "pods")
     return jax.tree_util.tree_map(spec, pb_stack)
 
 

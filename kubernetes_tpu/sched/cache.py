@@ -73,6 +73,32 @@ class SchedulerCache:
         # observability: full re-encodes performed by snapshot() (the
         # autoscaler's overlay path depends on snapshot freshness)
         self._full_encodes = 0
+        # active ("pods","nodes") scheduling mesh, or None (single-device).
+        # The scheduler installs it (Scheduler.set_mesh); staging helpers
+        # below then device_put encodings SHARDED so the drain programs run
+        # under GSPMD instead of on one chip.
+        self._mesh = None
+
+    # ---- device mesh -----------------------------------------------------
+
+    def set_mesh(self, mesh) -> None:
+        self._mesh = mesh
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def stage_drain_batch(self, pb_stack):
+        """Stage a STACKED drain batch [B,P,...] for dispatch: under a mesh
+        the pod axis is device_put split over "pods" (parallel/mesh.py
+        stack_shardings) so the drain's batch tensors arrive pre-sharded;
+        single-device, the host arrays pass through and jit stages them."""
+        if self._mesh is None:
+            return pb_stack
+        import jax
+        from kubernetes_tpu.parallel.mesh import stack_shardings
+        return jax.device_put(pb_stack,
+                              stack_shardings(self._mesh, pb_stack))
 
     # ---- delta log (drain-context patch feed) ----------------------------
 
@@ -436,11 +462,15 @@ class SchedulerCache:
             CACHE_GENERATION,
             ENCODE_POD_CACHE_HITS,
             ENCODE_POD_CACHE_MISSES,
+            ENCODE_POD_ROWS_FILLED,
+            ENCODE_POD_ROWS_STACKED,
         )
         CACHE_GENERATION.set(self._generation)
         CACHE_FULL_ENCODES.set(self._full_encodes)
         ENCODE_POD_CACHE_HITS.set(self._encoder.pod_cache_hits)
         ENCODE_POD_CACHE_MISSES.set(self._encoder.pod_cache_misses)
+        ENCODE_POD_ROWS_STACKED.set(self._encoder.pod_rows_stacked)
+        ENCODE_POD_ROWS_FILLED.set(self._encoder.pod_rows_filled)
 
     def _snapshot_serialized(self, pending_pods, slot_headroom):
         with self._lock:
@@ -537,10 +567,14 @@ class SchedulerCache:
             self._encode_lock.release()
 
     def encode_cache_stats(self) -> dict[str, int]:
-        """Hit/miss counters of the pod compile cache (benchmarks report
-        these: a healthy connected run shows hits >> misses)."""
+        """Hit/miss counters of the pod compile cache plus the row-pack
+        assembly split (benchmarks report these: a healthy connected run
+        shows hits >> misses and rows_stacked >> rows_filled — fill-only
+        cycles do no per-pod fill work at all)."""
         return {"hits": self._encoder.pod_cache_hits,
-                "misses": self._encoder.pod_cache_misses}
+                "misses": self._encoder.pod_cache_misses,
+                "rows_stacked": self._encoder.pod_rows_stacked,
+                "rows_filled": self._encoder.pod_rows_filled}
 
     def overlay_nominated(self, ct, meta, entries, min_m: int = 0):
         """ct with nominated-pod reservations applied (encoder.with_nominated);

@@ -205,14 +205,17 @@ def _static_filters_program(ct, pb):
 
 def tensor_static_masks(nodes, preemptors, ct=None, meta=None,
                         bound_pods=None, encode_pods=None,
-                        min_p: int = 1) -> "np.ndarray":
+                        min_p: int = 1, mesh=None) -> "np.ndarray":
     """[Q,N] victim-independent feasibility via the encoded filter masks —
     ONE device program instead of Q x N host-side oracle probes, which
     dominated wave setup at fleet scale. Pass an already-encoded cluster
     (``ct``/``meta`` + an ``encode_pods(pods, meta, min_p=...)`` callable —
     e.g. the scheduler cache's) to skip the fresh encode. ``min_p`` pins
     the pod-batch bucket (WAVE_BUCKET) so varying wave sizes share one
-    compiled program."""
+    compiled program. ``mesh``: optional ("pods","nodes") Mesh — the
+    [Q,N]-dominant filter program (the preempt/masks span) runs sharded
+    under GSPMD, cluster split on "nodes", the preemptor batch on "pods";
+    the [Q,N] result mask is O(Q*N) bools either way."""
     import jax
     import numpy as np
     if ct is None:
@@ -221,7 +224,13 @@ def tensor_static_masks(nodes, preemptors, ct=None, meta=None,
         ct, meta = enc.encode_cluster(nodes, bound_pods or [])
         encode_pods = enc.encode_pods
     pb = encode_pods(preemptors, meta, min_p=min_p)
-    mask = np.asarray(jax.device_get(_static_filters_program(ct, pb)))
+    if mesh is not None:
+        from kubernetes_tpu.parallel.mesh import shard_batch, shard_cluster
+        with mesh:
+            mask = np.asarray(jax.device_get(_static_filters_program(
+                shard_cluster(mesh, ct), shard_batch(mesh, pb))))
+    else:
+        mask = np.asarray(jax.device_get(_static_filters_program(ct, pb)))
     return mask[:len(preemptors), :len(nodes)]
 
 
@@ -232,8 +241,8 @@ WAVE_BUCKET = 256
 
 def preempt_wave(nodes: list[Node], bound_pods: list[Pod],
                  preemptors: list[Pod], pdbs: Optional[list[dict]] = None,
-                 dra=None, static_masks=None, min_q: int = 1
-                 ) -> list[Optional[PreemptionResult]]:
+                 dra=None, static_masks=None, min_q: int = 1,
+                 mesh=None) -> list[Optional[PreemptionResult]]:
     """Resolve a WAVE of preemptors with sequential-commit semantics in one
     device program + one shared host simulation.
 
@@ -258,7 +267,7 @@ def preempt_wave(nodes: list[Node], bound_pods: list[Pod],
         try:
             static_masks = tensor_static_masks(nodes, preemptors,
                                                bound_pods=bound_pods,
-                                               min_p=min_q)
+                                               min_p=min_q, mesh=mesh)
         except Exception:
             _LOG.exception("tensor static masks failed; using host helper")
             static_masks = None  # host helper path inside dry_run_wave

@@ -30,13 +30,23 @@ from kubernetes_tpu.sched.queue import (
 from kubernetes_tpu.sched.scheduler import Scheduler
 from kubernetes_tpu.store.store import ADDED, DELETED, MODIFIED
 
+# Published like the autoscaler's cluster-autoscaler-status: one ConfigMap
+# other components (and ``ktpu status``) read for the live deployment shape
+# — most importantly the active device mesh.
+STATUS_CONFIGMAP = "kubernetes-tpu-scheduler-status"
+
 
 class SchedulerRunner:
     """Owns informers, cache, queue, scheduler; drives the loop."""
 
     def __init__(self, client, cfg: Optional[SchedulerConfiguration] = None,
-                 identity: str = "kubernetes-tpu-scheduler", registry=None):
+                 identity: str = "kubernetes-tpu-scheduler", registry=None,
+                 status_namespace: str = "default"):
         self.client = client
+        # where publish_status writes its ConfigMap (same shape as the
+        # autoscaler's status_namespace: RBAC commonly restricts writes to
+        # the component's own namespace; ktpu -n <ns> status must match)
+        self.status_namespace = status_namespace
         if hasattr(client, "default_user_agent"):
             client.default_user_agent("kube-scheduler")
         # GIL tuning for the connected deployment shape: informer bursts
@@ -346,7 +356,47 @@ class SchedulerRunner:
             self._threads.append(t)
         elif start_loop:
             self._start_loop()
+        self.publish_status()
         return self
+
+    def publish_status(self) -> None:
+        """Publish the deployment-shape status ConfigMap (``ktpu status``
+        reads it): active mesh shape/devices and the batching knobs. Best
+        effort — status must never take the scheduler down."""
+        import json
+        mesh = self.scheduler._mesh
+        status = {
+            "identity": self.identity,
+            "mesh": ({"shape": dict(zip(mesh.axis_names,
+                                        (int(s) for s in mesh.devices.shape))),
+                      "devices": int(mesh.devices.size),
+                      "deviceIds": [int(d.id) for d in mesh.devices.flat]}
+                     if mesh is not None else None),
+            "batchSize": self.cfg.batch_size,
+            "maxDrainBatches": self.cfg.max_drain_batches,
+            "pipelineDepth": self.cfg.pipeline_depth,
+            "profiles": [p.scheduler_name for p in self.cfg.profiles],
+        }
+        body = {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": STATUS_CONFIGMAP,
+                         "namespace": self.status_namespace},
+            "data": {"status": json.dumps(status, indent=1)},
+        }
+        cms = self.client.resource("configmaps", self.status_namespace)
+        try:
+            current = cms.get(STATUS_CONFIGMAP)
+            current["data"] = body["data"]
+            cms.update(current)
+        except ApiError as e:
+            if e.code != 404:
+                return
+            try:
+                cms.create(body)
+            except ApiError:
+                pass
+        except Exception:
+            pass
 
     def _start_loop(self):
         # Chain terms: if the previous term's loop is still draining (e.g.

@@ -14,6 +14,7 @@ Profiles: pods are grouped by spec.schedulerName; unknown names are ignored
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import queue as queue_mod
 import threading
@@ -27,10 +28,13 @@ from kubernetes_tpu.config.types import SchedulerConfiguration
 from kubernetes_tpu.metrics.registry import (
     ATTEMPT_DURATION,
     BATCH_DURATION,
+    DRAIN_SHARD_MS,
     GANG_ROUNDS,
+    MESH_DEVICES,
     PIPELINE_DEPTH,
     PIPELINE_INFLIGHT,
     QUEUE_DEPTH,
+    RESOLVE_BYTES,
     SCHEDULE_ATTEMPTS,
 )
 from kubernetes_tpu.models.gang import gang_schedule
@@ -84,6 +88,41 @@ class Scheduler:
         # of the cluster encoding, valid while the only pending cache deltas
         # are assumes this loop folded on device
         self._drain_ctx = None
+        # ---- device mesh (multi-chip scheduling) -------------------------
+        # cfg.meshShape / KTPU_MESH arm a ("pods","nodes") mesh: the drain's
+        # cluster encoding device_puts SHARDED (node axis split), pod stacks
+        # split on "pods", and the jitted programs lower to GSPMD
+        # collectives. _mesh_epoch bumps on every reshape; the drain context
+        # records the epoch it was staged under, so a reshape forces a
+        # rebuild instead of patching arrays whose layout no longer matches.
+        self._mesh = None
+        self._mesh_epoch = 0
+        mesh_shape = cfg.mesh_shape
+        env_mesh = _os.environ.get("KTPU_MESH")
+        if env_mesh is not None:
+            from kubernetes_tpu.config.types import ValidationError, validate
+            from kubernetes_tpu.parallel.mesh import parse_mesh_shape
+            try:
+                env_shape = parse_mesh_shape(env_mesh)
+                # same rules the YAML path enforces (pow2 axes, pods axis
+                # divides batchSize) — the env knob must not smuggle in a
+                # shape validate() would have rejected at construction
+                validate(dataclasses.replace(cfg, mesh_shape=env_shape))
+                mesh_shape = env_shape
+            except (ValidationError, ValueError) as e:
+                _LOG.warning("ignoring invalid KTPU_MESH=%r: %s",
+                             env_mesh, e)
+        if mesh_shape is not None and mesh_shape[0] * mesh_shape[1] > 1:
+            from kubernetes_tpu.parallel.mesh import mesh_from_shape
+            try:
+                self.set_mesh(mesh_from_shape(mesh_shape))
+            except Exception:
+                # fewer devices than configured (or no backend yet): run
+                # single-device rather than refuse to schedule — the mesh is
+                # a throughput knob, not a correctness requirement
+                _LOG.warning("mesh shape %s unavailable; running "
+                             "single-device", mesh_shape, exc_info=True)
+        MESH_DEVICES.set(self._mesh.devices.size if self._mesh else 1)
         # context lifecycle counters (benchmarks report these: a healthy
         # churn run shows patches >> rebuilds)
         self.ctx_stats = {"patches": 0, "rebuilds": 0, "unfit": 0,
@@ -153,6 +192,33 @@ class Scheduler:
                 raise ValueError(
                     f"profile {prof.scheduler_name!r} references "
                     f"unregistered out-of-tree plugins: {sorted(unknown)}")
+
+    # ---- device mesh -----------------------------------------------------
+
+    def set_mesh(self, mesh) -> None:
+        """Install (or drop, with ``None``) the scheduling mesh. Bumps the
+        mesh epoch so a resident drain context staged under the OLD layout
+        rebuilds at its next dispatch — patching sharded arrays with a
+        stale-layout patch would be silently wrong, never just slow."""
+        self._mesh = mesh
+        self._mesh_epoch += 1
+        self.cache.set_mesh(mesh)
+        MESH_DEVICES.set(mesh.devices.size if mesh is not None else 1)
+
+    def _mesh_scope(self):
+        """Context manager activating the mesh for a jitted dispatch (a
+        no-op scope when single-device)."""
+        if self._mesh is None:
+            import contextlib
+            return contextlib.nullcontext()
+        return self._mesh
+
+    @property
+    def _winners_sharding(self):
+        if self._mesh is None:
+            return None
+        from kubernetes_tpu.parallel.mesh import replicated
+        return replicated(self._mesh)
 
     # ---- external nominations -------------------------------------------
 
@@ -398,7 +464,8 @@ class Scheduler:
                 max_rounds=self.cfg.max_gang_rounds,
                 weights=profile.weights(),
                 enabled_filters=profile.enabled_filters,
-                ext_mask=ext_mask, ext_scores=ext_scores, plugins=plugins)
+                ext_mask=ext_mask, ext_scores=ext_scores, plugins=plugins,
+                mesh=self._mesh)
         GANG_ROUNDS.observe(rounds)
         if sanity.check_enabled():
             for problem in sanity.check_assignment(assignment, len(nodes)):
@@ -496,6 +563,14 @@ class Scheduler:
         ctx = self._drain_ctx
         use_ctx = False
         n_prev = 0
+        if (ctx is not None
+                and ctx.get("mesh_epoch") != self._mesh_epoch):
+            # mesh reshape since this context was staged: its arrays carry
+            # the OLD layout, and a patch compiled against them would apply
+            # shard-inconsistently. Epoch mismatch always rebuilds.
+            self._ctx_reason("mesh_reshape")
+            n_prev += self._resolve_pending()
+            self._drain_ctx = ctx = None
         if ctx is not None and ctx["profile"] == profile.scheduler_name:
             cs = ctx["cs"]
             known = set(ctx["meta"].resources)
@@ -555,7 +630,12 @@ class Scheduler:
                         if (patch is not None
                                 and ctx["fill_bound"] + len(pods)
                                 <= cs.top):
-                            with TRACER.span("scheduler/ctx_patch_apply"):
+                            with TRACER.span("scheduler/ctx_patch_apply"), \
+                                    self._mesh_scope():
+                                # sharded context: the scatter program runs
+                                # under the mesh — the tiny patch arrays
+                                # replicate, the donated sharded buffers
+                                # keep their layout (epoch-checked above)
                                 ctx["ct"] = apply_ctx_patch(ctx["ct"], patch)
                             ctx["seq"] = new_seq
                             use_ctx = True
@@ -605,7 +685,8 @@ class Scheduler:
         if not use_ctx:
             from kubernetes_tpu.encode.patch import fork_meta
             built = build_drain_context(ct, pbs,
-                                        nom_bucket=DRAIN_NOM_BUCKET)
+                                        nom_bucket=DRAIN_NOM_BUCKET,
+                                        mesh=self._mesh)
             cs = self.cache.patch_state_fork()
             if built is None or cs is None:
                 # base slots not packed (host patches left holes): run the
@@ -615,12 +696,15 @@ class Scheduler:
                     self._schedule_group(profile, c, slot_headroom)
                     for c in chunks)
             ct_dev, e0, fill = built
+            from kubernetes_tpu.encode.patch import sync_resident_widths
+            sync_resident_widths(cs, ct_dev)
             self.ctx_stats["rebuilds"] += 1
             ctx = {"ct": ct_dev, "e0": e0, "fill_dev": fill,
                    "fill_bound": fill, "meta": fork_meta(meta),
                    "nodes": nodes, "cs": cs, "seq": seq0,
                    "pb_shape": batch_shapes(pb_stack),
-                   "profile": profile.scheduler_name}
+                   "profile": profile.scheduler_name,
+                   "mesh_epoch": self._mesh_epoch}
             meta = ctx["meta"]
             if nom_target:
                 patch = self.cache.compile_ctx_patch(
@@ -631,7 +715,8 @@ class Scheduler:
                     return n_prev + sum(
                         self._schedule_group(profile, c, slot_headroom)
                         for c in chunks)
-                ctx["ct"] = apply_ctx_patch(ctx["ct"], patch)
+                with self._mesh_scope():
+                    ctx["ct"] = apply_ctx_patch(ctx["ct"], patch)
             self._drain_ctx = ctx
         else:
             # pin the batch to the context's compiled shapes: pop-dependent
@@ -658,14 +743,20 @@ class Scheduler:
                                     round(time.time() - t0, 3)))
         with TRACER.span("scheduler/gang_dispatch",
                          pods=len(pods), nodes=len(nodes),
-                         depth=len(self._pending) + 1):
+                         depth=len(self._pending) + 1), self._mesh_scope():
+            # mesh on: the batch stack ships pre-sharded on "pods" (the
+            # context's cluster arrays are already resident split on
+            # "nodes"), and the winners view is pinned replicated so the
+            # resolve fetch stays O(P)
             assignments, rounds, new_ct, new_fill = drain_step(
-                ctx["ct"], pb_stack, ctx["fill_dev"], e0=ctx["e0"],
+                ctx["ct"], self.cache.stage_drain_batch(pb_stack),
+                ctx["fill_dev"], e0=ctx["e0"],
                 seed=self.cfg.seed, fit_strategy=profile.fit_strategy,
                 topo_keys=meta.topo_keys,
                 weights=tuple(sorted(profile.weights().items())),
                 enabled_filters=tuple(sorted(profile.enabled_filters or ())),
-                max_rounds=self.cfg.max_gang_rounds, plugins=plugins)
+                max_rounds=self.cfg.max_gang_rounds, plugins=plugins,
+                winners_sharding=self._winners_sharding)
         ctx["ct"] = new_ct
         ctx["fill_dev"] = new_fill
         ctx["fill_bound"] += len(pods)
@@ -729,6 +820,7 @@ class Scheduler:
         import jax
         import numpy as np
         from kubernetes_tpu.utils.tracing import TRACER
+        t_wait = time.time()
         with BATCH_DURATION.time(), TRACER.span(
                 "scheduler/resolve_wait", depth=len(self._pending) + 1):
             # fill_bound is maintained purely by the dispatch-side
@@ -744,6 +836,14 @@ class Scheduler:
             if res is None:  # resolver off or its fetch failed: go inline
                 res = jax.device_get((pend["assignments"], pend["rounds"]))
             assignments, rounds = res
+        wait_ms = round((time.time() - t_wait) * 1000.0, 3)
+        RESOLVE_BYTES.set(np.asarray(assignments).nbytes
+                          + np.asarray(rounds).nbytes)
+        # the drain is ONE SPMD program — every shard runs it lock-step, so
+        # there is exactly one honest wall time (per-shard labels would
+        # duplicate it N ways and leave stale series after a reshape);
+        # stragglers surface in collective time, which this number includes
+        DRAIN_SHARD_MS.set(wait_ms)
         ctx, meta, profile = pend["ctx"], pend["meta"], pend["profile"]
         active = self._drain_ctx is ctx
         pend_count = sum(len(c) for c in pend["chunks"])
@@ -868,7 +968,8 @@ class Scheduler:
                for c in chunks]
         pb_stack = jax.tree_util.tree_map(
             lambda *xs: np.stack(xs), *unify_batches(pbs))
-        built = build_drain_context(ct, pbs, nom_bucket=DRAIN_NOM_BUCKET)
+        built = build_drain_context(ct, pbs, nom_bucket=DRAIN_NOM_BUCKET,
+                                    mesh=self._mesh)
         if built is None:
             return False
         ct_dev, e0, fill = built
@@ -885,31 +986,37 @@ class Scheduler:
                   topo_keys=meta.topo_keys,
                   weights=tuple(sorted(profile.weights().items())),
                   enabled_filters=tuple(sorted(profile.enabled_filters or ())),
-                  max_rounds=self.cfg.max_gang_rounds, plugins=plugins)
-        _, _, ct_dev2, fill2 = drain_step(ct_dev, pb_stack, fill, **kw)
-        # second call matches the steady-state variant exactly: donated-
-        # buffer layouts AND a device-resident fill scalar
-        _, _, ct_dev3, fill3 = drain_step(ct_dev2, pb_stack, fill2, **kw)
-        # rehearse the real churn alternation — drain -> patch -> drain —
-        # so BOTH programs compile at each other's output layouts (a layout
-        # mismatch recompiles drain_step for seconds inside the measured
-        # window) at the standard patch write buckets
-        try:
-            from kubernetes_tpu.models.gang import apply_ctx_patch
-            cs_warm = self.cache.patch_state_fork()
-            if cs_warm is not None:
-                warm_patch = self.cache.compile_ctx_patch(
-                    fork_meta(meta), cs_warm, [], {}, DRAIN_NOM_BUCKET)
-                if warm_patch is not None:
-                    ct_dev4 = apply_ctx_patch(ct_dev3, warm_patch)
-                    drain_step(ct_dev4, pb_stack, fill3, **kw)
-        except Exception:
-            _LOG.exception("patch-program warmup failed (non-fatal)")
-        built = build_drain_context(ct, pbs, nom_bucket=DRAIN_NOM_BUCKET)
+                  max_rounds=self.cfg.max_gang_rounds, plugins=plugins,
+                  winners_sharding=self._winners_sharding)
+        pb_staged = self.cache.stage_drain_batch(pb_stack)
+        with self._mesh_scope():
+            _, _, ct_dev2, fill2 = drain_step(ct_dev, pb_staged, fill, **kw)
+            # second call matches the steady-state variant exactly: donated-
+            # buffer layouts AND a device-resident fill scalar
+            _, _, ct_dev3, fill3 = drain_step(ct_dev2, pb_staged, fill2, **kw)
+            # rehearse the real churn alternation — drain -> patch -> drain —
+            # so BOTH programs compile at each other's output layouts (a
+            # layout mismatch recompiles drain_step for seconds inside the
+            # measured window) at the standard patch write buckets
+            try:
+                from kubernetes_tpu.models.gang import apply_ctx_patch
+                cs_warm = self.cache.patch_state_fork()
+                if cs_warm is not None:
+                    warm_patch = self.cache.compile_ctx_patch(
+                        fork_meta(meta), cs_warm, [], {}, DRAIN_NOM_BUCKET)
+                    if warm_patch is not None:
+                        ct_dev4 = apply_ctx_patch(ct_dev3, warm_patch)
+                        drain_step(ct_dev4, pb_staged, fill3, **kw)
+            except Exception:
+                _LOG.exception("patch-program warmup failed (non-fatal)")
+        built = build_drain_context(ct, pbs, nom_bucket=DRAIN_NOM_BUCKET,
+                                    mesh=self._mesh)
         cs = self.cache.patch_state_fork()
         if built is None or cs is None:
             return False
         ct_dev, e0, fill = built
+        from kubernetes_tpu.encode.patch import sync_resident_widths
+        sync_resident_widths(cs, ct_dev)
         # the context upload streams asynchronously over the (remote) device
         # link; returning before it lands makes the FIRST real drain eat the
         # remaining transfer (~seconds at 10k-scale encodings) inside the
@@ -921,7 +1028,8 @@ class Scheduler:
                            "cs": cs,
                            "seq": self.cache.last_snapshot_seq(),
                            "pb_shape": batch_shapes(pb_stack),
-                           "profile": profile.scheduler_name}
+                           "profile": profile.scheduler_name,
+                           "mesh_epoch": self._mesh_epoch}
         return True
 
     # ---- failure path: PostFilter / preemption ---------------------------
@@ -1023,7 +1131,7 @@ class Scheduler:
                 masks = preemption_mod.tensor_static_masks(
                     nodes, views, ct=ct, meta=meta,
                     encode_pods=self.cache.encode_pods,
-                    min_p=preemption_mod.WAVE_BUCKET)
+                    min_p=preemption_mod.WAVE_BUCKET, mesh=self._mesh)
         except Exception:
             _LOG.exception("static masks from resident encoding failed; "
                            "preempt_wave will re-encode")
@@ -1033,7 +1141,7 @@ class Scheduler:
             results = preemption_mod.preempt_wave(
                 nodes, bound, views, pdbs=self.pdb_lister(),
                 dra=self.cache.dra_catalog, static_masks=masks,
-                min_q=preemption_mod.WAVE_BUCKET)
+                min_q=preemption_mod.WAVE_BUCKET, mesh=self._mesh)
         out: list[Optional[str]] = []
         with TRACER.span("preempt/evict"):
             for res in results:

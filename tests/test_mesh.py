@@ -59,7 +59,7 @@ def _mesh(pods_axis=2):
     return make_mesh(jax.devices()[:8], pods_axis=pods_axis)
 
 
-def _sharded_backend_usable():
+def _sharded_backend_usable(mesh_shape=(2, 4)):
     """Gate the sharding suite on a backend that can actually run it.
 
     Two distinct reasons to skip, both environmental rather than product
@@ -71,9 +71,17 @@ def _sharded_backend_usable():
     cluster shape the parity cases use, sharded and unsharded, and diffs
     the choice vector — a crash or drift means the cases below would fail
     for the same environmental reason, so the suite skips deterministically
-    instead of failing tier-1 on a jaxlib regression."""
-    if jax.device_count() < 2 or len(jax.devices()) < 8:
-        return False, "needs >=2 real devices (8 virtual for the 2x4 mesh)"
+    instead of failing tier-1 on a jaxlib regression.
+
+    ``mesh_shape``: the (pods, nodes) mesh the caller will actually use —
+    miscompiles are SHAPE-SPECIFIC (a jaxlib that breaks the 2x4 mesh can
+    run 1x2 fine), so each suite canaries at its own shape. Verdicts are
+    cached per shape via ``_sharded_backend_verdict``."""
+    pods_axis, nodes_axis = mesh_shape
+    want = pods_axis * nodes_axis
+    if jax.device_count() < 2 or len(jax.devices()) < want:
+        return False, (f"needs >=2 real devices ({want} virtual for the "
+                       f"{pods_axis}x{nodes_axis} mesh)")
     # the UNSHARDED half runs outside the guard: encode/schedule_step
     # breakage is a product bug and must fail collection loudly — only the
     # sharded execution may be excused as environmental
@@ -81,7 +89,7 @@ def _sharded_backend_usable():
     ct, pb, meta = _encode(nodes, pods)
     base = schedule_step(ct, pb, seed=0, topo_keys=meta.topo_keys)
     try:
-        mesh = _mesh()
+        mesh = make_mesh(jax.devices()[:want], pods_axis=pods_axis)
         with mesh:
             out = schedule_step(shard_cluster(mesh, ct),
                                 shard_batch(mesh, pb),
@@ -96,9 +104,9 @@ def _sharded_backend_usable():
                        f"{type(e).__name__}")
 
 
-@functools.lru_cache(maxsize=1)
-def _sharded_backend_verdict():
-    return _sharded_backend_usable()
+@functools.lru_cache(maxsize=None)
+def _sharded_backend_verdict(mesh_shape=(2, 4)):
+    return _sharded_backend_usable(mesh_shape)
 
 
 @pytest.fixture(scope="module", autouse=True)
