@@ -19,7 +19,10 @@ Env knobs: BENCH_CASE (only this case), BENCH_SCALE (default 1.0),
 BENCH_BATCH (default 1024), BENCH_CONNECTED=0 to skip the connected run,
 BENCH_CONNECTED_PODS/NODES (default 2000/1000), BENCH_CONNECTED_PIPELINE
 (dispatch-pipeline depth for the connected run — sweep it to find the
-knee; unset = SchedulerConfiguration.pipeline_depth default).
+knee; unset = SchedulerConfiguration.pipeline_depth default),
+BENCH_CHAOS=0 to skip the ChaosChurn case (BENCH_CHAOS_PODS/NODES size
+it; KTPU_CHAOS_SEED replays a failing fault schedule — the case exits
+the bench non-zero if any pod is lost under faults).
 """
 
 from __future__ import annotations
@@ -125,6 +128,21 @@ def main():
         log("[bench] " + json.dumps(connected_mesh))
         _write_multichip(here, connected_mesh, log)
 
+    chaos_churn = None
+    if os.environ.get("BENCH_CHAOS", "1") != "0" and not only_case:
+        # churn workload under the default fault schedule: API storms,
+        # watch gaps, a breaker-tripping device burst, thread stalls. The
+        # seed is logged and env-overridable (KTPU_CHAOS_SEED) so any
+        # failure replays deterministically; the gate below exits non-zero
+        # if a single pod was lost.
+        from benchmarks.connected import run_chaos_churn
+        log("[bench] chaos churn run ...")
+        chaos_churn = run_chaos_churn(
+            n_pods=int(os.environ.get("BENCH_CHAOS_PODS", "2000")),
+            n_nodes=int(os.environ.get("BENCH_CHAOS_NODES", "1000")),
+            log=log)
+        log("[bench] " + json.dumps(chaos_churn))
+
     preemption = None
     if os.environ.get("BENCH_PREEMPTION", "1") != "0" and not only_case:
         from benchmarks.preemption_bench import run_preemption
@@ -202,6 +220,7 @@ def main():
              **({"churn_api_ops": r["churn_api_ops"], "connected": True}
                 if "churn_api_ops" in r else {})} for r in results],
         "connected": connected,
+        "chaos_churn": chaos_churn,
         "connected_mesh": connected_mesh,
         "preemption": preemption,
         "connected_preemption": connected_preemption,
@@ -209,6 +228,14 @@ def main():
         "pallas": pallas,
     }
     print(json.dumps(out))
+    if chaos_churn is not None and (chaos_churn.get("chaos") or {}) \
+            .get("lost"):
+        # hard gate: pods lost under the fault schedule means self-healing
+        # failed somewhere — replay with the logged seed to localize it
+        print(f"[bench] FATAL: ChaosChurn lost "
+              f"{chaos_churn['chaos']['lost']} pods "
+              f"(seed {chaos_churn['chaos']['seed']})", file=sys.stderr)
+        sys.exit(1)
     if (connected_mesh is not None
             and connected_mesh.get("parity") is not None
             and not connected_mesh["parity"].get("ok")):

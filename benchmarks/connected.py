@@ -108,6 +108,7 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
                   timeout: float = 300.0, churn: bool = False,
                   churn_period_s: float = 0.1, min_churn_ops: int = 500,
                   pipeline_depth: int | None = None,
+                  chaos_seed: int | None = None,
                   log=lambda *a: None) -> dict:
     from kubernetes_tpu.client.clientset import HTTPClient
     from kubernetes_tpu.config.types import SchedulerConfiguration
@@ -121,6 +122,7 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
     server.start()
     port = parent.recv()
     url = f"http://127.0.0.1:{port}"
+    schedule = device_chaos = None
     try:
         seed_client = HTTPClient(url, timeout=120.0)
         nodes, pods = mixed_heterogeneous(pods=n_pods, nodes=n_nodes)
@@ -134,12 +136,39 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
             # clamp like the scheduler does, so the reported depth is the
             # depth that actually ran (depth 0 would silently run as 1)
             cfg_kw["pipeline_depth"] = max(1, int(pipeline_depth))
-        runner = SchedulerRunner(HTTPClient(url),
+        sched_client = HTTPClient(url)
+        if chaos_seed is not None:
+            # ChaosChurn: the SCHEDULER's transport is chaos-wrapped (the
+            # harness's own seed/verify clients stay clean — the bench
+            # owns ground truth), device + thread faults install after
+            # warmup so the measured window eats them, and the breaker
+            # cooldown shrinks so half-open recovery happens inside the
+            # window. The seed is logged: any failure replays from it.
+            from kubernetes_tpu.chaos import ChaosClient, FaultSchedule
+            schedule = FaultSchedule.generate(chaos_seed, profile="churn")
+            log(f"  chaos schedule armed (seed {chaos_seed}; "
+                f"KTPU_CHAOS_SEED replays it)")
+            sched_client = ChaosClient(sched_client, schedule)
+            cfg_kw["breaker_cooldown_s"] = 5.0
+        runner = SchedulerRunner(sched_client,
                                  SchedulerConfiguration(**cfg_kw))
         # informers first (nodes sync into the scheduler cache); the loop
         # starts after pod creation so the first pop drains a deep backlog
         runner.start(start_loop=False)
         ctx_armed = _warm_jit(runner, pods, batch_size, n_pods, log)
+        chaos_base: dict = {}
+        if schedule is not None:
+            from kubernetes_tpu.chaos import (DeviceChaos, ThreadChaos,
+                                              hooks)
+            from kubernetes_tpu.metrics.registry import (BIND_RETRIES,
+                                                         LOOP_ERRORS)
+            device_chaos = DeviceChaos(schedule).install()
+            hooks.install(ThreadChaos(schedule))
+            # the registry is process-global and earlier bench phases ran
+            # in this process: snapshot now, diff at report time, so the
+            # chaos JSON attributes only THIS window's errors/retries
+            chaos_base = {"bind_retries": BIND_RETRIES.get(),
+                          "loop_errors": LOOP_ERRORS.items()}
 
         _, rv0 = seed_client.pods("default").list_rv()
         count = ctx.Value("i", 0)
@@ -250,9 +279,17 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
                    and time.time() < budget_deadline):
                 time.sleep(0.05)
             churn_stop.set()
+        if schedule is not None:
+            from kubernetes_tpu.chaos import hooks
+            hooks.uninstall()
+            if device_chaos is not None:
+                device_chaos.uninstall()
+                device_chaos = None
         runner.stop()
         out = {
-            "case": "ConnectedChurn" if churn else "ConnectedScheduler",
+            "case": ("ChaosChurn" if chaos_seed is not None
+                     else "ConnectedChurn" if churn
+                     else "ConnectedScheduler"),
             "workload": f"{n_pods}x{n_nodes}",
             "SchedulingThroughput": round(bound / dt, 1) if dt > 0 else 0.0,
             "bound": bound, "pods": n_pods, "nodes": n_nodes,
@@ -269,6 +306,27 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         }
         if churn:
             out["churn_api_ops"] = churn_stats.get("ops", 0)
+        if schedule is not None:
+            from kubernetes_tpu.metrics.registry import (BIND_RETRIES,
+                                                         LOOP_ERRORS)
+            base_errs = chaos_base.get("loop_errors", {})
+            window_errs = {}
+            for key, v in LOOP_ERRORS.items().items():
+                dv = v - base_errs.get(key, 0.0)
+                if dv:
+                    window_errs["".join(k for _, k in key)] = dv
+            # the gate's inputs: lost = pods the run failed to bind (the
+            # caller exits non-zero on any), recovery spans per fault
+            # class, and the same resilience aggregation ktpu status shows
+            out["chaos"] = {
+                "seed": schedule.seed,
+                "lost": n_pods - bound,
+                "recovery": schedule.report(),
+                "resilience": runner._resilience_status(),
+                "bind_retries": BIND_RETRIES.get()
+                - chaos_base.get("bind_retries", 0.0),
+                "loop_errors": window_errs,
+            }
         # pipeline + incremental-encode attribution (measured-window
         # snapshot, like p99/spans): depth knob in effect, and how many pod
         # rows the hot path served from the informer-time compile cache
@@ -278,6 +336,11 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         out["attempt_buckets"] = attempt_buckets
         return out
     finally:
+        if schedule is not None:  # crash path: never leak installed chaos
+            from kubernetes_tpu.chaos import hooks as _hooks
+            _hooks.uninstall()
+            if device_chaos is not None:
+                device_chaos.uninstall()
         try:
             parent.send("stop")
         except Exception:
@@ -285,6 +348,26 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         server.join(timeout=5.0)
         if server.is_alive():
             server.terminate()
+
+
+def run_chaos_churn(n_pods: int = 2000, n_nodes: int = 1000,
+                    batch_size: int = 512, drain_batches: int = 2,
+                    timeout: float = 300.0, seed: int | None = None,
+                    log=lambda *a: None) -> dict:
+    """ChaosChurn: the standard churn workload under the default fault
+    schedule — API error/conflict/latency storms on the scheduler's
+    transport, truncated watch streams + forced relists, a device-failure
+    burst that trips the circuit breaker (and must half-open back), and
+    thread stalls. The gate is absolute: 100% of pods must still bind;
+    ``chaos.lost`` > 0 fails the bench run (bench.py exits non-zero).
+    Recovery spans per fault class land in the result JSON."""
+    from kubernetes_tpu.chaos import seed_from_env
+    if seed is None:
+        seed = seed_from_env(0)
+    return run_connected(n_pods=n_pods, n_nodes=n_nodes,
+                         batch_size=batch_size,
+                         drain_batches=drain_batches, timeout=timeout,
+                         churn=True, chaos_seed=seed, log=log)
 
 
 def drain_parity_check(mesh_shape: tuple[int, int], n_nodes: int = 1024,

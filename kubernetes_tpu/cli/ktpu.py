@@ -612,6 +612,18 @@ def cmd_status(client: HTTPClient, args, out) -> int:
     out.write(f"Drain batches: {st.get('maxDrainBatches', '?')}\n")
     out.write(f"Pipeline:      {st.get('pipelineDepth', '?')} deep\n")
     out.write(f"Profiles:      {', '.join(st.get('profiles') or [])}\n")
+    res = st.get("resilience")
+    if res:
+        degraded = (res.get("degradedIndex") or 0) > 0
+        out.write(f"Degraded:      "
+                  f"{res.get('degradedMode') if degraded else 'no'} "
+                  f"(breaker trips: {res.get('breakerTrips', 0)}, "
+                  f"restores: {res.get('breakerRestores', 0)})\n")
+        out.write(f"Watchdog:      "
+                  f"{res.get('watchdogRestarts', 0)} restarts\n")
+        out.write(f"Last relist:   "
+                  f"{res.get('lastRelist') or 'never'} "
+                  f"(relists: {res.get('watchRelists', 0)})\n")
     return 0
 
 

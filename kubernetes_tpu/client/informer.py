@@ -9,13 +9,17 @@ and every controller feed from these.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Optional
 
 from kubernetes_tpu.api.selectors import compile_list_selector
 from kubernetes_tpu.client.clientset import ResourceClient
+from kubernetes_tpu.metrics.registry import LOOP_ERRORS, WATCH_RELISTS
 from kubernetes_tpu.store.store import ADDED, DELETED, MODIFIED, TooOld
+
+_LOG = logging.getLogger("kubernetes_tpu.client.informer")
 
 
 def meta_namespace_key(obj: dict) -> str:
@@ -107,6 +111,12 @@ class SharedInformer:
         self._stop = threading.Event()
         self._synced = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # relist-and-resync bookkeeping: every relist AFTER the initial
+        # sync means a watch gap healed (dropped/truncated stream, or a
+        # "resourceVersion too old" 410) — counted so chaos runs can
+        # assert the healing actually ran, surfaced in ktpu status
+        self.relists = 0
+        self.last_relist: Optional[float] = None
 
     def add_event_handler(self, fn: Callable):
         self._handlers.append(fn)
@@ -132,12 +142,23 @@ class SharedInformer:
         while not self._stop.is_set():
             try:
                 rv = self._list_and_notify()
+                if self._synced.is_set():
+                    # any list AFTER the first sync is a relist healing a
+                    # watch gap: the rebuilt store + the delta dispatch in
+                    # _list_and_notify are the resync
+                    self.relists += 1
+                    self.last_relist = time.time()
+                    WATCH_RELISTS.inc(
+                        {"resource": getattr(self.resource, "plural", "?")})
                 self._synced.set()
                 self._watch_loop(rv)
                 backoff = 0.1
             except TooOld:
                 continue  # immediate relist
             except Exception:
+                LOOP_ERRORS.inc({"site": "informer_listwatch"})
+                _LOG.debug("list/watch failed; backing off %.1fs",
+                           backoff, exc_info=True)
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
 
@@ -187,7 +208,14 @@ class SharedInformer:
             try:
                 fn(type_, obj, old)
             except Exception:
-                pass
+                # a handler that throws has dropped an event its component
+                # will never see again until a relist: count + log, never
+                # silently swallow (and never let one handler starve the
+                # rest)
+                LOOP_ERRORS.inc({"site": "informer_handler"})
+                _LOG.warning("informer handler failed on %s %s", type_,
+                             ((obj or {}).get("metadata") or {})
+                             .get("name", "?"), exc_info=True)
 
 
 class InformerFactory:

@@ -8,13 +8,17 @@ OnStoppedLeading) with ``resourcelock/leaselock.go`` semantics (holderIdentity
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
 from kubernetes_tpu.client.clientset import ApiError
+from kubernetes_tpu.metrics.registry import LOOP_ERRORS
 from kubernetes_tpu.store.store import AlreadyExists, Conflict, NotFound
+
+_LOG = logging.getLogger("kubernetes_tpu.client.leaderelection")
 
 
 @dataclass
@@ -78,27 +82,55 @@ class LeaderElector:
             return False
 
     def run(self, stop: Optional[threading.Event] = None):
-        """Block: acquire, then renew until lost or stopped."""
+        """Block: acquire, then renew until lost or stopped.
+
+        Hardened against the silent-exit gap: ``_try`` already absorbs
+        transport failures (an ApiError storm is just a missed renewal),
+        and a CALLBACK that raises — on_started_leading failing to spin up
+        the loop, on_stopped_leading tripping over partially-torn-down
+        state — is logged + counted and drops leadership for this term
+        instead of killing the elector thread. The next iteration backs
+        off one retry_period and re-contends, so the loop resumes
+        leadership as soon as the API (or the callback's precondition)
+        heals."""
         stop = stop or self._stop
         while not stop.is_set():
-            if self._try():
-                if not self.is_leader:
-                    self.is_leader = True
-                    if self.cfg.on_started_leading:
-                        self.cfg.on_started_leading()
-                deadline = time.time() + self.cfg.renew_deadline
-                while not stop.is_set():
-                    time.sleep(self.cfg.retry_period)
-                    if self._try():
-                        deadline = time.time() + self.cfg.renew_deadline
-                    elif time.time() > deadline:
-                        break
-                if self.is_leader:
+            try:
+                self._run_term(stop)
+            except Exception:
+                LOOP_ERRORS.inc({"site": "leader_elector"})
+                _LOG.exception("leader-election term failed; dropping "
+                               "leadership and re-contending")
+                self.is_leader = False
+                stop.wait(self.cfg.retry_period)
+
+    def _run_term(self, stop: threading.Event) -> None:
+        """One acquire -> renew -> release cycle (or a failed acquire)."""
+        if not self._try():
+            stop.wait(self.cfg.retry_period)
+            return
+        if not self.is_leader:
+            self.is_leader = True
+            if self.cfg.on_started_leading:
+                try:
+                    self.cfg.on_started_leading()
+                except Exception:
+                    # failed to take up the work: we hold the lease but
+                    # lead nothing — release and re-contend rather than
+                    # sitting as a zombie leader
                     self.is_leader = False
-                    if self.cfg.on_stopped_leading:
-                        self.cfg.on_stopped_leading()
-            else:
-                time.sleep(self.cfg.retry_period)
+                    raise
+        deadline = time.time() + self.cfg.renew_deadline
+        while not stop.is_set():
+            stop.wait(self.cfg.retry_period)
+            if self._try():
+                deadline = time.time() + self.cfg.renew_deadline
+            elif time.time() > deadline:
+                break
+        if self.is_leader:
+            self.is_leader = False
+            if self.cfg.on_stopped_leading:
+                self.cfg.on_stopped_leading()
 
     def stop(self):
         self._stop.set()

@@ -141,6 +141,23 @@ class SchedulerConfiguration:
     bind_workers: int = 16         # binding-cycle pool size (goroutine analog)
     parallelism: int = 16          # compat field; unused on TPU
     leader_elect: bool = False
+    # ---- self-healing knobs (sched/resilience.py) ------------------------
+    # Device circuit breaker: this many CONSECUTIVE device-program failures
+    # degrade one level (mesh -> single-device -> pure-numpy oracle); after
+    # the cooldown one cycle half-open-probes the better level back.
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 30.0
+    # Bind/status writes: extra in-request retries (full-jitter backoff)
+    # before a transient API failure falls through to the requeue path.
+    bind_retries: int = 2
+    bind_retry_backoff_s: float = 0.05
+    # Thread watchdog: sweep cadence, and how stale a busy thread's
+    # heartbeat may grow before it counts as stalled (generous default —
+    # a first-touch XLA compile can legitimately run minutes; a stalled
+    # verdict only SIGNALS the term to stop, the restart waits for the
+    # thread to actually exit).
+    watchdog_interval_s: float = 2.0
+    watchdog_stall_s: float = 600.0
 
     def profile_for(self, scheduler_name: str) -> Optional[Profile]:
         for p in self.profiles:
@@ -165,6 +182,12 @@ class SchedulerConfiguration:
             ("clientQPS", "client_qps"), ("parallelism", "parallelism"),
             ("bindWorkers", "bind_workers"),
             ("leaderElect", "leader_elect"),
+            ("breakerFailureThreshold", "breaker_threshold"),
+            ("breakerCooldownSeconds", "breaker_cooldown_s"),
+            ("bindRetries", "bind_retries"),
+            ("bindRetryBackoffSeconds", "bind_retry_backoff_s"),
+            ("watchdogIntervalSeconds", "watchdog_interval_s"),
+            ("watchdogStallSeconds", "watchdog_stall_s"),
         ]:
             if yaml_key in d:
                 setattr(cfg, attr, type(getattr(cfg, attr))(d[yaml_key]))
@@ -219,6 +242,18 @@ def validate(cfg: SchedulerConfiguration):
         raise ValidationError("pipelineDepth must be >= 1")
     if cfg.bind_workers < 1:
         raise ValidationError("bindWorkers must be >= 1")
+    if cfg.breaker_threshold < 1:
+        raise ValidationError("breakerFailureThreshold must be >= 1")
+    if cfg.breaker_cooldown_s < 0:
+        raise ValidationError("breakerCooldownSeconds must be >= 0")
+    if cfg.bind_retries < 0:
+        raise ValidationError("bindRetries must be >= 0")
+    if cfg.bind_retry_backoff_s < 0:
+        raise ValidationError("bindRetryBackoffSeconds must be >= 0")
+    if cfg.watchdog_interval_s <= 0:
+        raise ValidationError("watchdogIntervalSeconds must be > 0")
+    if cfg.watchdog_stall_s <= 0:
+        raise ValidationError("watchdogStallSeconds must be > 0")
     if cfg.mesh_shape is not None:
         if len(cfg.mesh_shape) != 2:
             raise ValidationError(

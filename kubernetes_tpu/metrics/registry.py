@@ -49,6 +49,12 @@ class Counter(_Metric):
     def get(self, labels: Optional[dict] = None) -> float:
         return self._values.get(_label_key(labels), 0.0)
 
+    def items(self) -> dict:
+        """Label-key tuple -> value snapshot (benchmarks diff two of these
+        to attribute counts to one measured window of a shared process)."""
+        with self._lock:
+            return dict(self._values)
+
     def expose(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
@@ -262,6 +268,42 @@ RESOLVE_BYTES = REGISTRY.gauge(
     "scheduler_resolve_bytes",
     "Bytes device_get moved host-side for the last drain's compact "
     "winners view (assignments + rounds; O(P), never sharded intermediates)")
+
+# Resilience / self-healing (the chaos harness asserts against these).
+# LOOP_ERRORS replaces the old bare `except: pass` swallows: every control
+# -loop failure is logged AND counted by site, so a chaos run can assert
+# "no silent swallow" by diffing this counter against its fault log.
+LOOP_ERRORS = REGISTRY.counter(
+    "scheduler_loop_errors_total",
+    "Control-loop failures absorbed (not swallowed) by site — e.g. "
+    "pod_decode, informer_handler, run_once, device_gang, device_drain, "
+    "device_preempt, resolver, resolver_wait, drain_resolve, "
+    "bind_worker, publish_status, leader_elector (open set: grep "
+    "LOOP_ERRORS.inc for the current sites)")
+WATCH_RELISTS = REGISTRY.counter(
+    "watch_relists_total",
+    "Reflector relist-and-resync passes after a watch gap (dropped or "
+    "truncated stream, resourceVersion too old) by resource")
+DEGRADED_MODE = REGISTRY.gauge(
+    "scheduler_degraded_mode",
+    "Device circuit-breaker degradation level: 0 = healthy (full tensor "
+    "path, mesh if configured), each +1 = one degrade step toward the "
+    "pure-numpy oracle")
+BREAKER_TRIPS = REGISTRY.counter(
+    "scheduler_breaker_trips_total",
+    "Circuit-breaker trips (one consecutive-failure threshold crossing = "
+    "one degrade step)")
+WATCHDOG_RESTARTS = REGISTRY.counter(
+    "scheduler_watchdog_restarts_total",
+    "Dead/stalled threads the watchdog restarted, by thread")
+EVENTS_DROPPED = REGISTRY.counter(
+    "events_dropped_total",
+    "Events dropped by the recorder (full queue or failed API write) — "
+    "events are best-effort, but silently so no longer")
+BIND_RETRIES = REGISTRY.counter(
+    "scheduler_bind_retries_total",
+    "Jittered retries of bind/status API writes that would previously "
+    "have failed straight through to a requeue")
 
 # Kubelet pod-sync health (pod_workers.go error bookkeeping analog).
 # Aggregate only — per-pod counts are PodWorkers.sync_errors(uid); a

@@ -347,6 +347,13 @@ class SchedulerCache:
         with self._lock:
             return pod_key in self._pods
 
+    def is_assumed_or_bound(self, pod_key: str) -> bool:
+        """True if the pod holds capacity (assumed OR confirmed) — the
+        mid-cycle rescue path must not requeue a pod whose placement this
+        very cycle already committed."""
+        with self._lock:
+            return pod_key in self._pods or pod_key in self._assumed
+
     def remove_pod(self, pod_key: str):
         with self._lock:
             existed = self._pods.pop(pod_key, None) or self._assumed.pop(pod_key, None)
@@ -588,6 +595,19 @@ class SchedulerCache:
         full snapshot from non-scheduling threads."""
         with self._lock:
             return self._nodes.get(name)
+
+    def list_nodes(self) -> list[Node]:
+        """Plain node list WITHOUT an encode pass — the oracle fallback
+        path reads typed objects only, so a broken device layer never
+        stands between it and the cluster state."""
+        with self._lock:
+            return list(self._nodes.values())
+
+    def namespace_labels(self) -> dict[str, dict]:
+        """Namespace -> labels view (the oracle's namespaceSelector
+        resolution source)."""
+        with self._lock:
+            return dict(self._namespace_labels)
 
     def delta_info(self) -> tuple[int, set, bool, bool]:
         """-> (generation, pending upsert keys, any deletes pending,

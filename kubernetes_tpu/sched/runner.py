@@ -19,8 +19,14 @@ from kubernetes_tpu.client.clientset import ApiError
 from kubernetes_tpu.client.informer import InformerFactory, meta_namespace_key
 from kubernetes_tpu.client.leaderelection import LeaderElectionConfig, LeaderElector
 from kubernetes_tpu.config.types import SchedulerConfiguration
-from kubernetes_tpu.metrics.registry import BIND_RESULTS
+from kubernetes_tpu.metrics.registry import (
+    BIND_RESULTS,
+    BIND_RETRIES,
+    LOOP_ERRORS,
+)
 from kubernetes_tpu.sched.cache import SchedulerCache
+from kubernetes_tpu.sched.resilience import ThreadWatchdog
+from kubernetes_tpu.utils.retry import with_retries
 from kubernetes_tpu.sched.queue import (
     EVENT_NODE_ADD,
     EVENT_NODE_UPDATE,
@@ -79,7 +85,22 @@ class SchedulerRunner:
         # of stacking a second concurrent loop.
         self._loop_stop: Optional[threading.Event] = None
         self._loop_thread: Optional[threading.Thread] = None
+        self._loop_expected = False
+        # serializes loop lifecycle transitions between the elector thread
+        # (start/stop on leadership changes) and the watchdog's revive —
+        # without it a revive racing a lost lease could restart a
+        # non-leader's loop
+        self._loop_lock = threading.Lock()
         self._scheduler_names = {p.scheduler_name for p in self.cfg.profiles}
+        # thread watchdog (sched/resilience.py): restarts a dead or
+        # stalled scheduling loop / drain resolver instead of letting the
+        # runner hang with a live process and a dead brain
+        self._watchdog = ThreadWatchdog(
+            interval_s=self.cfg.watchdog_interval_s,
+            stall_s=self.cfg.watchdog_stall_s)
+        self.scheduler.heartbeat = lambda: self._watchdog.beat("loop")
+        self.scheduler.resolver_heartbeat = \
+            lambda: self._watchdog.beat("resolver")
 
     # ---- event handlers (pkg/scheduler/eventhandlers.go analog) ----------
 
@@ -102,6 +123,12 @@ class SchedulerRunner:
         try:
             pod = Pod.from_dict(obj)
         except Exception:
+            # a pod we cannot decode is a pod we silently never schedule:
+            # count + log it loudly (chaos runs assert no silent swallow)
+            LOOP_ERRORS.inc({"site": "pod_decode"})
+            _LOG.warning("dropping undecodable pod event %s: %s", type_,
+                         (obj.get("metadata") or {}).get("name", "?"),
+                         exc_info=True)
             return
         if type_ == DELETED or pod.status.phase in ("Succeeded", "Failed"):
             # Terminal pods release their node's resources immediately; the
@@ -155,6 +182,10 @@ class SchedulerRunner:
         try:
             node = Node.from_dict(obj)
         except Exception:
+            LOOP_ERRORS.inc({"site": "node_decode"})
+            _LOG.warning("dropping undecodable node event %s: %s", type_,
+                         (obj.get("metadata") or {}).get("name", "?"),
+                         exc_info=True)
             return
         if type_ == DELETED:
             self.cache.remove_node(node.metadata.name)
@@ -181,6 +212,17 @@ class SchedulerRunner:
 
     # ---- binding via API (DefaultBinder analog) --------------------------
 
+    def _retry(self, fn):
+        """Jittered bounded retries for bind/status writes (utils/retry):
+        a transient API failure (connection reset, 5xx, 429) retries
+        in-request instead of failing straight through to a requeue —
+        semantic outcomes (404 gone, 409 conflict) still surface
+        immediately to the callers' existing handling."""
+        return with_retries(
+            fn, attempts=self.cfg.bind_retries + 1,
+            base_s=self.cfg.bind_retry_backoff_s,
+            on_retry=lambda e: BIND_RETRIES.inc())
+
     def _bind(self, pod: Pod, node_name: str) -> bool:
         # PreBind: claim allocations (dynamicresources.go bindClaim), then
         # volumes (volumebinding.go BindPodVolumes), then the binding itself.
@@ -197,8 +239,8 @@ class SchedulerRunner:
                 ns = (claim.get("metadata") or {}).get("namespace", "default")
                 patched = allocation_patch(claim, node_name, pod)
                 try:
-                    self.client.resource("resourceclaims", ns).update_status(
-                        patched)
+                    self._retry(lambda: self.client.resource(
+                        "resourceclaims", ns).update_status(patched))
                     allocated.append(patched)
                 except ApiError as e:
                     if e.code != 409:
@@ -216,7 +258,8 @@ class SchedulerRunner:
                 self._unreserve(allocated)
                 return False
         try:
-            self.client.pods(pod.metadata.namespace).bind(pod.metadata.name, node_name)
+            self._retry(lambda: self.client.pods(pod.metadata.namespace)
+                        .bind(pod.metadata.name, node_name))
             return True
         except ApiError as e:
             self._unreserve(allocated)
@@ -247,9 +290,10 @@ class SchedulerRunner:
         Per-item result: True (bound), False (failed — requeue), None (pod
         vanished mid-flight — nothing to requeue, e.g. a churn delete)."""
         try:
-            errs = self.client.pods("default").bind_many(
-                [(p.metadata.namespace, p.metadata.name, node)
-                 for p, node in pairs])
+            bindings = [(p.metadata.namespace, p.metadata.name, node)
+                        for p, node in pairs]
+            errs = self._retry(
+                lambda: self.client.pods("default").bind_many(bindings))
         except ApiError as e:
             BIND_RESULTS.inc({"result": "error"}, by=len(pairs))
             _LOG.warning("bulk bind of %d pods failed: %s", len(pairs), e)
@@ -300,8 +344,10 @@ class SchedulerRunner:
             self.client.pods(victim.metadata.namespace).delete(victim.metadata.name)
         except ApiError as e:
             if e.code != 404:  # already gone is fine
+                LOOP_ERRORS.inc({"site": "evict"})
                 _LOG.warning("evict %s failed: %s", victim.key, e)
         except Exception as e:
+            LOOP_ERRORS.inc({"site": "evict"})
             _LOG.warning("evict %s: API unreachable: %s", victim.key, e)
         self.cache.remove_pod(victim.key)
 
@@ -351,7 +397,12 @@ class SchedulerRunner:
                 lock_name="kubernetes-tpu-scheduler", identity=self.identity,
                 on_started_leading=self._start_loop,
                 on_stopped_leading=self._stop_loop))
-            t = threading.Thread(target=elector.run, args=(self._stop,), daemon=True)
+            self._elector = elector
+            # elector.run self-heals per term (ApiError storms are missed
+            # renewals, callback failures drop leadership and re-contend),
+            # so the thread body needs no further wrapping
+            t = threading.Thread(target=elector.run, args=(self._stop,),
+                                 daemon=True)
             t.start()
             self._threads.append(t)
         elif start_loop:
@@ -359,10 +410,35 @@ class SchedulerRunner:
         self.publish_status()
         return self
 
+    def _resilience_status(self) -> dict:
+        """Live self-healing state for the status ConfigMap: degraded mode
+        (mesh/single/oracle), breaker trip/restore counts, watchdog
+        restarts, and the informer layer's relist totals."""
+        from kubernetes_tpu.utils.clock import rfc3339_from_epoch
+        breaker = self.scheduler.breaker
+        relists = 0
+        last_relist = None
+        for inf in self.factory._informers.values():
+            relists += getattr(inf, "relists", 0)
+            lr = getattr(inf, "last_relist", None)
+            if lr and (last_relist is None or lr > last_relist):
+                last_relist = lr
+        return {
+            "degradedMode": breaker.mode,
+            "degradedIndex": breaker.index,
+            "breakerTrips": breaker.trips,
+            "breakerRestores": breaker.restores,
+            "watchdogRestarts": self._watchdog.restarts,
+            "watchRelists": relists,
+            "lastRelist": (rfc3339_from_epoch(last_relist)
+                           if last_relist else None),
+        }
+
     def publish_status(self) -> None:
         """Publish the deployment-shape status ConfigMap (``ktpu status``
-        reads it): active mesh shape/devices and the batching knobs. Best
-        effort — status must never take the scheduler down."""
+        reads it): active mesh shape/devices, the batching knobs, and the
+        resilience state. Best effort — status must never take the
+        scheduler down."""
         import json
         mesh = self.scheduler._mesh
         status = {
@@ -376,6 +452,7 @@ class SchedulerRunner:
             "maxDrainBatches": self.cfg.max_drain_batches,
             "pipelineDepth": self.cfg.pipeline_depth,
             "profiles": [p.scheduler_name for p in self.cfg.profiles],
+            "resilience": self._resilience_status(),
         }
         body = {
             "apiVersion": "v1", "kind": "ConfigMap",
@@ -390,15 +467,23 @@ class SchedulerRunner:
             cms.update(current)
         except ApiError as e:
             if e.code != 404:
+                LOOP_ERRORS.inc({"site": "publish_status"})
+                _LOG.debug("status ConfigMap update failed: %s", e)
                 return
             try:
                 cms.create(body)
             except ApiError:
-                pass
+                LOOP_ERRORS.inc({"site": "publish_status"})
+                _LOG.debug("status ConfigMap create failed", exc_info=True)
         except Exception:
-            pass
+            LOOP_ERRORS.inc({"site": "publish_status"})
+            _LOG.debug("status ConfigMap publish failed", exc_info=True)
 
     def _start_loop(self):
+        with self._loop_lock:
+            self._start_loop_locked()
+
+    def _start_loop_locked(self):
         # Chain terms: if the previous term's loop is still draining (e.g.
         # stuck in a long run_once/JIT compile when the lease bounced), the
         # new term's thread waits for it rather than stacking a concurrent
@@ -414,19 +499,103 @@ class SchedulerRunner:
                 prev_t.join()
             self.scheduler.run(stop)
 
+        self._loop_expected = True
         self._loop_stop = stop
         self._loop_thread = threading.Thread(target=term, daemon=True)
         self._loop_thread.start()
+        self._watch_threads()
+
+    def _watch_threads(self) -> None:
+        """Arm the watchdog over the loop + resolver threads (idempotent).
+        ``_loop_expected`` distinguishes 'a loop should be running' from an
+        intentional stop (lost lease, shutdown) — a watchdog-signaled term
+        stays expected, so the sweep after the wedged thread finally exits
+        restarts it."""
+        self._watchdog.register(
+            "loop",
+            is_alive=lambda: (not getattr(self, "_loop_expected", False)
+                              or self._stop.is_set()
+                              or (self._loop_thread is not None
+                                  and self._loop_thread.is_alive())),
+            restart=self._revive_loop,
+            # an intentionally-stopped loop (standby replica after a lost
+            # lease) has no heartbeat to give; stall detection applies
+            # only while a loop is supposed to be running
+            busy=lambda: (getattr(self, "_loop_expected", False)
+                          and not self._stop.is_set()))
+        sch = self.scheduler
+        self._watchdog.register(
+            "resolver",
+            is_alive=lambda: (sch._resolver_thread is None
+                              or sch._resolver_thread.is_alive()
+                              or self._stop.is_set()),
+            restart=self._revive_resolver,
+            # a resolver with no in-flight drains has nothing to beat about
+            busy=lambda: bool(sch._pending))
+        self._watchdog.start()
+
+    def _revive_loop(self):
+        """Watchdog path. A DEAD loop thread (BaseException, chaos kill)
+        restarts immediately: the resident drain context is tainted —
+        whatever the dead thread was mid-way through left the device state
+        unaccountable — and a fresh term begins. A STALLED-but-alive
+        thread is only SIGNALED to stop: two loops would mutate the
+        scheduler's unsynchronized state concurrently (a Python thread
+        cannot be killed), so the restart happens on the sweep after the
+        wedged thread actually exits — and a thread merely stuck in a
+        long first-touch compile resumes its (now stopping) term
+        harmlessly. Returns False when no restart actually happened (the
+        watchdog then doesn't count one). Runs under the loop lock so a
+        revive can never race a leadership-change start/stop."""
+        with self._loop_lock:
+            if not self._loop_expected or self._stop.is_set():
+                # leadership was lost (or the runner is stopping) between
+                # the sweep and this call: a non-leader must not schedule
+                return False
+            t = self._loop_thread
+            if t is not None and t.is_alive():
+                if self._loop_stop is not None:
+                    self._loop_stop.set()
+                self.scheduler.taint_ctx()
+                return False  # signaled only; restart on a later sweep
+            self.scheduler.taint_ctx()
+            self._start_loop_locked()
+        self.publish_status()
+        return True
+
+    def _revive_resolver(self) -> None:
+        self.scheduler.restart_resolver()
+        self.publish_status()
 
     def _stop_loop(self):
-        if self._loop_stop is not None:
-            self._loop_stop.set()
-        if self._loop_thread is not None:
-            self._loop_thread.join(timeout=5.0)
+        with self._loop_lock:
+            # intentional stop: the watchdog must not revive
+            self._loop_expected = False
+            if self._loop_stop is not None:
+                self._loop_stop.set()
+            t = self._loop_thread
+        if t is not None:
+            t.join(timeout=5.0)
 
     def stop(self):
         self._stop.set()
+        self._watchdog.stop()
         self._stop_loop()
         self.queue.close()
         self.scheduler.close()
+        self.factory.stop_all()
+
+    def kill(self):
+        """Crash simulation (recovery tests): tear the runner down WITHOUT
+        the graceful-drain discipline — no resolve of in-flight device
+        work, no binder flush, no status publish. Everything the dead
+        incarnation assumed-but-never-bound or nominated must be
+        reconstructable by a fresh runner from apiserver state alone;
+        tests/test_chaos.py proves it is."""
+        self._stop.set()
+        self._watchdog.stop()
+        self._loop_expected = False
+        if self._loop_stop is not None:
+            self._loop_stop.set()
+        self.queue.close()
         self.factory.stop_all()

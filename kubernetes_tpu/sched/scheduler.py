@@ -23,6 +23,7 @@ from collections import deque
 from typing import Callable, Optional
 
 from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.chaos.hooks import chaos_point
 from kubernetes_tpu.config.features import DEFAULT_FEATURE_GATE
 from kubernetes_tpu.config.types import SchedulerConfiguration
 from kubernetes_tpu.metrics.registry import (
@@ -30,6 +31,7 @@ from kubernetes_tpu.metrics.registry import (
     BATCH_DURATION,
     DRAIN_SHARD_MS,
     GANG_ROUNDS,
+    LOOP_ERRORS,
     MESH_DEVICES,
     PIPELINE_DEPTH,
     PIPELINE_INFLIGHT,
@@ -41,6 +43,7 @@ from kubernetes_tpu.models.gang import gang_schedule
 from kubernetes_tpu.sched.cache import SchedulerCache
 from kubernetes_tpu.sched import preemption as preemption_mod
 from kubernetes_tpu.sched.queue import SchedulingQueue
+from kubernetes_tpu.sched.resilience import DeviceCircuitBreaker
 from kubernetes_tpu.utils import sanity
 from kubernetes_tpu.utils.events import NullRecorder
 
@@ -55,6 +58,11 @@ Binder = Callable[[Pod, str], bool]
 # context. Static — part of the compiled drain shapes.
 import os as _os
 DRAIN_NOM_BUCKET = int(_os.environ.get("KTPU_DRAIN_NOM_BUCKET", "128"))
+
+# Bounded resolve wait: how long the scheduling thread waits on the
+# resolver's Event before degrading to an inline device fetch — a dead or
+# stalled resolver must never hang the loop.
+RESOLVE_WAIT_S = float(_os.environ.get("KTPU_RESOLVE_TIMEOUT", "30"))
 
 
 class Scheduler:
@@ -97,6 +105,21 @@ class Scheduler:
         # rebuild instead of patching arrays whose layout no longer matches.
         self._mesh = None
         self._mesh_epoch = 0
+        # operator-configured mesh (what the breaker restores to after a
+        # degrade window; _install_mesh toggles the ACTIVE mesh without
+        # touching this)
+        self._configured_mesh = None
+        # device circuit breaker: consecutive device-program failures walk
+        # mesh -> single-device -> pure-numpy oracle, with half-open
+        # recovery (sched/resilience.py). Levels gain "mesh" in set_mesh.
+        self.breaker = DeviceCircuitBreaker(
+            levels=("single", "oracle"), threshold=cfg.breaker_threshold,
+            cooldown_s=cfg.breaker_cooldown_s)
+        self._attempt_level = self.breaker.mode
+        # watchdog heartbeats (the runner wires these to its watchdog;
+        # library embedders keep the no-ops)
+        self.heartbeat: Callable[[], None] = lambda: None
+        self.resolver_heartbeat: Callable[[], None] = lambda: None
         mesh_shape = cfg.mesh_shape
         env_mesh = _os.environ.get("KTPU_MESH")
         if env_mesh is not None:
@@ -144,6 +167,9 @@ class Scheduler:
         # BENCH_r05). The scheduling thread waits on a plain Event instead.
         self._resolver_q: Optional["queue_mod.Queue"] = None
         self._resolver_thread: Optional[threading.Thread] = None
+        # serializes (queue, thread) swaps between the scheduling thread's
+        # lazy spawn and the watchdog's restart_resolver
+        self._resolver_swap_lock = threading.Lock()
         self._use_resolver = _os.environ.get(
             "KTPU_RESOLVER_THREAD", "1") != "0"
         # fragment pops parked while the device is busy (see run_once)
@@ -196,10 +222,24 @@ class Scheduler:
     # ---- device mesh -----------------------------------------------------
 
     def set_mesh(self, mesh) -> None:
-        """Install (or drop, with ``None``) the scheduling mesh. Bumps the
-        mesh epoch so a resident drain context staged under the OLD layout
+        """Install (or drop, with ``None``) the scheduling mesh — the
+        OPERATOR-facing entry. Also records the mesh as the configured
+        layout the circuit breaker restores to, and resets the breaker's
+        degradation ladder (an explicit reshape means the substrate
+        changed; old trip history is moot)."""
+        self._configured_mesh = mesh
+        self._install_mesh(mesh)
+        self.breaker.reset_levels(
+            ("mesh", "single", "oracle") if mesh is not None
+            else ("single", "oracle"))
+
+    def _install_mesh(self, mesh) -> None:
+        """Activate a mesh (or drop to single-device). Bumps the mesh
+        epoch so a resident drain context staged under the OLD layout
         rebuilds at its next dispatch — patching sharded arrays with a
-        stale-layout patch would be silently wrong, never just slow."""
+        stale-layout patch would be silently wrong, never just slow. The
+        breaker's degrade/restore path uses this directly so a temporary
+        single-device window never forgets the configured mesh."""
         self._mesh = mesh
         self._mesh_epoch += 1
         self.cache.set_mesh(mesh)
@@ -277,6 +317,9 @@ class Scheduler:
         try:
             return pend["assignments"].is_ready()
         except Exception:
+            # a handle that can't even answer is_ready is broken: route it
+            # to resolve NOW, where the failure is handled and counted
+            LOOP_ERRORS.inc({"site": "drain_ready"})
             return True
 
     def _resolve_ready(self) -> int:
@@ -296,27 +339,70 @@ class Scheduler:
         if not self._use_resolver:
             return
         pend["done"] = threading.Event()
-        if self._resolver_thread is None or not self._resolver_thread.is_alive():
-            self._resolver_q = queue_mod.Queue()
-            self._resolver_thread = threading.Thread(
-                target=self._resolver_loop, args=(self._resolver_q,),
-                daemon=True, name="drain-resolver")
-            self._resolver_thread.start()
-        self._resolver_q.put(pend)
+        self._ensure_resolver().put(pend)
 
-    @staticmethod
-    def _resolver_loop(q: "queue_mod.Queue") -> None:
+    def _ensure_resolver(self) -> "queue_mod.Queue":
+        """Resolver queue, (re)spawning the thread if dead — the resolver
+        self-heals on thread death; a STALLED one is the watchdog's job
+        (restart_resolver). Serialized with restart_resolver: the watchdog
+        swaps the queue/thread pair from its own thread, and a dispatch
+        racing the swap must never see a half-installed pair."""
+        with self._resolver_swap_lock:
+            if (self._resolver_thread is None
+                    or not self._resolver_thread.is_alive()):
+                self._spawn_resolver_locked()
+            return self._resolver_q
+
+    def _spawn_resolver_locked(self) -> None:
+        """Install a fresh (queue, thread) pair and MIGRATE the old
+        queue's drains — a dead thread's queued pends would otherwise
+        never get their done Event set, and each would stall a resolve
+        for the full bounded wait. Queue installed before the thread
+        becomes visible: a concurrent reader can never observe (alive
+        thread, no queue)."""
+        old_q = self._resolver_q
+        new_q = queue_mod.Queue()
+        t = threading.Thread(
+            target=self._resolver_loop, args=(new_q,),
+            daemon=True, name="drain-resolver")
+        self._resolver_q = new_q
+        self._resolver_thread = t
+        t.start()
+        if old_q is not None:
+            try:
+                while True:
+                    it = old_q.get_nowait()
+                    if it is not None:
+                        new_q.put(it)
+            except queue_mod.Empty:
+                pass
+            old_q.put(None)  # poison, should the old thread still wake
+
+    def restart_resolver(self) -> None:
+        """Watchdog restart path: swap in a fresh resolver thread and move
+        the old queue's drains over. A merely-stalled old thread drains to
+        its poison pill when it wakes; the pend it held in flight resolves
+        late or falls to _resolve_one's bounded-wait inline fetch. The
+        resident ctx is NOT touched here — resolver death loses no device
+        state, only a fetch."""
+        with self._resolver_swap_lock:
+            self._spawn_resolver_locked()
+
+    def _resolver_loop(self, q: "queue_mod.Queue") -> None:
         import jax
         while True:
             pend = q.get()
-            if pend is None:  # poison pill from close()
+            if pend is None:  # poison pill from close()/restart
                 return
             try:
+                self.resolver_heartbeat()
+                chaos_point("resolver")
                 pend["resolved"] = jax.device_get(
                     (pend["assignments"], pend["rounds"]))
             except Exception:
                 # surface on the scheduling thread: _resolve_one retries the
-                # fetch inline and propagates the real error
+                # fetch inline and handles the real error
+                LOOP_ERRORS.inc({"site": "resolver"})
                 _LOG.exception("drain resolver device_get failed")
             finally:
                 pend["done"].set()
@@ -343,6 +429,31 @@ class Scheduler:
             self._staged = []
         if not batch:
             return n_early + self._resolve_pending()
+        try:
+            return n_early + self._run_batch(batch, cap)
+        except BaseException:
+            # mid-cycle failure with the popped batch in hand: the pods
+            # are in no queue and no watch event will re-deliver them —
+            # requeue before the exception escapes to run()'s self-healing
+            # (or kills the thread for the watchdog). Without this, an
+            # absorbed failure would silently strand the whole pop.
+            self._rescue_batch(batch)
+            raise
+
+    def _rescue_batch(self, batch) -> None:
+        self._staged = []  # a fragment staged THIS cycle is part of batch
+        rescued = 0
+        for pod, attempts in batch:
+            if not self.cache.is_assumed_or_bound(pod.key):
+                self.queue.add_unschedulable(pod, attempts + 1)
+                rescued += 1
+        if rescued:
+            _LOG.warning("mid-cycle failure: requeued %d popped pods",
+                         rescued)
+
+    def _run_batch(self, batch, cap: int) -> int:
+        """The body of one cycle once a batch is in hand (split out so
+        run_once can rescue the batch on ANY failure)."""
         if (len(batch) < self.cfg.batch_size and not self._staged_once
                 and (self._pending or self._last_pop_full)):
             # A fragment pop while the device is busy or right after a
@@ -355,7 +466,7 @@ class Scheduler:
             # tail.
             self._staged = batch
             self._staged_once = True
-            return n_early + self._resolve_one()
+            return self._resolve_one()
         self._staged_once = False
         self._last_pop_full = len(batch) >= cap
         stats = self.queue.stats()
@@ -370,8 +481,27 @@ class Scheduler:
         for pod, attempts in batch:
             by_profile.setdefault(pod.spec.scheduler_name, []).append((pod, attempts))
 
-        n_bound = 0
+        n_bound = n_landed = 0
         serial = not self.features.enabled("TPUBatchScheduling")
+        # degrade-don't-die routing: the breaker picks the level this cycle
+        # attempts — the current degraded mode, or one better when the
+        # half-open window opened (the probe). "mesh"/"single" still run
+        # the tensor programs (mesh installed or dropped to match);
+        # "oracle" bypasses the device entirely.
+        level = self.breaker.attempt_level()
+        self._attempt_level = level
+        if level != "oracle":
+            want = self._configured_mesh if level == "mesh" else None
+            if want is not self._mesh:
+                _LOG.warning("degraded-mode transition: running %s "
+                             "(breaker mode %r)",
+                             "under the configured mesh" if want is not None
+                             else "single-device", self.breaker.mode)
+                self._install_mesh(want)
+        elif self._pending:
+            # oracle mode dispatches nothing new; in-flight drains from
+            # before the degrade must not linger (bounded waits inside)
+            n_landed += self._resolve_pending()
         for sched_name, items in by_profile.items():
             profile = self.cfg.profile_for(sched_name)
             if profile is None:
@@ -380,15 +510,17 @@ class Scheduler:
                 for pod, attempts in items:
                     self.queue.park_unschedulable(pod, attempts)
                 continue
-            if ((len(items) > self.cfg.batch_size
-                 or self._drain_ctx is not None)
+            if level == "oracle":
+                n_bound += self._schedule_oracle(profile, items)
+            elif ((len(items) > self.cfg.batch_size
+                   or self._drain_ctx is not None)
                     and not serial and not self._extenders):
                 n_bound += self._schedule_drain(profile, items, headroom)
             else:
                 for i in range(0, len(items), self.cfg.batch_size):
                     n_bound += self._schedule_group(
                         profile, items[i:i + self.cfg.batch_size], headroom)
-        return n_early + n_bound
+        return n_landed + n_bound
 
     def _schedule_group(self, profile, items, slot_headroom: int = 0) -> int:
         from kubernetes_tpu.utils.tracing import TRACER
@@ -458,14 +590,27 @@ class Scheduler:
         plugins = self.registry.tensor_plugins(oot)
         with BATCH_DURATION.time(), TRACER.span(
                 "scheduler/gang_schedule", pods=len(pods), nodes=len(nodes)):
-            assignment, rounds = gang_schedule(
-                ct, pb, seed=self.cfg.seed, fit_strategy=profile.fit_strategy,
-                topo_keys=meta.topo_keys, serial=serial,
-                max_rounds=self.cfg.max_gang_rounds,
-                weights=profile.weights(),
-                enabled_filters=profile.enabled_filters,
-                ext_mask=ext_mask, ext_scores=ext_scores, plugins=plugins,
-                mesh=self._mesh)
+            try:
+                assignment, rounds = gang_schedule(
+                    ct, pb, seed=self.cfg.seed,
+                    fit_strategy=profile.fit_strategy,
+                    topo_keys=meta.topo_keys, serial=serial,
+                    max_rounds=self.cfg.max_gang_rounds,
+                    weights=profile.weights(),
+                    enabled_filters=profile.enabled_filters,
+                    ext_mask=ext_mask, ext_scores=ext_scores,
+                    plugins=plugins, mesh=self._mesh)
+            except Exception:
+                # device program failed (compile/runtime/transport): feed
+                # the breaker and schedule THIS batch with the pure-numpy
+                # oracle — degraded, never dropped
+                LOOP_ERRORS.inc({"site": "device_gang"})
+                _LOG.warning("gang program failed at level %r; scheduling "
+                             "the batch with the host oracle",
+                             self._attempt_level, exc_info=True)
+                self.breaker.fail(self._attempt_level)
+                return self._schedule_oracle(profile, items)
+        self.breaker.succeed(self._attempt_level)
         GANG_ROUNDS.observe(rounds)
         if sanity.check_enabled():
             for problem in sanity.check_assignment(assignment, len(nodes)):
@@ -748,15 +893,33 @@ class Scheduler:
             # context's cluster arrays are already resident split on
             # "nodes"), and the winners view is pinned replicated so the
             # resolve fetch stays O(P)
-            assignments, rounds, new_ct, new_fill = drain_step(
-                ctx["ct"], self.cache.stage_drain_batch(pb_stack),
-                ctx["fill_dev"], e0=ctx["e0"],
-                seed=self.cfg.seed, fit_strategy=profile.fit_strategy,
-                topo_keys=meta.topo_keys,
-                weights=tuple(sorted(profile.weights().items())),
-                enabled_filters=tuple(sorted(profile.enabled_filters or ())),
-                max_rounds=self.cfg.max_gang_rounds, plugins=plugins,
-                winners_sharding=self._winners_sharding)
+            try:
+                assignments, rounds, new_ct, new_fill = drain_step(
+                    ctx["ct"], self.cache.stage_drain_batch(pb_stack),
+                    ctx["fill_dev"], e0=ctx["e0"],
+                    seed=self.cfg.seed, fit_strategy=profile.fit_strategy,
+                    topo_keys=meta.topo_keys,
+                    weights=tuple(sorted(profile.weights().items())),
+                    enabled_filters=tuple(
+                        sorted(profile.enabled_filters or ())),
+                    max_rounds=self.cfg.max_gang_rounds, plugins=plugins,
+                    winners_sharding=self._winners_sharding)
+            except Exception:
+                # dispatch failed (compile error, dead tunnel, chaos):
+                # the resident context's device state is unaccountable —
+                # drop it, land whatever is still in flight, and schedule
+                # this pop on the per-batch path (which itself degrades to
+                # the oracle if the device stays broken)
+                LOOP_ERRORS.inc({"site": "device_drain"})
+                _LOG.warning("drain dispatch failed at level %r; falling "
+                             "back to the per-batch path",
+                             self._attempt_level, exc_info=True)
+                self.breaker.fail(self._attempt_level)
+                self._drain_ctx = None
+                n_prev += self._resolve_pending()
+                return n_prev + sum(
+                    self._schedule_group(profile, c, slot_headroom)
+                    for c in chunks)
         ctx["ct"] = new_ct
         ctx["fill_dev"] = new_fill
         ctx["fill_bound"] += len(pods)
@@ -765,6 +928,12 @@ class Scheduler:
             "chunks": chunks, "ctx": ctx,
             "meta": meta, "n_nodes": len(nodes), "profile": profile,
             "t0": t0,
+            # breaker attribution: the level THIS drain was dispatched at
+            # (resolve may happen cycles later, at a different level) and
+            # the dispatch time on the BREAKER's clock (a stale success
+            # must not mask newer failures)
+            "level": self._attempt_level,
+            "dispatched_at": self.breaker.clock.now(),
             # nominations the dispatched program already respects (resident
             # reservation slots); resolve re-checks winners only against
             # nominations that arrive AFTER this point
@@ -821,6 +990,7 @@ class Scheduler:
         import numpy as np
         from kubernetes_tpu.utils.tracing import TRACER
         t_wait = time.time()
+        fetch_failed = False
         with BATCH_DURATION.time(), TRACER.span(
                 "scheduler/resolve_wait", depth=len(self._pending) + 1):
             # fill_bound is maintained purely by the dispatch-side
@@ -830,12 +1000,54 @@ class Scheduler:
             res = None
             if done is not None:
                 # resolver thread owns the device fetch; this thread parks
-                # on a plain Event — no GIL tug-of-war inside the tunnel
-                done.wait()
+                # on a plain Event — BOUNDED: a dead or stalled resolver
+                # degrades to an inline fetch instead of hanging the loop
+                deadline = time.time() + RESOLVE_WAIT_S
+                while not done.wait(0.25):
+                    t = self._resolver_thread
+                    dead = t is not None and not t.is_alive()
+                    if dead or time.time() > deadline:
+                        LOOP_ERRORS.inc({"site": "resolver_wait"})
+                        _LOG.warning(
+                            "drain resolver %s; fetching inline",
+                            "died" if dead
+                            else f"silent for {RESOLVE_WAIT_S:.0f}s")
+                        break
                 res = pend.pop("resolved", None)
-            if res is None:  # resolver off or its fetch failed: go inline
-                res = jax.device_get((pend["assignments"], pend["rounds"]))
-            assignments, rounds = res
+            if res is None:  # resolver off/stalled or its fetch failed
+                try:
+                    chaos_point("resolve")
+                    res = jax.device_get(
+                        (pend["assignments"], pend["rounds"]))
+                except Exception:
+                    fetch_failed = True
+                    LOOP_ERRORS.inc({"site": "drain_resolve"})
+                    _LOG.exception("drain results unrecoverable; "
+                                   "requeueing the drain's pods")
+            if not fetch_failed:
+                assignments, rounds = res
+        if fetch_failed:
+            # the drain's winners are lost: requeue every pod (the cache
+            # never assumed them), release the fold reservation, and taint
+            # the resident context — the device-side fold state is unknown
+            self.breaker.fail(pend.get("level", self._attempt_level))
+            ctx = pend["ctx"]
+            pend_count = sum(len(c) for c in pend["chunks"])
+            if self._drain_ctx is ctx:
+                ctx["cs"].tainted = True
+                ctx["fill_bound"] -= pend_count
+            for chunk in pend["chunks"]:
+                for pod, attempts in chunk:
+                    if not self.cache.is_bound(pod.key):
+                        self.queue.add_unschedulable(pod, attempts + 1)
+            SCHEDULE_ATTEMPTS.inc({"result": "error"}, by=pend_count)
+            return 0
+        # results landed: the device executed this drain end to end — the
+        # breaker's success signal for the fused path (dispatch alone is
+        # async and proves nothing). Attributed to the level and time the
+        # drain was DISPATCHED at, not this cycle's.
+        self.breaker.succeed(pend.get("level", self._attempt_level),
+                             dispatched_at=pend.get("dispatched_at"))
         wait_ms = round((time.time() - t_wait) * 1000.0, 3)
         RESOLVE_BYTES.set(np.asarray(assignments).nbytes
                           + np.asarray(rounds).nbytes)
@@ -1032,6 +1244,95 @@ class Scheduler:
                            "mesh_epoch": self._mesh_epoch}
         return True
 
+    # ---- degraded floor: pure-numpy oracle scheduling --------------------
+
+    def _schedule_oracle(self, profile, items) -> int:
+        """Degrade-don't-die floor: schedule a batch with the serial
+        pure-numpy oracle (sched/oracle.py — the documented CPU fallback
+        path). Orders of magnitude slower than the tensor programs, but
+        device-free and exactly parity-tested against them — the breaker
+        routes here when the device layer is broken so a scheduling cycle
+        is never dropped."""
+        import dataclasses
+        from kubernetes_tpu.sched.oracle import OracleScheduler
+        t0 = time.time()
+        if self._extenders:
+            # an extender's filter veto is authoritative (it guards state
+            # the scheduler cannot see — storage capacity, license seats);
+            # the oracle cannot consult it mid-outage, and binding past a
+            # veto is worse than waiting one backoff for the device (or
+            # the operator) to come back
+            _LOG.warning("degraded to oracle but %d extender(s) are "
+                         "configured: requeueing %d pods instead of "
+                         "bypassing extender filters", len(self._extenders),
+                         len(items))
+            for pod, attempts in items:
+                self.queue.add_unschedulable(pod, attempts + 1)
+                SCHEDULE_ATTEMPTS.inc({"result": "unschedulable"})
+            return 0
+        nodes = self.cache.list_nodes()
+        if not nodes:
+            for pod, attempts in items:
+                self.queue.add_unschedulable(pod, attempts + 1)
+                SCHEDULE_ATTEMPTS.inc({"result": "unschedulable"})
+            return 0
+        orc = OracleScheduler(
+            nodes, bound_pods=self.cache.bound_pods(include_assumed=True),
+            weights=profile.weights(), seed=self.cfg.seed,
+            volumes=self.cache.volume_catalog,
+            namespace_labels=self.cache.namespace_labels(),
+            dra=self.cache.dra_catalog)
+        pods = profile.apply_added_affinity([p for p, _ in items])
+        # the oracle's assume() writes node_name onto what it schedules:
+        # give it detached views so a failed bind can requeue the ORIGINAL
+        # pod unbound
+        views = [dataclasses.replace(p, spec=dataclasses.replace(p.spec))
+                 for p in pods]
+        placed = orc.schedule_all(views)
+        # same assume-time nomination re-check as the tensor paths: the
+        # oracle's node states carried no reservation overlay. The prune
+        # matters here too — in a long oracle window this is the ONLY
+        # path running, and an unpruned stale nomination would reserve a
+        # node for the whole outage.
+        self._fold_staged_nominations()
+        now = time.time()
+        self._nominated = {
+            k: e for k, e in self._nominated.items()
+            if now - e[3] < self._nominated_ttl
+            and not self.cache.is_bound(k)}
+        batch_keys = {p.key for p, _ in items}
+        reserved: dict[str, int] = {}
+        for k, (n, prio, _p, _ts) in self._nominated.items():
+            if k not in batch_keys:
+                reserved[n] = max(prio, reserved.get(n, prio))
+        n_bound = n_unsched = 0
+        to_bind: list[tuple[Pod, str]] = []
+        failures: list[tuple[Pod, int]] = []
+        for (pod, attempts), ni in zip(items, placed):
+            if ni is None:
+                failures.append((pod, attempts))
+                n_unsched += 1
+                continue
+            node_name = nodes[ni].metadata.name
+            rp = reserved.get(node_name)
+            if rp is not None and rp >= pod.spec.priority:
+                failures.append((pod, attempts))
+                n_unsched += 1
+                continue
+            self._nominated.pop(pod.key, None)
+            self.cache.assume(pod, node_name)
+            to_bind.append((pod, node_name))
+            n_bound += 1
+        self._handle_failures(failures)
+        self._bind_async_batch(to_bind, profile)
+        dt = time.time() - t0
+        for result, n in (("scheduled", n_bound),
+                          ("unschedulable", n_unsched)):
+            if n:
+                SCHEDULE_ATTEMPTS.inc({"result": result}, by=n)
+                ATTEMPT_DURATION.observe(dt, {"result": result}, n=n)
+        return n_bound
+
     # ---- failure path: PostFilter / preemption ---------------------------
 
     def _handle_failure(self, pod: Pod, attempts: int):
@@ -1106,14 +1407,37 @@ class Scheduler:
     def _default_preempt(self, pod: Pod) -> Optional[str]:
         nodes, _, _ = self.cache.snapshot()
         bound = self.cache.bound_pods(include_assumed=True)
-        res = preemption_mod.find_candidate_tensor(
-            nodes, bound, self._preempt_view(pod), pdbs=self.pdb_lister(),
-            dra=self.cache.dra_catalog)
+        if self._attempt_level == "oracle":
+            # device known-broken: go straight to the exact host scan
+            # instead of paying a doomed device dry-run first
+            res = preemption_mod.find_candidate(
+                nodes, bound, self._preempt_view(pod),
+                pdbs=self.pdb_lister(), dra=self.cache.dra_catalog)
+        else:
+            res = preemption_mod.find_candidate_tensor(
+                nodes, bound, self._preempt_view(pod),
+                pdbs=self.pdb_lister(), dra=self.cache.dra_catalog)
         if res is None:
             return None
         for v in res.victims:
             self._evict(v)
         return res.node_name
+
+    def _preempt_serial(self, nodes, bound, views) -> list:
+        """Serial host-scan preemption for a wave: each winner's victims
+        leave the shared bound view before the next pick, mirroring the
+        wave's sequential-commit semantics without the device."""
+        results = []
+        bound_left = list(bound)
+        for v in views:
+            res = preemption_mod.find_candidate(
+                nodes, bound_left, v, pdbs=self.pdb_lister(),
+                dra=self.cache.dra_catalog)
+            results.append(res)
+            if res is not None:
+                gone = {x.key for x in res.victims}
+                bound_left = [p for p in bound_left if p.key not in gone]
+        return results
 
     def _default_preempt_wave(self, pods: list[Pod]) -> list[Optional[str]]:
         """One snapshot + one sequential-commit wave program for a batch of
@@ -1126,6 +1450,22 @@ class Scheduler:
             nodes, ct, meta = self.cache.snapshot()
             bound = self.cache.bound_pods(include_assumed=True)
         views = [self._preempt_view(p) for p in pods]
+        if self._attempt_level == "oracle":
+            # device known-broken this cycle: don't pay a doomed wave
+            # dispatch (possibly a multi-second compile/tunnel timeout)
+            # before falling back — go straight to the host scan
+            with TRACER.span("preempt/serial", pods=len(pods)):
+                results = self._preempt_serial(nodes, bound, views)
+            out_serial: list[Optional[str]] = []
+            with TRACER.span("preempt/evict"):
+                for res in results:
+                    if res is None:
+                        out_serial.append(None)
+                        continue
+                    for v in res.victims:
+                        self._evict(v)
+                    out_serial.append(res.node_name)
+            return out_serial
         try:
             with TRACER.span("preempt/masks", pods=len(pods)):
                 masks = preemption_mod.tensor_static_masks(
@@ -1138,10 +1478,21 @@ class Scheduler:
             masks = None  # preempt_wave computes its own
         with TRACER.span("preempt/wave", pods=len(pods),
                          nodes=len(nodes)):
-            results = preemption_mod.preempt_wave(
-                nodes, bound, views, pdbs=self.pdb_lister(),
-                dra=self.cache.dra_catalog, static_masks=masks,
-                min_q=preemption_mod.WAVE_BUCKET, mesh=self._mesh)
+            try:
+                results = preemption_mod.preempt_wave(
+                    nodes, bound, views, pdbs=self.pdb_lister(),
+                    dra=self.cache.dra_catalog, static_masks=masks,
+                    min_q=preemption_mod.WAVE_BUCKET, mesh=self._mesh)
+            except Exception:
+                # device wave broke: feed the breaker and fall back to the
+                # serial host scan (the wave's sequential-commit
+                # semantics, minus the device)
+                LOOP_ERRORS.inc({"site": "device_preempt"})
+                _LOG.warning("preempt_wave device program failed; "
+                             "degrading to the serial host scan",
+                             exc_info=True)
+                self.breaker.fail(self._attempt_level)
+                results = self._preempt_serial(nodes, bound, views)
         out: list[Optional[str]] = []
         with TRACER.span("preempt/evict"):
             for res in results:
@@ -1215,6 +1566,7 @@ class Scheduler:
                 else:
                     self._bind_one(item[1], item[2])
             except Exception:
+                LOOP_ERRORS.inc({"site": "bind_worker"})
                 _LOG.exception("binding cycle failed")
             finally:
                 with self._bind_cv:
@@ -1304,6 +1656,8 @@ class Scheduler:
                 ok = (self.binder(pod, node_name) if delegated is None
                       else delegated)
         except Exception:
+            LOOP_ERRORS.inc({"site": "bind_lifecycle"})
+            _LOG.exception("binding cycle for %s failed", pod.key)
             ok = False
         # a binder returning None means the pod no longer exists (deleted
         # while the binding was in flight — expected under churn): there is
@@ -1345,7 +1699,33 @@ class Scheduler:
 
     # ---- loop ------------------------------------------------------------
 
+    def taint_ctx(self) -> None:
+        """Mark the device-resident drain context unaccountable: the next
+        dispatch rebuilds from a host snapshot instead of patching arrays
+        whose true device state is unknown (mid-cycle failure, watchdog
+        thread restart)."""
+        ctx = self._drain_ctx
+        if ctx is not None:
+            ctx["cs"].tainted = True
+
     def run(self, stop: threading.Event):
-        """wait.UntilWithContext(sched.ScheduleOne, 0) analog."""
+        """wait.UntilWithContext(sched.ScheduleOne, 0) analog — hardened:
+        a run_once failure is logged + counted (never swallowed, never
+        fatal), the resident drain context is tainted (a mid-dispatch
+        death leaves its device state unaccountable), and the loop backs
+        off briefly and continues. Only a BaseException — watchdog food
+        like ChaosThreadDeath, or interpreter shutdown — escapes."""
+        consecutive = 0
         while not stop.is_set() and not self.queue.closed:
-            self.run_once()
+            self.heartbeat()
+            try:
+                chaos_point("loop")
+                self.run_once()
+                consecutive = 0
+            except Exception:
+                consecutive += 1
+                LOOP_ERRORS.inc({"site": "run_once"})
+                _LOG.exception("run_once failed (%d consecutive); "
+                               "self-healing", consecutive)
+                self.taint_ctx()
+                stop.wait(min(0.05 * (2 ** min(consecutive, 6)), 2.0))

@@ -18,6 +18,8 @@ import threading
 import time
 from typing import Optional
 
+from kubernetes_tpu.metrics.registry import EVENTS_DROPPED
+
 EVENT_NORMAL, EVENT_WARNING = "Normal", "Warning"
 
 
@@ -89,7 +91,10 @@ class EventRecorder:
                     (ns, name, kind, md.get("uid", ""), ev_name,
                      prior is not None, type_, reason, message, now))
             except queue.Full:
-                pass
+                # best-effort, but not silently so: a chaos run (or an
+                # operator staring at a gap in `kubectl get events`) can
+                # see exactly how many records the overflow ate
+                EVENTS_DROPPED.inc({"reason": "queue_full"})
 
     def _drain(self) -> None:
         while True:
@@ -138,9 +143,13 @@ class EventRecorder:
                     try:
                         self.client.resource("events", ns).create_many(objs)
                     except Exception:
-                        pass  # events are best-effort
+                        # best-effort: a failing client must neither raise
+                        # into the sink loop nor spin it — but every event
+                        # it eats is counted
+                        EVENTS_DROPPED.inc({"reason": "write_failed"},
+                                           by=len(objs))
             except Exception:
-                pass  # never break the control loop
+                EVENTS_DROPPED.inc({"reason": "sink_error"}, by=len(batch))
             finally:
                 for _ in batch:
                     self._q.task_done()

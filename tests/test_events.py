@@ -40,6 +40,98 @@ def test_recorder_writes_and_aggregates():
     assert by_reason["FailedScheduling"]["lastTimestamp"] == 1001.0
 
 
+class _FailingResource:
+    def __init__(self, exc):
+        self._exc = exc
+
+    def create_many(self, objs):
+        raise self._exc
+
+    def get(self, name):
+        raise self._exc
+
+    def update(self, obj):
+        raise self._exc
+
+
+class _FailingClient:
+    """Every API write fails — the recorder must neither raise nor spin."""
+
+    def __init__(self, exc=None):
+        self.exc = exc or ConnectionError("api down")
+
+    def resource(self, plural, ns="default"):
+        return _FailingResource(self.exc)
+
+
+def test_recorder_survives_failing_client_and_counts_drops():
+    """Satellite contract: under a failing client the drain thread must
+    not raise or spin, flush(timeout=) must return on deadline, and every
+    eaten event lands on the events_dropped_total counter."""
+    from kubernetes_tpu.metrics.registry import EVENTS_DROPPED
+    before = EVENTS_DROPPED.get({"reason": "write_failed"})
+    rec = EventRecorder(_FailingClient(), "test-component")
+    for i in range(5):
+        rec.event(pod_obj(f"p{i}"), "Normal", "Scheduled", f"msg {i}")
+    t0 = time.time()
+    rec.flush(timeout=3.0)  # failed writes still drain the queue
+    assert time.time() - t0 < 3.0, "flush spun out to its full deadline"
+    assert rec._q.unfinished_tasks == 0
+    deadline = time.time() + 3.0
+    while (EVENTS_DROPPED.get({"reason": "write_failed"}) - before < 5
+           and time.time() < deadline):
+        time.sleep(0.01)
+    assert EVENTS_DROPPED.get({"reason": "write_failed"}) - before == 5
+    # recording after the failures must still be non-blocking and silent
+    rec.event(pod_obj("late"), "Normal", "Scheduled", "still fine")
+    rec.flush(timeout=2.0)
+
+
+def test_recorder_flush_returns_on_deadline_with_stuck_sink():
+    """A sink wedged mid-write must not wedge flush past its deadline."""
+    import threading
+
+    class _HangingResource:
+        def __init__(self, release):
+            self._release = release
+
+        def create_many(self, objs):
+            self._release.wait(10.0)
+            raise ConnectionError("api down")
+
+    class _HangingClient:
+        def __init__(self):
+            self.release = threading.Event()
+
+        def resource(self, plural, ns="default"):
+            return _HangingResource(self.release)
+
+    client = _HangingClient()
+    rec = EventRecorder(client, "test-component")
+    rec.event(pod_obj(), "Normal", "Scheduled", "msg")
+    t0 = time.time()
+    rec.flush(timeout=0.3)
+    assert time.time() - t0 < 2.0  # returned on deadline, not on drain
+    client.release.set()  # unwedge the daemon sink
+
+
+def test_recorder_queue_overflow_counts_drops():
+    from kubernetes_tpu.metrics.registry import EVENTS_DROPPED
+    import queue as queue_mod
+    before = EVENTS_DROPPED.get({"reason": "queue_full"})
+    rec = EventRecorder(_FailingClient(), "test-component")
+    rec._q = queue_mod.Queue(maxsize=1)  # force overflow deterministically
+
+    class _StuckSink:  # pretend a sink is alive but never draining
+        @staticmethod
+        def is_alive():
+            return True
+    rec._sink = _StuckSink()
+    rec.event(pod_obj("of0"), "Normal", "Scheduled", "fills the queue")
+    rec.event(pod_obj("of1"), "Normal", "Scheduled", "overflows")
+    assert EVENTS_DROPPED.get({"reason": "queue_full"}) - before >= 1
+
+
 def test_scheduler_emits_scheduling_events():
     from kubernetes_tpu.config.types import SchedulerConfiguration
     from kubernetes_tpu.sched.runner import SchedulerRunner
