@@ -226,8 +226,25 @@ def main():
         "connected_preemption": connected_preemption,
         "kubemark": kubemark,
         "pallas": pallas,
+        # confirmed correctness-invariant violations across every audited
+        # case (connected / chaos / mesh legs). _require_invariant_field
+        # refuses to emit a summary without this key: BENCH_r05's
+        # parsed-null crash taught that a silently missing figure reads
+        # as "fine" for rounds
+        "invariant_violations": _sum_violations(connected, chaos_churn,
+                                                connected_mesh),
     }
+    _require_invariant_field(out, "bench summary")
     print(json.dumps(out))
+    if out["invariant_violations"]:
+        audited = {name: c.get("invariant_violations") for name, c in
+                   (("connected", connected), ("chaos_churn", chaos_churn),
+                    ("connected_mesh", connected_mesh)) if c}
+        print(f"[bench] FATAL: {out['invariant_violations']} correctness-"
+              f"invariant violation(s) confirmed by the auditor "
+              f"({audited}); repro bundles are on disk — replay with the "
+              "logged chaos seed", file=sys.stderr)
+        sys.exit(1)
     if chaos_churn is not None and (chaos_churn.get("chaos") or {}) \
             .get("lost"):
         # hard gate: pods lost under the fault schedule means self-healing
@@ -248,10 +265,31 @@ def main():
         sys.exit(1)
 
 
+def _sum_violations(*cases) -> int:
+    """Total invariant violations across audited case results (None cases
+    — skipped via env knobs — contribute nothing)."""
+    return sum(int(c.get("invariant_violations") or 0)
+               for c in cases if c is not None)
+
+
+def _require_invariant_field(summary: dict, label: str) -> None:
+    """Refuse to emit a result JSON whose summary omits
+    ``invariant_violations``: a missing correctness figure must fail the
+    run loudly, not read as zero (the BENCH_r05 lesson, encoded)."""
+    if "invariant_violations" not in summary:
+        print(f"[bench] FATAL: {label} omits the invariant_violations "
+              "field; refusing to emit it", file=sys.stderr)
+        sys.exit(1)
+
+
 def _write_multichip(here: str, result: dict, log) -> None:
     """Record the ConnectedMesh case in the next free MULTICHIP_r*.json
-    (same series the driver's dry-run writes)."""
+    (same series the driver's dry-run writes). Results that reached the
+    audited legs must carry invariant_violations; pure error/skip records
+    (the subprocess died before any leg ran) are exempt."""
     import re
+    if not (result.get("error") or result.get("skipped")):
+        _require_invariant_field(result, "MULTICHIP result")
     try:
         ns = [int(m.group(1)) for m in
               (re.match(r"MULTICHIP_r(\d+)\.json$", f)

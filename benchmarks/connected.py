@@ -43,6 +43,41 @@ def _serve(conn) -> None:
     server.stop()
 
 
+def _bench_auditor(runner, clean_client, interval_s: float = 2.0):
+    """Fail-fast invariant auditor for a bench window (replaces the
+    runner's production-cadence auditor BEFORE start): tight sweeps, a
+    clean ground-truth client, raise-on-violation semantics."""
+    from kubernetes_tpu.audit.auditor import InvariantAuditor
+    return InvariantAuditor(
+        client=clean_client, cache=runner.cache,
+        scheduler=runner.scheduler, interval_s=interval_s, fail_fast=True,
+        pre_sweep=runner.sweep_stale_nominations,
+        post_sweep=runner.publish_status,
+        relists=runner._total_relists)
+
+
+def _audit_close(runner) -> dict:
+    """Stop the bench auditor, run two settle sweeps (confirm-2 invariants
+    need consecutive observations of end-state corruption), and return the
+    block every audited bench case records. Never raises: the violations
+    are already counted/bundled and the caller gates on the count."""
+    from kubernetes_tpu.audit.auditor import InvariantViolationError
+    auditor = runner.auditor
+    auditor.stop()
+    for _ in range(2):
+        try:
+            auditor.run_once()
+        except InvariantViolationError:
+            pass  # recorded + bundled; the count below fails the bench
+    out = {"invariant_violations": auditor.total_violations,
+           "audit": auditor.status()}
+    sentinel = runner.scheduler.sentinel
+    if sentinel is not None:
+        sentinel.drain()
+        out["parity"] = sentinel.stats()
+    return out
+
+
 def _watch_bound(url: str, ns: str, rv0: int, n_pods: int,
                  count, done, dead, ready) -> None:
     """Watcher process: count pods whose nodeName got set (one event per
@@ -150,8 +185,20 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
                 f"KTPU_CHAOS_SEED replays it)")
             sched_client = ChaosClient(sched_client, schedule)
             cfg_kw["breaker_cooldown_s"] = 5.0
+        if chaos_seed is not None:
+            # chaos runs sample the parity sentinel densely: the device
+            # fault burst is exactly when a wrong-answer regression would
+            # hide behind the breaker's exception-only view
+            cfg_kw.setdefault("parity_sample_every", 4)
         runner = SchedulerRunner(sched_client,
                                  SchedulerConfiguration(**cfg_kw))
+        # fail-fast invariant audit over the whole measured run: sweeps
+        # ride a CLEAN client (the bench owns ground truth; the chaos
+        # wrapper stays on the scheduler's transport only) and any
+        # confirmed violation is recorded + repro-bundled, then reported
+        # as invariant_violations in this case's JSON — bench.py exits
+        # non-zero on it (the loud-failure lesson, applied to correctness)
+        runner.auditor = _bench_auditor(runner, HTTPClient(url))
         # informers first (nodes sync into the scheduler cache); the loop
         # starts after pod creation so the first pop drains a deep backlog
         runner.start(start_loop=False)
@@ -285,6 +332,7 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
             if device_chaos is not None:
                 device_chaos.uninstall()
                 device_chaos = None
+        audit_block = _audit_close(runner)
         runner.stop()
         out = {
             "case": ("ChaosChurn" if chaos_seed is not None
@@ -334,6 +382,7 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         out["pipeline_depth"] = runner.cfg.pipeline_depth
         out["encode_cache"] = encode_cache
         out["attempt_buckets"] = attempt_buckets
+        out.update(audit_block)
         return out
     finally:
         if schedule is not None:  # crash path: never leak installed chaos
@@ -448,6 +497,10 @@ def _run_mesh_leg(mesh_shape, n_pods: int, n_nodes: int, batch_size: int,
             SchedulerConfiguration(batch_size=batch_size,
                                    max_drain_batches=drain_batches,
                                    mesh_shape=mesh_shape))
+        # churn legs run under fail-fast audit too: a sharded program that
+        # silently corrupts placements must fail THIS leg, not surface as
+        # a throughput anomaly three rounds later
+        runner.auditor = _bench_auditor(runner, HTTPClient(url))
         runner.start(wait_sync=30.0, start_loop=False)
         armed = _warm_jit(runner, pods, batch_size, n_pods, log)
         mesh = runner.scheduler._mesh
@@ -493,6 +546,7 @@ def _run_mesh_leg(mesh_shape, n_pods: int, n_nodes: int, batch_size: int,
         p99 = ATTEMPT_DURATION.percentile(0.99, {"result": "scheduled"})
         span_ms = _span_totals()
         encode_cache = runner.cache.encode_cache_stats()
+        audit_block = _audit_close(runner)
         log(f"  mesh={mesh_shape}: {bound}/{n_pods} bound at +{dt:.1f}s")
         return {
             "mesh": (f"{mesh_shape[0]}x{mesh_shape[1]}"
@@ -505,6 +559,7 @@ def _run_mesh_leg(mesh_shape, n_pods: int, n_nodes: int, batch_size: int,
             "span_ms": span_ms,
             "encode_cache": encode_cache,
             "jit_warmed": armed,
+            **audit_block,
         }
     finally:
         try:
@@ -552,6 +607,8 @@ def run_connected_mesh(mesh_shape: tuple[int, int] = (1, 2),
            "parity": parity}
     if not parity["ok"]:
         # live legs would measure a miscompiling backend; report and stop
+        # (no audited legs ran — bench.py fails on the parity verdict)
+        out["invariant_violations"] = 0
         return out
     legs = {}
     for name, shape in (("unsharded", None), ("sharded", mesh_shape)):
@@ -574,6 +631,11 @@ def run_connected_mesh(mesh_shape: tuple[int, int] = (1, 2),
     out["throughput_ratio"] = round(sh / un, 3) if un and sh else None
     out["all_bound"] = (legs["unsharded"].get("bound") == n_pods
                         and legs["sharded"].get("bound") == n_pods)
+    # summary-level audit figure: a MULTICHIP JSON without it is refused
+    # by bench.py (the loud-failure lesson — a missing field must never
+    # read as "zero violations")
+    out["invariant_violations"] = sum(
+        int(leg.get("invariant_violations") or 0) for leg in legs.values())
     return out
 
 

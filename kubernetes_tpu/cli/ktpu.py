@@ -627,6 +627,62 @@ def cmd_status(client: HTTPClient, args, out) -> int:
     return 0
 
 
+def cmd_audit(client: HTTPClient, args, out) -> int:
+    """ktpu audit status: the continuous invariant auditor's published
+    state (the ``audit`` block of the scheduler status ConfigMap) —
+    invariants checked, confirmed violations, repro-bundle locations, and
+    the device-parity sentinel's sample/divergence counters."""
+    from kubernetes_tpu.sched.runner import STATUS_CONFIGMAP
+    try:
+        cm = client.resource("configmaps", args.namespace).get(
+            STATUS_CONFIGMAP)
+    except ApiError as e:
+        if e.code != 404:
+            raise
+        out.write("error: no scheduler status published "
+                  f"(configmap {STATUS_CONFIGMAP!r} not found in "
+                  f"{args.namespace!r})\n")
+        return 1
+    st = json.loads((cm.get("data") or {}).get("status", "{}") or "{}")
+    audit = st.get("audit")
+    if audit is None:
+        out.write("error: scheduler status carries no audit block "
+                  "(older scheduler?)\n")
+        return 1
+    if args.output == "json":
+        out.write(json.dumps(audit, indent=1) + "\n")
+        return 0
+    out.write(f"Sweeps:        {audit.get('sweeps', 0)} "
+              f"(every {audit.get('intervalSeconds', '?')}s, "
+              f"last: {audit.get('lastSweep') or 'never'})\n")
+    out.write(f"Fail-fast:     "
+              f"{'on' if audit.get('failFast') else 'off'}"
+              f"{' — TRIPPED' if audit.get('failed') else ''}\n")
+    n = audit.get("violations", 0)
+    out.write(f"Violations:    {n}\n")
+    for inv, c in sorted((audit.get("byInvariant") or {}).items()):
+        out.write(f"  {inv}: {c}\n")
+    out.write(f"Bundles:       {audit.get('bundleDir')}\n")
+    for b in audit.get("bundles") or []:
+        out.write(f"  {b}\n")
+    par = audit.get("parity")
+    if par:
+        samples = par.get("samples") or {}
+        out.write(f"Parity:        every {par.get('every')}th dispatch "
+                  f"(drain samples: {samples.get('drain', 0)}, "
+                  f"wave: {samples.get('wave', 0)}, "
+                  f"skipped: {par.get('skipped', 0)})\n")
+        out.write(f"Divergences:   {par.get('divergences', 0)}\n")
+        last = par.get("lastDivergence")
+        if last:
+            out.write(f"  last: {last.get('site')} at level "
+                      f"{last.get('level')} -> {last.get('mode')} "
+                      f"(bundle: {last.get('bundle')})\n")
+    else:
+        out.write("Parity:        off\n")
+    return 0
+
+
 def cmd_autoscale(client: HTTPClient, args, out) -> int:
     """ktpu autoscale status: the cluster-autoscaler's published status
     (the ``cluster-autoscaler-status`` ConfigMap, same surface as the
@@ -904,6 +960,11 @@ def build_parser() -> argparse.ArgumentParser:
     asc.add_argument("-o", "--output", choices=["table", "json"],
                      default="table")
 
+    au = sub.add_parser("audit")
+    au.add_argument("action", choices=["status"])
+    au.add_argument("-o", "--output", choices=["table", "json"],
+                    default="table")
+
     ds = sub.add_parser("deschedule")
     ds.add_argument("action", choices=["run", "status"])
     ds.add_argument("--policy", default=None,
@@ -978,6 +1039,8 @@ def main(argv=None, out=None) -> int:
             return cmd_status(client, args, out)
         if args.cmd == "autoscale":
             return cmd_autoscale(client, args, out)
+        if args.cmd == "audit":
+            return cmd_audit(client, args, out)
         if args.cmd == "deschedule":
             return cmd_deschedule(client, args, out)
     except ApiError as e:

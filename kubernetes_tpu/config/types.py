@@ -158,6 +158,20 @@ class SchedulerConfiguration:
     # thread to actually exit).
     watchdog_interval_s: float = 2.0
     watchdog_stall_s: float = 600.0
+    # ---- continuous auditing (kubernetes_tpu/audit/) ---------------------
+    # Invariant auditor sweep cadence: every sweep takes a resourceVersion-
+    # consistent apiserver list + scheduler-cache view and checks the
+    # correctness invariants (no overcommit, no double-bind, gang
+    # atomicity, nomination consistency, cache/ctx parity).
+    audit_interval_s: float = 30.0
+    # Fail-fast: a confirmed violation RAISES (tests/benches) instead of
+    # only counting + writing a repro bundle (production default).
+    audit_fail_fast: bool = False
+    # Device-parity sentinel: every Kth drain_step / preempt_wave dispatch
+    # is re-checked against the numpy oracle off the hot path; a refuted
+    # answer trips the circuit breaker with reason "parity". 0 disables.
+    # KTPU_PARITY_EVERY overrides at scheduler construction.
+    parity_sample_every: int = 16
 
     def profile_for(self, scheduler_name: str) -> Optional[Profile]:
         for p in self.profiles:
@@ -188,6 +202,9 @@ class SchedulerConfiguration:
             ("bindRetryBackoffSeconds", "bind_retry_backoff_s"),
             ("watchdogIntervalSeconds", "watchdog_interval_s"),
             ("watchdogStallSeconds", "watchdog_stall_s"),
+            ("auditIntervalSeconds", "audit_interval_s"),
+            ("auditFailFast", "audit_fail_fast"),
+            ("paritySampleEvery", "parity_sample_every"),
         ]:
             if yaml_key in d:
                 setattr(cfg, attr, type(getattr(cfg, attr))(d[yaml_key]))
@@ -254,6 +271,10 @@ def validate(cfg: SchedulerConfiguration):
         raise ValidationError("watchdogIntervalSeconds must be > 0")
     if cfg.watchdog_stall_s <= 0:
         raise ValidationError("watchdogStallSeconds must be > 0")
+    if cfg.audit_interval_s <= 0:
+        raise ValidationError("auditIntervalSeconds must be > 0")
+    if cfg.parity_sample_every < 0:
+        raise ValidationError("paritySampleEvery must be >= 0 (0 = off)")
     if cfg.mesh_shape is not None:
         if len(cfg.mesh_shape) != 2:
             raise ValidationError(

@@ -291,8 +291,9 @@ DEGRADED_MODE = REGISTRY.gauge(
     "pure-numpy oracle")
 BREAKER_TRIPS = REGISTRY.counter(
     "scheduler_breaker_trips_total",
-    "Circuit-breaker trips (one consecutive-failure threshold crossing = "
-    "one degrade step)")
+    "Circuit-breaker trips (one degrade step each) by reason: 'device' = "
+    "consecutive program failures, 'parity' = the sentinel proved a "
+    "program returned a wrong answer")
 WATCHDOG_RESTARTS = REGISTRY.counter(
     "scheduler_watchdog_restarts_total",
     "Dead/stalled threads the watchdog restarted, by thread")
@@ -304,6 +305,28 @@ BIND_RETRIES = REGISTRY.counter(
     "scheduler_bind_retries_total",
     "Jittered retries of bind/status API writes that would previously "
     "have failed straight through to a requeue")
+
+# Continuous correctness auditing (kubernetes_tpu/audit/): the auditor
+# sweeps a consistent apiserver+scheduler snapshot for invariant breaks;
+# the parity sentinel cross-checks sampled device dispatches against the
+# numpy oracle. Violations here mean WRONG state, not slow state — every
+# one also writes a replayable repro bundle to disk.
+INVARIANT_VIOLATIONS = REGISTRY.counter(
+    "scheduler_invariant_violations_total",
+    "Confirmed correctness-invariant violations by invariant "
+    "(node_overcommit|double_bind|gang_atomicity|nomination_consistency|"
+    "cache_parity|ctx_parity)")
+AUDIT_SWEEPS = REGISTRY.counter(
+    "scheduler_audit_sweeps_total",
+    "Completed invariant-auditor sweeps")
+PARITY_SAMPLES = REGISTRY.counter(
+    "scheduler_parity_samples_total",
+    "Device dispatches sampled by the parity sentinel, by site "
+    "(drain|wave)")
+PARITY_DIVERGENCES = REGISTRY.counter(
+    "scheduler_parity_divergence_total",
+    "Sampled device dispatches whose winners the numpy oracle REFUTED "
+    "(each one trips the circuit breaker with reason 'parity'), by site")
 
 # Kubelet pod-sync health (pod_workers.go error bookkeeping analog).
 # Aggregate only — per-pod counts are PodWorkers.sync_errors(uid); a

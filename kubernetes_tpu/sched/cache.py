@@ -631,3 +631,19 @@ class SchedulerCache:
                     "assumed": len(self._assumed),
                     "generation": self._generation,
                     "full_encodes": self._full_encodes}
+
+    def audit_view(self) -> dict:
+        """One-lock-pass consistent view for the invariant auditor:
+        confirmed-bound and assumed placements (key -> node), the node-name
+        set, and the generation. Plain values only — the auditor runs on
+        its own thread and must never hold references that alias the
+        cache's mutable state."""
+        with self._lock:
+            return {
+                "bound": {k: p.spec.node_name
+                          for k, p in self._pods.items()},
+                "assumed": {k: p.spec.node_name
+                            for k, (p, _dl) in self._assumed.items()},
+                "nodes": set(self._nodes),
+                "generation": self._generation,
+            }

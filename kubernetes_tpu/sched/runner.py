@@ -101,6 +101,20 @@ class SchedulerRunner:
         self.scheduler.heartbeat = lambda: self._watchdog.beat("loop")
         self.scheduler.resolver_heartbeat = \
             lambda: self._watchdog.beat("resolver")
+        # continuous invariant auditor (kubernetes_tpu/audit/): background
+        # sweeps over a consistent apiserver list + the scheduler's cache/
+        # resident-ctx views. The stale-nomination GC rides the same
+        # cadence as the pre-sweep hook, so every sweep judges the
+        # post-GC state; relist counting gates cache-parity (an informer
+        # healing from a watch outage is lagging, not wrong).
+        from kubernetes_tpu.audit.auditor import InvariantAuditor
+        self.auditor = InvariantAuditor(
+            client=client, cache=self.cache, scheduler=self.scheduler,
+            interval_s=self.cfg.audit_interval_s,
+            fail_fast=self.cfg.audit_fail_fast,
+            pre_sweep=self.sweep_stale_nominations,
+            post_sweep=self.publish_status,
+            relists=self._total_relists)
 
     # ---- event handlers (pkg/scheduler/eventhandlers.go analog) ----------
 
@@ -333,6 +347,58 @@ class SchedulerRunner:
                 # the claim controller's release sweep is the backstop
                 _LOG.warning("claim unreserve failed (sweep will catch): %s", e)
 
+    def _total_relists(self) -> int:
+        return sum(getattr(inf, "relists", 0)
+                   for inf in self.factory._informers.values())
+
+    def sweep_stale_nominations(self) -> int:
+        """Periodic GC: clear ``status.nominatedNodeName`` from bound or
+        terminal pods. A nomination's job ends the moment its pod binds
+        (or dies); the field surviving past that — a preemption nominee
+        bound elsewhere, a descheduler gang plan that half-executed —
+        pins a node's capacity in every consumer that honors nominations
+        and is exactly what the auditor's nomination_consistency invariant
+        flags. Runs as the auditor's pre-sweep hook; returns pods cleared.
+        Best effort per pod: 404/409 mean the pod moved on and the next
+        sweep re-judges it."""
+        cleared = 0
+        try:
+            pods = self.client.resource("pods", None).list()
+        except Exception:
+            LOOP_ERRORS.inc({"site": "nomination_gc"})
+            _LOG.warning("stale-nomination sweep: pod list failed",
+                         exc_info=True)
+            return 0
+        for p in pods:
+            st = p.get("status") or {}
+            if not st.get("nominatedNodeName"):
+                continue
+            bound = bool((p.get("spec") or {}).get("nodeName"))
+            terminal = st.get("phase") in ("Succeeded", "Failed")
+            if not (bound or terminal):
+                continue
+            md = p.get("metadata") or {}
+            q = dict(p)
+            q["status"] = {k: v for k, v in st.items()
+                           if k != "nominatedNodeName"}
+            try:
+                self.client.pods(md.get("namespace", "default")) \
+                    .update_status(q)
+                cleared += 1
+                _LOG.info("cleared stale nomination on %s pod %s/%s",
+                          "bound" if bound else "terminal",
+                          md.get("namespace", "default"), md.get("name"))
+            except ApiError as e:
+                if e.code not in (404, 409):
+                    LOOP_ERRORS.inc({"site": "nomination_gc"})
+                    _LOG.warning("stale-nomination clear for %s failed: %s",
+                                 md.get("name"), e)
+            except Exception:
+                LOOP_ERRORS.inc({"site": "nomination_gc"})
+                _LOG.warning("stale-nomination clear for %s failed",
+                             md.get("name"), exc_info=True)
+        return cleared
+
     def _evict(self, victim: Pod):
         # Preemption DELETEs the victim directly (schedule_one.go preempts
         # via clientset Pods().Delete, not the Eviction API): victim
@@ -407,6 +473,7 @@ class SchedulerRunner:
             self._threads.append(t)
         elif start_loop:
             self._start_loop()
+        self.auditor.start()
         self.publish_status()
         return self
 
@@ -427,12 +494,22 @@ class SchedulerRunner:
             "degradedMode": breaker.mode,
             "degradedIndex": breaker.index,
             "breakerTrips": breaker.trips,
+            "breakerTripReasons": dict(breaker.trip_reasons),
+            "lastTripReason": breaker.last_trip_reason,
             "breakerRestores": breaker.restores,
             "watchdogRestarts": self._watchdog.restarts,
             "watchRelists": relists,
             "lastRelist": (rfc3339_from_epoch(last_relist)
                            if last_relist else None),
         }
+
+    def _audit_status(self) -> dict:
+        """Auditor + parity-sentinel state for the status ConfigMap
+        (``ktpu audit status`` reads this block)."""
+        status = self.auditor.status()
+        sentinel = self.scheduler.sentinel
+        status["parity"] = sentinel.stats() if sentinel is not None else None
+        return status
 
     def publish_status(self) -> None:
         """Publish the deployment-shape status ConfigMap (``ktpu status``
@@ -453,6 +530,7 @@ class SchedulerRunner:
             "pipelineDepth": self.cfg.pipeline_depth,
             "profiles": [p.scheduler_name for p in self.cfg.profiles],
             "resilience": self._resilience_status(),
+            "audit": self._audit_status(),
         }
         body = {
             "apiVersion": "v1", "kind": "ConfigMap",
@@ -580,6 +658,7 @@ class SchedulerRunner:
     def stop(self):
         self._stop.set()
         self._watchdog.stop()
+        self.auditor.stop()
         self._stop_loop()
         self.queue.close()
         self.scheduler.close()
@@ -594,6 +673,7 @@ class SchedulerRunner:
         tests/test_chaos.py proves it is."""
         self._stop.set()
         self._watchdog.stop()
+        self.auditor.stop()
         self._loop_expected = False
         if self._loop_stop is not None:
             self._loop_stop.set()

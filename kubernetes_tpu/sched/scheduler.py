@@ -116,6 +116,25 @@ class Scheduler:
             levels=("single", "oracle"), threshold=cfg.breaker_threshold,
             cooldown_s=cfg.breaker_cooldown_s)
         self._attempt_level = self.breaker.mode
+        # device-parity sentinel (audit/sentinel.py): every Kth drain/wave
+        # dispatch is re-judged against the numpy oracle off this thread;
+        # a refuted answer trips the breaker with reason "parity" — the
+        # runtime guard for the GSPMD-miscompile class the startup canaries
+        # can't cover. breaker_ref is a callable because tests swap
+        # self.breaker wholesale.
+        parity_every = cfg.parity_sample_every
+        env_parity = _os.environ.get("KTPU_PARITY_EVERY")
+        if env_parity is not None:
+            try:
+                parity_every = max(0, int(env_parity))
+            except ValueError:
+                _LOG.warning("ignoring invalid KTPU_PARITY_EVERY=%r",
+                             env_parity)
+        self.sentinel = None
+        if parity_every > 0:
+            from kubernetes_tpu.audit.sentinel import ParitySentinel
+            self.sentinel = ParitySentinel(lambda: self.breaker,
+                                           every=parity_every)
         # watchdog heartbeats (the runner wires these to its watchdog;
         # library embedders keep the no-ops)
         self.heartbeat: Callable[[], None] = lambda: None
@@ -879,6 +898,17 @@ class Scheduler:
         oot = (None if profile.out_of_tree is None
                else set(profile.out_of_tree))
         plugins = self.registry.tensor_plugins(oot)
+        # parity sentinel: on sampled dispatches capture the host views the
+        # resident encoding mirrors (consistent here — the ctx's log cursor
+        # was settled on this thread moments ago; anything newer is carried
+        # as the exempt set). Winners of still-in-flight drains resolve
+        # before this one, so their placements are collected at resolve.
+        parity_cap = None
+        if self.sentinel is not None and not self._extenders:
+            parity_cap = self.sentinel.maybe_capture_drain(
+                self.cache, profile, self._attempt_level, ctx["seq"])
+            if parity_cap is not None:
+                parity_cap["prior"] = list(self._pending)
         # ---- dispatch (async): the device crunches this drain while the
         # host resolves the PREVIOUS one — assume/bind/requeue and the next
         # pop's decode all overlap device execution (software pipelining;
@@ -939,6 +969,8 @@ class Scheduler:
             # nominations that arrive AFTER this point
             "nom_keys": set(nom_target),
         }
+        if parity_cap is not None:
+            pend["parity"] = parity_cap
         if self.cycle_log is not None:
             marks = dict(self._cyc_marks)
             marks["done"] = round(time.time() - t0, 3)
@@ -1133,6 +1165,15 @@ class Scheduler:
                 for pod, _node in to_bind:
                     if nominated:
                         nominated.pop(pod.key, None)
+        # every resolved drain records its winners: a later sampled drain's
+        # parity check needs the placements of the drains that were in
+        # flight when it dispatched (the device fold already counted them)
+        pend["winners"] = list(to_bind)
+        cap = pend.get("parity")
+        if cap is not None and self.sentinel is not None:
+            prior = [w for pp in cap.pop("prior", ())
+                     for w in pp.get("winners", ())]
+            self.sentinel.submit_drain(cap, list(to_bind), prior)
         n_bound = len(to_bind)
         n_unsched = len(failures)
         self._handle_failures(failures)
@@ -1476,6 +1517,7 @@ class Scheduler:
             _LOG.exception("static masks from resident encoding failed; "
                            "preempt_wave will re-encode")
             masks = None  # preempt_wave computes its own
+        device_wave = True
         with TRACER.span("preempt/wave", pods=len(pods),
                          nodes=len(nodes)):
             try:
@@ -1492,7 +1534,15 @@ class Scheduler:
                              "degrading to the serial host scan",
                              exc_info=True)
                 self.breaker.fail(self._attempt_level)
+                device_wave = False
                 results = self._preempt_serial(nodes, bound, views)
+        if device_wave and self.sentinel is not None:
+            # parity sample for the DEVICE wave only — the serial fallback
+            # IS the oracle. Inputs are the exact host objects the wave's
+            # masks were built from; judging runs off this thread.
+            self.sentinel.maybe_submit_wave(
+                nodes, bound, views, results, self._attempt_level,
+                namespace_labels=self.cache.namespace_labels)
         out: list[Optional[str]] = []
         with TRACER.span("preempt/evict"):
             for res in results:
@@ -1618,6 +1668,8 @@ class Scheduler:
             self._resolver_q.put(None)  # poison pill; thread is daemon
             self._resolver_thread = None
             self._resolver_q = None
+        if self.sentinel is not None:
+            self.sentinel.close()
         if self._staged:
             # parked fragments go back to the queue, not the void — with
             # their attempt history, so backoff does not reset
@@ -1707,6 +1759,24 @@ class Scheduler:
         ctx = self._drain_ctx
         if ctx is not None:
             ctx["cs"].tainted = True
+
+    def audit_ctx_view(self) -> Optional[dict]:
+        """Plain-value view of the resident drain context's host-side fold
+        ledger for the invariant auditor (audit/invariants.py ctx_parity).
+        Reads from a foreign thread: each field is one GIL-atomic read or
+        dict copy off a local ctx reference — a concurrent dispatch can
+        make the view momentarily inconsistent, which the auditor's
+        confirm-across-sweeps engine absorbs."""
+        ctx = self._drain_ctx
+        if ctx is None:
+            return None
+        cs = ctx["cs"]
+        return {"profile": ctx["profile"], "tainted": cs.tainted,
+                "seq": ctx["seq"], "fill_bound": ctx["fill_bound"],
+                "fill_host": cs.fill_host, "top": cs.top,
+                "folded": dict(cs.folded),
+                "mesh_epoch": ctx["mesh_epoch"],
+                "pending": len(self._pending)}
 
     def run(self, stop: threading.Event):
         """wait.UntilWithContext(sched.ScheduleOne, 0) analog — hardened:
