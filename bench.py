@@ -217,6 +217,8 @@ def main():
              "pods_per_sec": r.get("SchedulingThroughput"),
              "p99_s": r.get("p99_schedule_latency_s"),
              "passed": r["passed"],
+             **({"slo_failures": r["slo_failures"]}
+                if r.get("slo_failures") else {}),
              **({"churn_api_ops": r["churn_api_ops"], "connected": True}
                 if "churn_api_ops" in r else {})} for r in results],
         "connected": connected,
@@ -233,9 +235,18 @@ def main():
         # as "fine" for rounds
         "invariant_violations": _sum_violations(connected, chaos_churn,
                                                 connected_mesh),
+        # hard SLO verdicts from case-config gates (SchedulingChurn p99 +
+        # throughput, ConnectedMesh legs). Missing numbers are failures —
+        # the BENCH_r05 parsed-null lesson: a silently absent figure must
+        # never read as a pass.
+        "slo_failures": _collect_slo_failures(results, connected_mesh),
     }
     _require_invariant_field(out, "bench summary")
     print(json.dumps(out))
+    if out["slo_failures"]:
+        print(f"[bench] FATAL: {len(out['slo_failures'])} SLO gate "
+              f"failure(s): {out['slo_failures']}", file=sys.stderr)
+        sys.exit(1)
     if out["invariant_violations"]:
         audited = {name: c.get("invariant_violations") for name, c in
                    (("connected", connected), ("chaos_churn", chaos_churn),
@@ -263,6 +274,18 @@ def main():
         print("[bench] FATAL: ConnectedMesh sharded placements diverge "
               "from unsharded", file=sys.stderr)
         sys.exit(1)
+
+
+def _collect_slo_failures(results, connected_mesh) -> list:
+    """Flatten every case's hard-SLO failure strings, prefixed by case."""
+    out = []
+    for r in results or []:
+        for msg in r.get("slo_failures") or []:
+            out.append(f"{r['case']}/{r['workload']}: {msg}")
+    if connected_mesh is not None:
+        for msg in connected_mesh.get("slo_failures") or []:
+            out.append(f"ConnectedMesh: {msg}")
+    return out
 
 
 def _sum_violations(*cases) -> int:

@@ -43,6 +43,32 @@ def _serve(conn) -> None:
     server.stop()
 
 
+def check_slo_gates(result: dict, gates: dict) -> list[str]:
+    """HARD SLO verdicts for a bench case: throughput floors and latency
+    ceilings from the case config. A MISSING or unparseable figure fails
+    exactly like a regressed one — BENCH_r05's summary crash silently
+    nulled every number for three rounds, and a gate that treats None as
+    'no data, pass' would do it again. Returns failure strings (empty =
+    all gates green)."""
+    failures: list[str] = []
+    for key, bound in (gates or {}).items():
+        if key == "SchedulingThroughput":
+            val, ok = result.get("SchedulingThroughput"), "floor"
+        elif key == "p99AttemptLatencySeconds":
+            val, ok = result.get("p99_attempt_latency_s"), "ceiling"
+        else:
+            failures.append(f"unknown SLO gate {key!r} (refusing to skip)")
+            continue
+        if not isinstance(val, (int, float)):
+            failures.append(f"{key}: value missing/unparsed ({val!r}) — "
+                            f"gate {bound} cannot pass silently")
+        elif ok == "floor" and val < bound:
+            failures.append(f"{key}: {val} below the {bound} floor")
+        elif ok == "ceiling" and val > bound:
+            failures.append(f"{key}: {val} above the {bound}s ceiling")
+    return failures
+
+
 def _bench_auditor(runner, clean_client, interval_s: float = 2.0):
     """Fail-fast invariant auditor for a bench window (replaces the
     runner's production-cadence auditor BEFORE start): tight sweeps, a
@@ -584,7 +610,7 @@ def _run_mesh_leg(mesh_shape, n_pods: int, n_nodes: int, batch_size: int,
 def run_connected_mesh(mesh_shape: tuple[int, int] = (1, 2),
                        n_pods: int = 1024, n_nodes: int = 96,
                        batch_size: int = 128, drain_batches: int = 2,
-                       timeout: float = 300.0,
+                       timeout: float = 300.0, slo_gates: dict | None = None,
                        log=lambda *a: None) -> dict:
     """ConnectedMesh case: the deterministic sharded-vs-unsharded drain
     parity gate, then the SAME live workload (connected apiserver + hollow
@@ -631,6 +657,18 @@ def run_connected_mesh(mesh_shape: tuple[int, int] = (1, 2),
     out["throughput_ratio"] = round(sh / un, 3) if un and sh else None
     out["all_bound"] = (legs["unsharded"].get("bound") == n_pods
                         and legs["sharded"].get("bound") == n_pods)
+    # HARD SLO gates per leg (case-config thresholds, BENCH_MESH_SLO_*
+    # env-overridable): a leg that RAN but produced a missing or regressed
+    # p99/throughput figure fails the bench. Legs that crashed carry an
+    # "error" key and are judged by the parity verdict instead (the
+    # virtual-CPU GSPMD environmental-crash contract from PR 5).
+    if slo_gates is None:
+        slo_gates = {"SchedulingThroughput": 60,
+                     "p99AttemptLatencySeconds": 10}
+    out["slo_gates"] = slo_gates
+    out["slo_failures"] = [
+        f"{name}: {msg}" for name, leg in legs.items()
+        if "error" not in leg for msg in check_slo_gates(leg, slo_gates)]
     # summary-level audit figure: a MULTICHIP JSON without it is refused
     # by bench.py (the loud-failure lesson — a missing field must never
     # read as "zero violations")
@@ -833,6 +871,12 @@ if __name__ == "__main__":
             n_pods=int(os.environ.get("BENCH_MESH_PODS", "1024")),
             n_nodes=int(os.environ.get("BENCH_MESH_NODES", "96")),
             batch_size=int(os.environ.get("BENCH_MESH_BATCH", "128")),
+            slo_gates={
+                "SchedulingThroughput":
+                    float(os.environ.get("BENCH_MESH_SLO_TPUT", "60")),
+                "p99AttemptLatencySeconds":
+                    float(os.environ.get("BENCH_MESH_SLO_P99", "10")),
+            },
             log=lambda *a: print(*a, file=sys.stderr))
         print(json.dumps(res))
         sys.exit(0 if res.get("parity", {}).get("ok") else 1)

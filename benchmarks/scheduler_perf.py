@@ -310,15 +310,28 @@ def _run_churn_workload(case: dict, workload: dict, params: dict,
               and all(throughput >= t * scale
                       for k, t in thresholds.items()
                       if k == "SchedulingThroughput"))
+    # HARD SLO gates (distinct from the advisory thresholds above): a
+    # missing or regressed p99/throughput figure must fail the bench run,
+    # not read as fine — bench.py exits non-zero on slo_failures.
+    # Throughput floors scale with the workload like the advisory
+    # thresholds do; latency ceilings stay absolute (a scaled-down run is
+    # only ever faster).
+    from benchmarks.connected import check_slo_gates
+    slo = {k: (v * scale if k == "SchedulingThroughput" else v)
+           for k, v in (workload.get("sloGates") or {}).items()}
+    slo_failures = check_slo_gates(res, slo)
     return {
         "case": case["name"], "workload": workload["name"],
         "SchedulingThroughput": throughput,
+        "p99_attempt_latency_s": res.get("p99_attempt_latency_s"),
         "p99_schedule_latency_s": res.get("p99_attempt_latency_s"),
         "scheduled": res["bound"], "pods": res["pods"],
         "nodes": res["nodes"], "measure_s": res["measure_s"],
         "churn_api_ops": res.get("churn_api_ops", 0),
+        "ctx_stats": res.get("ctx_stats"),
         "connected": True,
-        "thresholds": thresholds, "passed": passed,
+        "thresholds": thresholds, "passed": passed and not slo_failures,
+        "slo_gates": slo, "slo_failures": slo_failures,
     }
 
 

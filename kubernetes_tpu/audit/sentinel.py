@@ -237,7 +237,16 @@ class ParitySentinel:
         non-sampled dispatches; skips (counted) profiles whose disabled
         filters the oracle cannot honor and captures racing cluster-level
         churn (pending node/full deltas) — judging either would refute
-        CORRECT answers."""
+        CORRECT answers.
+
+        Fused folds (deltas applied INSIDE the sampled dispatch as
+        drain_step's third input) need no special casing: the scheduler
+        advances ``ctx_seq`` past them before capturing, and the scatter
+        applies in front of the scan — so the device's view at judgment
+        time equals the host views captured here, and the folded deltas
+        are correctly NOT exempt. In fact fused folds make MORE dispatches
+        judgeable: node churn that used to sit pending (strict-mode skip)
+        is consumed by the dispatch itself."""
         if self.every <= 0:
             return None
         self._n_drain += 1

@@ -610,7 +610,22 @@ def cmd_status(client: HTTPClient, args, out) -> int:
     out.write(f"Identity:      {st.get('identity', '<unknown>')}\n")
     out.write(f"Batch size:    {st.get('batchSize', '?')}\n")
     out.write(f"Drain batches: {st.get('maxDrainBatches', '?')}\n")
-    out.write(f"Pipeline:      {st.get('pipelineDepth', '?')} deep\n")
+    inflight = st.get("pipelineInflight")
+    out.write(f"Pipeline:      {st.get('pipelineDepth', '?')} deep"
+              + (f" ({inflight} in flight)" if inflight is not None else "")
+              + "\n")
+    ctx = st.get("ctx")
+    if ctx is not None:
+        fused = st.get("fusedFold")
+        reasons = ", ".join(f"{k}={v}" for k, v in
+                            sorted((ctx.get("reasons") or {}).items()))
+        out.write(f"Resident ctx:  folds {ctx.get('folds', 0)}, "
+                  f"patches {ctx.get('patches', 0)}, "
+                  f"rebuilds {ctx.get('rebuilds', 0)}"
+                  + (f" ({reasons})" if reasons else "")
+                  + (f" — fused fold {'on' if fused else 'off'}"
+                     if fused is not None else "")
+                  + "\n")
     out.write(f"Profiles:      {', '.join(st.get('profiles') or [])}\n")
     res = st.get("resilience")
     if res:

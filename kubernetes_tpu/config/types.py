@@ -125,6 +125,13 @@ class SchedulerConfiguration:
     # host-side apply/bind work behind device execution. jax dispatch is
     # asynchronous, so deeper pipelines cost HBM for queued programs only.
     pipeline_depth: int = 2
+    # Fused fold: churn patches ride the drain dispatch as a third input of
+    # the resident device program (models/gang.py drain_step) instead of a
+    # separate blocking apply_ctx_patch dispatch — and fold-SAFE churn
+    # (encode/patch.py entries_fold_safe) no longer drains the dispatch
+    # pipeline first. False restores the PR3-era patch-then-dispatch path
+    # (the parity tests diff the two). KTPU_FUSED_FOLD=0 overrides.
+    fused_fold: bool = True
     # Device-mesh shape (pods_axis, nodes_axis) for the live scheduling
     # path: cluster tensors shard over "nodes", pod batches over "pods",
     # and the drain/preemption programs run under GSPMD with ICI
@@ -191,6 +198,7 @@ class SchedulerConfiguration:
             ("batchSize", "batch_size"), ("maxGangRounds", "max_gang_rounds"),
             ("maxDrainBatches", "max_drain_batches"),
             ("pipelineDepth", "pipeline_depth"),
+            ("fusedFold", "fused_fold"),
             ("seed", "seed"), ("backoffInitialSeconds", "backoff_initial_s"),
             ("backoffMaxSeconds", "backoff_max_s"), ("assumeTTLSeconds", "assume_ttl_s"),
             ("clientQPS", "client_qps"), ("parallelism", "parallelism"),

@@ -192,9 +192,51 @@ def entries_all_folded(cs: CtxPatchState, entries: list) -> bool:
     return True
 
 
+def entries_fold_safe(cs: CtxPatchState, entries: list,
+                      inflight_keys: set) -> bool:
+    """True when the delta-log entries can be compiled into a patch WITHOUT
+    first draining the dispatch pipeline — the fused-fold gate.
+
+    The patch state's slot/row maps lag the device by exactly the in-flight
+    drains' folds (mirrored at resolve). A delta is fold-safe when nothing
+    it touches depends on those unmirrored folds:
+
+    - pod-level entries (``assume``/``pod``/``poddel``) must not name a pod
+      an in-flight drain is scheduling: its fold slot is unknown until
+      resolve, so a delete/rebind could not be addressed;
+    - ``nodedel`` is never fold-safe while drains are in flight: the
+      retire-or-free decision reads ``row_pods``, which does not yet count
+      in-flight folds — a row could be freed (and later reused by a node
+      add) while folded pods still reference it;
+    - ``full`` always forces the rebuild path (compile would refuse it
+      anyway, but the caller should not burn a compile to learn that).
+
+    Node upserts are safe: existing rows rewrite in place, and new rows
+    come from ``node_free`` — rows no in-flight fold can reference (folds
+    only land on valid winner rows). Slot-cursor collisions are handled
+    separately: the caller compiles with ``fold_floor`` set to its
+    dispatch-side fill reservation."""
+    for _seq, op, payload in entries:
+        if op in ("full", "nodedel"):
+            return False
+        if op == "assume":
+            key = payload[0]
+        elif op == "pod":
+            key = payload.key
+        elif op == "poddel":
+            key = payload
+        elif op == "node":
+            continue
+        else:
+            return False  # unknown op: fail safe
+        if key in inflight_keys:
+            return False
+    return True
+
+
 def compile_patch(encoder, meta: SnapshotMeta, cs: CtxPatchState,
                   entries: list, nom_target: dict,
-                  nom_bucket: int) -> Optional[dict]:
+                  nom_bucket: int, fold_floor: int = 0) -> Optional[dict]:
     """Delta-log entries + nominee target set -> numpy scatter arrays for
     apply_ctx_patch, updating ``cs``/``meta`` bookkeeping in the same pass.
 
@@ -202,16 +244,23 @@ def compile_patch(encoder, meta: SnapshotMeta, cs: CtxPatchState,
     {"assume", "pod", "poddel", "node", "nodedel", "full"}.
     ``nom_target``: pod_key -> (node_name, priority, Pod) — the COMPLETE
     desired reservation set; the diff against ``cs.nom_applied`` is patched.
+    ``fold_floor``: lowest slot the patch allocator may descend to — the
+    fused-fold path passes the scheduler's dispatch-side fill reservation
+    (``fill_bound``), which is ahead of ``fill_host`` by exactly the
+    in-flight drains' pods, so a patch compiled without draining the
+    pipeline can never hand out a slot an unresolved fold will take.
 
     Returns None when any delta does not fit (caller rebuilds; ``cs`` is
     then discarded, so no rollback is attempted)."""
     try:
-        return _compile(encoder, meta, cs, entries, nom_target, nom_bucket)
+        return _compile(encoder, meta, cs, entries, nom_target, nom_bucket,
+                        fold_floor)
     except _Unfit:
         return None
 
 
-def _compile(encoder, meta, cs, entries, nom_target, nom_bucket):
+def _compile(encoder, meta, cs, entries, nom_target, nom_bucket,
+             fold_floor=0):
     R = len(cs.resources)
     # final-value accumulators
     pod_writes: dict[int, Optional[tuple]] = {}
@@ -305,7 +354,7 @@ def _compile(encoder, meta, cs, entries, nom_target, nom_bucket):
             cs.folded.pop(key, None)
             return
         if not had_slot:
-            if cs.top <= cs.fill_host:
+            if cs.top <= max(cs.fill_host, fold_floor):
                 raise _Unfit  # patch cursor met the fold watermark
             cs.top -= 1
             slot = cs.top

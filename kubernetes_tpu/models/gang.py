@@ -503,6 +503,7 @@ def drain_widths_fit(ct_all: ClusterTensors, pb_stack: PodBatch) -> bool:
                           "weights", "enabled_filters", "max_rounds",
                           "plugins", "winners_sharding"))
 def drain_step(ct_all: ClusterTensors, pb_stack: PodBatch, fill,
+               patch=None, *,
                e0: int, seed: int, fit_strategy: str,
                topo_keys: tuple[int, ...], weights: tuple,
                enabled_filters: tuple, max_rounds: int,
@@ -519,12 +520,23 @@ def drain_step(ct_all: ClusterTensors, pb_stack: PodBatch, fill,
     [fill, fill+n) and the extension region invalidated — ready to be the
     next call's ``ct_all`` with zero host↔device traffic.
 
+    ``patch``: optional compiled churn patch (encode/patch.py) — the THIRD
+    input of the resident program. When present, the scatter that used to
+    be a separate blocking ``apply_ctx_patch`` dispatch is FUSED in front
+    of the scan: foreign churn folds into the same device program that
+    schedules over it, so a churn cycle costs zero extra dispatches and
+    (when the deltas are fold-safe) no pipeline drain. The patch arrays
+    are ~KB and compile at fixed bucket widths, so the fused variant is
+    one extra XLA program, compiled once at warmup.
+
     ``winners_sharding``: optional (hashable) NamedSharding the compact
     winners view (assignments + rounds + new_fill) is constrained to. Under
     a device mesh the cluster encoding stays sharded in HBM, and pinning
     the winners replicated means the resolver's device_get moves O(B*P)
     int32s — never a gathered sharded intermediate.
     """
+    if patch is not None:
+        ct_all = _apply_patch(ct_all, patch)
     B, P = pb_stack.pod_valid.shape
     K = ct_all.epod_labels.shape[1]
     ET = ct_all.ea_valid.shape[1]
@@ -700,13 +712,12 @@ def build_drain_context(ct: ClusterTensors, pbs: list[PodBatch],
     return ct_dev, e0, fill0
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def apply_ctx_patch(ct_all: ClusterTensors, patch: dict) -> ClusterTensors:
-    """Scatter a compiled churn patch (encode/patch.py compile_patch) into
-    the device-resident drain encoding: pod slot rewrites/clears, node row
-    rewrites/retires, nominee reservation diffs, and the dense
-    requested[N,R] delta — one fused program, donated buffers, ~KB of
-    host->device traffic. Pad entries carry index -1 and are dropped.
+def _apply_patch(ct_all: ClusterTensors, patch: dict) -> ClusterTensors:
+    """Traceable body of the churn-patch scatter: pod slot rewrites/clears,
+    node row rewrites/retires, nominee reservation diffs, and the dense
+    requested[N,R] delta. Shared by the standalone ``apply_ctx_patch``
+    dispatch (rebuild-time nominee staging, fusedFold=off) and the fused
+    drain (``drain_step``'s third input), so the two paths can never drift.
 
     Reference shape: the incremental half of ``Cache.UpdateSnapshot``
     (pkg/scheduler/internal/cache/cache.go) — churn moves only what changed."""
@@ -771,6 +782,9 @@ def apply_ctx_patch(ct_all: ClusterTensors, patch: dict) -> ClusterTensors:
         nom_req=sc(ct_all.nom_req, ms, patch["nom_req"]),
         nom_valid=sc(ct_all.nom_valid, ms, patch["nom_valid"]),
     )
+
+
+apply_ctx_patch = partial(jax.jit, donate_argnums=(0,))(_apply_patch)
 
 
 def prepare_drain(ct: ClusterTensors, pbs: list[PodBatch], stage: bool = True):

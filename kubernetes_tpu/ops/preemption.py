@@ -194,30 +194,34 @@ def _wave_scan(allocatable, requested0, static_mask, vic_req, vic_valid,
 
 
 def _encode_cluster_arrays(nodes, bound_pods, resources, prio_cut,
-                           budgets, dra=None):
+                           budgets, dra=None, resident_arrays=None,
+                           req_lookup=None):
     """Shared host encoding for dry-run programs: per-node totals plus the
     victim tensors in eviction order (non-violating first, priority asc —
     SelectVictimsOnNode's two-phase removal). ``prio_cut``: only pods with
     priority strictly below it are encoded as victims (for a wave, the max
     preemptor priority; the device re-masks per preemptor).
+
+    ``resident_arrays``: optional ``fn(resources) -> (allocatable [N,R],
+    requested [N,R]) | None`` — the scheduler's resident drain context
+    already holds these totals in HBM (folds + churn patches keep them
+    current), so a wave riding it reads them back instead of re-summing
+    every bound pod's requests host-side. ``req_lookup``: optional
+    ``fn(pod, resources) -> [R] | None`` serving per-victim request
+    vectors from the context's fold ledger (same scaled-integer encoding,
+    remapped onto the wave's resource axis).
     -> (allocatable [N,R], requested [N,R], vic_req, vic_valid,
         vic_violating, vic_prio, vic_ref [N,V] indices into bound_pods)."""
     from kubernetes_tpu.sched.preemption import _violates
     R = len(resources)
     N = len(nodes)
     name_to_i = {n.metadata.name: i for i, n in enumerate(nodes)}
-    allocatable = np.zeros((N, R), np.int64)
-    for i, n in enumerate(nodes):
-        alloc = n.allocatable_canonical()
-        if dra is not None:
-            alloc.update(dra.node_capacity(n.metadata.name))
-        for j, r in enumerate(resources):
-            if r == "pods" and r not in alloc:
-                allocatable[i, j] = np.iinfo(np.int32).max
-            else:
-                allocatable[i, j] = scale_allocatable(r, alloc.get(r, 0))
 
     def req_vec(p: Pod) -> np.ndarray:
+        if req_lookup is not None:
+            v = req_lookup(p, resources)
+            if v is not None:
+                return v
         pr = dict(p.resource_requests())
         if dra is not None:
             pr.update(dra.pod_demands(p))
@@ -227,18 +231,39 @@ def _encode_cluster_arrays(nodes, bound_pods, resources, prio_cut,
                 scale_request(r, pr.get(r, 1))
         return v
 
-    requested = np.zeros((N, R), np.int64)
+    precomputed = resident_arrays(resources) if resident_arrays else None
     per_node: dict[int, list[int]] = {}
     req_cache = {}
-    for idx, p in enumerate(bound_pods):
-        i = name_to_i.get(p.spec.node_name)
-        if i is None:
-            continue
-        rv = req_vec(p)
-        req_cache[idx] = rv
-        requested[i] += rv
-        if p.spec.priority < prio_cut:
-            per_node.setdefault(i, []).append(idx)
+    if precomputed is not None:
+        allocatable, requested = precomputed
+        # victims only: the totals came from the resident encoding, so the
+        # O(pods) per-pod vector pass shrinks to the below-cutoff set
+        for idx, p in enumerate(bound_pods):
+            i = name_to_i.get(p.spec.node_name)
+            if i is not None and p.spec.priority < prio_cut:
+                per_node.setdefault(i, []).append(idx)
+                req_cache[idx] = req_vec(p)
+    else:
+        allocatable = np.zeros((N, R), np.int64)
+        for i, n in enumerate(nodes):
+            alloc = n.allocatable_canonical()
+            if dra is not None:
+                alloc.update(dra.node_capacity(n.metadata.name))
+            for j, r in enumerate(resources):
+                if r == "pods" and r not in alloc:
+                    allocatable[i, j] = np.iinfo(np.int32).max
+                else:
+                    allocatable[i, j] = scale_allocatable(r, alloc.get(r, 0))
+        requested = np.zeros((N, R), np.int64)
+        for idx, p in enumerate(bound_pods):
+            i = name_to_i.get(p.spec.node_name)
+            if i is None:
+                continue
+            rv = req_vec(p)
+            req_cache[idx] = rv
+            requested[i] += rv
+            if p.spec.priority < prio_cut:
+                per_node.setdefault(i, []).append(idx)
     V = next_bucket(max((len(v) for v in per_node.values()), default=1),
                     minimum=1)
     vic_req = np.zeros((N, V, R), np.int64)
@@ -266,7 +291,8 @@ def _encode_cluster_arrays(nodes, bound_pods, resources, prio_cut,
 def dry_run_wave(nodes: list[Node], bound_pods: list[Pod],
                  preemptors: list[Pod], budgets: list[tuple], dra=None,
                  static_masks: Optional[np.ndarray] = None,
-                 min_q: int = 1) -> list:
+                 min_q: int = 1, resident_arrays=None,
+                 req_lookup=None) -> list:
     """Device dry-run for a WAVE of preemptors with sequential-commit
     semantics. -> per-preemptor ``None`` (no resource-feasible eviction
     set), ``"zero_evict"`` (fits without evicting: failure was relational,
@@ -309,7 +335,8 @@ def dry_run_wave(nodes: list[Node], bound_pods: list[Pod],
     allocatable, requested, vic_req, vic_valid, vic_violating, vic_prio, \
         vic_ref = _encode_cluster_arrays(
             nodes, bound_pods, resources, int(prio.max(initial=0)),
-            budgets, dra=dra)
+            budgets, dra=dra, resident_arrays=resident_arrays,
+            req_lookup=req_lookup)
     if static_masks is None:
         static_masks = np.stack([_static_mask(nodes, pod)
                                  for pod in preemptors])

@@ -287,11 +287,22 @@ class SchedulerCache:
         the assumed copy and the watch-confirmed object — so the cached
         encoding stays valid and the confirm costs a dict move, not a
         tensor patch. Under a binding storm this removes one incremental
-        patch per bound pod (the whole fleet confirms within seconds)."""
+        patch per bound pod (the whole fleet confirms within seconds).
+
+        STATUS-only churn on an already-bound pod is encoding-neutral too
+        (the pod twin of the node-fingerprint check): kubelets rewrite
+        ``status`` on every sync, and each such MODIFIED used to append a
+        ``pod`` delta — at fleet scale that made nearly every drain cycle
+        compile a patch over hundreds of unchanged pods (and cross patch
+        write-buckets, recompiling the fold program mid-window; the bulk
+        of MULTICHIP_r06's 1.4-1.9s ctx_patch_apply was exactly this).
+        The encoder reads labels + spec only, so equality there keeps the
+        encoding valid; the stored object still refreshes."""
         with self._lock:
             if not pod.spec.node_name:
                 return
             prior = self._assumed.pop(pod.key, None)
+            old = self._pods.get(pod.key)
             self._pods[pod.key] = pod
             if prior is not None:
                 ap = prior[0]
@@ -299,6 +310,10 @@ class SchedulerCache:
                         and ap.metadata.labels == pod.metadata.labels
                         and pod.key not in self._delta_deletes):
                     return  # pure confirmation: encoding unaffected
+            elif (old is not None and pod.key not in self._delta_deletes
+                    and old.metadata.labels == pod.metadata.labels
+                    and old.spec.to_dict() == pod.spec.to_dict()):
+                return  # status-only update: encoding unaffected
             self._generation += 1
             self._delta_upserts[pod.key] = pod
             self._delta_deletes.discard(pod.key)
@@ -543,13 +558,14 @@ class SchedulerCache:
             return fork_patch_state(self._encoder._patch)
 
     def compile_ctx_patch(self, meta, cs, entries, nom_target: dict,
-                          nom_bucket: int):
+                          nom_bucket: int, fold_floor: int = 0):
         """compile_patch under the encode lock (interning is shared with
         snapshot/encode_pods and must not interleave)."""
         from kubernetes_tpu.encode.patch import compile_patch
         with self._encode_lock:
             return compile_patch(self._encoder, meta, cs, entries,
-                                 nom_target, nom_bucket)
+                                 nom_target, nom_bucket,
+                                 fold_floor=fold_floor)
 
     def encode_pods(self, pods: list[Pod], meta: SnapshotMeta,
                     min_p: int = 1, cache_rows: bool = True):

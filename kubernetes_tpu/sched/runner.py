@@ -511,6 +511,16 @@ class SchedulerRunner:
         status["parity"] = sentinel.stats() if sentinel is not None else None
         return status
 
+    def _copy_reasons(self) -> dict:
+        """Copy ctx_stats['reasons'] from the status thread while the
+        scheduling thread may be inserting a first-seen reason key."""
+        for _ in range(3):
+            try:
+                return dict(self.scheduler.ctx_stats["reasons"])
+            except RuntimeError:  # resized mid-iteration; rare — retry
+                continue
+        return {}
+
     def publish_status(self) -> None:
         """Publish the deployment-shape status ConfigMap (``ktpu status``
         reads it): active mesh shape/devices, the batching knobs, and the
@@ -528,6 +538,17 @@ class SchedulerRunner:
             "batchSize": self.cfg.batch_size,
             "maxDrainBatches": self.cfg.max_drain_batches,
             "pipelineDepth": self.cfg.pipeline_depth,
+            # live pipeline depth + resident-context lifecycle counters:
+            # degraded fusion (patches climbing instead of folds, rebuild
+            # reasons piling up) is visible from ktpu status without a
+            # bench run. Momentarily stale is fine for a status surface;
+            # the reasons dict is the one piece that GROWS on the
+            # scheduling thread (new reason keys), so its copy retries —
+            # dict() over a concurrently-resizing dict raises RuntimeError.
+            "pipelineInflight": len(self.scheduler._pending),
+            "fusedFold": self.scheduler._fused_fold,
+            "ctx": dict(self.scheduler.ctx_stats,
+                        reasons=self._copy_reasons()),
             "profiles": [p.scheduler_name for p in self.cfg.profiles],
             "resilience": self._resilience_status(),
             "audit": self._audit_status(),

@@ -165,10 +165,21 @@ class Scheduler:
                 _LOG.warning("mesh shape %s unavailable; running "
                              "single-device", mesh_shape, exc_info=True)
         MESH_DEVICES.set(self._mesh.devices.size if self._mesh else 1)
+        # Fused fold: churn patches ride the drain dispatch as the resident
+        # program's third input instead of a separate apply_ctx_patch
+        # dispatch (and fold-safe churn skips the pipeline drain). The env
+        # knob exists so a bench A/B can flip modes without config surgery.
+        self._fused_fold = cfg.fused_fold
+        env_fused = _os.environ.get("KTPU_FUSED_FOLD")
+        if env_fused is not None:
+            self._fused_fold = env_fused != "0"
         # context lifecycle counters (benchmarks report these: a healthy
-        # churn run shows patches >> rebuilds)
-        self.ctx_stats = {"patches": 0, "rebuilds": 0, "unfit": 0,
-                          "reasons": {}}
+        # churn run shows folds/patches >> rebuilds; "folds" are churn
+        # deltas fused into a drain dispatch, "patches" are separate
+        # apply_ctx_patch dispatches — steady-state fused churn keeps
+        # patches at 0)
+        self.ctx_stats = {"patches": 0, "folds": 0, "rebuilds": 0,
+                          "unfit": 0, "reasons": {}}
         # per-drain-cycle debug trail (pop size, t_pop, t_dispatch,
         # t_resolve) when KTPU_CYCLE_LOG=1
         self.cycle_log: list = [] if _os.environ.get(
@@ -726,6 +737,7 @@ class Scheduler:
 
         ctx = self._drain_ctx
         use_ctx = False
+        fused_patch = None  # churn deltas riding THIS dispatch (fused fold)
         n_prev = 0
         if (ctx is not None
                 and ctx.get("mesh_epoch") != self._mesh_epoch):
@@ -766,26 +778,47 @@ class Scheduler:
                         ctx["seq"] = entries[-1][0] + 1
                     use_ctx = True
                 else:
-                    # foreign churn / nominee change: EVERY in-flight drain
-                    # must resolve FIRST so the patch state knows which
-                    # slots their folds took (and their assume log entries
-                    # land before the re-read)
-                    if self.cycle_log is not None:
-                        self._cyc_marks.append(("resolve_prev_start",
-                                                round(time.time() - t0, 3)))
-                    n_prev += self._resolve_pending()
-                    if self.cycle_log is not None:
-                        self._cyc_marks.append(
-                            ("resolve_prev_end", round(time.time() - t0, 3)))
-                    entries = self.cache.deltas_since(ctx["seq"])
+                    # Foreign churn / nominee change. Fused-fold mode
+                    # compiles the patch against the LIVE patch state and
+                    # ships it as the drain dispatch's third input — the
+                    # pipeline drains first only when a delta actually
+                    # depends on an in-flight drain's unmirrored folds
+                    # (encode/patch.py entries_fold_safe: a pod an
+                    # in-flight drain is scheduling, or a node delete
+                    # whose retire accounting can't see in-flight folds).
+                    # Legacy mode (fusedFold off) resolves everything and
+                    # dispatches a separate apply_ctx_patch, as before.
+                    from kubernetes_tpu.encode.patch import entries_fold_safe
+                    if self._pending and not (
+                            self._fused_fold and entries_fold_safe(
+                                cs, entries,
+                                {p.key for pend in self._pending
+                                 for c in pend["chunks"] for p, _ in c})):
+                        if self.cycle_log is not None:
+                            self._cyc_marks.append(("resolve_prev_start",
+                                                    round(time.time() - t0,
+                                                          3)))
+                        n_prev += self._resolve_pending()
+                        if self.cycle_log is not None:
+                            self._cyc_marks.append(
+                                ("resolve_prev_end",
+                                 round(time.time() - t0, 3)))
+                        entries = self.cache.deltas_since(ctx["seq"])
                     if entries is not None:
                         new_seq = (entries[-1][0] + 1 if entries
                                    else ctx["seq"])
-                        with TRACER.span("scheduler/ctx_patch_compile",
+                        # host-side half of the on-device fold: delta log ->
+                        # static-shape scatter arrays. fold_floor pins the
+                        # patch allocator above the DISPATCH-side fill
+                        # reservation so a patch compiled with drains still
+                        # in flight can never hand out a slot an unresolved
+                        # fold will take.
+                        with TRACER.span("scheduler/fold_deltas",
                                          deltas=len(entries)):
                             patch = self.cache.compile_ctx_patch(
                                 ctx["meta"], cs, entries, nom_target,
-                                DRAIN_NOM_BUCKET)
+                                DRAIN_NOM_BUCKET,
+                                fold_floor=ctx["fill_bound"])
                         # the patch may have moved the slot cursor: the
                         # fold region this dispatch will write must still
                         # clear every patched slot (re-check AFTER compile;
@@ -794,16 +827,25 @@ class Scheduler:
                         if (patch is not None
                                 and ctx["fill_bound"] + len(pods)
                                 <= cs.top):
-                            with TRACER.span("scheduler/ctx_patch_apply"), \
-                                    self._mesh_scope():
-                                # sharded context: the scatter program runs
-                                # under the mesh — the tiny patch arrays
-                                # replicate, the donated sharded buffers
-                                # keep their layout (epoch-checked above)
-                                ctx["ct"] = apply_ctx_patch(ctx["ct"], patch)
+                            if self._fused_fold:
+                                # the scatter rides THIS dispatch as
+                                # drain_step's third input — zero separate
+                                # device round trips for churn
+                                fused_patch = patch
+                                self.ctx_stats["folds"] += 1
+                            else:
+                                with TRACER.span("scheduler/ctx_patch_apply"), \
+                                        self._mesh_scope():
+                                    # sharded context: the scatter program
+                                    # runs under the mesh — the tiny patch
+                                    # arrays replicate, the donated sharded
+                                    # buffers keep their layout
+                                    # (epoch-checked above)
+                                    ctx["ct"] = apply_ctx_patch(ctx["ct"],
+                                                                patch)
+                                self.ctx_stats["patches"] += 1
                             ctx["seq"] = new_seq
                             use_ctx = True
-                            self.ctx_stats["patches"] += 1
                         elif patch is None:
                             self.ctx_stats["unfit"] += 1
                             self._ctx_reason("patch_unfit")
@@ -916,17 +958,25 @@ class Scheduler:
         if self.cycle_log is not None:
             self._cyc_marks.append(("dispatch_start",
                                     round(time.time() - t0, 3)))
+        # staging is its OWN span: under a mesh this is the per-dispatch
+        # device_put of the batch stack split on "pods" — MULTICHIP_r06's
+        # sharded gang_dispatch growth (381ms -> 1641ms) was this transfer
+        # hiding inside the dispatch span, not the program getting slower
+        with TRACER.span("scheduler/stage_batch", pods=len(pods)):
+            pb_staged = self.cache.stage_drain_batch(pb_stack)
         with TRACER.span("scheduler/gang_dispatch",
                          pods=len(pods), nodes=len(nodes),
                          depth=len(self._pending) + 1), self._mesh_scope():
             # mesh on: the batch stack ships pre-sharded on "pods" (the
             # context's cluster arrays are already resident split on
             # "nodes"), and the winners view is pinned replicated so the
-            # resolve fetch stays O(P)
+            # resolve fetch stays O(P). fused_patch (churn deltas) is the
+            # third input of the resident program — the scatter applies
+            # in front of the scan, inside this same dispatch.
             try:
                 assignments, rounds, new_ct, new_fill = drain_step(
-                    ctx["ct"], self.cache.stage_drain_batch(pb_stack),
-                    ctx["fill_dev"], e0=ctx["e0"],
+                    ctx["ct"], pb_staged,
+                    ctx["fill_dev"], fused_patch, e0=ctx["e0"],
                     seed=self.cfg.seed, fit_strategy=profile.fit_strategy,
                     topo_keys=meta.topo_keys,
                     weights=tuple(sorted(profile.weights().items())),
@@ -1247,17 +1297,29 @@ class Scheduler:
             # second call matches the steady-state variant exactly: donated-
             # buffer layouts AND a device-resident fill scalar
             _, _, ct_dev3, fill3 = drain_step(ct_dev2, pb_staged, fill2, **kw)
-            # rehearse the real churn alternation — drain -> patch -> drain —
-            # so BOTH programs compile at each other's output layouts (a
-            # layout mismatch recompiles drain_step for seconds inside the
-            # measured window) at the standard patch write buckets
+            # rehearse the real churn alternation at the standard patch
+            # write buckets so every steady-state program compiles here,
+            # at each other's output layouts (a layout mismatch recompiles
+            # drain_step for seconds inside the measured window). Fused
+            # mode alternates drain(patch=None) with drain(patch=...);
+            # the standalone apply_ctx_patch still stages rebuild-time
+            # nominee reservations (and is THE churn program with
+            # fusedFold off), so it warms in both modes.
             try:
                 from kubernetes_tpu.models.gang import apply_ctx_patch
                 cs_warm = self.cache.patch_state_fork()
                 if cs_warm is not None:
                     warm_patch = self.cache.compile_ctx_patch(
                         fork_meta(meta), cs_warm, [], {}, DRAIN_NOM_BUCKET)
-                    if warm_patch is not None:
+                    if warm_patch is not None and self._fused_fold:
+                        _, _, ct_dev4, fill4 = drain_step(
+                            ct_dev3, pb_staged, fill3, warm_patch, **kw)
+                        # plain drain over the fused variant's output
+                        # layout, then the standalone apply program
+                        _, _, ct_dev5, _ = drain_step(ct_dev4, pb_staged,
+                                                      fill4, **kw)
+                        apply_ctx_patch(ct_dev5, warm_patch)
+                    elif warm_patch is not None:
                         ct_dev4 = apply_ctx_patch(ct_dev3, warm_patch)
                         drain_step(ct_dev4, pb_staged, fill3, **kw)
             except Exception:
@@ -1480,16 +1542,131 @@ class Scheduler:
                 bound_left = [p for p in bound_left if p.key not in gone]
         return results
 
+    def _resident_wave_view(self) -> Optional[dict]:
+        """The preemption wave's view of the DEVICE-RESIDENT drain context,
+        or None when the resident encoding cannot stand in for a fresh
+        snapshot. Valid only when the context is accountable (untainted),
+        staged under the CURRENT mesh epoch, and current with the cache —
+        every unconsumed delta-log entry is an assume the context already
+        folded. That is exactly the state at a drain resolve, which is
+        where preemption failures are handled: the wave then shares the
+        sharded resident cluster image (static masks run on it in place,
+        per-node totals read back from it, victim request vectors served
+        from its fold ledger) instead of re-staging tensors the device
+        already holds."""
+        import numpy as np
+        from kubernetes_tpu.encode.patch import entries_all_folded
+        ctx = self._drain_ctx
+        if ctx is None or self._pending:
+            # in-flight drains' winners are folded into the resident
+            # requested[N,R] but not yet in the cache's bound view — the
+            # wave's semantics (judge against bound+assumed, like the
+            # snapshot path) require the two to agree
+            return None
+        cs = ctx["cs"]
+        if cs.tainted or ctx.get("mesh_epoch") != self._mesh_epoch:
+            return None
+        entries = self.cache.deltas_since(ctx["seq"])
+        if entries is None or not entries_all_folded(cs, entries):
+            return None
+        nodes = self.cache.list_nodes()
+        meta = ctx["meta"]
+        rows = []
+        for n in nodes:
+            ni = meta.node_index.get(n.metadata.name, -1)
+            if ni < 0:
+                return None  # node the context has not absorbed: stale
+            rows.append(ni)
+        return {"ct": ctx["ct"], "meta": meta, "cs": cs,
+                "nodes": nodes, "rows": np.asarray(rows, np.int32)}
+
+    def _resident_cluster_arrays(self, view: dict):
+        """``fn(resources) -> (allocatable, requested) | None`` for
+        dry_run_wave: one device_get of the resident [N,R] totals (folds
+        and churn patches keep them current), rows gathered into the live
+        node-list order and columns remapped onto the wave's resource
+        axis. Resources the resident encoding doesn't know stay 0 on both
+        arrays — identical to the host encode, which scales
+        ``alloc.get(r, 0)`` and can have no bound requests for a resource
+        no bound pod carries (patches refuse unknown resource kinds)."""
+        import jax
+        import numpy as np
+
+        def arrays(resources):
+            try:
+                alloc_res, req_res = jax.device_get(
+                    (view["ct"].allocatable, view["ct"].requested))
+            except Exception:
+                _LOG.exception("resident totals readback failed; wave "
+                               "falls back to the host encode")
+                return None
+            rows = view["rows"]
+            res_index = view["cs"].res_index
+            N, R = len(view["nodes"]), len(resources)
+            allocatable = np.zeros((N, R), np.int64)
+            requested = np.zeros((N, R), np.int64)
+            for j, r in enumerate(resources):
+                ri = res_index.get(r)
+                if ri is not None:
+                    allocatable[:, j] = alloc_res[rows, ri]
+                    requested[:, j] = req_res[rows, ri]
+            return allocatable, requested
+
+        return arrays
+
+    def _resident_req_lookup(self, view: dict):
+        """``fn(pod, resources) -> [R] | None`` serving victim request
+        vectors from the fold ledger's cached per-pod vectors (compiled at
+        encode/patch time on the RESIDENT resource axis), remapped onto
+        the wave's axis. Pods the ledger holds as raw Pod objects (device
+        folds defer the vector) fall back to the wave's own computation —
+        which is memoized on the Pod instance anyway."""
+        import numpy as np
+        slot_req = view["cs"].slot_req
+        res_index = view["cs"].res_index
+
+        def lookup(pod, resources):
+            v = slot_req.get(pod.key)
+            if not isinstance(v, np.ndarray):
+                return None
+            out = np.zeros(len(resources), np.int64)
+            for j, r in enumerate(resources):
+                ri = res_index.get(r)
+                if ri is not None:
+                    out[j] = int(v[ri])
+            return out
+
+        return lookup
+
     def _default_preempt_wave(self, pods: list[Pod]) -> list[Optional[str]]:
-        """One snapshot + one sequential-commit wave program for a batch of
-        preemptors (preempt_wave); victims are evicted per winner in wave
-        order, mirroring Q serial _default_preempt calls. The cache's
-        already-encoded cluster supplies the [Q,N] static filter masks —
-        preempt_wave would otherwise re-encode the whole cluster for them."""
+        """One sequential-commit wave program for a batch of preemptors
+        (preempt_wave); victims are evicted per winner in wave order,
+        mirroring Q serial _default_preempt calls. The wave is an extra
+        stage of the resident scheduling program whenever the drain
+        context is current (_resident_wave_view): static masks run on the
+        device-resident sharded encoding in place, per-node totals read
+        back from it, and victim vectors come from its fold ledger — no
+        snapshot, no re-encode, no per-wave re-staging of cluster tensors.
+        Only when the context is stale/tainted does the wave fall back to
+        one cache snapshot (which itself reuses the cached encoding)."""
         from kubernetes_tpu.utils.tracing import TRACER
-        with TRACER.span("preempt/snapshot"):
-            nodes, ct, meta = self.cache.snapshot()
+        resident = None
+        if self._attempt_level != "oracle":
+            # bound is captured BEFORE the staleness check: a foreign bind
+            # racing this wave from the informer thread is then either in
+            # BOTH the victim list and the delta log (the view declines) or
+            # in NEITHER the list nor the resident totals — the two views
+            # dry_run_wave reconciles can never disagree
             bound = self.cache.bound_pods(include_assumed=True)
+            resident = self._resident_wave_view()
+        if resident is not None:
+            with TRACER.span("preempt/resident", pods=len(pods)):
+                nodes = resident["nodes"]
+                ct, meta = resident["ct"], resident["meta"]
+        else:
+            with TRACER.span("preempt/snapshot"):
+                nodes, ct, meta = self.cache.snapshot()
+                bound = self.cache.bound_pods(include_assumed=True)
         views = [self._preempt_view(p) for p in pods]
         if self._attempt_level == "oracle":
             # device known-broken this cycle: don't pay a doomed wave
@@ -1512,7 +1689,10 @@ class Scheduler:
                 masks = preemption_mod.tensor_static_masks(
                     nodes, views, ct=ct, meta=meta,
                     encode_pods=self.cache.encode_pods,
-                    min_p=preemption_mod.WAVE_BUCKET, mesh=self._mesh)
+                    min_p=preemption_mod.WAVE_BUCKET, mesh=self._mesh,
+                    pre_staged=resident is not None,
+                    node_rows=(resident["rows"] if resident is not None
+                               else None))
         except Exception:
             _LOG.exception("static masks from resident encoding failed; "
                            "preempt_wave will re-encode")
@@ -1524,7 +1704,12 @@ class Scheduler:
                 results = preemption_mod.preempt_wave(
                     nodes, bound, views, pdbs=self.pdb_lister(),
                     dra=self.cache.dra_catalog, static_masks=masks,
-                    min_q=preemption_mod.WAVE_BUCKET, mesh=self._mesh)
+                    min_q=preemption_mod.WAVE_BUCKET, mesh=self._mesh,
+                    resident_arrays=(
+                        self._resident_cluster_arrays(resident)
+                        if resident is not None else None),
+                    req_lookup=(self._resident_req_lookup(resident)
+                                if resident is not None else None))
             except Exception:
                 # device wave broke: feed the breaker and fall back to the
                 # serial host scan (the wave's sequential-commit

@@ -366,9 +366,9 @@ def test_wrong_winners_trip_parity_and_converge_via_oracle(tmp_path,
     before = PARITY_DIVERGENCES.get({"site": "drain"})
     orig = gang_mod.drain_step
 
-    def wrong_winners(ct, pb, fill, **kw):
+    def wrong_winners(ct, pb, fill, patch=None, **kw):
         import jax.numpy as jnp
-        a, rounds, ct2, fill2 = orig(ct, pb, fill, **kw)
+        a, rounds, ct2, fill2 = orig(ct, pb, fill, patch, **kw)
         return jnp.where(a >= 0, 0, a), rounds, ct2, fill2
     monkeypatch.setattr(gang_mod, "drain_step", wrong_winners)
     try:

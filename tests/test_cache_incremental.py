@@ -116,6 +116,35 @@ def test_heartbeat_does_not_invalidate():
     assert ct2 is ct  # same cached object — not even a patch
 
 
+def test_bound_pod_status_update_does_not_invalidate():
+    """The pod twin of the heartbeat check: a kubelet rewriting ``status``
+    on an already-bound pod must not bump the generation or append a
+    delta-log entry — at fleet scale these MODIFIEDs arrive per pod per
+    sync and used to make every drain cycle compile a churn patch over
+    hundreds of unchanged pods. A REAL change (labels, requests, node)
+    still invalidates."""
+    import copy
+    cache = SchedulerCache()
+    for n in _nodes(4):
+        cache.add_node(n)
+    bound = _pod(0)
+    bound.spec.node_name = "n0"
+    cache.add_pod(bound)
+    _, ct, _ = cache.snapshot()
+    gen0, seq0 = cache._generation, cache.log_seq()
+    hb = copy.deepcopy(bound)
+    hb.status.phase = "Running"
+    cache.add_pod(hb)
+    assert cache._generation == gen0 and cache.log_seq() == seq0
+    _, ct2, _ = cache.snapshot()
+    assert ct2 is ct  # same cached object — not even a patch
+    assert cache._pods[bound.key] is hb  # the stored object still refreshes
+    relabeled = copy.deepcopy(hb)
+    relabeled.metadata.labels["app"] = "changed"
+    cache.add_pod(relabeled)
+    assert cache._generation > gen0 and cache.log_seq() == seq0 + 1
+
+
 def test_structural_changes_force_full_encode():
     cache = SchedulerCache()
     for n in _nodes(4):

@@ -140,23 +140,35 @@ def test_mesh_reshape_forces_ctx_rebuild():
 # ---- donation audit (satellite): steady-state drain/patch aliasing -------
 
 def test_drain_patch_steady_state_no_copy_on_donate_warnings():
-    """The resident ctx is donated through drain_step AND apply_ctx_patch;
-    steady-state cycles must alias buffers in place. A 'donated buffers
-    were not usable' warning means a layout mismatch re-copies the multi-MB
-    encoding every drain — the exact regression the warmup double-execute
-    exists to prevent."""
+    """The resident ctx is donated through drain_step (both its plain and
+    fused-fold variants) AND apply_ctx_patch; steady-state cycles must
+    alias buffers in place. A 'donated buffers were not usable' warning
+    means a layout mismatch re-copies the multi-MB encoding every drain —
+    the exact regression the warmup double-execute exists to prevent. Runs
+    the THREE-input drain (churn patch fused into the dispatch) and the
+    legacy separate-apply path back to back."""
     sched, cache, queue, log = _scheduler()
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
         bound = _run_to_empty(sched, queue, _pods(24))
-        # churn -> patch -> drain again (apply_ctx_patch in the loop)
+        # churn -> fold-into-dispatch -> drain again (three-input drain)
         cache.add_node(
             make_node("late-node")
             .capacity({"cpu": "8", "memory": "16Gi", "pods": "20"})
             .label("kubernetes.io/hostname", "late-node").obj())
         bound += _run_to_empty(sched, queue, _pods(16, prefix="late"))
-    assert bound == 40
-    assert sched.ctx_stats["patches"] >= 1, "churn did not take the patch path"
+        # legacy mode: churn -> separate apply_ctx_patch dispatch -> drain
+        sched._fused_fold = False
+        cache.add_node(
+            make_node("late-node-2")
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": "20"})
+            .label("kubernetes.io/hostname", "late-node-2").obj())
+        bound += _run_to_empty(sched, queue, _pods(16, prefix="later"))
+    assert bound == 56
+    assert sched.ctx_stats["folds"] >= 1, \
+        "churn did not take the fused-fold path"
+    assert sched.ctx_stats["patches"] >= 1, \
+        "churn did not take the legacy patch path"
     donate_warnings = [str(w.message) for w in caught
                        if "donated" in str(w.message).lower()]
     assert not donate_warnings, donate_warnings
@@ -180,7 +192,7 @@ def test_ctx_patch_after_batch_widened_label_bucket():
         .label("kubernetes.io/hostname", "late-node").obj())
     bound += _run_to_empty(sched, queue, _pods(16, prefix="late"))
     assert bound == 40
-    assert sched.ctx_stats["patches"] >= 1
+    assert sched.ctx_stats["patches"] + sched.ctx_stats["folds"] >= 1
     sched.close()
 
 
@@ -271,6 +283,9 @@ def test_publish_status_and_ktpu_status():
         text = out.getvalue()
         assert "Mesh:" in text and "single-device" in text
         assert "default-scheduler" in text
+        # resident-ctx fusion health is part of the status surface
+        assert "Resident ctx:" in text and "fused fold on" in text
+        assert "in flight" in text
         out = io.StringIO()
         rc = ktpu_main(["--server", server.url, "status", "-o", "json"],
                        out=out)
@@ -278,6 +293,8 @@ def test_publish_status_and_ktpu_status():
         import json
         st = json.loads(out.getvalue())
         assert st["mesh"] is None and st["batchSize"] == 256
+        assert st["ctx"]["patches"] == 0 and st["ctx"]["folds"] == 0
+        assert st["pipelineInflight"] == 0 and st["fusedFold"] is True
         runner.scheduler.close()
     finally:
         server.stop()

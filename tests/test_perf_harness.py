@@ -26,6 +26,28 @@ def test_materialize_ops():
     assert measured[0].spec.affinity.pod_anti_affinity.required
 
 
+def test_slo_gates_fail_on_missing_or_worse_numbers():
+    """The hard SLO gate contract (BENCH_r05 lesson): a value that is
+    missing, None, or unparsed fails exactly like a regressed one — it can
+    never read as a pass. Unknown gate keys refuse to skip silently too."""
+    from benchmarks.connected import check_slo_gates
+    gates = {"SchedulingThroughput": 30, "p99AttemptLatencySeconds": 30}
+    ok = {"SchedulingThroughput": 75.0, "p99_attempt_latency_s": 12.0}
+    assert check_slo_gates(ok, gates) == []
+    slow = {"SchedulingThroughput": 10.0, "p99_attempt_latency_s": 45.0}
+    assert len(check_slo_gates(slow, gates)) == 2
+    missing = {"SchedulingThroughput": None}  # p99 absent entirely
+    fails = check_slo_gates(missing, gates)
+    assert len(fails) == 2 and all("missing" in f for f in fails)
+    assert check_slo_gates(ok, {"bogusGate": 1})  # unknown key = failure
+    assert check_slo_gates(ok, None) == [] == check_slo_gates(ok, {})
+    # the churn case config actually carries the gates the bench enforces
+    cases = {c["name"]: c for c in load_config()}
+    wl = cases["SchedulingChurn"]["workloads"][0]
+    assert wl["sloGates"]["p99AttemptLatencySeconds"] > 0
+    assert wl["sloGates"]["SchedulingThroughput"] > 0
+
+
 def test_run_workload_small_passes_threshold():
     cases = {c["name"]: c for c in load_config()}
     res = run_workload(cases["SchedulingBasic"],
