@@ -66,6 +66,15 @@ def main():
             results.append(res)
             log("[bench] " + json.dumps(res))
 
+    # Perfetto traces of each connected-path case's measured window land
+    # next to the result JSON, case-suffixed (BENCH_TRACE.<Case>.json;
+    # BENCH_TRACE_PATH="" disables; load at ui.perfetto.dev). Set before
+    # ALL cases — ChaosChurn/ExplainAB dump traces too.
+    os.environ.setdefault(
+        "BENCH_TRACE_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_TRACE.json"))
+
     connected = None
     if os.environ.get("BENCH_CONNECTED", "1") != "0" and not only_case:
         log("[bench] connected-path run ...")
@@ -142,6 +151,21 @@ def main():
             n_nodes=int(os.environ.get("BENCH_CHAOS_NODES", "1000")),
             log=log)
         log("[bench] " + json.dumps(chaos_churn))
+
+    explain_ab = None
+    if os.environ.get("BENCH_EXPLAIN_AB", "1") != "0" and not only_case:
+        # explainer + flight recorder on/off A/B on the churn workload:
+        # the observability layer must cost <= 5% throughput (hard gate,
+        # like the other sloGates — a missing ratio fails too)
+        from benchmarks.connected import run_explain_ab
+        log("[bench] explain A/B run ...")
+        explain_ab = run_explain_ab(
+            n_pods=int(os.environ.get("BENCH_EXPLAIN_PODS", "2000")),
+            n_nodes=int(os.environ.get("BENCH_EXPLAIN_NODES", "1000")),
+            min_ratio=float(os.environ.get("BENCH_EXPLAIN_MIN_RATIO",
+                                           "0.95")),
+            log=log)
+        log("[bench] " + json.dumps(explain_ab))
 
     preemption = None
     if os.environ.get("BENCH_PREEMPTION", "1") != "0" and not only_case:
@@ -224,6 +248,7 @@ def main():
         "connected": connected,
         "chaos_churn": chaos_churn,
         "connected_mesh": connected_mesh,
+        "explain_ab": explain_ab,
         "preemption": preemption,
         "connected_preemption": connected_preemption,
         "kubemark": kubemark,
@@ -234,12 +259,13 @@ def main():
         # parsed-null crash taught that a silently missing figure reads
         # as "fine" for rounds
         "invariant_violations": _sum_violations(connected, chaos_churn,
-                                                connected_mesh),
+                                                connected_mesh, explain_ab),
         # hard SLO verdicts from case-config gates (SchedulingChurn p99 +
         # throughput, ConnectedMesh legs). Missing numbers are failures —
         # the BENCH_r05 parsed-null lesson: a silently absent figure must
         # never read as a pass.
-        "slo_failures": _collect_slo_failures(results, connected_mesh),
+        "slo_failures": _collect_slo_failures(results, connected_mesh,
+                                              explain_ab),
     }
     _require_invariant_field(out, "bench summary")
     print(json.dumps(out))
@@ -276,7 +302,7 @@ def main():
         sys.exit(1)
 
 
-def _collect_slo_failures(results, connected_mesh) -> list:
+def _collect_slo_failures(results, connected_mesh, explain_ab=None) -> list:
     """Flatten every case's hard-SLO failure strings, prefixed by case."""
     out = []
     for r in results or []:
@@ -285,6 +311,9 @@ def _collect_slo_failures(results, connected_mesh) -> list:
     if connected_mesh is not None:
         for msg in connected_mesh.get("slo_failures") or []:
             out.append(f"ConnectedMesh: {msg}")
+    if explain_ab is not None:
+        for msg in explain_ab.get("slo_failures") or []:
+            out.append(f"ExplainAB: {msg}")
     return out
 
 

@@ -170,12 +170,20 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
                   churn_period_s: float = 0.1, min_churn_ops: int = 500,
                   pipeline_depth: int | None = None,
                   chaos_seed: int | None = None,
+                  explain: bool = True,
+                  trace_tag: str | None = None,
                   log=lambda *a: None) -> dict:
     from kubernetes_tpu.client.clientset import HTTPClient
     from kubernetes_tpu.config.types import SchedulerConfiguration
     from kubernetes_tpu.metrics.registry import ATTEMPT_DURATION
     from kubernetes_tpu.sched.runner import SchedulerRunner
+    from kubernetes_tpu.utils.tracing import FLIGHT
     from benchmarks.workloads import mixed_heterogeneous
+
+    # explain=False is the A/B's baseline leg: explainer off AND flight
+    # recorder off (run_explain_ab gates the on-leg's throughput cost)
+    flight_was = FLIGHT.enabled
+    FLIGHT.enabled = explain
 
     ctx = mp.get_context("spawn")  # never fork a live TPU client
     parent, child = ctx.Pipe()
@@ -192,7 +200,8 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         log(f"  seeded {n_nodes} nodes in {time.time()-t0:.1f}s")
 
         cfg_kw = dict(batch_size=batch_size,
-                      max_drain_batches=drain_batches)
+                      max_drain_batches=drain_batches,
+                      explainer_enabled=explain)
         if pipeline_depth is not None:
             # clamp like the scheduler does, so the reported depth is the
             # depth that actually ran (depth 0 would silently run as 1)
@@ -270,6 +279,11 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         # the registry is process-global: an earlier bench phase's attempts
         # (e.g. the churn workload) must not pollute this window's p99
         ATTEMPT_DURATION.reset()
+        from kubernetes_tpu.metrics.registry import (E2E_SCHEDULING,
+                                                     UNSCHEDULABLE_REASONS)
+        FLIGHT.reset()
+        E2E_SCHEDULING.reset()
+        reasons_base = UNSCHEDULABLE_REASONS.items()
         t_start = time.time()
         by_ns: dict = {}
         for p in pods:
@@ -340,6 +354,52 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
                 {"result": "scheduled"}) if c]
         ctx_stats = dict(runner.scheduler.ctx_stats)
         encode_cache = runner.cache.encode_cache_stats()
+        # decision-provenance + flight-recorder attribution for this
+        # window: reason breakdown, explainer thread totals (its spans are
+        # explain/* in span_ms — all off the drain cycle), per-pod
+        # timeline coverage, and the derived end-to-end SLI
+        explain_block = None
+        ex = runner.scheduler.explainer
+        if ex is not None:
+            ex.drain(5.0)
+            explain_block = ex.stats()
+            # re-snapshot AFTER the drain: a capture still queued at the
+            # span_ms snapshot finishes its explain/* spans inside the
+            # drain, and the cost attribution must include them
+            explain_block["span_ms"] = {
+                k: v for k, v in _span_totals().items()
+                if k.startswith("explain/")}
+        unsched_reasons = {}
+        for key, v in UNSCHEDULABLE_REASONS.items().items():
+            dv = v - reasons_base.get(key, 0.0)
+            if dv:
+                unsched_reasons["".join(k for _, k in key)] = dv
+        flight_block = FLIGHT.stats()
+        e2e_block = {"count": E2E_SCHEDULING.count(),
+                     "p50_s": E2E_SCHEDULING.percentile(0.50),
+                     "p99_s": E2E_SCHEDULING.percentile(0.99)}
+        # Perfetto-loadable dump of the measured window (batch spans +
+        # per-pod flight tracks): BENCH_TRACE_PATH (bench.py defaults it
+        # next to the result JSON; empty string disables). The path is
+        # suffixed per CASE — several cases run run_connected in one bench
+        # process, and the last one must not silently overwrite the
+        # headline window's trace.
+        import os as _os
+        case_name = ("ChaosChurn" if chaos_seed is not None
+                     else "ConnectedChurn" if churn
+                     else "ConnectedScheduler")
+        trace_file = _os.environ.get("BENCH_TRACE_PATH") or None
+        if trace_file:
+            from kubernetes_tpu.utils.tracing import TRACER
+            tag = trace_tag or case_name
+            root, dot, ext = trace_file.rpartition(".")
+            trace_file = (f"{root}.{tag}.{ext}" if dot
+                          else f"{trace_file}.{tag}")
+            try:
+                TRACER.export_chrome(trace_file)
+                log(f"  perfetto trace -> {trace_file}")
+            except Exception:
+                trace_file = None
         if churn_stop is not None:
             # fixed churn-op budget DECOUPLED from drain duration: a fast
             # drain must not mean the churn path went unexercised (r05: the
@@ -361,9 +421,7 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         audit_block = _audit_close(runner)
         runner.stop()
         out = {
-            "case": ("ChaosChurn" if chaos_seed is not None
-                     else "ConnectedChurn" if churn
-                     else "ConnectedScheduler"),
+            "case": case_name,
             "workload": f"{n_pods}x{n_nodes}",
             "SchedulingThroughput": round(bound / dt, 1) if dt > 0 else 0.0,
             "bound": bound, "pods": n_pods, "nodes": n_nodes,
@@ -408,9 +466,16 @@ def run_connected(n_pods: int = 2000, n_nodes: int = 1000,
         out["pipeline_depth"] = runner.cfg.pipeline_depth
         out["encode_cache"] = encode_cache
         out["attempt_buckets"] = attempt_buckets
+        out["unschedulable_reasons"] = unsched_reasons
+        out["explain"] = explain_block
+        out["flight"] = flight_block
+        out["e2e"] = e2e_block
+        out["trace_file"] = trace_file
         out.update(audit_block)
         return out
     finally:
+        from kubernetes_tpu.utils.tracing import FLIGHT as _FL
+        _FL.enabled = flight_was
         if schedule is not None:  # crash path: never leak installed chaos
             from kubernetes_tpu.chaos import hooks as _hooks
             _hooks.uninstall()
@@ -443,6 +508,74 @@ def run_chaos_churn(n_pods: int = 2000, n_nodes: int = 1000,
                          batch_size=batch_size,
                          drain_batches=drain_batches, timeout=timeout,
                          churn=True, chaos_seed=seed, log=log)
+
+
+def run_explain_ab(n_pods: int = 2000, n_nodes: int = 1000,
+                   batch_size: int = 512, drain_batches: int = 2,
+                   timeout: float = 300.0, min_ratio: float = 0.95,
+                   log=lambda *a: None) -> dict:
+    """ExplainAB: the ConnectedChurn workload with the decision-provenance
+    explainer + flight recorder ON vs OFF. The observability layer's whole
+    contract is "off the hot path": the on-leg must sustain at least
+    ``min_ratio`` of the off-leg's throughput (default 95% — the <=5% cost
+    budget), gated HARD like PR 8's sloGates (a missing number fails)."""
+    import os
+    legs = {}
+    # a leaked KTPU_EXPLAIN would override BOTH legs' explainer_enabled
+    # config (scheduler construction reads it last), silently turning the
+    # A/B into on-vs-on or off-vs-off — the gate would then price nothing
+    env_explain = os.environ.pop("KTPU_EXPLAIN", None)
+    try:
+        for name, on in (("off", False), ("on", True)):
+            log(f"  explain A/B leg: {name} ...")
+            legs[name] = run_connected(
+                n_pods=n_pods, n_nodes=n_nodes, batch_size=batch_size,
+                drain_batches=drain_batches, timeout=timeout, churn=True,
+                explain=on, trace_tag=f"ExplainAB.{name}", log=log)
+    finally:
+        if env_explain is not None:
+            os.environ["KTPU_EXPLAIN"] = env_explain
+    on_t = legs["on"].get("SchedulingThroughput")
+    off_t = legs["off"].get("SchedulingThroughput")
+    ratio = (round(on_t / off_t, 3)
+             if isinstance(on_t, (int, float))
+             and isinstance(off_t, (int, float)) and off_t else None)
+    failures = []
+    if ratio is None:
+        failures.append(
+            f"throughput ratio unavailable (on={on_t!r}, off={off_t!r}) — "
+            "the <=5% overhead gate cannot pass silently")
+    elif ratio < min_ratio:
+        failures.append(
+            f"explainer+flight overhead too high: on/off throughput "
+            f"ratio {ratio} below the {min_ratio} floor")
+    # the A/B must actually have measured on-vs-off: the on leg carries
+    # the layer it is pricing, the off leg provably does not
+    ex = (legs["on"].get("explain") or {})
+    if legs["on"].get("explain") is None:
+        failures.append("on-leg ran without the explainer constructed")
+    if legs["off"].get("explain") is not None:
+        failures.append("off-leg ran WITH the explainer (A/B invalid)")
+    if not (legs["on"].get("flight") or {}).get("enabled"):
+        failures.append("on-leg ran with the flight recorder disabled")
+    out = {
+        "case": "ExplainAB",
+        "workload": f"{n_pods}x{n_nodes}churn",
+        "throughput_on": on_t, "throughput_off": off_t,
+        "throughput_ratio": ratio, "min_ratio": min_ratio,
+        "explain_on": ex,
+        "unschedulable_reasons": legs["on"].get("unschedulable_reasons"),
+        "e2e_on": legs["on"].get("e2e"),
+        "slo_failures": failures,
+        "invariant_violations": sum(
+            int(leg.get("invariant_violations") or 0)
+            for leg in legs.values()),
+        "legs": {name: {k: leg.get(k) for k in
+                        ("SchedulingThroughput", "bound", "measure_s",
+                         "p99_attempt_latency_s", "jit_warmed")}
+                 for name, leg in legs.items()},
+    }
+    return out
 
 
 def drain_parity_check(mesh_shape: tuple[int, int], n_nodes: int = 1024,

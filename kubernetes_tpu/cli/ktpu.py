@@ -627,6 +627,29 @@ def cmd_status(client: HTTPClient, args, out) -> int:
                      if fused is not None else "")
                   + "\n")
     out.write(f"Profiles:      {', '.join(st.get('profiles') or [])}\n")
+    pending = st.get("pending")
+    if pending is not None:
+        out.write(f"Pending pods:  active {pending.get('active', 0)}, "
+                  f"backoff {pending.get('backoff', 0)}, "
+                  f"unschedulable {pending.get('unschedulable', 0)}\n")
+    e2e = st.get("e2e")
+    if e2e and e2e.get("count"):
+        out.write(f"E2E latency:   p50 {e2e.get('p50Seconds')}s, "
+                  f"p99 {e2e.get('p99Seconds')}s "
+                  f"({e2e.get('count')} pods)\n")
+    explain = st.get("explain")
+    if explain is not None:
+        out.write(f"Explainer:     {explain.get('podsExplained', 0)} pods "
+                  f"explained ({explain.get('entries', 0)} live, "
+                  f"skipped {explain.get('skipped', 0)}, "
+                  f"errors {explain.get('errors', 0)}) — ktpu why <pod>\n")
+    flight = st.get("flight")
+    if flight is not None:
+        out.write(f"Flight rec:    "
+                  f"{'on' if flight.get('enabled') else 'off'} "
+                  f"({flight.get('pods', 0)} pod timelines, "
+                  f"dropped {flight.get('droppedPods', 0)}) — "
+                  "ktpu trace dump\n")
     res = st.get("resilience")
     if res:
         degraded = (res.get("degradedIndex") or 0) > 0
@@ -639,6 +662,110 @@ def cmd_status(client: HTTPClient, args, out) -> int:
         out.write(f"Last relist:   "
                   f"{res.get('lastRelist') or 'never'} "
                   f"(relists: {res.get('watchRelists', 0)})\n")
+    return 0
+
+
+def cmd_why(client: HTTPClient, args, out) -> int:
+    """ktpu why <pod>: per-pod decision provenance. A bound pod reports
+    its node (+ the Scheduled event); a pending pod gets the explainer's
+    per-filter reject breakdown from the ``scheduler-explanations``
+    ConfigMap — the upstream-style "0/N nodes are available: ..." verdict
+    with the node count each filter rejected."""
+    from kubernetes_tpu.sched.runner import EXPLAIN_CONFIGMAP
+    key = f"{args.namespace}/{args.name}"
+    pod = None
+    try:
+        pod = client.pods(args.namespace).get(args.name)
+    except ApiError as e:
+        if e.code != 404:
+            raise
+    if pod is not None and (pod.get("spec") or {}).get("nodeName"):
+        node = pod["spec"]["nodeName"]
+        if args.output == "json":
+            out.write(json.dumps({"pod": key, "scheduled": True,
+                                  "node": node}) + "\n")
+            return 0
+        out.write(f"pod {key}: scheduled to {node}\n")
+        from kubernetes_tpu.utils.events import events_for
+        for e in events_for(client, args.namespace, args.name,
+                            uid=(pod.get("metadata") or {}).get("uid")):
+            if e.get("reason") == "Scheduled":
+                out.write(f"  {e.get('message')}\n")
+        return 0
+    # the ConfigMap lives in the RUNNER's status namespace, not the pod's:
+    # try the pod's namespace first (single-namespace deployments), then
+    # the runner default — explanations are keyed ns/name, so a pod from
+    # any namespace resolves once the right ConfigMap is found
+    explanation = None
+    for cm_ns in dict.fromkeys((args.namespace, "default")):
+        try:
+            cm = client.resource("configmaps", cm_ns).get(EXPLAIN_CONFIGMAP)
+        except ApiError as e:
+            if e.code != 404:
+                raise
+            continue
+        explanation = json.loads(
+            (cm.get("data") or {}).get("explanations", "{}")).get(key)
+        if explanation is not None:
+            break
+    if pod is None and explanation is None:
+        out.write(f"error: pod {key} not found\n")
+        return 1
+    if explanation is None:
+        out.write(f"pod {key}: pending, no explanation recorded yet "
+                  "(the explainer publishes after the pod's first failed "
+                  "cycle; is explainerEnabled on?)\n")
+        return 1
+    if args.output == "json":
+        out.write(json.dumps({"pod": key, "scheduled": False,
+                              **explanation}, indent=1) + "\n")
+        return 0
+    out.write(f"pod {key}: unschedulable ({explanation.get('mode')} "
+              f"verdict at {explanation.get('ts')})\n")
+    out.write(f"  {explanation.get('message')}\n")
+    filters = explanation.get("filters") or {}
+    for f, c in sorted(filters.items(), key=lambda kv: -kv[1]):
+        out.write(f"    {f}: {c} node(s)\n")
+    if explanation.get("feasibleNow"):
+        out.write(f"  note: {explanation['feasibleNow']} node(s) were "
+                  "feasible when re-judged — retry may succeed\n")
+    return 0
+
+
+def cmd_trace(client: HTTPClient, args, out) -> int:
+    """ktpu trace dump: the scheduler's flight-recorder export (batch
+    spans + per-pod lifecycle tracks) as Chrome trace-event JSON — load
+    the output in https://ui.perfetto.dev or chrome://tracing."""
+    from kubernetes_tpu.sched.runner import TRACE_CONFIGMAP
+    from kubernetes_tpu.utils.tracing import validate_chrome_trace
+    try:
+        cm = client.resource("configmaps", args.namespace).get(
+            TRACE_CONFIGMAP)
+    except ApiError as e:
+        if e.code != 404:
+            raise
+        out.write("error: no trace published "
+                  f"(configmap {TRACE_CONFIGMAP!r} not found in "
+                  f"{args.namespace!r})\n")
+        return 1
+    raw = (cm.get("data") or {}).get("trace", "")
+    try:
+        doc = json.loads(raw or "{}")
+    except ValueError:
+        out.write("error: published trace is not valid JSON\n")
+        return 1
+    problems = validate_chrome_trace(doc)
+    if problems:
+        out.write("error: published trace fails the Chrome trace-event "
+                  f"schema: {problems[0]} (+{len(problems) - 1} more)\n")
+        return 1
+    if args.output_file:
+        with open(args.output_file, "w") as f:
+            f.write(raw)
+        out.write(f"wrote {len(doc.get('traceEvents', []))} events to "
+                  f"{args.output_file} (load in ui.perfetto.dev)\n")
+    else:
+        out.write(raw + "\n")
     return 0
 
 
@@ -980,6 +1107,17 @@ def build_parser() -> argparse.ArgumentParser:
     au.add_argument("-o", "--output", choices=["table", "json"],
                     default="table")
 
+    wy = sub.add_parser("why", help="explain a pod's scheduling verdict")
+    wy.add_argument("name")
+    wy.add_argument("-o", "--output", choices=["table", "json"],
+                    default="table")
+
+    tr = sub.add_parser("trace")
+    tr.add_argument("action", choices=["dump"])
+    tr.add_argument("-o", "--output-file", default=None,
+                    help="write the Chrome trace-event JSON here "
+                    "(default: stdout)")
+
     ds = sub.add_parser("deschedule")
     ds.add_argument("action", choices=["run", "status"])
     ds.add_argument("--policy", default=None,
@@ -1056,6 +1194,10 @@ def main(argv=None, out=None) -> int:
             return cmd_autoscale(client, args, out)
         if args.cmd == "audit":
             return cmd_audit(client, args, out)
+        if args.cmd == "why":
+            return cmd_why(client, args, out)
+        if args.cmd == "trace":
+            return cmd_trace(client, args, out)
         if args.cmd == "deschedule":
             return cmd_deschedule(client, args, out)
     except ApiError as e:

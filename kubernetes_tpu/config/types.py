@@ -179,6 +179,14 @@ class SchedulerConfiguration:
     # answer trips the circuit breaker with reason "parity". 0 disables.
     # KTPU_PARITY_EVERY overrides at scheduler construction.
     parity_sample_every: int = 16
+    # ---- explainable scheduling (sched/explainer.py) ---------------------
+    # Decision-provenance explainer: a background thread re-runs the static
+    # filter stack in per-filter-output mode over each cycle's
+    # unschedulable pods, producing upstream-style FailedScheduling
+    # messages, the scheduler-explanations ConfigMap (ktpu why), and
+    # scheduler_unschedulable_reasons_total. Zero dispatches added to the
+    # drain cycle. KTPU_EXPLAIN=0 overrides at scheduler construction.
+    explainer_enabled: bool = True
 
     def profile_for(self, scheduler_name: str) -> Optional[Profile]:
         for p in self.profiles:
@@ -213,6 +221,7 @@ class SchedulerConfiguration:
             ("auditIntervalSeconds", "audit_interval_s"),
             ("auditFailFast", "audit_fail_fast"),
             ("paritySampleEvery", "parity_sample_every"),
+            ("explainerEnabled", "explainer_enabled"),
         ]:
             if yaml_key in d:
                 setattr(cfg, attr, type(getattr(cfg, attr))(d[yaml_key]))

@@ -123,6 +123,11 @@ class Histogram(_Metric):
                     return b
             return self.buckets[-1] if self.buckets else 0.0
 
+    def count(self, labels: Optional[dict] = None) -> int:
+        """Total observations for one label set (the _count series)."""
+        with self._lock:
+            return self._totals.get(_label_key(labels), 0)
+
     def bucket_counts(self, labels: Optional[dict] = None):
         """[(upper_bound, cumulative_count)] snapshot for diagnostics."""
         k = _label_key(labels)
@@ -215,6 +220,25 @@ BATCH_DURATION = REGISTRY.histogram(
 E2E_DURATION = REGISTRY.histogram(
     "scheduler_pod_scheduling_sli_duration_seconds",
     "Pod queue-add to bound latency")
+# Derived by the flight recorder (utils/tracing.py) at bind time: first
+# recorded lifecycle stage (informer event) to binding success — the
+# whole-pipeline figure an operator's "where did this pod's 10s go"
+# question is about, where the attempt histogram covers one cycle only.
+E2E_SCHEDULING = REGISTRY.histogram(
+    "scheduler_e2e_scheduling_duration_seconds",
+    "Pod end-to-end scheduling latency (informer event to bound), from "
+    "the per-pod flight recorder")
+# Decision provenance (sched/explainer.py): per-filter verdicts recovered
+# off the hot path for unschedulable pods. Labeled by the filter that
+# rejected the MOST nodes for that pod (its dominant reason).
+UNSCHEDULABLE_REASONS = REGISTRY.counter(
+    "scheduler_unschedulable_reasons_total",
+    "Unschedulable-pod explanations by dominant rejecting filter "
+    "(the filter that rejected the most nodes for that pod)")
+EXPLAIN_SAMPLES = REGISTRY.counter(
+    "scheduler_explainer_pods_total",
+    "Pods explained by the decision-provenance explainer, by mode "
+    "(tensor = batched per-filter-output program, oracle = numpy fallback)")
 QUEUE_DEPTH = REGISTRY.gauge(
     "scheduler_pending_pods", "Pending pods by queue (active|backoff|unschedulable)")
 BIND_RESULTS = REGISTRY.counter(

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.utils.tracing import FLIGHT
 
 # Cluster events that can make unschedulable pods schedulable again
 # (events.go ClusterEvent analog).
@@ -89,6 +90,7 @@ class SchedulingQueue:
                 return
             heapq.heappush(self._active, item)
             self._lock.notify_all()
+        FLIGHT.record(k, "queue_add")
 
     def add_unschedulable(self, pod: Pod, attempts: int):
         """Failed scheduling attempt: backoffQ (will retry), mirroring
@@ -106,6 +108,7 @@ class SchedulingQueue:
             heapq.heappush(self._backoff, (time.time() + delay, item))
             self._keys_queued.add(k)
             self._lock.notify_all()
+        FLIGHT.record(k, "requeue", attempts=attempts)
 
     def park_unschedulable(self, pod: Pod, attempts: int):
         """No event expected to help soon: unschedulable map (event-driven requeue)."""
@@ -116,6 +119,7 @@ class SchedulingQueue:
             self._entries[k] = item
             self._unschedulable[k] = item
             self._keys_queued.add(k)
+        FLIGHT.record(k, "park", attempts=attempts)
 
     def delete(self, pod: Pod):
         self.delete_key(self._key(pod))

@@ -40,6 +40,18 @@ from kubernetes_tpu.store.store import ADDED, DELETED, MODIFIED
 # other components (and ``ktpu status``) read for the live deployment shape
 # — most importantly the active device mesh.
 STATUS_CONFIGMAP = "kubernetes-tpu-scheduler-status"
+# Decision provenance: per-pod unschedulability explanations (the
+# explainer's verdicts), read by ``ktpu why <pod>``.
+EXPLAIN_CONFIGMAP = "scheduler-explanations"
+# Flight-recorder export: the newest window of batch spans + per-pod
+# lifecycle tracks as Chrome trace-event JSON, read by ``ktpu trace dump``
+# (loads directly in Perfetto). Bounded — see _publish_trace.
+TRACE_CONFIGMAP = "kubernetes-tpu-scheduler-trace"
+# span events / pod tracks kept in the published trace ConfigMap (the
+# full in-process ring is TRACER.max_spans and FLIGHT.max_pods; the
+# ConfigMap is a bounded API object rewritten on the audit cadence)
+TRACE_PUBLISH_EVENTS = 1000
+TRACE_PUBLISH_PODS = 200
 
 
 class SchedulerRunner:
@@ -76,6 +88,11 @@ class SchedulerRunner:
         from kubernetes_tpu.utils.events import EventRecorder
         self.scheduler.recorder = EventRecorder(client, "default-scheduler")
         self.scheduler._evict = self._evict  # preemption deletes via API
+        # decision provenance: the explainer publishes its verdicts as the
+        # scheduler-explanations ConfigMap (ktpu why reads it; events ride
+        # the recorder wired above)
+        if self.scheduler.explainer is not None:
+            self.scheduler.explainer.publisher = self._publish_explanations
         self.factory = InformerFactory(client)
         self.identity = identity
         self._stop = threading.Event()
@@ -183,11 +200,14 @@ class SchedulerRunner:
             # event just for the fold to discard it (ADDED pods are skipped
             # for the same reason).
             self.scheduler.nominate_external(pod, "")
+        from kubernetes_tpu.utils.tracing import FLIGHT
+        FLIGHT.record(pod.key, "informer", event=type_)
         # incremental encode: compile the pod's encode record NOW, on the
         # watch thread, so the drain's encode_pods is array-fill only by
         # the time this pod pops (sched/cache.py precompile_pod never
         # blocks behind an in-progress encode)
         self.cache.precompile_pod(pod)
+        FLIGHT.record(pod.key, "precompile")
         if type_ == MODIFIED and not pod.spec.scheduling_gates:
             self.queue.activate_gated(pod)
         self.queue.add(pod)
@@ -552,31 +572,87 @@ class SchedulerRunner:
             "profiles": [p.scheduler_name for p in self.cfg.profiles],
             "resilience": self._resilience_status(),
             "audit": self._audit_status(),
+            "pending": self.queue.stats(),
+            "e2e": self._e2e_status(),
+            "explain": (self.scheduler.explainer.stats()
+                        if self.scheduler.explainer is not None else None),
+            "flight": self._flight_status(),
         }
-        body = {
-            "apiVersion": "v1", "kind": "ConfigMap",
-            "metadata": {"name": STATUS_CONFIGMAP,
-                         "namespace": self.status_namespace},
-            "data": {"status": json.dumps(status, indent=1)},
-        }
+        self._publish_configmap(STATUS_CONFIGMAP,
+                                {"status": json.dumps(status, indent=1)})
+        self._publish_trace()
+
+    def _e2e_status(self) -> dict:
+        """End-to-end scheduling SLI (flight-recorder-derived histogram)
+        for the status ConfigMap: ktpu status shows the whole-pipeline
+        latency next to the pending-pod gauges."""
+        from kubernetes_tpu.metrics.registry import E2E_SCHEDULING
+        return {"count": E2E_SCHEDULING.count(),
+                "p50Seconds": E2E_SCHEDULING.percentile(0.50),
+                "p99Seconds": E2E_SCHEDULING.percentile(0.99)}
+
+    def _flight_status(self) -> dict:
+        from kubernetes_tpu.utils.tracing import FLIGHT, TRACER
+        st = FLIGHT.stats()
+        st["spanDrops"] = TRACER.dropped
+        return st
+
+    def _publish_configmap(self, name: str, data: dict) -> None:
+        """Create-or-update one of the runner's published ConfigMaps.
+        Best effort — publishing must never take the scheduler down."""
+        body = {"apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": name,
+                             "namespace": self.status_namespace},
+                "data": data}
         cms = self.client.resource("configmaps", self.status_namespace)
         try:
-            current = cms.get(STATUS_CONFIGMAP)
-            current["data"] = body["data"]
+            current = cms.get(name)
+            current["data"] = data
             cms.update(current)
         except ApiError as e:
             if e.code != 404:
                 LOOP_ERRORS.inc({"site": "publish_status"})
-                _LOG.debug("status ConfigMap update failed: %s", e)
+                _LOG.debug("%s ConfigMap update failed: %s", name, e)
                 return
             try:
                 cms.create(body)
             except ApiError:
                 LOOP_ERRORS.inc({"site": "publish_status"})
-                _LOG.debug("status ConfigMap create failed", exc_info=True)
+                _LOG.debug("%s ConfigMap create failed", name,
+                           exc_info=True)
         except Exception:
             LOOP_ERRORS.inc({"site": "publish_status"})
-            _LOG.debug("status ConfigMap publish failed", exc_info=True)
+            _LOG.debug("%s ConfigMap publish failed", name, exc_info=True)
+
+    def _publish_explanations(self, explanations: dict) -> None:
+        """Explainer-thread callback: the scheduler-explanations ConfigMap
+        ``ktpu why <pod>`` reads. One JSON blob keyed by pod key."""
+        import json
+        import time as _time
+        self._publish_configmap(
+            EXPLAIN_CONFIGMAP,
+            {"explanations": json.dumps(explanations),
+             "updated": str(_time.time())})
+
+    def publish_trace(self) -> None:
+        """Publish the flight-recorder export NOW (``ktpu trace dump``
+        freshness; publish_status also refreshes it on the audit cadence)."""
+        self._publish_trace()
+
+    def _publish_trace(self) -> None:
+        import json
+        import time as _time
+        from kubernetes_tpu.utils.tracing import TRACER
+        try:
+            doc = TRACER.export_chrome(max_events=TRACE_PUBLISH_EVENTS,
+                                       max_flight_pods=TRACE_PUBLISH_PODS)
+        except Exception:
+            LOOP_ERRORS.inc({"site": "publish_status"})
+            _LOG.debug("trace export failed", exc_info=True)
+            return
+        self._publish_configmap(
+            TRACE_CONFIGMAP,
+            {"trace": json.dumps(doc), "updated": str(_time.time())})
 
     def _start_loop(self):
         with self._loop_lock:

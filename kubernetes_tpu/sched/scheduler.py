@@ -46,6 +46,7 @@ from kubernetes_tpu.sched.queue import SchedulingQueue
 from kubernetes_tpu.sched.resilience import DeviceCircuitBreaker
 from kubernetes_tpu.utils import sanity
 from kubernetes_tpu.utils.events import NullRecorder
+from kubernetes_tpu.utils.tracing import FLIGHT
 
 _LOG = logging.getLogger(__name__)
 
@@ -135,6 +136,21 @@ class Scheduler:
             from kubernetes_tpu.audit.sentinel import ParitySentinel
             self.sentinel = ParitySentinel(lambda: self.breaker,
                                            every=parity_every)
+        # decision-provenance explainer (sched/explainer.py): re-runs the
+        # static filter stack in per-filter-output mode over unschedulable
+        # pods on its own thread — upstream-style FailedScheduling
+        # messages, ktpu why, and unschedulable-reason metrics with zero
+        # dispatches added to the drain cycle. recorder_ref is a callable
+        # because the runner swaps self.recorder after construction.
+        explain_on = cfg.explainer_enabled
+        env_explain = _os.environ.get("KTPU_EXPLAIN")
+        if env_explain is not None:
+            explain_on = env_explain != "0"
+        self.explainer = None
+        if explain_on:
+            from kubernetes_tpu.sched.explainer import SchedulingExplainer
+            self.explainer = SchedulingExplainer(cfg,
+                                                 lambda: self.recorder)
         # watchdog heartbeats (the runner wires these to its watchdog;
         # library embedders keep the no-ops)
         self.heartbeat: Callable[[], None] = lambda: None
@@ -619,7 +635,8 @@ class Scheduler:
                else set(profile.out_of_tree))
         plugins = self.registry.tensor_plugins(oot)
         with BATCH_DURATION.time(), TRACER.span(
-                "scheduler/gang_schedule", pods=len(pods), nodes=len(nodes)):
+                "scheduler/gang_schedule", pods=len(pods),
+                nodes=len(nodes)) as sp_gang:
             try:
                 assignment, rounds = gang_schedule(
                     ct, pb, seed=self.cfg.seed,
@@ -689,6 +706,11 @@ class Scheduler:
             else:
                 failures.append((pod, attempts))
                 n_unsched += 1
+        if FLIGHT.enabled:
+            for pod, _a in items:
+                FLIGHT.record(pod.key, "dispatch", span=sp_gang)
+            for pod, _n in to_bind:
+                FLIGHT.record(pod.key, "resolve", span=sp_gang)
         self._handle_failures(failures)
         self._bind_async_batch(to_bind, profile)
         # every pod in the batch shares one cycle's wall time; record the
@@ -873,11 +895,14 @@ class Scheduler:
             self._cyc_marks.append(("encode_start",
                                     round(time.time() - t0, 3)))
         chunks = [items[i:i + P] for i in range(0, len(items), P)]
-        with TRACER.span("scheduler/encode_pods", pods=len(pods)):
+        with TRACER.span("scheduler/encode_pods", pods=len(pods)) as sp_enc:
             pbs = [self.cache.encode_pods(
                 profile.apply_added_affinity([p for p, _ in c]),
                 meta, min_p=P,
                 cache_rows=not profile.added_affinity) for c in chunks]
+        if FLIGHT.enabled:
+            for pod, _a in items:
+                FLIGHT.record(pod.key, "drain_fill", span=sp_enc)
         # pad to the fixed drain width with all-invalid batches (their pods
         # propose nothing; the scan converges them in one dead round)
         B = max(1, self.cfg.max_drain_batches)
@@ -966,7 +991,8 @@ class Scheduler:
             pb_staged = self.cache.stage_drain_batch(pb_stack)
         with TRACER.span("scheduler/gang_dispatch",
                          pods=len(pods), nodes=len(nodes),
-                         depth=len(self._pending) + 1), self._mesh_scope():
+                         depth=len(self._pending) + 1) as sp_disp, \
+                self._mesh_scope():
             # mesh on: the batch stack ships pre-sharded on "pods" (the
             # context's cluster arrays are already resident split on
             # "nodes"), and the winners view is pinned replicated so the
@@ -1021,6 +1047,9 @@ class Scheduler:
         }
         if parity_cap is not None:
             pend["parity"] = parity_cap
+        if FLIGHT.enabled:
+            for pod, _a in items:
+                FLIGHT.record(pod.key, "dispatch", span=sp_disp)
         if self.cycle_log is not None:
             marks = dict(self._cyc_marks)
             marks["done"] = round(time.time() - t0, 3)
@@ -1074,7 +1103,8 @@ class Scheduler:
         t_wait = time.time()
         fetch_failed = False
         with BATCH_DURATION.time(), TRACER.span(
-                "scheduler/resolve_wait", depth=len(self._pending) + 1):
+                "scheduler/resolve_wait",
+                depth=len(self._pending) + 1) as sp_res:
             # fill_bound is maintained purely by the dispatch-side
             # reservation arithmetic (adjusted below); the device fill stays
             # resident as ctx["fill_dev"] and is never fetched
@@ -1226,6 +1256,11 @@ class Scheduler:
             self.sentinel.submit_drain(cap, list(to_bind), prior)
         n_bound = len(to_bind)
         n_unsched = len(failures)
+        if FLIGHT.enabled:
+            for pod, _n in to_bind:
+                FLIGHT.record(pod.key, "resolve", span=sp_res)
+            for pod, _a in failures:
+                FLIGHT.record(pod.key, "resolve", span=sp_res)
         self._handle_failures(failures)
         # fill_bound is ADJUSTED, never overwritten: drains dispatched after
         # this one already reserved their own += len(pods) on top, so only
@@ -1426,6 +1461,9 @@ class Scheduler:
             self.cache.assume(pod, node_name)
             to_bind.append((pod, node_name))
             n_bound += 1
+        if FLIGHT.enabled:
+            for pod, _n in to_bind:
+                FLIGHT.record(pod.key, "resolve", mode="oracle")
         self._handle_failures(failures)
         self._bind_async_batch(to_bind, profile)
         dt = time.time() - t0
@@ -1449,6 +1487,7 @@ class Scheduler:
         (Metrics for the unschedulable result are batched by the caller.)"""
         preemptable: list[tuple[Pod, int]] = []
         preempt_on = self.features.enabled("PreemptionSimulation")
+        unschedulable: list[Pod] = []
         for pod, attempts in failures:
             if self.cache.is_bound(pod.key):
                 # Bound by another party while in-flight (its own bound copy
@@ -1457,13 +1496,12 @@ class Scheduler:
                 # future event clears it. No FailedScheduling event either:
                 # the pod IS scheduled.
                 continue
-            self.recorder.event(pod, "Warning", "FailedScheduling",
-                                "no node satisfied the pod's scheduling "
-                                "constraints this cycle")
+            unschedulable.append(pod)
             if pod.spec.priority > 0 and preempt_on:
                 preemptable.append((pod, attempts))
             else:
                 self._after_preempt(pod, attempts, None)
+        self._emit_failed_scheduling(unschedulable)
         if not preemptable:
             return
         if self._custom_preemptor or len(preemptable) == 1:
@@ -1475,6 +1513,29 @@ class Scheduler:
                 [p for p, _ in preemptable])
             for (pod, attempts), node in zip(preemptable, nominations):
                 self._after_preempt(pod, attempts, node)
+
+    def _emit_failed_scheduling(self, pods: list[Pod]) -> None:
+        """FailedScheduling events for one cycle's unschedulable pods. The
+        explainer owns them when it accepts the capture (its verdict is the
+        upstream-style per-filter message); the generic single-line event
+        remains the fallback for pods it refused (backlog full, disabled)."""
+        if not pods:
+            return
+        leftovers = pods
+        if self.explainer is not None:
+            by_prof: dict[str, list[Pod]] = {}
+            for p in pods:
+                by_prof.setdefault(p.spec.scheduler_name, []).append(p)
+            leftovers = []
+            for name, group in by_prof.items():
+                if not self.explainer.submit(
+                        self.cache, self.cfg.profile_for(name),
+                        self._attempt_level, group):
+                    leftovers.extend(group)
+        for pod in leftovers:
+            self.recorder.event(pod, "Warning", "FailedScheduling",
+                                "no node satisfied the pod's scheduling "
+                                "constraints this cycle")
 
     def _after_preempt(self, pod: Pod, attempts: int,
                        nominated: Optional[str]):
@@ -1822,6 +1883,7 @@ class Scheduler:
         for (pod, node_name), ok in zip(pairs, results):
             if ok:
                 self.cache.finish_binding(pod.key)
+                FLIGHT.record(pod.key, "bind", node=node_name)
                 self.recorder.event(
                     pod, "Normal", "Scheduled",
                     f"Successfully assigned {pod.key} to {node_name}")
@@ -1855,6 +1917,8 @@ class Scheduler:
             self._resolver_q = None
         if self.sentinel is not None:
             self.sentinel.close()
+        if self.explainer is not None:
+            self.explainer.close()
         if self._staged:
             # parked fragments go back to the queue, not the void — with
             # their attempt history, so backoff does not reset
@@ -1902,6 +1966,7 @@ class Scheduler:
         gone = ok is None
         if ok:
             fw.run_post_bind(lifecycle, pod, node_name)
+            FLIGHT.record(pod.key, "bind", node=node_name)
             self.recorder.event(pod, "Normal", "Scheduled",
                                 f"Successfully assigned {pod.key} to {node_name}")
         else:

@@ -653,9 +653,15 @@ class APIServer:
                     self.wfile.write(body)
                     return
                 if path == "/debug/traces":
-                    # OTLP/JSON export of the process tracer's spans
+                    # OTLP/JSON export of the process tracer's spans;
+                    # ?format=chrome serves Chrome trace-event JSON instead
+                    # (flight-recorder pod tracks included) — curl it
+                    # straight into ui.perfetto.dev
                     from kubernetes_tpu.utils.tracing import (TRACER,
                                                               export_otlp_json)
+                    q = parse_qs(urlparse(self.path).query)
+                    if q.get("format", [""])[0] == "chrome":
+                        return self._send_json(200, TRACER.export_chrome())
                     return self._send_json(200, export_otlp_json(TRACER))
                 if path == "/debug/stacks":
                     # /debug/pprof goroutine-dump analog

@@ -1,0 +1,170 @@
+"""CI-shaped e2e: apiserver + connected runner over HTTP, one pod made
+unschedulable by an untolerated taint. ``ktpu why`` must name the taint
+filter with per-filter node counts, the FailedScheduling event must carry
+the same breakdown, and ``ktpu trace dump`` must emit a valid Chrome
+trace-event document with the pod's flight-recorder track in it."""
+
+import io
+import json
+import time
+
+import pytest
+
+from kubernetes_tpu.cli.ktpu import main
+from kubernetes_tpu.client.clientset import HTTPClient
+from kubernetes_tpu.config.types import SchedulerConfiguration
+from kubernetes_tpu.sched.runner import SchedulerRunner
+from kubernetes_tpu.store.apiserver import APIServer
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+from kubernetes_tpu.utils.tracing import validate_chrome_trace
+
+
+def wait_for(pred, timeout=20.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    return None
+
+
+def ktpu(server, *argv):
+    out = io.StringIO()
+    rc = main(["--server", server.url, *argv], out=out)
+    return rc, out.getvalue()
+
+
+@pytest.fixture()
+def cluster():
+    server = APIServer().start()
+    client = HTTPClient(server.url)
+    runner = SchedulerRunner(client, SchedulerConfiguration(
+        backoff_initial_s=0.05, backoff_max_s=0.2))
+    runner.start()
+    yield server, client, runner
+    runner.stop()
+    server.stop()
+
+
+def test_why_trace_and_event_for_untolerated_taint(cluster):
+    server, client, runner = cluster
+    client.nodes().create(
+        make_node("tainted-0")
+        .capacity({"cpu": "4", "memory": "8Gi", "pods": "10"})
+        .taint("dedicated", "ml", "NoSchedule").obj().to_dict())
+    client.nodes().create(
+        make_node("tainted-1")
+        .capacity({"cpu": "4", "memory": "8Gi", "pods": "10"})
+        .taint("dedicated", "ml", "NoSchedule").obj().to_dict())
+    pods = client.pods("default")
+    # a schedulable pod proves the pipeline works end to end...
+    pods.create(make_pod("ok").req({"cpu": "100m"})
+                .toleration(key="dedicated", operator="Exists")
+                .obj().to_dict())
+    # ...and the victim has no toleration: unschedulable everywhere
+    pods.create(make_pod("stuck").req({"cpu": "100m"}).obj().to_dict())
+
+    assert wait_for(
+        lambda: pods.get("ok")["spec"].get("nodeName")), "ok pod never bound"
+
+    # the explainer's verdict reaches the scheduler-explanations ConfigMap
+    def explained():
+        rc, out = ktpu(server, "why", "stuck", "-o", "json")
+        return (rc, out) if rc == 0 else None
+    got = wait_for(explained)
+    assert got, "ktpu why never returned an explanation"
+    _rc, out = got
+    doc = json.loads(out)
+    assert doc["scheduled"] is False
+    # names the taint filter, with the per-filter node count
+    assert doc["filters"] == {"TaintToleration": 2}
+    assert doc["message"] == ("0/2 nodes are available: 2 node(s) had "
+                              "untolerated taint.")
+
+    # table output names the filter too
+    rc, table = ktpu(server, "why", "stuck")
+    assert rc == 0
+    assert "TaintToleration: 2 node(s)" in table
+    assert "0/2 nodes are available" in table
+
+    # the FailedScheduling EVENT carries the same per-filter message
+    runner.scheduler.recorder.flush()
+
+    def event_msg():
+        evs = client.resource("events", "default").list()
+        for e in evs:
+            if (e.get("reason") == "FailedScheduling"
+                    and (e.get("involvedObject") or {}).get("name")
+                    == "stuck"):
+                return e.get("message")
+        return None
+    msg = wait_for(event_msg)
+    assert msg == "0/2 nodes are available: 2 node(s) had untolerated taint."
+
+    # a bound pod's why: names the node it landed on
+    rc, out = ktpu(server, "why", "ok")
+    assert rc == 0 and "scheduled to" in out
+
+    # a pod OUTSIDE the runner's status namespace: the explanations
+    # ConfigMap lives in "default", and ktpu why -n team-a must fall back
+    # to it instead of 404ing on configmaps/team-a
+    client.pods("team-a").create(
+        make_pod("stuck2", "team-a").req({"cpu": "100m"}).obj().to_dict())
+
+    def explained_cross_ns():
+        rc, out = ktpu(server, "-n", "team-a", "why", "stuck2", "-o",
+                       "json")
+        return out if rc == 0 else None
+    cross = wait_for(explained_cross_ns)
+    assert cross, "cross-namespace ktpu why never resolved"
+    assert json.loads(cross)["filters"] == {"TaintToleration": 2}
+
+    # trace dump: publish now (the audit loop also does this on cadence),
+    # then validate against the Chrome trace-event schema
+    runner.publish_trace()
+    rc, raw = ktpu(server, "trace", "dump")
+    assert rc == 0, raw
+    trace = json.loads(raw)
+    assert validate_chrome_trace(trace) == []
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "scheduler/gang_schedule" in names or \
+        "scheduler/gang_dispatch" in names
+    # the flight recorder's per-pod track made it into the export
+    tracks = {e["args"].get("name") for e in trace["traceEvents"]
+              if e.get("ph") == "M"}
+    assert "default/ok" in tracks
+    stages = {e["name"] for e in trace["traceEvents"]
+              if e.get("cat") == "pod"}
+    assert {"informer", "queue_add", "dispatch", "bind"} <= stages
+
+    # trace dump -o writes the same document to disk
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".json") as f:
+        rc, out = ktpu(server, "trace", "dump", "-o", f.name)
+        assert rc == 0 and "events to" in out
+        on_disk = json.load(open(f.name))
+        assert validate_chrome_trace(on_disk) == []
+
+    # ktpu status surfaces the new observability blocks
+    rc, st = ktpu(server, "status")
+    assert rc == 0
+    assert "Explainer:" in st and "Flight rec:" in st
+    assert "Pending pods:" in st
+
+
+def test_e2e_histogram_observed(cluster):
+    """Binding through the product observes the flight-recorder-derived
+    end-to-end histogram."""
+    from kubernetes_tpu.metrics.registry import E2E_SCHEDULING
+    server, client, _runner = cluster
+    base = E2E_SCHEDULING.count()
+    client.nodes().create(
+        make_node("n0").capacity({"cpu": "4", "pods": "10"})
+        .obj().to_dict())
+    client.pods("default").create(
+        make_pod("e2e-pod").req({"cpu": "100m"}).obj().to_dict())
+    assert wait_for(lambda: client.pods("default")
+                    .get("e2e-pod")["spec"].get("nodeName"))
+    assert wait_for(lambda: E2E_SCHEDULING.count() > base), \
+        "e2e scheduling histogram never observed"

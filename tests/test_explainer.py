@@ -1,0 +1,314 @@
+"""Decision provenance: the per-filter-output program (models/explain.py)
+against the numpy oracle's per-filter verdicts, and the background
+explainer's event/ConfigMap/metric surfaces (sched/explainer.py)."""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.encode.snapshot import SnapshotEncoder
+from kubernetes_tpu.models.explain import (
+    EXPLAIN_FILTERS,
+    REASON_TO_FILTER,
+    explain_step,
+    failed_scheduling_message,
+    first_fail,
+    reject_histogram,
+)
+from kubernetes_tpu.sched.oracle import FailReason, OracleScheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+from test_filters_parity import random_node, random_pod
+
+
+def explain_verdicts(nodes, pods, bound=None, enabled=None):
+    import jax
+    enc = SnapshotEncoder()
+    ct, meta = enc.encode_cluster(nodes, bound or [], pending_pods=pods)
+    pb = enc.encode_pods(pods, meta)
+    verdicts, valid = jax.device_get(
+        explain_step(ct, pb, topo_keys=meta.topo_keys, enabled=enabled))
+    return first_fail(np.asarray(verdicts),
+                      np.asarray(valid))[:len(pods), :len(nodes)]
+
+
+def assert_explain_parity(nodes, pods, bound=None):
+    """Per-(pod, node) first-fail reasons from the batched per-filter
+    program must match the oracle's short-circuit verdict EXACTLY."""
+    ff = explain_verdicts(nodes, pods, bound)
+    orc = OracleScheduler(nodes, bound or [])
+    for pi, pod in enumerate(pods):
+        mask, reasons = orc.feasible(pod)
+        for ni, node in enumerate(nodes):
+            got = ff[pi, ni]
+            if mask[ni]:
+                assert got == -1, (
+                    f"{pod.key} on {node.metadata.name}: oracle feasible, "
+                    f"tensor rejected by {EXPLAIN_FILTERS[got]}")
+            else:
+                want = REASON_TO_FILTER[reasons[node.metadata.name]]
+                assert got >= 0 and EXPLAIN_FILTERS[got] == want, (
+                    f"{pod.key} on {node.metadata.name}: oracle said "
+                    f"{want!r}, tensor said "
+                    f"{EXPLAIN_FILTERS[got] if got >= 0 else 'feasible'!r}")
+    return ff
+
+
+# ------------------------------------------------------------ golden cases
+
+def test_first_fail_order_matches_oracle_short_circuit():
+    # one node failing MANY filters at once: the verdict must be the
+    # FIRST in the oracle's check order (unschedulable beats taint beats
+    # resources)
+    nodes = [make_node("bad").capacity({"cpu": "1", "pods": "10"})
+             .taint("dedicated", "ml", "NoSchedule").unschedulable().obj()]
+    pods = [make_pod("p0").req({"cpu": "4"}).obj()]
+    ff = assert_explain_parity(nodes, pods)
+    assert EXPLAIN_FILTERS[ff[0, 0]] == "NodeUnschedulable"
+
+
+def test_taint_and_resources_histogram():
+    nodes = [make_node("t0").capacity({"cpu": "8", "pods": "10"})
+             .taint("dedicated", "ml", "NoSchedule").obj(),
+             make_node("t1").capacity({"cpu": "8", "pods": "10"})
+             .taint("dedicated", "ml", "NoSchedule").obj(),
+             make_node("small").capacity({"cpu": "1", "pods": "10"}).obj()]
+    pods = [make_pod("p0").req({"cpu": "4"}).obj()]
+    ff = assert_explain_parity(nodes, pods)
+    hist = reject_histogram(ff[0])
+    assert hist == {"TaintToleration": 2, "NodeResourcesFit": 1}
+    msg = failed_scheduling_message(len(nodes), hist)
+    assert msg == ("0/3 nodes are available: 2 node(s) had untolerated "
+                   "taint, 1 Insufficient resources.")
+
+
+def test_message_counts_and_tiebreak_order():
+    hist = {"NodeResourcesFit": 2, "TaintToleration": 2, "NodeAffinity": 5}
+    msg = failed_scheduling_message(9, hist)
+    # counts descending; equal counts in filter-stack order
+    assert msg == ("0/9 nodes are available: 5 " + FailReason.AFFINITY
+                   + ", 2 " + FailReason.RESOURCES
+                   + ", 2 " + FailReason.TAINT + ".")
+    assert "became feasible" in failed_scheduling_message(
+        3, {"NodeName": 2}, feasible_now=1)
+    assert failed_scheduling_message(0, {}) \
+        == "0/0 nodes are available: no nodes in the cluster."
+
+
+def test_relational_filters_explained():
+    # spread: 2 replicas already in zone a -> skew violation there
+    nodes = [make_node("za").capacity({"cpu": "8", "pods": "10"})
+             .label("zone", "a").obj(),
+             make_node("zb").capacity({"cpu": "8", "pods": "10"})
+             .label("zone", "b").obj()]
+    bound = [make_pod(f"b{i}").label("app", "web").node("za").obj()
+             for i in range(2)]
+    pod = (make_pod("p0").label("app", "web")
+           .spread(1, "zone", "DoNotSchedule", {"app": "web"}).obj())
+    ff = assert_explain_parity(nodes, [pod], bound)
+    assert EXPLAIN_FILTERS[ff[0, 0]] == "PodTopologySpread"
+    assert ff[0, 1] == -1
+    # required anti-affinity to itself via an existing pod
+    anti = (make_pod("anti").label("app", "db")
+            .pod_affinity("zone", {"app": "db"}, anti=True).obj())
+    bound2 = [make_pod("b-db").label("app", "db").node("za").obj()]
+    ff2 = assert_explain_parity(nodes, [anti], bound2)
+    assert EXPLAIN_FILTERS[ff2[0, 0]] == "InterPodAffinity"
+
+
+def test_disabled_filters_pass_everywhere():
+    nodes = [make_node("t0").capacity({"cpu": "8", "pods": "10"})
+             .taint("dedicated", "ml", "NoSchedule").obj()]
+    pods = [make_pod("p0").req({"cpu": "1"}).obj()]
+    enabled = tuple(sorted(set(EXPLAIN_FILTERS) - {"TaintToleration"}))
+    ff = explain_verdicts(nodes, pods, enabled=enabled)
+    assert ff[0, 0] == -1  # taint filter disabled -> feasible
+
+
+# ------------------------------------------------------------- fuzz parity
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_explain_parity(seed):
+    """Randomized clusters: per-(pod, node) reject reasons from the
+    batched per-filter-output program match the numpy oracle exactly."""
+    rng = random.Random(1000 + seed)
+    n_nodes = rng.randint(1, 12)
+    n_bound = rng.randint(0, 8)
+    n_pods = rng.randint(1, 10)
+    nodes = [random_node(rng, i) for i in range(n_nodes)]
+    names = [n.metadata.name for n in nodes]
+    bound = []
+    for i in range(n_bound):
+        p = random_pod(rng, 100 + i, names)
+        p.spec.node_name = rng.choice(names)
+        bound.append(p)
+    pods = [random_pod(rng, i, names) for i in range(n_pods)]
+    assert_explain_parity(nodes, pods, bound)
+
+
+# --------------------------------------------------- explainer (threaded)
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def event(self, obj, type_, reason, message):
+        self.events.append((obj.key, type_, reason, message))
+
+
+def _cluster_cache(nodes):
+    from kubernetes_tpu.sched.cache import SchedulerCache
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.update_node(n)
+    return cache
+
+
+def _mk_explainer(recorder):
+    from kubernetes_tpu.config.types import SchedulerConfiguration
+    from kubernetes_tpu.sched.explainer import SchedulingExplainer
+    cfg = SchedulerConfiguration()
+    return cfg, SchedulingExplainer(cfg, lambda: recorder)
+
+
+@pytest.mark.parametrize("level,mode", [("single", "tensor"),
+                                        ("oracle", "oracle")])
+def test_explainer_thread_verdict(level, mode):
+    from kubernetes_tpu.metrics.registry import UNSCHEDULABLE_REASONS
+    nodes = [make_node("t0").capacity({"cpu": "8", "pods": "10"})
+             .taint("dedicated", "ml", "NoSchedule").obj()]
+    cache = _cluster_cache(nodes)
+    rec = _Recorder()
+    cfg, ex = _mk_explainer(rec)
+    published = []
+    ex.publisher = published.append
+    pod = make_pod("p0").req({"cpu": "1"}).obj()
+    base = UNSCHEDULABLE_REASONS.get({"filter": "TaintToleration"})
+    assert ex.submit(cache, cfg.profiles[0], level, [pod])
+    ex.drain()
+    exp = ex.explain_of(pod.key)
+    assert exp is not None and exp["mode"] == mode
+    assert exp["filters"] == {"TaintToleration": 1}
+    assert exp["message"] == ("0/1 nodes are available: 1 node(s) had "
+                              "untolerated taint.")
+    (key, type_, reason, msg) = rec.events[0]
+    assert (key, type_, reason) == (pod.key, "Warning", "FailedScheduling")
+    assert msg == exp["message"]
+    assert UNSCHEDULABLE_REASONS.get({"filter": "TaintToleration"}) \
+        == base + 1
+    assert published and pod.key in published[-1]
+    ex.close()
+
+
+def test_explainer_throttles_reexplanation():
+    nodes = [make_node("n0").capacity({"cpu": "1", "pods": "10"}).obj()]
+    cache = _cluster_cache(nodes)
+    rec = _Recorder()
+    cfg, ex = _mk_explainer(rec)
+    pod = make_pod("p0").req({"cpu": "4"}).obj()
+    assert ex.submit(cache, cfg.profiles[0], "single", [pod])
+    # immediately re-failing the same pod: the explainer still OWNS the
+    # event (True) but takes no second sample
+    assert ex.submit(cache, cfg.profiles[0], "single", [pod])
+    assert ex.samples == 1
+    ex.drain()
+    ex.close()
+
+
+def test_explainer_backlog_full_falls_back():
+    nodes = [make_node("n0").capacity({"cpu": "1", "pods": "10"}).obj()]
+    cache = _cluster_cache(nodes)
+    rec = _Recorder()
+    cfg, ex = _mk_explainer(rec)
+    ex._max_backlog = 0  # every submit sees a full backlog
+    pod = make_pod("p0").req({"cpu": "4"}).obj()
+    assert not ex.submit(cache, cfg.profiles[0], "single", [pod])
+    assert ex.skipped == 1
+    ex.close()
+
+
+def test_explainer_feasible_now_raced_cluster():
+    """The cluster moved between the failed cycle and the explanation:
+    the re-run finds a feasible node, and says so instead of lying."""
+    nodes = [make_node("n0").capacity({"cpu": "8", "pods": "10"}).obj()]
+    cache = _cluster_cache(nodes)
+    rec = _Recorder()
+    cfg, ex = _mk_explainer(rec)
+    pod = make_pod("p0").req({"cpu": "1"}).obj()  # fits fine NOW
+    assert ex.submit(cache, cfg.profiles[0], "single", [pod])
+    ex.drain()
+    exp = ex.explain_of(pod.key)
+    assert exp["feasibleNow"] == 1 and exp["filters"] == {}
+    assert "became feasible" in exp["message"]
+    ex.close()
+
+
+def test_oracle_mode_disabled_filter_rejections_become_unjudged():
+    """Degraded (oracle) mode cannot skip a profile's disabled filters;
+    nodes it rejected ONLY via a disabled filter must come out 'not
+    judged' — never blamed on a filter the profile disabled, never an
+    empty-cluster message."""
+    from kubernetes_tpu.config.types import Profile, SchedulerConfiguration
+    from kubernetes_tpu.sched.explainer import SchedulingExplainer
+    nodes = [make_node("t0").capacity({"cpu": "8", "pods": "10"})
+             .taint("dedicated", "ml", "NoSchedule").obj()]
+    cache = _cluster_cache(nodes)
+    rec = _Recorder()
+    cfg = SchedulerConfiguration(profiles=[
+        Profile(disabled_filters=["TaintToleration"])])
+    ex = SchedulingExplainer(cfg, lambda: rec)
+    pod = make_pod("p0").req({"cpu": "1"}).obj()
+    assert ex.submit(cache, cfg.profiles[0], "oracle", [pod])
+    ex.drain()
+    exp = ex.explain_of(pod.key)
+    assert exp["mode"] == "oracle"
+    assert exp["filters"] == {} and exp["unjudged"] == 1
+    assert "TaintToleration" not in exp["message"]
+    assert "not judged" in exp["message"]
+    assert "no nodes in the cluster" not in exp["message"]
+    ex.close()
+
+
+def test_scheduler_failure_path_routes_through_explainer():
+    """Scheduler-level: an unschedulable batch gets the explainer's
+    upstream-style event, not the generic one."""
+    from kubernetes_tpu.config.types import SchedulerConfiguration
+    from kubernetes_tpu.sched.queue import SchedulingQueue
+    from kubernetes_tpu.sched.scheduler import Scheduler
+    nodes = [make_node("t0").capacity({"cpu": "8", "pods": "10"})
+             .taint("dedicated", "ml", "NoSchedule").obj()]
+    cache = _cluster_cache(nodes)
+    queue = SchedulingQueue()
+    sched = Scheduler(SchedulerConfiguration(), cache, queue,
+                      binder=lambda p, n: True)
+    rec = _Recorder()
+    sched.recorder = rec
+    assert sched.explainer is not None
+    pod = make_pod("p0").req({"cpu": "1"}).obj()
+    queue.add(pod)
+    try:
+        sched.run_once(wait=0.5)
+        sched.explainer.drain()
+        assert any(r == "FailedScheduling"
+                   and "untolerated taint" in m
+                   for _k, _t, r, m in rec.events), rec.events
+    finally:
+        queue.close()
+        sched.close()
+
+
+def test_score_breakdown_for_scheduled_pod():
+    nodes = [make_node("n0").capacity({"cpu": "8", "pods": "10"}).obj(),
+             make_node("n1").capacity({"cpu": "2", "pods": "10"}).obj()]
+    bound = [make_pod("busy").req({"cpu": "1"}).node("n1").obj()]
+    rec = _Recorder()
+    _cfg, ex = _mk_explainer(rec)
+    pod = make_pod("p0").req({"cpu": "1"}).obj()
+    pod.spec.node_name = "n0"
+    bd = ex.score_breakdown(nodes, bound, pod)
+    assert bd["feasible"] == 2 and bd["chosen"] == "n0"
+    names = [n for n, _s in bd["top"]]
+    assert names[0] == "n0"  # LeastAllocated prefers the empty node
+    ex.close()

@@ -163,6 +163,14 @@ def test_debug_traces_and_stacks(cluster):
     assert any(s["name"] == "test/export" for s in spans)
     attrs = doc["resourceSpans"][0]["resource"]["attributes"]
     assert any(a["key"] == "service.name" for a in attrs)
+    # ?format=chrome: the Perfetto-loadable trace-event export
+    from kubernetes_tpu.utils.tracing import validate_chrome_trace
+    with urllib.request.urlopen(server.url
+                                + "/debug/traces?format=chrome") as r:
+        chrome = json.loads(r.read())
+    assert validate_chrome_trace(chrome) == []
+    assert any(e["name"] == "test/export"
+               for e in chrome["traceEvents"])
     with urllib.request.urlopen(server.url + "/debug/stacks") as r:
         text = r.read().decode()
     assert "thread " in text

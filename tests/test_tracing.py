@@ -1,0 +1,268 @@
+"""Tracing hardening: ring-buffer truncation, id-based span linkage,
+sampling, Chrome trace-event export, and the per-pod flight recorder."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.utils.tracing import (
+    FlightRecorder,
+    Tracer,
+    export_otlp_json,
+    validate_chrome_trace,
+)
+
+
+# ------------------------------------------------------------------ ring
+
+def test_ring_buffer_drops_oldest_and_counts():
+    tr = Tracer(max_spans=4)
+    for i in range(10):
+        with tr.span(f"s{i}"):
+            pass
+    spans = tr.spans()
+    assert [s.name for s in spans] == ["s6", "s7", "s8", "s9"]
+    assert tr.dropped == 6
+    tr.reset()
+    assert tr.spans() == [] and tr.dropped == 0
+
+
+def test_max_spans_resize_preserves_newest():
+    tr = Tracer(max_spans=8)
+    for i in range(8):
+        with tr.span(f"s{i}"):
+            pass
+    tr.max_spans = 3
+    assert [s.name for s in tr.spans()] == ["s5", "s6", "s7"]
+    # growing back keeps content and the new cap
+    tr.max_spans = 100
+    with tr.span("new"):
+        pass
+    assert [s.name for s in tr.spans()] == ["s5", "s6", "s7", "new"]
+
+
+# ------------------------------------------------------------- id linkage
+
+def test_span_ids_unique_and_parent_by_id():
+    tr = Tracer()
+    with tr.span("outer") as outer:
+        with tr.span("mid") as mid:
+            with tr.span("inner") as inner:
+                pass
+        with tr.span("sibling") as sib:
+            pass
+    ids = [s.span_id for s in tr.spans()]
+    assert len(ids) == len(set(ids)) == 4
+    assert inner.parent_id == mid.span_id
+    assert mid.parent_id == outer.span_id
+    assert sib.parent_id == outer.span_id
+    assert outer.parent_id == 0
+    # one trace: every span shares the root's trace id
+    assert {s.trace_id for s in tr.spans()} == {outer.span_id}
+    # display-name convenience still present
+    assert inner.parent == "mid" and outer.parent is None
+
+
+def test_same_name_spans_link_to_the_right_parent():
+    """The old name-based linkage guessed; ids don't. Two same-named
+    parents must each claim their own child."""
+    tr = Tracer()
+    parents = []
+    for _ in range(2):
+        with tr.span("cycle") as p:
+            parents.append(p)
+            with tr.span("child"):
+                pass
+    children = tr.spans("child")
+    assert [c.parent_id for c in children] == [p.span_id for p in parents]
+    assert children[0].trace_id != children[1].trace_id
+
+
+def test_separate_roots_get_separate_traces():
+    tr = Tracer()
+    with tr.span("a") as a:
+        pass
+    with tr.span("b") as b:
+        pass
+    assert a.trace_id != b.trace_id
+
+
+def test_nesting_is_per_thread():
+    tr = Tracer()
+    seen = {}
+
+    def worker():
+        with tr.span("worker-root") as sp:
+            seen["worker"] = sp
+
+    with tr.span("main-root") as main_sp:
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    # the worker's span must NOT have picked up main's stack as a parent
+    assert seen["worker"].parent_id == 0
+    assert seen["worker"].trace_id != main_sp.trace_id
+
+
+# ---------------------------------------------------------------- sampling
+
+def test_sampling_ratio_keeps_a_fraction():
+    tr = Tracer(ratio=0.25)
+    kept = 0
+    for _ in range(100):
+        with tr.span("s") as sp:
+            kept += sp is not None
+    assert kept == len(tr.spans()) == 25
+
+
+# ------------------------------------------------------------- otlp export
+
+def test_otlp_export_links_by_id_and_orphans_evicted_parents():
+    tr = Tracer(max_spans=2)
+    with tr.span("outer"):
+        with tr.span("inner1"):
+            pass
+        with tr.span("inner2"):
+            pass
+    # ring holds [inner2, outer]; inner1 was evicted
+    doc = export_otlp_json(tr)
+    spans = {s["name"]: s for s in
+             doc["resourceSpans"][0]["scopeSpans"][0]["spans"]}
+    assert set(spans) == {"inner2", "outer"}
+    assert spans["inner2"]["parentSpanId"] != ""
+    assert spans["outer"]["parentSpanId"] == ""
+    assert len(spans["outer"]["spanId"]) == 16
+    assert len(spans["outer"]["traceId"]) == 32
+    # a child exported while its parent is still OPEN (parent not yet in
+    # the finished ring) must come out a root, not dangle a broken link
+    tr2 = Tracer()
+    with tr2.span("outer"):
+        with tr2.span("inner"):
+            pass
+        doc2 = export_otlp_json(tr2)
+    (only,) = doc2["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert only["name"] == "inner" and only["parentSpanId"] == ""
+
+
+# ----------------------------------------------------------- chrome export
+
+def test_export_chrome_schema_and_content(tmp_path):
+    tr = Tracer()
+    fl = FlightRecorder(enabled=True)
+    with tr.span("scheduler/gang_dispatch", pods=3) as sp:
+        fl.record("default/p0", "dispatch", span=sp)
+    fl.record("default/p0", "bind", node="n0")
+    doc = tr.export_chrome(path=str(tmp_path / "t.json"), flight=fl)
+    assert validate_chrome_trace(doc) == []
+    on_disk = json.loads((tmp_path / "t.json").read_text())
+    assert validate_chrome_trace(on_disk) == []
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert "scheduler/gang_dispatch" in names
+    assert "dispatch" in names and "bind" in names
+    # the pod's dispatch slice links back to the batch span by id
+    ev = next(e for e in doc["traceEvents"] if e["name"] == "dispatch")
+    assert ev["args"]["span_id"] == sp.span_id
+    # per-pod track is named after the pod key
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert any(e["args"].get("name") == "default/p0" for e in meta)
+
+
+def test_export_chrome_max_events_keeps_newest():
+    tr = Tracer()
+    for i in range(10):
+        with tr.span(f"s{i}"):
+            pass
+    doc = tr.export_chrome(flight=FlightRecorder(enabled=False),
+                           max_events=3)
+    xs = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert xs == ["s7", "s8", "s9"]
+
+
+def test_export_chrome_bounds_flight_tracks():
+    tr = Tracer()
+    fl = FlightRecorder(enabled=True)
+    for i in range(10):
+        fl.record(f"ns/p{i}", "informer")
+    doc = tr.export_chrome(flight=fl, max_flight_pods=2)
+    tracks = {e["args"]["name"] for e in doc["traceEvents"]
+              if e["name"] == "thread_name" and e["pid"] == 2}
+    assert tracks == {"ns/p8", "ns/p9"}  # newest-inserted kept
+
+
+def test_validate_chrome_trace_rejects_garbage():
+    assert validate_chrome_trace({}) == ["traceEvents missing or not a list"]
+    bad = {"traceEvents": [{"ph": "X", "name": "x", "ts": 1.0, "pid": 1}]}
+    assert any("dur" in p for p in validate_chrome_trace(bad))
+    assert validate_chrome_trace({"traceEvents": [
+        {"ph": "i", "name": "x", "ts": 0.0, "s": "t", "pid": 2}]}) == []
+
+
+# --------------------------------------------------------- flight recorder
+
+def test_flight_recorder_per_pod_ring_and_pod_eviction():
+    fl = FlightRecorder(max_pods=2, max_events=3, enabled=True)
+    for i in range(5):
+        fl.record("ns/a", f"stage{i}")
+    tl = fl.timeline("ns/a")
+    assert [e["stage"] for e in tl] == ["stage2", "stage3", "stage4"]
+    fl.record("ns/b", "informer")
+    fl.record("ns/c", "informer")  # evicts ns/a (oldest inserted)
+    assert fl.timeline("ns/a") == []
+    assert fl.stats()["droppedPods"] == 1
+    assert set(fl.keys()) == {"ns/b", "ns/c"}
+
+
+def test_flight_recorder_disabled_is_noop():
+    fl = FlightRecorder(enabled=False)
+    fl.record("ns/a", "informer")
+    assert fl.timeline("ns/a") == [] and fl.stats()["pods"] == 0
+
+
+def test_flight_recorder_bind_observes_e2e_histograms():
+    from kubernetes_tpu.metrics.registry import E2E_DURATION, E2E_SCHEDULING
+    base_e2e = E2E_SCHEDULING.count()
+    base_sli = E2E_DURATION.count()
+    fl = FlightRecorder(enabled=True)
+    fl.record("ns/p", "informer")
+    fl.record("ns/p", "queue_add")
+    fl.record("ns/p", "dispatch")
+    fl.record("ns/p", "bind", node="n0")
+    assert E2E_SCHEDULING.count() == base_e2e + 1
+    assert E2E_DURATION.count() == base_sli + 1
+
+
+def test_flight_recorder_new_incarnation_resets_closed_timeline():
+    """A recreated pod under the same ns/name must not stitch onto the
+    old incarnation's bound timeline (the gap between them would poison
+    the derived e2e histogram)."""
+    from kubernetes_tpu.metrics.registry import E2E_SCHEDULING
+    fl = FlightRecorder(enabled=True)
+    fl.record("ns/p", "informer")
+    fl.record("ns/p", "bind", node="n0")
+    t_gap = time.time()
+    fl.record("ns/p", "informer")  # second incarnation
+    tl = fl.timeline("ns/p")
+    assert [e["stage"] for e in tl] == ["informer"]
+    assert tl[0]["ts"] >= t_gap
+    base = E2E_SCHEDULING.count()
+    fl.record("ns/p", "bind", node="n1")
+    assert E2E_SCHEDULING.count() == base + 1
+    # the new observation spans only the second incarnation, and a
+    # requeue mid-flight does NOT reset (same incarnation)
+    fl.record("ns/p", "informer")
+    fl.record("ns/p", "requeue")
+    fl.record("ns/p", "dispatch")
+    assert [e["stage"] for e in fl.timeline("ns/p")] == [
+        "informer", "requeue", "dispatch"]
+
+
+def test_flight_recorder_timeline_attrs_and_span_link():
+    tr = Tracer()
+    fl = FlightRecorder(enabled=True)
+    with tr.span("batch") as sp:
+        fl.record("ns/p", "dispatch", span=sp, depth=2)
+    (ev,) = fl.timeline("ns/p")
+    assert ev["span_id"] == sp.span_id
+    assert ev["attrs"] == {"depth": 2}
