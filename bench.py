@@ -87,35 +87,43 @@ def main():
         log("[bench] " + json.dumps(connected))
 
     connected_mesh = None
-    shape = ()
+    shapes = []
     if os.environ.get("BENCH_MESH", "1") != "0" and not only_case:
         # runs in a SUBPROCESS with a forced multi-device CPU host platform:
         # this process owns the single real TPU chip, and the mesh case
         # needs >= 2 devices to shard over (same trick as the driver's
-        # multichip dry-run). The subprocess runs the deterministic
-        # sharded-vs-unsharded drain parity gate, then the live
-        # hollow-kubelet legs with the mesh off and on.
+        # multichip dry-run). The subprocess runs, PER MESH WIDTH, the
+        # deterministic sharded-vs-unsharded drain parity gate and a live
+        # hollow-kubelet leg against one shared unsharded baseline — and
+        # gates sharded >= unsharded at every width that ran.
         import subprocess
         from kubernetes_tpu.parallel.mesh import parse_mesh_shape
-        shape_s = os.environ.get("BENCH_MESH_SHAPE", "1x2")
-        # "off"/"none" (parse -> None) or an unparseable value disables the
-        # case — never silently substitutes a default shape
+        # BENCH_MESH_SHAPES: ";"/space-separated width list ("1x2;1x4");
+        # falls back to the single-shape BENCH_MESH_SHAPE. "off"/"none"
+        # (parse -> None) or an unparseable value disables the case —
+        # never silently substitutes a default shape
+        shape_s = os.environ.get(
+            "BENCH_MESH_SHAPES", os.environ.get("BENCH_MESH_SHAPE", "1x2"))
         try:
-            shape = parse_mesh_shape(shape_s) or ()
+            shapes = [s for s in
+                      (parse_mesh_shape(tok)
+                       for tok in shape_s.replace(";", " ").split())
+                      if s is not None]
         except ValueError as e:
-            log(f"[bench] bad BENCH_MESH_SHAPE={shape_s!r} ({e}); "
+            log(f"[bench] bad BENCH_MESH_SHAPES={shape_s!r} ({e}); "
                 "skipping mesh case")
-            shape = ()
-    if shape:
+            shapes = []
+    if shapes:
         log(f"[bench] connected mesh run ({shape_s}) ...")
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         # append, don't clobber: the operator's own XLA flags (dump/tuning)
-        # must survive in the subprocess
+        # must survive in the subprocess. Device count covers the WIDEST
+        # swept width; narrower meshes use a prefix of the devices.
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                             f" --xla_force_host_platform_device_count="
-                            f"{shape[0] * shape[1]}").strip()
-        env["BENCH_MESH_SHAPE"] = shape_s
+                            f"{max(p * n for p, n in shapes)}").strip()
+        env["BENCH_MESH_SHAPES"] = shape_s
         # an exported KTPU_MESH would override BOTH legs' mesh_shape config
         # (including the unsharded leg's explicit None), silently turning
         # the A/B into sharded-vs-sharded
@@ -291,12 +299,11 @@ def main():
               f"(seed {chaos_churn['chaos']['seed']})", file=sys.stderr)
         sys.exit(1)
     if (connected_mesh is not None
-            and connected_mesh.get("parity") is not None
-            and not connected_mesh["parity"].get("ok")):
+            and (connected_mesh.get("parity") or {}).get("ok") is False):
         # hard gate: a mesh whose placements diverge from single-device is
         # a miscompile or a sharding bug, never a tolerable perf variance.
-        # (A subprocess error/timeout carries no parity verdict and is
-        # reported above, not failed here.)
+        # (A subprocess error/timeout — or a width whose check crashed
+        # environmentally — carries ok=None, reported above, not failed.)
         print("[bench] FATAL: ConnectedMesh sharded placements diverge "
               "from unsharded", file=sys.stderr)
         sys.exit(1)

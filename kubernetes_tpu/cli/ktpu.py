@@ -626,6 +626,14 @@ def cmd_status(client: HTTPClient, args, out) -> int:
                   + (f" — fused fold {'on' if fused else 'off'}"
                      if fused is not None else "")
                   + "\n")
+    staging = st.get("staging")
+    if staging is not None:
+        mb = (staging.get("bytesStaged") or 0) / 1e6
+        out.write(f"Staging:       arena "
+                  f"{'on' if staging.get('enabled') else 'off'} — "
+                  f"{staging.get('swaps', 0)} swaps, "
+                  f"{staging.get('fallbacks', 0)} fallbacks, "
+                  f"{mb:.1f} MB pre-staged\n")
     out.write(f"Profiles:      {', '.join(st.get('profiles') or [])}\n")
     pending = st.get("pending")
     if pending is not None:

@@ -132,6 +132,12 @@ class SchedulerConfiguration:
     # pipeline first. False restores the PR3-era patch-then-dispatch path
     # (the parity tests diff the two). KTPU_FUSED_FOLD=0 overrides.
     fused_fold: bool = True
+    # Pre-sharded double-buffered batch staging (sched/staging.py): batch
+    # K+1's pod stack uploads to pre-sharded device buffers on a background
+    # thread while batch K runs; dispatch swaps buffers instead of paying a
+    # device_put. False restores the inline staging path (the A/B the
+    # staging parity tests diff). KTPU_STAGE_ARENA=0 overrides.
+    staging_arena: bool = True
     # Device-mesh shape (pods_axis, nodes_axis) for the live scheduling
     # path: cluster tensors shard over "nodes", pod batches over "pods",
     # and the drain/preemption programs run under GSPMD with ICI
@@ -207,6 +213,7 @@ class SchedulerConfiguration:
             ("maxDrainBatches", "max_drain_batches"),
             ("pipelineDepth", "pipeline_depth"),
             ("fusedFold", "fused_fold"),
+            ("stagingArena", "staging_arena"),
             ("seed", "seed"), ("backoffInitialSeconds", "backoff_initial_s"),
             ("backoffMaxSeconds", "backoff_max_s"), ("assumeTTLSeconds", "assume_ttl_s"),
             ("clientQPS", "client_qps"), ("parallelism", "parallelism"),

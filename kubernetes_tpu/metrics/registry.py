@@ -293,6 +293,22 @@ RESOLVE_BYTES = REGISTRY.gauge(
     "Bytes device_get moved host-side for the last drain's compact "
     "winners view (assignments + rounds; O(P), never sharded intermediates)")
 
+# Zero-copy steady state (sched/staging.py): the batch staging arena
+# uploads pod stacks pre-sharded on a background thread; dispatch redeems
+# a buffer swap. Bytes count the h2d traffic the swap path moved off the
+# dispatch span; reuse counts swaps served from pre-staged buffers (a
+# healthy steady state shows reuse tracking dispatches 1:1, fallbacks ~0).
+STAGE_BYTES = REGISTRY.counter(
+    "scheduler_stage_bytes_total",
+    "Host-to-device bytes uploaded by the pre-sharded batch staging "
+    "arena (off the dispatch path; inline fallback uploads count too, "
+    "labeled path=inline)")
+STAGE_BUFFER_REUSE = REGISTRY.gauge(
+    "scheduler_stage_buffer_reuse_total",
+    "Dispatches whose batch stack was served by an arena buffer swap "
+    "(pre-staged on the background thread) instead of an inline "
+    "device_put")
+
 # Resilience / self-healing (the chaos harness asserts against these).
 # LOOP_ERRORS replaces the old bare `except: pass` swallows: every control
 # -loop failure is logged AND counted by site, so a chaos run can assert

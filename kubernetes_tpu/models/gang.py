@@ -501,13 +501,13 @@ def drain_widths_fit(ct_all: ClusterTensors, pb_stack: PodBatch) -> bool:
 @partial(jax.jit, donate_argnums=(0, 2),
          static_argnames=("e0", "seed", "fit_strategy", "topo_keys",
                           "weights", "enabled_filters", "max_rounds",
-                          "plugins", "winners_sharding"))
+                          "plugins", "winners_sharding", "mesh"))
 def drain_step(ct_all: ClusterTensors, pb_stack: PodBatch, fill,
                patch=None, *,
                e0: int, seed: int, fit_strategy: str,
                topo_keys: tuple[int, ...], weights: tuple,
                enabled_filters: tuple, max_rounds: int,
-               plugins: tuple = (), winners_sharding=None):
+               plugins: tuple = (), winners_sharding=None, mesh=None):
     """One fused drain over a DEVICE-RESIDENT cluster encoding.
 
     ``ct_all``: donated; rows [0,e0) are base existing-pod slots (``fill`` of
@@ -534,6 +534,12 @@ def drain_step(ct_all: ClusterTensors, pb_stack: PodBatch, fill,
     a device mesh the cluster encoding stays sharded in HBM, and pinning
     the winners replicated means the resolver's device_get moves O(B*P)
     int32s — never a gathered sharded intermediate.
+
+    ``mesh``: optional (hashable) Mesh — the folded ``new_ct_all`` is
+    constrained to the canonical cluster shardings, making the OUTPUT
+    shardings exactly the next dispatch's INPUT shardings: donation then
+    aliases the whole resident encoding in place across steady-state
+    drains (zero copy-on-donate, zero resharding between cycles).
     """
     if patch is not None:
         ct_all = _apply_patch(ct_all, patch)
@@ -633,6 +639,9 @@ def drain_step(ct_all: ClusterTensors, pb_stack: PodBatch, fill,
         ea_ns_mask=fold(ct_r.ea_ns_mask),
     )
     new_fill = fill + jnp.sum(flags, dtype=jnp.int32)
+    if mesh is not None:
+        from kubernetes_tpu.parallel.mesh import constrain_cluster
+        ct_out = constrain_cluster(mesh, ct_out)
     if winners_sharding is not None:
         constrain = partial(jax.lax.with_sharding_constraint,
                             shardings=winners_sharding)
@@ -784,7 +793,19 @@ def _apply_patch(ct_all: ClusterTensors, patch: dict) -> ClusterTensors:
     )
 
 
-apply_ctx_patch = partial(jax.jit, donate_argnums=(0,))(_apply_patch)
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("mesh",))
+def apply_ctx_patch(ct_all: ClusterTensors, patch: dict, mesh=None
+                    ) -> ClusterTensors:
+    """Standalone churn-patch dispatch (rebuild-time nominee staging,
+    fusedFold=off). ``mesh``: same output-sharding pin as ``drain_step`` —
+    the patched encoding must leave this program carrying exactly the
+    shardings the next drain dispatch expects, so donation aliases in
+    place instead of resharding the resident arrays."""
+    out = _apply_patch(ct_all, patch)
+    if mesh is not None:
+        from kubernetes_tpu.parallel.mesh import constrain_cluster
+        out = constrain_cluster(mesh, out)
+    return out
 
 
 def prepare_drain(ct: ClusterTensors, pbs: list[PodBatch], stage: bool = True):

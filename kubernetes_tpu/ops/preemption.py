@@ -346,9 +346,16 @@ def dry_run_wave(nodes: list[Node], bound_pods: list[Pod],
              np.zeros((Qb - static_masks.shape[0], static_masks.shape[1]),
                       bool)])
 
-    found, zero_evict, cand_nodes, evict_sel = jax.device_get(_wave_scan(
-        allocatable, requested, static_masks[:Qb], vic_req, vic_valid,
-        vic_violating, vic_prio, need, prio))
+    # explicit staging in, explicit device_get out: the wave contributes
+    # zero IMPLICIT transfers to a steady-state scheduling cycle (the
+    # transfer-guard invariant) — the puts cost exactly what the jit's
+    # implicit argument staging paid
+    staged = jax.device_put((allocatable, requested,
+                             np.ascontiguousarray(static_masks[:Qb]),
+                             vic_req, vic_valid, vic_violating, vic_prio,
+                             need, prio))
+    found, zero_evict, cand_nodes, evict_sel = jax.device_get(
+        _wave_scan(*staged))
     out = []
     for q in range(Q):
         if zero_evict[q]:
@@ -390,9 +397,10 @@ def dry_run_candidates(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
     if not vic_valid.any():
         return [], False
 
-    any_f, k_min, viols, maxprio = jax.device_get(_dry_run(
-        allocatable, requested, _static_mask(nodes, pod),
-        vic_req, vic_valid, vic_violating, vic_prio, need))
+    staged = jax.device_put((allocatable, requested,
+                             _static_mask(nodes, pod), vic_req, vic_valid,
+                             vic_violating, vic_prio, need))
+    any_f, k_min, viols, maxprio = jax.device_get(_dry_run(*staged))
     out = []
     zero_evict = False
     for i in range(len(nodes)):

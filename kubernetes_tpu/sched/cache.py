@@ -78,27 +78,102 @@ class SchedulerCache:
         # below then device_put encodings SHARDED so the drain programs run
         # under GSPMD instead of on one chip.
         self._mesh = None
+        # pre-sharded double-buffered batch staging (sched/staging.py):
+        # batch K+1 uploads on the background stager thread while batch K
+        # runs; dispatch redeems a buffer swap. KTPU_STAGE_ARENA=0 (or
+        # SchedulerConfiguration.staging_arena via configure_staging)
+        # restores the legacy inline device_put path everywhere.
+        from kubernetes_tpu.sched.staging import StagingArena
+        self._arena = StagingArena()
+        self._staging_enabled = os.environ.get(
+            "KTPU_STAGE_ARENA", "1") != "0"
 
     # ---- device mesh -----------------------------------------------------
 
     def set_mesh(self, mesh) -> None:
         self._mesh = mesh
+        # layout change: in-flight staged buffers carry the OLD shardings
+        self._arena.invalidate()
 
     @property
     def mesh(self):
         return self._mesh
 
+    def configure_staging(self, enabled: bool) -> None:
+        """Config-level arena switch (the KTPU_STAGE_ARENA env read at
+        construction still overrides OFF for bench A/Bs)."""
+        import os as _os
+        if _os.environ.get("KTPU_STAGE_ARENA") == "0":
+            enabled = False
+        self._staging_enabled = bool(enabled)
+
+    def stage_submit(self, pb_stack):
+        """Hand the final stacked drain batch to the staging arena: the
+        background thread uploads it PRE-SHARDED while the scheduling
+        thread finishes the cycle's host work (patch compile, sentinel
+        capture) and the previous drain still executes. Returns a ticket
+        for stage_redeem, or None (arena off / single-device / buffer
+        full) — the dispatch then stages inline as before."""
+        if not self._staging_enabled or self._mesh is None:
+            return None
+        return self._arena.submit(pb_stack, self._mesh)
+
+    def stage_redeem(self, ticket):
+        """Redeem a stage_submit ticket: the pre-staged device buffers, or
+        None (invalidated/failed/timed out — caller stages inline)."""
+        if ticket is None:
+            return None
+        return self._arena.redeem(ticket, self._mesh)
+
+    def close_staging(self) -> None:
+        self._arena.close()
+
     def stage_drain_batch(self, pb_stack):
-        """Stage a STACKED drain batch [B,P,...] for dispatch: under a mesh
-        the pod axis is device_put split over "pods" (parallel/mesh.py
-        stack_shardings) so the drain's batch tensors arrive pre-sharded;
-        single-device, the host arrays pass through and jit stages them."""
-        if self._mesh is None:
-            return pb_stack
+        """INLINE staging of a stacked drain batch [B,P,...] — the
+        fallback half of the staging pair (the steady state redeems a
+        stage_submit ticket via stage_redeem instead; the scheduler's
+        _stage_batch owns that flow and its span attribution). Under a
+        mesh: one device_put split over "pods". Single-device: one
+        EXPLICIT device_put so the drain dispatch performs zero implicit
+        transfers (the transfer-guard invariant) at the same cost the
+        jit's implicit staging paid."""
         import jax
-        from kubernetes_tpu.parallel.mesh import stack_shardings
-        return jax.device_put(pb_stack,
-                              stack_shardings(self._mesh, pb_stack))
+        from kubernetes_tpu.metrics.registry import STAGE_BYTES
+        from kubernetes_tpu.sched.staging import _tree_nbytes
+        if self._mesh is None:
+            staged = jax.device_put(pb_stack)
+        else:
+            from kubernetes_tpu.parallel.mesh import stack_shardings
+            staged = jax.device_put(pb_stack,
+                                    stack_shardings(self._mesh, pb_stack))
+        STAGE_BYTES.inc({"path": "inline"}, by=_tree_nbytes(pb_stack))
+        return staged
+
+    def stage_patch(self, patch):
+        """Explicitly stage a compiled churn patch's host arrays (~KB)
+        before the dispatch that consumes them: replicated under a mesh,
+        one device_put single-device — the fused drain then receives ONLY
+        device-resident inputs (zero implicit transfers at dispatch)."""
+        if patch is None:
+            return None
+        import jax
+        if self._mesh is None:
+            return jax.device_put(patch)
+        from kubernetes_tpu.parallel.mesh import replicated
+        rep = replicated(self._mesh)
+        return jax.device_put(
+            patch, jax.tree_util.tree_map(lambda _l: rep, patch))
+
+    def staging_stats(self) -> dict:
+        """Arena health for ktpu status / bench legs."""
+        return dict(self._arena.stats(), enabled=self._staging_enabled)
+
+    def request_vector(self, pod: Pod, resources: list) -> "np.ndarray":
+        """One pod's scaled request vector on ``resources`` (the resident
+        shadow's catch-up source) — same ``_request_vector`` the encode
+        and patch paths use, under the encode lock (DRA catalog reads)."""
+        with self._encode_lock:
+            return self._encoder._request_vector(pod, resources)
 
     # ---- delta log (drain-context patch feed) ----------------------------
 

@@ -240,7 +240,13 @@ def tensor_static_masks(nodes, preemptors, ct=None, meta=None,
             mask = np.asarray(jax.device_get(_static_filters_program(
                 ct_dev, shard_batch(mesh, pb))))
     else:
-        mask = np.asarray(jax.device_get(_static_filters_program(ct, pb)))
+        # EXPLICIT staging (same cost the jit's implicit transfer paid):
+        # when the wave rides the resident drain encoding, the whole
+        # steady-state cycle must add zero implicit host->device
+        # transfers — the transfer-guard invariant tests pin this
+        ct_dev = ct if pre_staged else jax.device_put(ct)
+        mask = np.asarray(jax.device_get(_static_filters_program(
+            ct_dev, jax.device_put(pb))))
     if node_rows is not None:
         return mask[:len(preemptors)][:, np.asarray(node_rows)]
     return mask[:len(preemptors), :len(nodes)]

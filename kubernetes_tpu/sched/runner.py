@@ -567,6 +567,10 @@ class SchedulerRunner:
             # dict() over a concurrently-resizing dict raises RuntimeError.
             "pipelineInflight": len(self.scheduler._pending),
             "fusedFold": self.scheduler._fused_fold,
+            # zero-copy staging health: swaps tracking dispatches 1:1 with
+            # fallbacks ~0 means the dispatch path pays buffer swaps, not
+            # device_puts (sched/staging.py)
+            "staging": self.cache.staging_stats(),
             "ctx": dict(self.scheduler.ctx_stats,
                         reasons=self._copy_reasons()),
             "profiles": [p.scheduler_name for p in self.cfg.profiles],

@@ -189,6 +189,11 @@ class Scheduler:
         env_fused = _os.environ.get("KTPU_FUSED_FOLD")
         if env_fused is not None:
             self._fused_fold = env_fused != "0"
+        # Pre-sharded double-buffered batch staging (sched/staging.py):
+        # dispatch-time stage_drain_batch becomes a buffer swap. The cache
+        # owns the arena (it owns the mesh staging helpers); the env knob
+        # KTPU_STAGE_ARENA=0 wins over config for bench A/Bs.
+        self.cache.configure_staging(cfg.staging_arena)
         # context lifecycle counters (benchmarks report these: a healthy
         # churn run shows folds/patches >> rebuilds; "folds" are churn
         # deltas fused into a drain dispatch, "patches" are separate
@@ -305,6 +310,36 @@ class Scheduler:
             return None
         from kubernetes_tpu.parallel.mesh import replicated
         return replicated(self._mesh)
+
+    def _stage_batch(self, pb_stack, ticket, n_pods: int):
+        """Dispatch-time batch staging with honest attribution: the whole
+        operation is ``scheduler/stage_batch`` (the span r06 pinned the
+        sharded regression on) and the arena redeem within it is
+        ``scheduler/stage_swap`` — in steady state the swap IS the whole
+        cost, and a fallback's inline device_put shows up as stage_batch
+        time exceeding stage_swap. EVERY drain staging site goes through
+        here (warm_drain included) so bench attribution can never miss a
+        transfer again."""
+        from kubernetes_tpu.utils.tracing import TRACER
+        with TRACER.span("scheduler/stage_batch", pods=n_pods):
+            if ticket is not None:
+                with TRACER.span("scheduler/stage_swap", pods=n_pods):
+                    staged = self.cache.stage_redeem(ticket)
+                if staged is not None:
+                    return staged
+            return self.cache.stage_drain_batch(pb_stack)
+
+    def _stage_fill(self, fill: int):
+        """Device-resident fill scalar for a fresh context: the steady
+        state donates the previous drain's new_fill through, and staging
+        the rebuild-time int as the SAME strong-int32 device scalar keeps
+        one compiled drain variant (and zero implicit transfers) from the
+        first post-rebuild dispatch on."""
+        import jax
+        import numpy as np
+        if self._mesh is None:
+            return jax.device_put(np.int32(fill))
+        return jax.device_put(np.int32(fill), self._winners_sharding)
 
     # ---- external nominations -------------------------------------------
 
@@ -849,6 +884,20 @@ class Scheduler:
                         if (patch is not None
                                 and ctx["fill_bound"] + len(pods)
                                 <= cs.top):
+                            shadow = ctx.get("shadow")
+                            if shadow is not None:
+                                # mirror the requested/allocatable writes
+                                # host-side BEFORE the host arrays are
+                                # staged away: the preemption wave then
+                                # reads totals without a device round-trip.
+                                # Pending winner folds flush FIRST — on
+                                # device they happened before this patch,
+                                # and a reset row must zero them too
+                                # (ResidentShadow.apply_patch contract).
+                                shadow.catch_up(
+                                    lambda p: self.cache.request_vector(
+                                        p, cs.resources))
+                                shadow.apply_patch(patch)
                             if self._fused_fold:
                                 # the scatter rides THIS dispatch as
                                 # drain_step's third input — zero separate
@@ -860,11 +909,15 @@ class Scheduler:
                                         self._mesh_scope():
                                     # sharded context: the scatter program
                                     # runs under the mesh — the tiny patch
-                                    # arrays replicate, the donated sharded
+                                    # arrays ship via one explicit
+                                    # replicated put, the donated sharded
                                     # buffers keep their layout
-                                    # (epoch-checked above)
-                                    ctx["ct"] = apply_ctx_patch(ctx["ct"],
-                                                                patch)
+                                    # (epoch-checked above, out-shardings
+                                    # pinned inside the program)
+                                    ctx["ct"] = apply_ctx_patch(
+                                        ctx["ct"],
+                                        self.cache.stage_patch(patch),
+                                        mesh=self._mesh)
                                 self.ctx_stats["patches"] += 1
                             ctx["seq"] = new_seq
                             use_ctx = True
@@ -928,13 +981,19 @@ class Scheduler:
                     for c in chunks)
             ct_dev, e0, fill = built
             from kubernetes_tpu.encode.patch import sync_resident_widths
+            from kubernetes_tpu.sched.staging import ResidentShadow
             sync_resident_widths(cs, ct_dev)
             self.ctx_stats["rebuilds"] += 1
-            ctx = {"ct": ct_dev, "e0": e0, "fill_dev": fill,
+            ctx = {"ct": ct_dev, "e0": e0,
+                   "fill_dev": self._stage_fill(fill),
                    "fill_bound": fill, "meta": fork_meta(meta),
                    "nodes": nodes, "cs": cs, "seq": seq0,
                    "pb_shape": batch_shapes(pb_stack),
                    "profile": profile.scheduler_name,
+                   # host mirror of the resident [N,R] totals, cut from
+                   # the SAME host encoding the context staged — the
+                   # preemption wave reads it instead of a device_get
+                   "shadow": ResidentShadow(ct.allocatable, ct.requested),
                    "mesh_epoch": self._mesh_epoch}
             meta = ctx["meta"]
             if nom_target:
@@ -946,8 +1005,11 @@ class Scheduler:
                     return n_prev + sum(
                         self._schedule_group(profile, c, slot_headroom)
                         for c in chunks)
+                ctx["shadow"].apply_patch(patch)
                 with self._mesh_scope():
-                    ctx["ct"] = apply_ctx_patch(ctx["ct"], patch)
+                    ctx["ct"] = apply_ctx_patch(
+                        ctx["ct"], self.cache.stage_patch(patch),
+                        mesh=self._mesh)
             self._drain_ctx = ctx
         else:
             # pin the batch to the context's compiled shapes: pop-dependent
@@ -962,6 +1024,11 @@ class Scheduler:
                                                      slot_headroom)
             pb_stack = padded
 
+        # hand the FINAL stacked batch to the staging arena now: the
+        # background stager uploads it pre-sharded while this thread
+        # finishes the cycle's remaining host work and the previous drain
+        # still executes — the dispatch below then swaps buffers
+        stage_ticket = self.cache.stage_submit(pb_stack)
         oot = (None if profile.out_of_tree is None
                else set(profile.out_of_tree))
         plugins = self.registry.tensor_plugins(oot)
@@ -983,12 +1050,17 @@ class Scheduler:
         if self.cycle_log is not None:
             self._cyc_marks.append(("dispatch_start",
                                     round(time.time() - t0, 3)))
-        # staging is its OWN span: under a mesh this is the per-dispatch
-        # device_put of the batch stack split on "pods" — MULTICHIP_r06's
-        # sharded gang_dispatch growth (381ms -> 1641ms) was this transfer
-        # hiding inside the dispatch span, not the program getting slower
-        with TRACER.span("scheduler/stage_batch", pods=len(pods)):
-            pb_staged = self.cache.stage_drain_batch(pb_stack)
+        # staging is its OWN span (scheduler/stage_batch, with the arena
+        # redeem nested as scheduler/stage_swap): MULTICHIP_r06's sharded
+        # gang_dispatch growth (381ms -> 1641ms) was the per-dispatch
+        # device_put hiding inside the dispatch span — the arena moves the
+        # upload to the background stager, so steady state pays a swap
+        pb_staged = self._stage_batch(pb_stack, stage_ticket, len(pods))
+        if fused_patch is not None:
+            # the churn scatter's ~KB arrays ship via one explicit
+            # replicated put: the fused dispatch below then takes ONLY
+            # device-resident inputs (the transfer-guard invariant)
+            fused_patch = self.cache.stage_patch(fused_patch)
         with TRACER.span("scheduler/gang_dispatch",
                          pods=len(pods), nodes=len(nodes),
                          depth=len(self._pending) + 1) as sp_disp, \
@@ -1009,7 +1081,8 @@ class Scheduler:
                     enabled_filters=tuple(
                         sorted(profile.enabled_filters or ())),
                     max_rounds=self.cfg.max_gang_rounds, plugins=plugins,
-                    winners_sharding=self._winners_sharding)
+                    winners_sharding=self._winners_sharding,
+                    mesh=self._mesh)
             except Exception:
                 # dispatch failed (compile error, dead tunnel, chaos):
                 # the resident context's device state is unaccountable —
@@ -1242,6 +1315,14 @@ class Scheduler:
                             # now approximate — rebuild at next dispatch
                             cs.tainted = True
                     cs.fill_host = fill
+                    shadow = ctx.get("shadow")
+                    if shadow is not None:
+                        # record the winners' (pod, row) pairs; their
+                        # request vectors fold into the host totals mirror
+                        # lazily, only when a preemption wave reads them
+                        shadow.fold_winners(
+                            [(pod, row) for (pod, _n), row
+                             in zip(to_bind, bound_rows)])
                 for pod, _node in to_bind:
                     if nominated:
                         nominated.pop(pod.key, None)
@@ -1325,10 +1406,17 @@ class Scheduler:
                   weights=tuple(sorted(profile.weights().items())),
                   enabled_filters=tuple(sorted(profile.enabled_filters or ())),
                   max_rounds=self.cfg.max_gang_rounds, plugins=plugins,
-                  winners_sharding=self._winners_sharding)
-        pb_staged = self.cache.stage_drain_batch(pb_stack)
+                  winners_sharding=self._winners_sharding,
+                  mesh=self._mesh)
+        # the SAME staging path (and spans) the live dispatch uses — warms
+        # the stager thread + pre-split layouts, and keeps this call site
+        # inside the scheduler/stage_batch attribution
+        pb_staged = self._stage_batch(
+            pb_stack, self.cache.stage_submit(pb_stack), len(sample_pods))
+        fill0_dev = self._stage_fill(fill)
         with self._mesh_scope():
-            _, _, ct_dev2, fill2 = drain_step(ct_dev, pb_staged, fill, **kw)
+            _, _, ct_dev2, fill2 = drain_step(ct_dev, pb_staged, fill0_dev,
+                                              **kw)
             # second call matches the steady-state variant exactly: donated-
             # buffer layouts AND a device-resident fill scalar
             _, _, ct_dev3, fill3 = drain_step(ct_dev2, pb_staged, fill2, **kw)
@@ -1344,8 +1432,10 @@ class Scheduler:
                 from kubernetes_tpu.models.gang import apply_ctx_patch
                 cs_warm = self.cache.patch_state_fork()
                 if cs_warm is not None:
-                    warm_patch = self.cache.compile_ctx_patch(
-                        fork_meta(meta), cs_warm, [], {}, DRAIN_NOM_BUCKET)
+                    warm_patch = self.cache.stage_patch(
+                        self.cache.compile_ctx_patch(
+                            fork_meta(meta), cs_warm, [], {},
+                            DRAIN_NOM_BUCKET))
                     if warm_patch is not None and self._fused_fold:
                         _, _, ct_dev4, fill4 = drain_step(
                             ct_dev3, pb_staged, fill3, warm_patch, **kw)
@@ -1353,9 +1443,11 @@ class Scheduler:
                         # layout, then the standalone apply program
                         _, _, ct_dev5, _ = drain_step(ct_dev4, pb_staged,
                                                       fill4, **kw)
-                        apply_ctx_patch(ct_dev5, warm_patch)
+                        apply_ctx_patch(ct_dev5, warm_patch,
+                                        mesh=self._mesh)
                     elif warm_patch is not None:
-                        ct_dev4 = apply_ctx_patch(ct_dev3, warm_patch)
+                        ct_dev4 = apply_ctx_patch(ct_dev3, warm_patch,
+                                                  mesh=self._mesh)
                         drain_step(ct_dev4, pb_staged, fill3, **kw)
             except Exception:
                 _LOG.exception("patch-program warmup failed (non-fatal)")
@@ -1372,13 +1464,17 @@ class Scheduler:
         # remaining transfer (~seconds at 10k-scale encodings) inside the
         # measured window
         jax.block_until_ready(ct_dev)
-        self._drain_ctx = {"ct": ct_dev, "e0": e0, "fill_dev": fill,
+        from kubernetes_tpu.sched.staging import ResidentShadow
+        self._drain_ctx = {"ct": ct_dev, "e0": e0,
+                           "fill_dev": self._stage_fill(fill),
                            "fill_bound": fill,
                            "meta": fork_meta(meta), "nodes": nodes,
                            "cs": cs,
                            "seq": self.cache.last_snapshot_seq(),
                            "pb_shape": batch_shapes(pb_stack),
                            "profile": profile.scheduler_name,
+                           "shadow": ResidentShadow(ct.allocatable,
+                                                    ct.requested),
                            "mesh_epoch": self._mesh_epoch}
         return True
 
@@ -1639,30 +1735,45 @@ class Scheduler:
                 return None  # node the context has not absorbed: stale
             rows.append(ni)
         return {"ct": ctx["ct"], "meta": meta, "cs": cs,
-                "nodes": nodes, "rows": np.asarray(rows, np.int32)}
+                "nodes": nodes, "rows": np.asarray(rows, np.int32),
+                "shadow": ctx.get("shadow")}
 
     def _resident_cluster_arrays(self, view: dict):
         """``fn(resources) -> (allocatable, requested) | None`` for
-        dry_run_wave: one device_get of the resident [N,R] totals (folds
-        and churn patches keep them current), rows gathered into the live
-        node-list order and columns remapped onto the wave's resource
-        axis. Resources the resident encoding doesn't know stay 0 on both
-        arrays — identical to the host encode, which scales
-        ``alloc.get(r, 0)`` and can have no bound requests for a resource
-        no bound pod carries (patches refuse unknown resource kinds)."""
+        dry_run_wave: the resident [N,R] totals, rows gathered into the
+        live node-list order and columns remapped onto the wave's resource
+        axis. Steady state serves them from the HOST SHADOW
+        (sched/staging.py ResidentShadow — winner folds mirrored at
+        resolve, churn patches applied from their host arrays), so the
+        wave performs ZERO device round-trips for cluster totals; a
+        poisoned or absent shadow falls back to one device_get of the
+        resident arrays. Resources the resident encoding doesn't know
+        stay 0 on both arrays — identical to the host encode, which
+        scales ``alloc.get(r, 0)`` and can have no bound requests for a
+        resource no bound pod carries (patches refuse unknown resource
+        kinds)."""
         import jax
         import numpy as np
 
         def arrays(resources):
-            try:
-                alloc_res, req_res = jax.device_get(
-                    (view["ct"].allocatable, view["ct"].requested))
-            except Exception:
-                _LOG.exception("resident totals readback failed; wave "
-                               "falls back to the host encode")
-                return None
+            cs = view["cs"]
+            got = None
+            shadow = view.get("shadow")
+            if shadow is not None:
+                shadow.catch_up(
+                    lambda p: self.cache.request_vector(p, cs.resources))
+                got = shadow.arrays()
+            if got is None:
+                try:
+                    got = jax.device_get(
+                        (view["ct"].allocatable, view["ct"].requested))
+                except Exception:
+                    _LOG.exception("resident totals readback failed; wave "
+                                   "falls back to the host encode")
+                    return None
+            alloc_res, req_res = got
             rows = view["rows"]
-            res_index = view["cs"].res_index
+            res_index = cs.res_index
             N, R = len(view["nodes"]), len(resources)
             allocatable = np.zeros((N, R), np.int64)
             requested = np.zeros((N, R), np.int64)
@@ -1915,6 +2026,7 @@ class Scheduler:
             self._resolver_q.put(None)  # poison pill; thread is daemon
             self._resolver_thread = None
             self._resolver_q = None
+        self.cache.close_staging()  # poison the batch-stager (daemon too)
         if self.sentinel is not None:
             self.sentinel.close()
         if self.explainer is not None:

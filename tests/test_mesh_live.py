@@ -286,6 +286,8 @@ def test_publish_status_and_ktpu_status():
         # resident-ctx fusion health is part of the status surface
         assert "Resident ctx:" in text and "fused fold on" in text
         assert "in flight" in text
+        # zero-copy staging health (sched/staging.py arena)
+        assert "Staging:" in text and "arena on" in text
         out = io.StringIO()
         rc = ktpu_main(["--server", server.url, "status", "-o", "json"],
                        out=out)
@@ -295,6 +297,8 @@ def test_publish_status_and_ktpu_status():
         assert st["mesh"] is None and st["batchSize"] == 256
         assert st["ctx"]["patches"] == 0 and st["ctx"]["folds"] == 0
         assert st["pipelineInflight"] == 0 and st["fusedFold"] is True
+        assert st["staging"]["enabled"] is True
+        assert st["staging"]["fallbacks"] == 0
         runner.scheduler.close()
     finally:
         server.stop()
