@@ -22,7 +22,11 @@ BENCH_CONNECTED_PODS/NODES (default 2000/1000), BENCH_CONNECTED_PIPELINE
 knee; unset = SchedulerConfiguration.pipeline_depth default),
 BENCH_CHAOS=0 to skip the ChaosChurn case (BENCH_CHAOS_PODS/NODES size
 it; KTPU_CHAOS_SEED replays a failing fault schedule — the case exits
-the bench non-zero if any pod is lost under faults).
+the bench non-zero if any pod is lost under faults),
+BENCH_SCALEFLEET=0 to skip the ScaleFleet sweep (BENCH_SCALE_NODES
+sizes the two-point fleet sweep, default "256 2048"; the 100k campaign
+tier is "1250 10000"; BENCH_SCALE_MAX_GROWTH tunes the sublinear
+control-plane gate).
 """
 
 from __future__ import annotations
@@ -208,6 +212,29 @@ def main():
             log=log)
         log("[bench] " + json.dumps(connected_preemption))
 
+    scale_fleet = None
+    if os.environ.get("BENCH_SCALEFLEET", "1") != "0" and not only_case:
+        # two-point hollow-fleet sweep with the sublinear control-plane
+        # gate (heartbeat + lease + status span growth <= 2x across an 8x
+        # fleet; missing number = failure). BENCH_SCALE_NODES sizes the
+        # sweep — default fits the box, the 100k campaign runs
+        # "1250 10000". Runs before kubemark for the same
+        # leftover-daemon-thread reason.
+        from benchmarks.scalefleet import run_scale_fleet
+        log("[bench] scale-fleet sweep ...")
+        sizes = [int(t) for t in os.environ.get(
+            "BENCH_SCALE_NODES", "256 2048").replace(",", " ").split()]
+        scale_fleet = run_scale_fleet(
+            fleet_sizes=sizes,
+            n_pods=int(os.environ.get("BENCH_SCALE_PODS", "256")),
+            window_s=float(os.environ.get("BENCH_SCALE_WINDOW_S", "12")),
+            heartbeat_period=float(os.environ.get("BENCH_SCALE_HB_PERIOD",
+                                                  "5.0")),
+            max_growth=float(os.environ.get("BENCH_SCALE_MAX_GROWTH",
+                                            "2.0")),
+            log=log)
+        log("[bench] " + json.dumps(scale_fleet))
+
     kubemark = None
     if os.environ.get("BENCH_KUBEMARK", "1") != "0" and not only_case:
         # LAST on purpose: the hollow fleet leaves hundreds of daemon
@@ -259,6 +286,7 @@ def main():
         "explain_ab": explain_ab,
         "preemption": preemption,
         "connected_preemption": connected_preemption,
+        "scale_fleet": scale_fleet,
         "kubemark": kubemark,
         "pallas": pallas,
         # confirmed correctness-invariant violations across every audited
@@ -267,13 +295,14 @@ def main():
         # parsed-null crash taught that a silently missing figure reads
         # as "fine" for rounds
         "invariant_violations": _sum_violations(connected, chaos_churn,
-                                                connected_mesh, explain_ab),
+                                                connected_mesh, explain_ab,
+                                                scale_fleet),
         # hard SLO verdicts from case-config gates (SchedulingChurn p99 +
         # throughput, ConnectedMesh legs). Missing numbers are failures —
         # the BENCH_r05 parsed-null lesson: a silently absent figure must
         # never read as a pass.
         "slo_failures": _collect_slo_failures(results, connected_mesh,
-                                              explain_ab),
+                                              explain_ab, scale_fleet),
     }
     _require_invariant_field(out, "bench summary")
     print(json.dumps(out))
@@ -284,7 +313,8 @@ def main():
     if out["invariant_violations"]:
         audited = {name: c.get("invariant_violations") for name, c in
                    (("connected", connected), ("chaos_churn", chaos_churn),
-                    ("connected_mesh", connected_mesh)) if c}
+                    ("connected_mesh", connected_mesh),
+                    ("scale_fleet", scale_fleet)) if c}
         print(f"[bench] FATAL: {out['invariant_violations']} correctness-"
               f"invariant violation(s) confirmed by the auditor "
               f"({audited}); repro bundles are on disk — replay with the "
@@ -309,7 +339,8 @@ def main():
         sys.exit(1)
 
 
-def _collect_slo_failures(results, connected_mesh, explain_ab=None) -> list:
+def _collect_slo_failures(results, connected_mesh, explain_ab=None,
+                          scale_fleet=None) -> list:
     """Flatten every case's hard-SLO failure strings, prefixed by case."""
     out = []
     for r in results or []:
@@ -321,6 +352,9 @@ def _collect_slo_failures(results, connected_mesh, explain_ab=None) -> list:
     if explain_ab is not None:
         for msg in explain_ab.get("slo_failures") or []:
             out.append(f"ExplainAB: {msg}")
+    if scale_fleet is not None:
+        for msg in scale_fleet.get("slo_failures") or []:
+            out.append(f"ScaleFleet: {msg}")
     return out
 
 

@@ -31,6 +31,8 @@ _VERB_SITES = {
     "update": "api.update",
     "update_status": "api.update_status",
     "update_status_many": "api.update_status",
+    "heartbeat_many": "api.update_status",
+    "renew_many": "api.update",
     "apply": "api.update",
     "delete": "api.delete",
     "bind": "api.bind",

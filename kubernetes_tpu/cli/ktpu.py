@@ -577,24 +577,58 @@ def cmd_api_resources(client: HTTPClient, args, out) -> int:
     return 0
 
 
+def _fleet_line(fleet: dict) -> str:
+    """One-line hollow-fleet summary from the fleet status ConfigMap."""
+    hb = fleet.get("heartbeat") or {}
+    le = fleet.get("lease") or {}
+    return (f"Fleet:         {fleet.get('nodes', 0)} hollow nodes, "
+            f"{fleet.get('shards', '?')} batcher shards — "
+            f"heartbeats {hb.get('itemsPerS', 0)}/s "
+            f"(batch {hb.get('lastBatch', 0)}), "
+            f"leases {le.get('itemsPerS', 0)}/s "
+            f"(batch {le.get('lastBatch', 0)})\n")
+
+
 def cmd_status(client: HTTPClient, args, out) -> int:
     """ktpu status: the connected scheduler's published deployment shape
     (the ``kubernetes-tpu-scheduler-status`` ConfigMap) — most importantly
     the active device mesh the drain/dispatch path runs under."""
+    from kubernetes_tpu.kubelet.kubemark import FLEET_CONFIGMAP
     from kubernetes_tpu.sched.runner import STATUS_CONFIGMAP
+    # hollow-fleet shape/rates (published by HollowCluster; absent when no
+    # fleet runs against this apiserver)
+    fleet = None
+    try:
+        fcm = client.resource("configmaps", args.namespace).get(
+            FLEET_CONFIGMAP)
+        fleet = json.loads((fcm.get("data") or {}).get("fleet", "{}")
+                           or "{}")
+    except ApiError as e:
+        if e.code != 404:
+            raise
     try:
         cm = client.resource("configmaps", args.namespace).get(
             STATUS_CONFIGMAP)
     except ApiError as e:
         if e.code != 404:
             raise
+        if fleet is not None:
+            # a fleet without a scheduler is still worth reporting
+            if args.output == "json":
+                out.write(json.dumps({"fleet": fleet}) + "\n")
+            else:
+                out.write(_fleet_line(fleet))
+            return 0
         out.write("error: no scheduler status published "
                   f"(configmap {STATUS_CONFIGMAP!r} not found in "
                   f"{args.namespace!r})\n")
         return 1
     data = cm.get("data") or {}
     if args.output == "json":
-        out.write(data.get("status", "{}") + "\n")
+        st = json.loads(data.get("status", "{}") or "{}")
+        if fleet is not None:
+            st["fleet"] = fleet
+        out.write(json.dumps(st) + "\n")
         return 0
     st = json.loads(data.get("status", "{}") or "{}")
     mesh = st.get("mesh")
@@ -658,6 +692,8 @@ def cmd_status(client: HTTPClient, args, out) -> int:
                   f"({flight.get('pods', 0)} pod timelines, "
                   f"dropped {flight.get('droppedPods', 0)}) — "
                   "ktpu trace dump\n")
+    if fleet is not None:
+        out.write(_fleet_line(fleet))
     res = st.get("resilience")
     if res:
         degraded = (res.get("degradedIndex") or 0) > 0

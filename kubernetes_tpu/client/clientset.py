@@ -196,6 +196,23 @@ class ResourceClient:
         message or None (success), request order."""
         return self._t.update_status_many(items)
 
+    # node subresources (fleet heartbeat fan-in)
+    def heartbeat_many(self, items: list[tuple[str, dict]]
+                       ) -> list[Optional[str]]:
+        """Bulk node heartbeat: ``[(name, status_patch)]`` in one request
+        (POST nodes/-/status; conditions merge by type server-side — the
+        kubemark heartbeat batcher's transport). Returns per-item error
+        message or None (success), request order."""
+        return self._t.heartbeat_many(items)
+
+    # lease subresources (fleet liveness fan-in)
+    def renew_many(self, items: list[tuple[str, float]]
+                   ) -> list[Optional[str]]:
+        """Bulk lease renewal: ``[(name, renew_time)]`` in one request
+        (POST leases/-/renew against this handle's namespace). Returns
+        per-item error message or None (success), request order."""
+        return self._t.renew_many(self.namespace, items)
+
     def evict(self, name: str) -> dict:
         return self._t.evict(self.namespace, name)
 
@@ -412,6 +429,12 @@ class DirectClient(_Handles):
 
     def update_status_many(self, items):
         return self.store.update_status_many("Pod", items)
+
+    def heartbeat_many(self, items):
+        return self.store.heartbeat_many(items)
+
+    def renew_many(self, ns, items):
+        return self.store.renew_leases(ns or "kube-node-lease", items)
 
     @_api_errors
     def evict(self, ns, name):
@@ -782,6 +805,24 @@ class HTTPClient(_Handles):
                         {"statuses": [
                             {"namespace": ns, "name": name, "status": status}
                             for ns, name, status in items]})
+        return [None if r.get("code") == 200 else r.get("message", "error")
+                for r in out.get("results", [])]
+
+    def heartbeat_many(self, items):
+        out = self._req("POST", self._path("nodes", None, "-", "status"),
+                        {"statuses": [
+                            {"name": name, "status": status}
+                            for name, status in items]})
+        return [None if r.get("code") == 200 else r.get("message", "error")
+                for r in out.get("results", [])]
+
+    def renew_many(self, ns, items):
+        out = self._req("POST",
+                        self._path("leases", ns or "kube-node-lease",
+                                   "-", "renew"),
+                        {"renews": [
+                            {"name": name, "renewTime": rt}
+                            for name, rt in items]})
         return [None if r.get("code") == 200 else r.get("message", "error")
                 for r in out.get("results", [])]
 

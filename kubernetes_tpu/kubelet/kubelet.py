@@ -101,6 +101,13 @@ class Kubelet:
         # bulk POSTs through this (kubelet/kubemark.py _StatusBatcher);
         # None = direct per-pod update_status as upstream
         self.status_sink = None
+        # same pattern for the node's own liveness traffic: when set,
+        # heartbeat_once/_renew_lease enqueue into the fleet batchers
+        # (sink(node_name, status_patch) / sink(node_name)) instead of
+        # paying their own GET+PUT round trips — the kubelet keeps its
+        # loop and cadence, only the transport is batched
+        self.heartbeat_sink = None
+        self.lease_sink = None
 
     def _next_pod_ip(self) -> str:
         n = next(self._pod_ip_seq)
@@ -160,13 +167,27 @@ class Kubelet:
             if e.code != 409:
                 raise  # exists: adopt + heartbeat
 
+    def heartbeat_payload(self) -> dict:
+        """The status patch one heartbeat asserts: a fresh Ready condition
+        plus the kubelet endpoint (nodes/-/status merges conditions by
+        type server-side, so this is exactly what the read-modify-write
+        singleton path produced)."""
+        status: dict = {"conditions": [self._ready_condition()]}
+        self._apply_endpoint_status(status)
+        return status
+
     def heartbeat_once(self):
         """One heartbeat: refresh the Ready condition AND re-assert the
         kubelet endpoint (a restarted kubelet binds a fresh port; the old
         daemonEndpoints on the adopted Node would 502 every logs/exec proxy
-        until corrected). Re-registers if the Node vanished. Shared by the
-        per-kubelet loop and the kubemark driver pool."""
+        until corrected). Re-registers if the Node vanished. Routed through
+        ``heartbeat_sink`` when set (the kubemark fleet batcher bulk-POSTs
+        the whole fleet's refreshes and re-registers per-item 404s); the
+        sink path defers the span to the batcher's bulk flush."""
         if self.dead:
+            return
+        if self.heartbeat_sink is not None:
+            self.heartbeat_sink(self.node_name, self.heartbeat_payload())
             return
         from kubernetes_tpu.utils.tracing import TRACER
         with TRACER.span("kubelet/heartbeat"):
@@ -200,6 +221,10 @@ class Kubelet:
         simply dropped until the next period — surfacing it would be
         misread as the node having vanished (heartbeat_once re-registers on
         ApiError) or kill a kubemark driver thread."""
+        if self.lease_sink is not None:
+            if not self.dead:
+                self.lease_sink(self.node_name)
+            return
         leases = self.client.leases("kube-node-lease")
         try:
             try:

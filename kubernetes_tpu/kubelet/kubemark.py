@@ -1,95 +1,410 @@
-"""Kubemark — hundreds of hollow kubelets in one process.
+"""Kubemark — thousands of hollow kubelets in one process.
 
 Reference: ``pkg/kubemark/hollow_kubelet.go`` + ``cmd/kubemark``: real
 kubelet code over a mocked CRI so a handful of machines can drive
 thousand-node control-plane tests. The packing trick here is SHARED
 PLUMBING: one pod watch stream fans events out to every hollow kubelet by
 ``spec.nodeName`` (500 per-node watch connections would melt a single-core
-box before the control plane breaks a sweat), node registration is one
-bulk create, and heartbeats ride a small driver pool instead of a timer
-thread per node. Each node still runs the REAL Kubelet sync machinery —
-admission (allocatable/cpu/device/topology), FakeRuntime sandbox +
-container lifecycle, status writes — via its own PodWorkers.
+box before the control plane breaks a sweat), node registration is chunked
+bulk creates, and EVERY per-node control-plane hot path rides a sharded
+fleet batcher over a bulk endpoint:
+
+  heartbeats   _HeartbeatBatcher -> POST nodes/-/status
+  node leases  _LeaseBatcher     -> POST leases/-/renew
+  pod status   _StatusBatcher    -> POST pods/-/status
+
+Each batcher runs K worker shards over N nodes with jittered phase, so a
+10k-node fleet's period costs O(K x ceil(N/K/max_batch)) requests instead
+of O(N) GET+PUT round trips — the control plane's cost grows with batch
+count, not node count. Each node still runs the REAL Kubelet sync
+machinery — admission (allocatable/cpu/device/topology), FakeRuntime
+sandbox + container lifecycle, status writes — via its own PodWorkers.
 
 Membership is dynamic (the cluster-autoscaler's node groups scale it):
-``add_nodes``/``remove_node`` fold nodes into the FIXED driver-shard pool
-— no thread per scale-up batch — and a removed kubelet is marked dead so
-an in-flight heartbeat cannot resurrect its just-deleted Node object.
+``add_nodes``/``remove_node`` fold nodes into the FIXED batcher shards —
+no thread per scale-up batch — and a removed kubelet is marked dead so an
+in-flight heartbeat cannot resurrect its just-deleted Node object.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+import zlib
 
 from kubernetes_tpu.client.informer import SharedInformer
 from kubernetes_tpu.kubelet.kubelet import HollowNode
+from kubernetes_tpu.metrics.registry import (
+    BATCHER_QUEUE_DEPTH,
+    HEARTBEAT_BATCH,
+    LEASE_BATCH,
+    STATUS_BATCH,
+)
 from kubernetes_tpu.utils.events import NullRecorder
 
+# nodes per bulk registration POST: spin-up is O(ceil(N / this)) requests
+REGISTER_CHUNK = 1024
 
-class _StatusBatcher:
-    """Coalesce the fleet's pod status writes into bulk POSTs.
+# sentinel a batcher's _member_payload returns to skip a member this sweep
+# (heartbeat thinning: leases carry liveness between status refreshes)
+_SKIP = object()
+
+# ``ktpu status`` reads the fleet's shape/rates from this ConfigMap (the
+# hollow fleet's analog of the scheduler's status ConfigMap)
+FLEET_CONFIGMAP = "kubernetes-tpu-fleet-status"
+
+
+class _ShardedBatcher:
+    """K worker shards over the fleet's members, jittered phase.
+
+    Each shard owns a slice of the membership (name -> Kubelet, assigned
+    by stable hash) plus a queue of sink pushes, under its OWN lock — one
+    global flush lock would re-serialize 10k nodes' traffic through a
+    single critical section. Shard i's sweep fires at phase
+    ``(i + phase) / K`` of the period, so the apiserver sees K spread-out
+    bulk requests per period instead of one thundering batch.
+
+    Subclasses define ``_items(members, queued)`` (what one sweep sends)
+    and ``_flush(chunk)`` (the bulk transport + heal handling)."""
+
+    batcher = "?"  # queue-depth gauge label
+
+    def __init__(self, client, period_s: float, shards: int = 4,
+                 max_batch: int = 512, phase: float = 0.0):
+        self.client = client
+        self.period_s = max(0.05, float(period_s))
+        self.n_shards = max(1, int(shards))
+        self.max_batch = max(1, int(max_batch))
+        self._phase = phase
+        self._locks = [threading.Lock() for _ in range(self.n_shards)]
+        self._members: list[dict] = [{} for _ in range(self.n_shards)]
+        self._queued: list[dict] = [{} for _ in range(self.n_shards)]
+        self._stop = threading.Event()
+        self._t0 = time.monotonic()
+        # counters are shared across the K shard threads (and flush_all
+        # callers): '+=' is not atomic in CPython, and an undercounted
+        # items total would silently deflate the Fleet rates the bench
+        # JSON records — so updates go through _count()
+        self._stats_lock = threading.Lock()
+        self.flushes = 0
+        self.items = 0
+        self.last_batch = 0
+        self.errors = 0
+        self._threads = [
+            threading.Thread(target=self._shard_loop, args=(i,), daemon=True)
+            for i in range(self.n_shards)]
+        for t in self._threads:
+            t.start()
+
+    # ---- membership / sink -----------------------------------------------
+
+    def _shard_of(self, name: str) -> int:
+        # stable across processes (hash() is salted): membership placement
+        # must not reshuffle between an operator's runs of the same fleet
+        return zlib.crc32(name.encode()) % self.n_shards
+
+    def add(self, kubelet) -> None:
+        i = self._shard_of(kubelet.node_name)
+        with self._locks[i]:
+            self._members[i][kubelet.node_name] = kubelet
+
+    def remove(self, name: str) -> None:
+        i = self._shard_of(name)
+        with self._locks[i]:
+            self._members[i].pop(name, None)
+            self._queued[i].pop(name, None)
+
+    def push(self, name: str, payload=None) -> None:
+        """Sink interface for kubelets driving their own loops: enqueue one
+        entry; the owning shard folds it into its next bulk flush (newest
+        payload wins, the status-manager dedup semantics)."""
+        i = self._shard_of(name)
+        with self._locks[i]:
+            self._queued[i][name] = payload
+
+    def member(self, name: str):
+        with self._locks[self._shard_of(name)]:
+            return self._members[self._shard_of(name)].get(name)
+
+    # ---- sweep machinery -------------------------------------------------
+
+    def _phase_delay(self, i: int) -> float:
+        """Initial wait for shard ``i``: spread the K shards (and sibling
+        batchers, via ``phase``) across the period so renewals trickle
+        instead of thundering every period boundary."""
+        return (self.period_s * ((i + self._phase) % self.n_shards)
+                / self.n_shards)
+
+    def _shard_loop(self, i: int) -> None:
+        self._stop.wait(self._phase_delay(i))
+        while not self._stop.wait(self.period_s):
+            self._sweep(i)
+
+    def _sweep(self, i: int) -> None:
+        # entry building stays under the shard lock: _member_payload
+        # mutates per-member state (heartbeat beats/fingerprints), and
+        # flush_all() sweeps from a foreign thread while the shard thread
+        # is live — the network flush below runs unlocked
+        with self._locks[i]:
+            members = list(self._members[i].values())
+            queued = self._queued[i]
+            self._queued[i] = {}
+            entries: dict = dict(queued)
+            for k in members:
+                if not getattr(k, "dead", False):
+                    p = self._member_payload(k)
+                    if p is not _SKIP:
+                        entries[k.node_name] = p
+        # per-shard series: one unlabeled gauge would hold only the
+        # last-swept shard's slice of the fleet
+        BATCHER_QUEUE_DEPTH.set(len(entries), {"batcher": self.batcher,
+                                               "shard": str(i)})
+        batch = list(entries.items())
+        for j in range(0, len(batch), self.max_batch):
+            self._flush(batch[j:j + self.max_batch])
+
+    def flush_all(self) -> None:
+        """Synchronous sweep of every shard (shutdown + tests)."""
+        for i in range(self.n_shards):
+            self._sweep(i)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def stats(self) -> dict:
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        return {"shards": self.n_shards, "flushes": self.flushes,
+                "items": self.items, "lastBatch": self.last_batch,
+                "errors": self.errors,
+                "itemsPerS": round(self.items / elapsed, 2)}
+
+    def _count(self, n_items: int) -> None:
+        with self._stats_lock:
+            self.flushes += 1
+            self.items += n_items
+            self.last_batch = n_items
+
+    def _count_error(self) -> None:
+        with self._stats_lock:
+            self.errors += 1
+
+    # ---- subclass hooks --------------------------------------------------
+
+    def _member_payload(self, kubelet):
+        return None
+
+    def _flush(self, chunk: list) -> None:
+        raise NotImplementedError
+
+
+class _HeartbeatBatcher(_ShardedBatcher):
+    """Fleet heartbeat fan-in: ``nodes/-/status`` POSTs refresh members'
+    Ready conditions + kubelet endpoints. Per-item 404s (Node deleted out
+    from under the fleet) heal by bulk re-registration — the singleton
+    heartbeat's 404 path, batched.
+
+    THINNED, the way upstream scale clusters thin node status: the LEASE
+    is the per-period liveness signal (upstream kubelets renew every 10s
+    but report unchanged status only 5-minutely —
+    ``nodeStatusReportFrequency``). A member's condition refresh is sent
+    when its payload CHANGES (Ready flip, endpoint re-bind — detected by
+    timestamp-free fingerprint) or on its every-``refresh_every``-th
+    sweep backstop (default 30: upstream's 10s-lease-to-5min-status
+    ratio), staggered by name hash so 1/refresh_every of the fleet
+    refreshes each period. Status traffic per period is O(N /
+    refresh_every); the watch fan-out and every informer's decode load
+    thin by the same factor."""
+
+    batcher = "heartbeat"
+
+    def __init__(self, client, period_s: float, shards: int = 4,
+                 max_batch: int = 512, phase: float = 0.0,
+                 refresh_every: int = 30):
+        self.refresh_every = max(1, int(refresh_every))
+        self._beats: dict[str, int] = {}
+        self._fps: dict[str, tuple] = {}
+        super().__init__(client, period_s, shards, max_batch, phase)
+
+    @staticmethod
+    def _fingerprint(payload: dict) -> tuple:
+        """Timestamp-free view of a heartbeat payload: what must force an
+        immediate send when it changes."""
+        return (
+            tuple(sorted((c.get("type"), c.get("status"), c.get("reason"))
+                         for c in payload.get("conditions") or [])),
+            tuple(sorted((a.get("type"), a.get("address"))
+                         for a in payload.get("addresses") or [])),
+            str(payload.get("daemonEndpoints")),
+        )
+
+    def _member_payload(self, kubelet):
+        name = kubelet.node_name
+        payload = kubelet.heartbeat_payload()
+        fp = self._fingerprint(payload)
+        # runs under the owning shard's lock (_sweep holds it while
+        # building entries), so _beats/_fps updates never race flush_all;
+        # _flush's fp invalidations happen outside the lock but are
+        # GIL-atomic dict pops — worst case one redundant refresh
+        beat = self._beats.get(name, 0)
+        self._beats[name] = beat + 1
+        due = ((beat + zlib.crc32(name.encode()) // self.n_shards)
+               % self.refresh_every == 0)
+        if not due and self._fps.get(name) == fp:
+            return _SKIP
+        self._fps[name] = fp
+        return payload
+
+    def remove(self, name: str) -> None:
+        super().remove(name)
+        self._beats.pop(name, None)
+        self._fps.pop(name, None)
+
+    def _flush(self, chunk: list) -> None:
+        from kubernetes_tpu.utils.tracing import TRACER
+        try:
+            with TRACER.span("kubelet/heartbeat", nodes=len(chunk)):
+                errs = self.client.nodes().heartbeat_many(chunk)
+        except Exception:
+            # best-effort transport — but the fingerprints recorded when
+            # these payloads were BUILT must not survive the lost send: a
+            # changed condition/endpoint suppressed by its own fp would
+            # otherwise wait out the full refresh backstop before being
+            # re-asserted
+            for name, _ in chunk:
+                self._fps.pop(name, None)
+            self._count_error()
+            return
+        HEARTBEAT_BATCH.observe(len(chunk))
+        self._count(len(chunk))
+        missing = [name for (name, _), e in zip(chunk, errs)
+                   if e and "not found" in e]
+        if missing:
+            # a 404'd member's fp must not suppress its next heartbeat: if
+            # the re-register below fails transiently, the per-period
+            # heartbeat (and its 404) is what retries the heal — without
+            # this the node would stay missing until the refresh backstop
+            for name in missing:
+                self._fps.pop(name, None)
+            self._reregister(missing)
+
+    def _reregister(self, names: list[str]) -> None:
+        # only LIVE members re-register: a scale-down's delete racing an
+        # in-flight flush must not resurrect the node as a Ready zombie
+        objs = []
+        for name in names:
+            k = self.member(name)
+            if k is not None and not getattr(k, "dead", False):
+                objs.append(k._node_object())
+        if not objs:
+            return
+        try:
+            self.client.nodes().create_many(objs)
+        except Exception:
+            pass  # 409 = adopted/raced; transport errors retry next period
+
+
+class _LeaseBatcher(_ShardedBatcher):
+    """Fleet lease fan-in: one ``leases/-/renew`` POST per shard per period
+    bumps every member's kube-node-lease renewTime (the kubelet's cheap
+    liveness signal — node-lifecycle treats a fresh renewTime as alive
+    even when status heartbeats lag). Missing leases (first renewal, or a
+    GC'd lease) are created in bulk and renew next period."""
+
+    batcher = "lease"
+
+    def _member_payload(self, kubelet):
+        return time.time()
+
+    def _flush(self, chunk: list) -> None:
+        from kubernetes_tpu.utils.tracing import TRACER
+        now = time.time()
+        items = [(name, rt if rt is not None else now) for name, rt in chunk]
+        leases = self.client.leases("kube-node-lease")
+        try:
+            with TRACER.span("kubelet/lease_renew", leases=len(items)):
+                errs = leases.renew_many(items)
+        except Exception:
+            self._count_error()
+            return
+        LEASE_BATCH.observe(len(items))
+        self._count(len(items))
+        missing = [(name, rt) for (name, rt), e in zip(items, errs)
+                   if e and "not found" in e]
+        if missing:
+            try:
+                leases.create_many([
+                    {"kind": "Lease",
+                     "metadata": {"name": name,
+                                  "namespace": "kube-node-lease"},
+                     "spec": {"holderIdentity": name,
+                              "leaseDurationSeconds": 40,
+                              "renewTime": rt}}
+                    for name, rt in missing])
+            except Exception:
+                pass  # AlreadyExists raced another creator; next period wins
+
+
+class _StatusBatcher(_ShardedBatcher):
+    """Coalesce the fleet's pod status writes into bulk POSTs, sharded.
 
     Every hollow kubelet's Pending->Running transition used to be its own
     status PUT — at 1,000 pods over 500 nodes that is thousands of
     request/response cycles fighting the scheduler for the apiserver and
     the GIL (kubemark's 15.9s mystery). Kubelets push ``(ns, name,
-    status)`` here (kubelet.status_sink); a flusher sends everything
-    accumulated as ONE ``pods/-/status`` POST per interval, newest status
-    per pod winning (the status manager's dedup semantics)."""
+    status)`` here (kubelet.status_sink); the shard flushers send
+    everything accumulated as ``pods/-/status`` POSTs per interval,
+    newest status per pod winning (the status manager's dedup semantics).
+    Pure push-mode use of the sharded base (no members): one global flush
+    lock used to convoy 10k nodes' sync threads through a single critical
+    section before the apiserver broke a sweat."""
 
-    def __init__(self, client, flush_s: float = 0.05, max_batch: int = 512):
-        self.client = client
-        self.flush_s = flush_s
-        self.max_batch = max_batch
-        self._lock = threading.Lock()
-        self._queued: dict[tuple, dict] = {}  # (ns, name) -> latest status
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
+    batcher = "status"
+
+    def __init__(self, client, flush_s: float = 0.05, max_batch: int = 512,
+                 shards: int = 4):
+        super().__init__(client, flush_s, shards, max_batch)
 
     def push(self, ns: str, name: str, status: dict) -> None:
-        with self._lock:
-            self._queued[(ns, name)] = status
-
-    def _loop(self) -> None:
-        while not self._stop.wait(self.flush_s):
-            self.flush()
-        self.flush()  # final drain so shutdown loses nothing queued
+        # "/" is illegal in both namespace and pod names, so the joined
+        # key round-trips losslessly through the base's name-keyed queue
+        super().push(f"{ns}/{name}", status)
 
     def flush(self) -> None:
-        with self._lock:
-            batch = list(self._queued.items())
-            self._queued.clear()
-        if not batch:
-            return
-        from kubernetes_tpu.utils.tracing import TRACER
-        for i in range(0, len(batch), self.max_batch):
-            chunk = batch[i:i + self.max_batch]
-            try:
-                with TRACER.span("kubemark/status_flush", pods=len(chunk)):
-                    self.client.pods("default").update_status_many(
-                        [(ns, name, st) for (ns, name), st in chunk])
-            except Exception:
-                # best-effort transport: the next sync re-asserts status
-                # (the kubelet, not the batcher, is the source of truth)
-                pass
+        self.flush_all()
 
-    def stop(self) -> None:
-        self._stop.set()
-        self._thread.join(timeout=2.0)
+    def _flush(self, chunk: list) -> None:
+        from kubernetes_tpu.utils.tracing import TRACER
+        items = [(key.split("/", 1)[0], key.split("/", 1)[1], st)
+                 for key, st in chunk]
+        try:
+            with TRACER.span("kubemark/status_flush", pods=len(items)):
+                self.client.pods("default").update_status_many(items)
+        except Exception:
+            # best-effort transport: the next sync re-asserts status
+            # (the kubelet, not the batcher, is the source of truth)
+            self._count_error()
+            return
+        STATUS_BATCH.observe(len(items))
+        self._count(len(items))
 
 
 class HollowCluster:
     def __init__(self, client, n: int, prefix: str = "hollow",
                  heartbeat_period: float = 10.0, drivers: int = 4,
                  allocatable: dict | None = None,
-                 exit_after: float | None = None):
+                 exit_after: float | None = None,
+                 publish_status: bool = True):
         self.client = client
         if hasattr(client, "default_user_agent"):
             client.default_user_agent("kubelet/hollow")
         self.heartbeat_period = heartbeat_period
+        # ``drivers`` now sizes the batcher shard pools (it used to size a
+        # per-node-sweep thread pool; same knob, same meaning: how many
+        # workers carry the fleet's liveness traffic)
         self.drivers = max(1, drivers)
+        self._publish = publish_status
         self.nodes: list[HollowNode] = []
         for i in range(n):
             hn = HollowNode(client, f"{prefix}-{i}", exit_after=exit_after,
@@ -104,38 +419,74 @@ class HollowCluster:
             self.nodes.append(hn)
         self._by_name = {hn.kubelet.node_name: hn.kubelet
                          for hn in self.nodes}
-        # fixed driver shards; membership mutates under _shard_lock and the
-        # driver threads iterate a snapshot per sweep
-        self._shards: list[list[HollowNode]] = [
-            self.nodes[i::self.drivers] for i in range(self.drivers)]
-        self._shard_lock = threading.Lock()
         self._informer: SharedInformer | None = None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
-        self._status: _StatusBatcher | None = None  # armed by start()
+        # batchers armed by start()
+        self._status: _StatusBatcher | None = None
+        self._heartbeats: _HeartbeatBatcher | None = None
+        self._leases: _LeaseBatcher | None = None
 
     # ---- lifecycle -------------------------------------------------------
 
     def start(self, wait_sync: float = 30.0) -> "HollowCluster":
-        # one shared status batcher for the whole fleet (bulk PATCHes)
-        self._status = _StatusBatcher(self.client)
+        # fleet-shared batchers: bulk pod status, bulk heartbeats, bulk
+        # lease renewals — every per-node hot path becomes a batched one
+        self._status = _StatusBatcher(self.client, shards=self.drivers)
+        self._heartbeats = _HeartbeatBatcher(
+            self.client, self.heartbeat_period, shards=self.drivers)
+        # leases renew on upstream's fixed ~10s cadence, decoupled from
+        # the (thinned) status heartbeat period — they are the per-period
+        # liveness signal, and their per-item cost is the one O(N) term
+        # that cannot be deduped away, so its period must not shrink just
+        # because an operator tightened heartbeat_period for test speed
+        self._leases = _LeaseBatcher(
+            self.client, min(10.0, self.heartbeat_period * 5),
+            shards=self.drivers,
+            phase=0.5)  # interleave with the heartbeat shards
         for hn in self.nodes:
-            hn.kubelet.status_sink = self._status.push
-        # one bulk registration for the whole fleet
-        if self.nodes:
-            self.client.nodes().create_many(
-                [hn.kubelet._node_object() for hn in self.nodes])
+            self._wire(hn)
+        # chunked bulk registration (adopting nodes that already exist)
+        self._register_fleet(self.nodes)
         # one shared watch stream; dispatch by spec.nodeName
         self._informer = SharedInformer(self.client.resource("pods", None))
         self._informer.add_event_handler(self._on_pod_event)
         self._informer.start()
         self._informer.wait_for_cache_sync(wait_sync)
-        for shard in self._shards:
-            t = threading.Thread(target=self._driver_loop, args=(shard,),
-                                 daemon=True)
+        for hn in self.nodes:
+            self._join_batchers(hn)
+        if self._publish:
+            t = threading.Thread(target=self._publish_loop, daemon=True)
             t.start()
             self._threads.append(t)
         return self
+
+    def _wire(self, hn: HollowNode) -> None:
+        hn.kubelet.status_sink = self._status.push
+        hn.kubelet.heartbeat_sink = self._heartbeats.push
+        hn.kubelet.lease_sink = self._leases.push
+
+    def _join_batchers(self, hn: HollowNode) -> None:
+        self._heartbeats.add(hn.kubelet)
+        self._leases.add(hn.kubelet)
+
+    def _register_fleet(self, hollow_nodes: list[HollowNode]) -> None:
+        """Bulk node create/adopt in REGISTER_CHUNK batches: spin-up is
+        O(batches), not O(nodes). A chunk whose members already exist
+        (409) is ADOPTED — siblings committed server-side, and the first
+        heartbeat flush refreshes every adopted node's condition — the
+        singleton register path's exists-is-fine semantics."""
+        from kubernetes_tpu.client.clientset import ApiError
+        from kubernetes_tpu.utils.tracing import TRACER
+        for i in range(0, len(hollow_nodes), REGISTER_CHUNK):
+            chunk = hollow_nodes[i:i + REGISTER_CHUNK]
+            with TRACER.span("kubemark/register", nodes=len(chunk)):
+                try:
+                    self.client.nodes().create_many(
+                        [hn.kubelet._node_object() for hn in chunk])
+                except ApiError as e:
+                    if e.code != 409:
+                        raise
 
     # ---- dynamic membership (cluster-autoscaler node groups) -------------
 
@@ -144,7 +495,7 @@ class HollowCluster:
                   taints: list | None = None) -> list[HollowNode]:
         """Provision hollow kubelets mid-flight (the autoscaler's scale-up
         path): bulk-register the node objects, join the shared pod watch
-        by name, and fold the batch into the existing driver shards. Each
+        by name, and fold the batch into the existing batcher shards. Each
         node gets a ``kubernetes.io/hostname`` label on top of ``labels``;
         ``taints`` register with the node (template fidelity)."""
         added = []
@@ -159,7 +510,7 @@ class HollowCluster:
                             register_node=False)
             hn.kubelet.recorder = NullRecorder()
             if self._status is not None:
-                hn.kubelet.status_sink = self._status.push
+                self._wire(hn)
             added.append(hn)
         # join the watch fan-out BEFORE the nodes become visible: a pod
         # bound in the gap between create and fan-out registration would
@@ -168,33 +519,32 @@ class HollowCluster:
         for hn in added:
             self._by_name[hn.kubelet.node_name] = hn.kubelet
         try:
-            self.client.nodes().create_many(
-                [hn.kubelet._node_object() for hn in added])
+            self._register_fleet(added)
         except Exception:
             for hn in added:
                 self._by_name.pop(hn.kubelet.node_name, None)
             self.nodes = [hn for hn in self.nodes if hn not in added]
             raise
-        with self._shard_lock:
-            for hn in added:  # least-loaded shard keeps heartbeats level
-                min(self._shards, key=len).append(hn)
+        if self._heartbeats is not None:
+            for hn in added:
+                self._join_batchers(hn)
         return added
 
     def remove_node(self, name: str):
         """Deprovision one hollow kubelet (scale-down): mark it dead (so an
         in-flight heartbeat cannot re-register the Node it is about to
         lose), stop its sync machinery, drop it from the watch fan-out and
-        its driver shard, delete the node object."""
+        its batcher shards, delete the node object."""
         kubelet = self._by_name.pop(name, None)
         if kubelet is None:
             return
         kubelet.dead = True
         self.nodes = [hn for hn in self.nodes
                       if hn.kubelet.node_name != name]
-        with self._shard_lock:
-            for shard in self._shards:
-                shard[:] = [hn for hn in shard
-                            if hn.kubelet.node_name != name]
+        if self._heartbeats is not None:
+            self._heartbeats.remove(name)
+        if self._leases is not None:
+            self._leases.remove(name)
         kubelet.workers.stop()
         try:
             self.client.nodes().delete(name)
@@ -207,8 +557,11 @@ class HollowCluster:
             self._informer.stop()
         for hn in self.nodes:
             hn.kubelet.workers.stop()
+        for b in (self._heartbeats, self._leases, self._status):
+            if b is not None:
+                b.stop()
         if self._status is not None:
-            self._status.stop()
+            self._status.flush()  # final drain so shutdown loses nothing
         for t in self._threads:
             t.join(timeout=5.0)
 
@@ -226,33 +579,45 @@ class HollowCluster:
             if prev is not None:
                 prev._on_pod_event("DELETED", old, None)
 
-    # ---- driver pool: heartbeats without a thread per node ---------------
-
-    def _driver_loop(self, shard):
-        # spread the shard's heartbeats across the period so the apiserver
-        # sees a steady trickle, not a thundering herd every period
-        while not self._stop.is_set():
-            with self._shard_lock:
-                sweep = list(shard)
-            if not sweep:
-                self._stop.wait(self.heartbeat_period)
-                continue
-            t0 = time.time()
-            for hn in sweep:
-                if self._stop.is_set():
-                    return
-                if self._by_name.get(
-                        hn.kubelet.node_name) is not hn.kubelet:
-                    continue  # removed (scale-down) mid-sweep
-                hn.kubelet.heartbeat_once()
-                hn.kubelet._renew_lease()
-                budget = self.heartbeat_period / len(sweep)
-                self._stop.wait(max(0.0, budget - 0.001))
-            leftover = self.heartbeat_period - (time.time() - t0)
-            if leftover > 0:
-                self._stop.wait(leftover)
-
     # ---- observability ---------------------------------------------------
+
+    def fleet_stats(self) -> dict:
+        """Live fleet shape + batcher rates (the Fleet block of
+        ``ktpu status``; also recorded per leg by the ScaleFleet bench)."""
+        return {
+            "nodes": len(self.nodes),
+            "shards": self.drivers,
+            "heartbeatPeriodSeconds": self.heartbeat_period,
+            "heartbeat": (self._heartbeats.stats()
+                          if self._heartbeats is not None else None),
+            "lease": (self._leases.stats()
+                      if self._leases is not None else None),
+            "status": (self._status.stats()
+                       if self._status is not None else None),
+        }
+
+    def publish_fleet_status(self) -> None:
+        """Best-effort: write the fleet stats ConfigMap ``ktpu status``
+        reads. Publishing must never take the fleet down."""
+        import json
+        body = {"apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": FLEET_CONFIGMAP,
+                             "namespace": "default"},
+                "data": {"fleet": json.dumps(self.fleet_stats())}}
+        cms = self.client.resource("configmaps", "default")
+        try:
+            cur = cms.get(FLEET_CONFIGMAP)
+            cur["data"] = body["data"]
+            cms.update(cur)
+        except Exception:
+            try:
+                cms.create(body)
+            except Exception:
+                pass
+
+    def _publish_loop(self) -> None:
+        while not self._stop.wait(5.0):
+            self.publish_fleet_status()
 
     def running_pods(self) -> int:
         return sum(len(hn.kubelet.runtime.list_sandboxes())

@@ -368,6 +368,41 @@ PARITY_DIVERGENCES = REGISTRY.counter(
     "Sampled device dispatches whose winners the numpy oracle REFUTED "
     "(each one trips the circuit breaker with reason 'parity'), by site")
 
+# Bulk control-plane fan-in (the sublinear-control-plane paths): every
+# store-level bulk verb counts here regardless of transport (HTTP endpoint
+# or DirectClient), so a bench JSON can attribute how much of the fleet's
+# API traffic rode batched requests vs per-object round trips.
+BULK_REQUESTS = REGISTRY.counter(
+    "apiserver_bulk_requests_total",
+    "Bulk API requests by endpoint (pods/-/binding | pods/-/status | "
+    "nodes/-/status | leases/-/renew | bulk-create)")
+HEARTBEAT_BATCH = REGISTRY.histogram(
+    "kubelet_heartbeat_batch_size",
+    "Nodes per bulk heartbeat flush (kubemark _HeartbeatBatcher shards)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096))
+LEASE_BATCH = REGISTRY.histogram(
+    "kubelet_lease_batch_size",
+    "Leases per bulk renew flush (kubemark _LeaseBatcher shards)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096))
+STATUS_BATCH = REGISTRY.histogram(
+    "kubemark_status_batch_size",
+    "Pod statuses per bulk flush (kubemark _StatusBatcher shards)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096))
+BATCHER_QUEUE_DEPTH = REGISTRY.gauge(
+    "kubemark_batcher_queue_depth",
+    "Entries queued in a fleet batcher at its last flush, by batcher "
+    "(heartbeat | lease | status)")
+
+# Scheduler informer hygiene at fleet scale: node MODIFIEDs whose only
+# news is liveness (heartbeat condition timestamps / lease-driven
+# refreshes) are skipped BEFORE decode — they must not wake the
+# scheduling loop or append resident-ctx deltas (the PR-8 bound-pod
+# status-MODIFIED discipline applied to nodes).
+NODE_LIVENESS_SKIPS = REGISTRY.gauge(
+    "scheduler_node_liveness_event_skips",
+    "Node MODIFIED events skipped by the scheduler's informer handler "
+    "because only liveness fields (heartbeat/lease refresh) changed")
+
 # Kubelet pod-sync health (pod_workers.go error bookkeeping analog).
 # Aggregate only — per-pod counts are PodWorkers.sync_errors(uid); a
 # per-uid label would grow one label set per failing pod forever.

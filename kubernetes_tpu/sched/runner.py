@@ -23,6 +23,7 @@ from kubernetes_tpu.metrics.registry import (
     BIND_RESULTS,
     BIND_RETRIES,
     LOOP_ERRORS,
+    NODE_LIVENESS_SKIPS,
 )
 from kubernetes_tpu.sched.cache import SchedulerCache
 from kubernetes_tpu.sched.resilience import ThreadWatchdog
@@ -109,6 +110,10 @@ class SchedulerRunner:
         # non-leader's loop
         self._loop_lock = threading.Lock()
         self._scheduler_names = {p.scheduler_name for p in self.cfg.profiles}
+        # liveness-only node MODIFIEDs skipped before decode (_on_node);
+        # written from the single informer dispatch thread, mirrored into
+        # the NODE_LIVENESS_SKIPS gauge
+        self._node_skips = 0
         # thread watchdog (sched/resilience.py): restarts a dead or
         # stalled scheduling loop / drain resolver instead of letting the
         # runner hang with a live process and a dead brain
@@ -212,7 +217,40 @@ class SchedulerRunner:
             self.queue.activate_gated(pod)
         self.queue.add(pod)
 
+    @staticmethod
+    def _node_liveness_only(obj: dict, old: dict) -> bool:
+        """True when a node MODIFIED carries only liveness news — heartbeat
+        condition timestamps, kubelet endpoint/address re-assertions — and
+        nothing scheduling-relevant (spec/taints, labels, allocatable,
+        capacity, images, condition STATUS transitions). At 10k-node fleet
+        scale the bulk heartbeat/lease paths emit one such MODIFIED per
+        node per period; decoding each and waking the scheduling queue for
+        it was pure informer-thread burn (the PR-8 bound-pod
+        status-MODIFIED fingerprint skip, applied to nodes)."""
+        if obj.get("spec") != old.get("spec"):
+            return False
+        if ((obj.get("metadata") or {}).get("labels")
+                != (old.get("metadata") or {}).get("labels")):
+            return False
+        st, ost = obj.get("status") or {}, old.get("status") or {}
+        for k in ("allocatable", "capacity", "images"):
+            if st.get(k) != ost.get(k):
+                return False
+        return ({(c.get("type"), c.get("status"))
+                 for c in st.get("conditions") or []}
+                == {(c.get("type"), c.get("status"))
+                    for c in ost.get("conditions") or []})
+
     def _on_node(self, type_, obj, old):
+        if type_ == MODIFIED and old is not None \
+                and self._node_liveness_only(obj, old):
+            # liveness-only refresh: no decode, no cache delta, no queue
+            # wake. (The cache's own fingerprint would have kept the
+            # ENCODING valid, but the Node.from_dict + requeue storm is
+            # what melts the informer thread at fleet scale.)
+            self._node_skips += 1
+            NODE_LIVENESS_SKIPS.set(self._node_skips)
+            return
         try:
             node = Node.from_dict(obj)
         except Exception:

@@ -990,6 +990,52 @@ class APIServer:
                         for e in errors]
                     return self._send_json(200, {"kind": "Status",
                                                  "results": results})
+                if sub == "status" and kind == "Node" and name == "-":
+                    # Bulk heartbeat: one POST refreshes many nodes' status
+                    # conditions in a single store lock pass with ONE watch
+                    # fan-out pass per batch (a 10k hollow-node fleet's
+                    # per-node GET+PUT heartbeat chatter was the control-
+                    # plane bottleneck once the device program got cheap).
+                    # Body: {"statuses": [{"name":..., "status": {...}}]};
+                    # conditions merge by type server-side; response is a
+                    # per-item status array in request order.
+                    items = body.get("statuses")
+                    if not isinstance(items, list):
+                        return self._error(400, "statuses must be a list",
+                                           "BadRequest")
+                    reqs = [(it.get("name", ""), it.get("status") or {})
+                            for it in items]
+                    errors = server.store.heartbeat_many(reqs)
+                    results = [
+                        {"code": 200} if e is None else
+                        {"code": 404, "message": e, "reason": "NotFound"}
+                        for e in errors]
+                    return self._send_json(200, {"kind": "Status",
+                                                 "results": results})
+                if sub == "renew" and kind == "Lease" and name == "-":
+                    # Bulk lease renewal: one POST bumps many Leases'
+                    # spec.renewTime in a single store lock pass (the
+                    # kube-node-lease analog of the bulk heartbeat — the
+                    # kubelet's cheap liveness signal, batched fleet-wide).
+                    # Body: {"renews": [{"name":..., "renewTime": <epoch>}]};
+                    # missing leases report per-item 404s without failing
+                    # siblings (the fleet batcher creates them in bulk).
+                    items = body.get("renews")
+                    if not isinstance(items, list):
+                        return self._error(400, "renews must be a list",
+                                           "BadRequest")
+                    import time as _time
+                    reqs = [(it.get("name", ""),
+                             float(it.get("renewTime") or _time.time()))
+                            for it in items]
+                    errors = server.store.renew_leases(
+                        ns or "kube-node-lease", reqs)
+                    results = [
+                        {"code": 200} if e is None else
+                        {"code": 404, "message": e, "reason": "NotFound"}
+                        for e in errors]
+                    return self._send_json(200, {"kind": "Status",
+                                                 "results": results})
                 if sub == "binding" and kind == "Pod":
                     # BindingREST.Create: set spec.nodeName if not already set.
                     target = body.get("target", {}).get("name", "")

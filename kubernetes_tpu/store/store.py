@@ -182,6 +182,23 @@ class ObjectStore:
         self._rv += 1
         return self._rv
 
+    def _emit_many_locked(self, kind: str, evs: list[Event]):
+        """Batched watch fan-out: one history append + trim and ONE pass
+        over the watcher list for a whole bulk verb's events, instead of
+        per-event bookkeeping. Semantically identical to N _emit_locked
+        calls — every watcher still receives every event in order."""
+        if not evs:
+            return
+        hist = self._history.setdefault(kind, [])
+        hist.extend(evs)
+        if len(hist) > REPLAY_WINDOW:
+            cut = len(hist) - REPLAY_WINDOW
+            self._compacted[kind] = hist[cut - 1].resource_version
+            del hist[:cut]
+        for q in self._watchers.get(kind, []):
+            for ev in evs:
+                q.put(ev)
+
     def _emit_locked(self, kind: str, ev: Event):
         # Event payloads SHARE the authoritative object: the store never
         # mutates a stored dict in place (every write REPLACES space[k] with
@@ -401,6 +418,8 @@ class ObjectStore:
         the siblings commit — callers wanting all-or-nothing pre-check names.
         Semantically identical to N create() calls, minus N-1 lock
         round-trips and defensive-copy passes."""
+        from kubernetes_tpu.metrics.registry import BULK_REQUESTS
+        BULK_REQUESTS.inc({"endpoint": "bulk-create"})
         out = []
         errors = []
         with self._lock:
@@ -507,6 +526,8 @@ class ObjectStore:
         generalized to a batch — the reference has no bulk variant; its
         scheduler binds one pod per POST, which is exactly the per-pod
         round-trip cost this path removes)."""
+        from kubernetes_tpu.metrics.registry import BULK_REQUESTS
+        BULK_REQUESTS.inc({"endpoint": "pods/-/binding"})
         out: list[Optional[str]] = []
         with self._lock:
             space = self._data.setdefault("Pod", {})
@@ -547,6 +568,8 @@ class ObjectStore:
         storage half of the kubemark status batcher — 500 hollow kubelets
         each PUTting Pending->Running transitions one at a time were the
         kubemark bottleneck."""
+        from kubernetes_tpu.metrics.registry import BULK_REQUESTS
+        BULK_REQUESTS.inc({"endpoint": "pods/-/status"})
         out: list[Optional[str]] = []
         with self._lock:
             space = self._data.setdefault(kind, {})
@@ -568,6 +591,90 @@ class ObjectStore:
                                       "name": k[1], "rv": rv, "obj": obj})
                 self._emit_locked(kind, Event(MODIFIED, obj, rv))
                 out.append(None)
+        return out
+
+    def heartbeat_many(self, items: list[tuple[str, dict]]
+                       ) -> list[Optional[str]]:
+        """Apply many NODE heartbeat status refreshes in ONE lock pass: for
+        each ``(name, status_patch)`` merge the patch into the node's
+        status — ``conditions`` merge BY TYPE (a Ready refresh replaces the
+        Ready condition and leaves NetworkUnavailable & co alone; exactly
+        what the per-node heartbeat's read-modify-write produced), every
+        other key (addresses, daemonEndpoints, ...) replaces wholesale.
+        Returns a per-item error string (or None); successes commit even
+        when siblings fail, and each item gets its own resourceVersion +
+        MODIFIED event — bulk and singleton heartbeats are
+        indistinguishable to a watcher. Watch fan-out happens in one batch
+        pass at the end (the hot cost at 10k-node fleet scale).
+
+        No rv precondition: the kubelet owns its node's status and the
+        fleet batcher serializes per-node writes, so last-write-wins
+        within one owner — the update_status_many discipline."""
+        from kubernetes_tpu.metrics.registry import BULK_REQUESTS
+        BULK_REQUESTS.inc({"endpoint": "nodes/-/status"})
+        out: list[Optional[str]] = []
+        evs: list[Event] = []
+        with self._lock:
+            space = self._data.setdefault("Node", {})
+            for name, patch in items:
+                k = ("", name)
+                cur = space.get(k)
+                if cur is None:
+                    out.append(f"Node {name} not found")
+                    continue
+                rv = self._bump_locked()
+                obj = fastcopy(cur)
+                st = obj.setdefault("status", {})
+                patch = fastcopy(patch)
+                for key, val in patch.items():
+                    if key == "conditions":
+                        by_type = {c.get("type"): c for c in val}
+                        merged = [by_type.pop(c.get("type"), c)
+                                  for c in st.get("conditions") or []]
+                        st["conditions"] = merged + list(by_type.values())
+                    else:
+                        st[key] = val
+                obj["metadata"]["resourceVersion"] = str(rv)
+                space[k] = obj
+                self._journal_locked({"op": "set", "kind": "Node", "ns": "",
+                                      "name": name, "rv": rv, "obj": obj})
+                evs.append(Event(MODIFIED, obj, rv))
+                out.append(None)
+            self._emit_many_locked("Node", evs)
+        return out
+
+    def renew_leases(self, namespace: str, items: list[tuple[str, float]]
+                     ) -> list[Optional[str]]:
+        """Bump ``spec.renewTime`` on many Leases in ONE lock pass: for
+        each ``(name, renew_time)`` in ``namespace``. Returns per-item
+        error string (or None); a missing Lease reports "not found"
+        without failing its siblings (the fleet batcher bulk-creates the
+        missing ones and renews them next period). Same per-item
+        resourceVersion + MODIFIED-event discipline as N singleton
+        updates, minus N-1 round trips; watch fan-out is one batch pass."""
+        from kubernetes_tpu.metrics.registry import BULK_REQUESTS
+        BULK_REQUESTS.inc({"endpoint": "leases/-/renew"})
+        out: list[Optional[str]] = []
+        evs: list[Event] = []
+        with self._lock:
+            space = self._data.setdefault("Lease", {})
+            for name, renew_time in items:
+                k = (namespace or "", name)
+                cur = space.get(k)
+                if cur is None:
+                    out.append(f"Lease {namespace}/{name} not found")
+                    continue
+                rv = self._bump_locked()
+                obj = fastcopy(cur)
+                obj.setdefault("spec", {})["renewTime"] = float(renew_time)
+                obj["metadata"]["resourceVersion"] = str(rv)
+                space[k] = obj
+                self._journal_locked({"op": "set", "kind": "Lease",
+                                      "ns": k[0], "name": name, "rv": rv,
+                                      "obj": obj})
+                evs.append(Event(MODIFIED, obj, rv))
+                out.append(None)
+            self._emit_many_locked("Lease", evs)
         return out
 
     def delete(self, kind: str, namespace: str, name: str) -> dict:

@@ -1,0 +1,441 @@
+"""Bulk control-plane fan-in: ``nodes/-/status`` + ``leases/-/renew``
+parity with the singleton paths, the kubemark fleet batchers, and the
+scheduler's liveness-only node-event skip.
+
+The sublinear-control-plane contract: batched heartbeats/lease renewals/
+status writes must be INDISTINGUISHABLE from N singleton requests to
+every consumer (watchers, resourceVersion discipline, node-lifecycle),
+while per-item failures report without failing siblings."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client.clientset import DirectClient, HTTPClient
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.kubelet.kubemark import (
+    HollowCluster,
+    _HeartbeatBatcher,
+    _LeaseBatcher,
+    _StatusBatcher,
+)
+from kubernetes_tpu.store.store import MODIFIED, ObjectStore
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def wait_until(fn, timeout=10.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+def _node_dict(name, extra_conditions=()):
+    n = make_node(name).allocatable({"cpu": "4", "pods": "10"}) \
+        .obj().to_dict()
+    n["status"]["conditions"] = [
+        {"type": "Ready", "status": "Unknown", "lastHeartbeatTime": 0.0},
+        *extra_conditions]
+    return n
+
+
+def _lease(name, renew=1.0):
+    return {"kind": "Lease",
+            "metadata": {"name": name, "namespace": "kube-node-lease"},
+            "spec": {"holderIdentity": name, "leaseDurationSeconds": 40,
+                     "renewTime": renew}}
+
+
+# ---- 1. bulk-vs-singleton parity ------------------------------------------
+
+def test_bulk_heartbeat_matches_singleton_merge_and_watch():
+    """One nodes/-/status batch produces exactly what N singleton
+    heartbeats produced: conditions merged BY TYPE (foreign conditions
+    survive, Ready replaced), one MODIFIED + one fresh resourceVersion
+    per item, in request order."""
+    store = ObjectStore()
+    client = DirectClient(store)
+    other = {"type": "NetworkUnavailable", "status": "False"}
+    client.nodes().create_many([_node_dict("b0", [other]),
+                                _node_dict("b1"), _node_dict("s0", [other])])
+
+    # singleton reference: the kubelet's read-modify-write heartbeat
+    k = Kubelet(client, "s0", register_node=False)
+    k.heartbeat_once()
+    single = client.nodes().get("s0")["status"]["conditions"]
+
+    rv0 = store.resource_version
+    w = store.watch("Node", since_rv=rv0)
+    errs = client.nodes().heartbeat_many([
+        ("b0", {"conditions": [
+            {"type": "Ready", "status": "True",
+             "lastHeartbeatTime": time.time()}]}),
+        ("b1", {"conditions": [
+            {"type": "Ready", "status": "True",
+             "lastHeartbeatTime": time.time()}]}),
+    ])
+    assert errs == [None, None]
+    evs = [w.get(timeout=1.0), w.get(timeout=1.0)]
+    assert [e.type for e in evs] == [MODIFIED, MODIFIED]
+    # rv discipline: one bump per item, in order, stamped on the object
+    assert [e.resource_version for e in evs] == [rv0 + 1, rv0 + 2]
+    assert [e.object["metadata"]["resourceVersion"] for e in evs] == \
+        [str(rv0 + 1), str(rv0 + 2)]
+    assert [e.object["metadata"]["name"] for e in evs] == ["b0", "b1"]
+    w.stop()
+
+    # merge parity with the singleton path: Ready replaced, foreign
+    # condition preserved, nothing else about the object touched
+    bulk = client.nodes().get("b0")["status"]["conditions"]
+    assert {(c["type"], c["status"]) for c in bulk} == \
+        {(c["type"], c["status"]) for c in single}
+    assert client.nodes().get("b0")["status"]["allocatable"] == \
+        client.nodes().get("s0")["status"]["allocatable"]
+
+
+def test_bulk_heartbeat_per_item_404_does_not_fail_batch():
+    store = ObjectStore()
+    client = DirectClient(store)
+    client.nodes().create(_node_dict("n0"))
+    fresh = {"conditions": [{"type": "Ready", "status": "True",
+                             "lastHeartbeatTime": 9.0}]}
+    errs = client.nodes().heartbeat_many(
+        [("ghost", fresh), ("n0", fresh), ("ghost2", fresh)])
+    assert errs[0] and "not found" in errs[0]
+    assert errs[1] is None
+    assert errs[2] and "not found" in errs[2]
+    # the sibling committed
+    conds = client.nodes().get("n0")["status"]["conditions"]
+    assert any(c["status"] == "True" for c in conds
+               if c["type"] == "Ready")
+
+
+def test_bulk_lease_renew_parity_and_missing():
+    store = ObjectStore()
+    client = DirectClient(store)
+    leases = client.leases("kube-node-lease")
+    leases.create_many([_lease("n0"), _lease("n1")])
+    rv0 = store.resource_version
+    w = store.watch("Lease", since_rv=rv0)
+    errs = leases.renew_many([("n0", 50.0), ("missing", 1.0),
+                              ("n1", 60.0)])
+    assert errs[0] is None and errs[2] is None
+    assert errs[1] and "not found" in errs[1]
+    assert leases.get("n0")["spec"]["renewTime"] == 50.0
+    assert leases.get("n1")["spec"]["renewTime"] == 60.0
+    evs = [w.get(timeout=1.0), w.get(timeout=1.0)]
+    assert [e.type for e in evs] == [MODIFIED, MODIFIED]
+    assert [e.resource_version for e in evs] == [rv0 + 1, rv0 + 2]
+    # holderIdentity untouched (renew bumps renewTime only)
+    assert leases.get("n0")["spec"]["holderIdentity"] == "n0"
+    w.stop()
+
+
+def test_bulk_endpoints_over_http():
+    """The HTTP transport speaks the same bulk protocol: per-item status
+    arrays in request order from both endpoints."""
+    from kubernetes_tpu.store.apiserver import APIServer
+    server = APIServer().start()
+    try:
+        client = HTTPClient(server.url)
+        client.nodes().create_many([_node_dict("h0"), _node_dict("h1")])
+        errs = client.nodes().heartbeat_many([
+            ("h0", {"conditions": [{"type": "Ready", "status": "True",
+                                    "lastHeartbeatTime": 3.0}]}),
+            ("nope", {}),
+        ])
+        assert errs[0] is None and errs[1] and "not found" in errs[1]
+        assert any(c["status"] == "True"
+                   for c in client.nodes().get("h0")["status"]["conditions"]
+                   if c["type"] == "Ready")
+        leases = client.leases("kube-node-lease")
+        leases.create_many([_lease("h0")])
+        errs = leases.renew_many([("h0", 77.0), ("nope", 1.0)])
+        assert errs[0] is None and errs[1] and "not found" in errs[1]
+        assert leases.get("h0")["spec"]["renewTime"] == 77.0
+    finally:
+        server.stop()
+
+
+# ---- 2. fleet batchers -----------------------------------------------------
+
+class _StubKubelet:
+    """The slice of Kubelet the batchers consume."""
+
+    def __init__(self, name):
+        self.node_name = name
+        self.dead = False
+
+    def heartbeat_payload(self):
+        return {"conditions": [{"type": "Ready", "status": "True",
+                                "lastHeartbeatTime": time.time()}]}
+
+    def _node_object(self):
+        return {"kind": "Node", "metadata": {"name": self.node_name},
+                "spec": {}, "status": self.heartbeat_payload()}
+
+
+def test_heartbeat_batcher_shards_flush_and_heal():
+    store = ObjectStore()
+    client = DirectClient(store)
+    stubs = [_StubKubelet(f"hb-{i}") for i in range(8)]
+    client.nodes().create_many([s._node_object() for s in stubs])
+    b = _HeartbeatBatcher(client, period_s=999.0, shards=3)
+    try:
+        for s in stubs:
+            b.add(s)
+        # membership spread over the shards (stable hash, no empty fleet
+        # concentration)
+        sizes = [len(m) for m in b._members]
+        assert sum(sizes) == 8 and max(sizes) < 8
+        # a node deleted out from under the fleet heals via bulk
+        # re-register; a DEAD member must not resurrect
+        client.nodes().delete("hb-0")
+        client.nodes().delete("hb-1")
+        dead = next(s for s in stubs if s.node_name == "hb-1")
+        dead.dead = True
+        b.flush_all()
+        assert b.items > 0 and b.flushes >= 1
+        names = {n["metadata"]["name"] for n in client.nodes().list()}
+        assert "hb-0" in names        # healed
+        assert "hb-1" not in names    # dead stays dead
+        # conditions actually refreshed
+        conds = client.nodes().get("hb-2")["status"]["conditions"]
+        assert any(c["status"] == "True" for c in conds
+                   if c["type"] == "Ready")
+    finally:
+        b.stop()
+
+
+def test_heartbeat_heal_retries_after_failed_reregister():
+    """A per-item 404 invalidates the member's fingerprint even when the
+    re-register itself fails: the NEXT period's heartbeat (not the
+    30-sweep refresh backstop) re-encounters the 404 and retries the
+    heal."""
+    store = ObjectStore()
+    client = DirectClient(store)
+    s = _StubKubelet("heal-0")
+    client.nodes().create(s._node_object())
+    b = _HeartbeatBatcher(client, period_s=999.0, shards=1)
+    try:
+        b.add(s)
+        b.flush_all()
+        client.nodes().delete("heal-0")
+        b._fps.pop("heal-0")  # the node's refresh-backstop slot comes due
+        calls = {"n": 0}
+        orig = b._reregister
+
+        def flaky(names):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return  # transient transport failure, swallowed
+            orig(names)
+
+        b._reregister = flaky
+        b.flush_all()  # 404 seen, fp popped, re-register attempt fails
+        assert "heal-0" not in {n["metadata"]["name"]
+                                for n in client.nodes().list()}
+        b.flush_all()  # fp invalidated -> heartbeat resent -> heal retries
+        assert calls["n"] == 2
+        assert "heal-0" in {n["metadata"]["name"]
+                            for n in client.nodes().list()}
+    finally:
+        b.stop()
+
+
+def test_batcher_phase_jitter_spreads_shards():
+    store = ObjectStore()
+    client = DirectClient(store)
+    hb = _HeartbeatBatcher(client, period_s=10.0, shards=4)
+    le = _LeaseBatcher(client, period_s=10.0, shards=4, phase=0.5)
+    try:
+        delays = [hb._phase_delay(i) for i in range(4)]
+        assert delays == sorted(delays) and len(set(delays)) == 4
+        assert delays[-1] < 10.0  # all within one period
+        # sibling batcher interleaves between the heartbeat shards
+        assert set(le._phase_delay(i) for i in range(4)) \
+            .isdisjoint(set(delays))
+    finally:
+        hb.stop()
+        le.stop()
+
+
+def test_lease_batcher_creates_missing_then_renews():
+    store = ObjectStore()
+    client = DirectClient(store)
+    stubs = [_StubKubelet(f"lb-{i}") for i in range(4)]
+    b = _LeaseBatcher(client, period_s=999.0, shards=2)
+    try:
+        for s in stubs:
+            b.add(s)
+        b.flush_all()  # no leases yet: per-item 404s -> bulk create
+        leases = client.leases("kube-node-lease")
+        created = {ls["metadata"]["name"] for ls in leases.list()}
+        assert created == {s.node_name for s in stubs}
+        t0 = leases.get("lb-0")["spec"]["renewTime"]
+        time.sleep(0.01)
+        b.flush_all()  # now they exist: renewed in bulk
+        assert leases.get("lb-0")["spec"]["renewTime"] > t0
+    finally:
+        b.stop()
+
+
+def test_status_batcher_sharded_newest_wins():
+    store = ObjectStore()
+    client = DirectClient(store)
+    client.pods("default").create_many(
+        [make_pod(f"sp-{i}").obj().to_dict() for i in range(6)])
+    b = _StatusBatcher(client, flush_s=999.0, shards=3)
+    try:
+        for i in range(6):
+            b.push("default", f"sp-{i}", {"phase": "Pending"})
+        # newest status for one pod wins within the un-flushed window
+        b.push("default", "sp-0", {"phase": "Running"})
+        b.flush()
+        phases = {p["metadata"]["name"]: p["status"]["phase"]
+                  for p in client.pods("default").list()}
+        assert phases["sp-0"] == "Running"
+        assert all(phases[f"sp-{i}"] == "Pending" for i in range(1, 6))
+        assert b.items == 6  # dedup: 7 pushes, 6 writes
+    finally:
+        b.stop()
+
+
+def test_hollow_cluster_batches_heartbeats_and_leases():
+    """Fleet integration: HollowCluster routes every liveness path
+    through the batchers — nodes turn Ready via nodes/-/status, leases
+    appear and renew via leases/-/renew, and a removed node cannot be
+    resurrected by an in-flight flush."""
+    store = ObjectStore()
+    client = DirectClient(store)
+    cluster = HollowCluster(client, 6, prefix="fx", heartbeat_period=0.2,
+                            publish_status=False).start(wait_sync=5.0)
+    try:
+        assert len(client.nodes().list()) == 6
+        assert wait_until(lambda: (cluster._heartbeats.items > 0
+                                   and cluster._leases.items > 0), 5.0)
+        assert wait_until(lambda: len(
+            client.leases("kube-node-lease").list()) == 6, 5.0)
+        rt0 = client.leases("kube-node-lease").get("fx-0")["spec"].get(
+            "renewTime")
+        assert wait_until(lambda: client.leases("kube-node-lease")
+                          .get("fx-0")["spec"].get("renewTime", 0)
+                          > (rt0 or 0), 5.0)
+        cluster.remove_node("fx-5")
+        cluster._heartbeats.flush_all()
+        names = {n["metadata"]["name"] for n in client.nodes().list()}
+        assert "fx-5" not in names
+    finally:
+        cluster.stop()
+
+
+# ---- 3. node lifecycle: leases keep nodes alive ---------------------------
+
+def test_batched_lease_renewal_keeps_node_ready_while_status_lags():
+    """node-lifecycle treats a fresh lease renewTime as liveness even
+    when the status heartbeat is STALE (upstream: status 5-minutely,
+    leases every 10s) — so the fleet's cheap bulk-renew path alone keeps
+    nodes untainted; stopping it surfaces unreachable."""
+    from kubernetes_tpu.controllers.nodelifecycle import (
+        TAINT_UNREACHABLE, NodeLifecycleController)
+    store = ObjectStore()
+    client = DirectClient(store)
+    node = _node_dict("lagging")
+    # status heartbeat far in the past, condition still True
+    node["status"]["conditions"] = [
+        {"type": "Ready", "status": "True",
+         "lastHeartbeatTime": time.time() - 3600.0}]
+    client.nodes().create(node)
+    # the lease starts FRESH (a stale lease + stale status would taint
+    # before the first batched renewal could land)
+    client.leases("kube-node-lease").create_many(
+        [_lease("lagging", renew=time.time())])
+    ctrl = NodeLifecycleController(client, grace_period=0.6,
+                                   monitor_period=0.1)
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    try:
+        # batched renewals every ~0.2s: the node must stay untainted for
+        # well past the grace period
+        deadline = time.time() + 1.5
+        while time.time() < deadline:
+            client.leases("kube-node-lease").renew_many(
+                [("lagging", time.time())])
+            time.sleep(0.1)
+            taints = (client.nodes().get("lagging")["spec"]
+                      .get("taints") or [])
+            assert not any(t["key"] == TAINT_UNREACHABLE for t in taints)
+        # renewals stop -> unreachable within grace + monitor slack
+        assert wait_until(lambda: any(
+            t["key"] == TAINT_UNREACHABLE
+            for t in (client.nodes().get("lagging")["spec"]
+                      .get("taints") or [])), 10.0)
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+# ---- 4. scheduler informer hygiene ----------------------------------------
+
+def test_scheduler_skips_liveness_only_node_modifieds():
+    """A heartbeat/lease-driven node MODIFIED must not wake the
+    scheduling loop or append deltas; a real change (allocatable, taints,
+    labels, condition STATUS) still processes."""
+    from kubernetes_tpu.sched.runner import SchedulerRunner
+    store = ObjectStore()
+    runner = SchedulerRunner(DirectClient(store))
+    try:
+        v1 = _node_dict("sk0")
+        runner._on_node("ADDED", v1, None)
+        gen0 = runner.cache._generation
+        skips0 = runner._node_skips
+
+        # heartbeat-only refresh: timestamp + endpoint re-assertion
+        v2 = {**v1, "status": {**v1["status"], "conditions": [
+            {"type": "Ready", "status": "Unknown",
+             "lastHeartbeatTime": time.time()}],
+            "daemonEndpoints": {"kubeletEndpoint": {"Port": 12345}}}}
+        runner._on_node("MODIFIED", v2, v1)
+        assert runner._node_skips == skips0 + 1
+        assert runner.cache._generation == gen0
+
+        # condition STATUS transition: NOT liveness-only
+        v3 = {**v2, "status": {**v2["status"], "conditions": [
+            {"type": "Ready", "status": "False",
+             "lastHeartbeatTime": time.time()}]}}
+        runner._on_node("MODIFIED", v3, v2)
+        assert runner._node_skips == skips0 + 1  # processed, not skipped
+
+        # allocatable change: processed, generation bumps
+        v4 = {**v3, "status": {**v3["status"],
+                               "allocatable": {"cpu": "8", "pods": "10"}}}
+        runner._on_node("MODIFIED", v4, v3)
+        assert runner.cache._generation > gen0
+        assert runner._node_skips == skips0 + 1
+    finally:
+        runner.scheduler.close()
+
+
+@pytest.mark.parametrize("mutate,expect_skip", [
+    (lambda n: n["status"]["conditions"].__setitem__(
+        0, {"type": "Ready", "status": "Unknown",
+            "lastHeartbeatTime": 999.0}), True),
+    (lambda n: n["metadata"].setdefault("labels", {})
+     .__setitem__("zone", "b"), False),
+    (lambda n: n["spec"].__setitem__(
+        "taints", [{"key": "k", "effect": "NoSchedule"}]), False),
+    (lambda n: n["status"].__setitem__("capacity", {"cpu": "16"}), False),
+])
+def test_node_liveness_fingerprint_matrix(mutate, expect_skip):
+    from kubernetes_tpu.sched.runner import SchedulerRunner
+    import json
+    old = _node_dict("fp0")
+    new = json.loads(json.dumps(old))
+    mutate(new)
+    assert SchedulerRunner._node_liveness_only(new, old) is expect_skip

@@ -45,7 +45,13 @@ def _cluster(n=3):
     return nodes
 
 
-def _leader(nodes, timeout=10.0):
+def _leader(nodes, timeout=30.0):
+    # Election budget sized for FULL-SUITE load, not a quiet interpreter:
+    # the raft tickers share the GIL with hundreds of suite threads, so
+    # silence detection (1.2s) + prevote round trips (2s timeouts) can
+    # stretch a single election attempt to multiple seconds, and split
+    # votes retry from scratch. 10s flaked under load (passed alone);
+    # the wider budget only costs time when something is actually wrong.
     deadline = time.time() + timeout
     while time.time() < deadline:
         leaders = [nd for nd in nodes
@@ -108,12 +114,15 @@ def test_leader_failover_and_continued_writes():
         # the committed pre-failover write survived the election
         objs, _ = new_leader.store.list("ConfigMap")
         assert any(o["metadata"]["name"] == "pre" for o in objs)
-        # and the group still commits (2/3 alive = quorum)
-        ReplicatedStore(new_leader).create("ConfigMap", _cm("post"))
+        # and the group still commits (2/3 alive = quorum); the commit
+        # gate itself gets the suite-load budget too
+        ReplicatedStore(new_leader,
+                        commit_timeout=15.0).create("ConfigMap",
+                                                    _cm("post"))
         other = next(nd for nd in survivors if nd is not new_leader)
         assert wait_until(lambda: any(
             o["metadata"]["name"] == "post"
-            for o in other.store.list("ConfigMap")[0]))
+            for o in other.store.list("ConfigMap")[0]), timeout=15.0)
     finally:
         for nd in nodes:
             nd.stop()
