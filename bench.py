@@ -26,7 +26,10 @@ the bench non-zero if any pod is lost under faults),
 BENCH_SCALEFLEET=0 to skip the ScaleFleet sweep (BENCH_SCALE_NODES
 sizes the two-point fleet sweep, default "256 2048"; the 100k campaign
 tier is "1250 10000"; BENCH_SCALE_MAX_GROWTH tunes the sublinear
-control-plane gate).
+control-plane gate), BENCH_DISASTER=0 to skip the DisasterChurn case
+(apiserver SIGKILL + WAL-replay restart mid-churn; BENCH_DISASTER_NODES/
+PODS/OUTAGE_S size it, BENCH_DISASTER_BIND_SLO bounds time-to-first-
+bind-after-restart — every gate treats a missing number as failure).
 """
 
 from __future__ import annotations
@@ -235,6 +238,24 @@ def main():
             log=log)
         log("[bench] " + json.dumps(scale_fleet))
 
+    disaster = None
+    if os.environ.get("BENCH_DISASTER", "1") != "0" and not only_case:
+        # apiserver SIGKILL + WAL-replay restart mid-churn: every pod
+        # bound, 0 invariant violations, 0 outage-caused evictions/taints
+        # (disruption mode engaged AND released), first-bind-after-restart
+        # <= BENCH_DISASTER_BIND_SLO (10s) — missing number = failure
+        from benchmarks.disaster import run_disaster_churn
+        log("[bench] disaster churn run ...")
+        disaster = run_disaster_churn(
+            n_hollow=int(os.environ.get("BENCH_DISASTER_NODES", "48")),
+            n_pods=int(os.environ.get("BENCH_DISASTER_PODS", "96")),
+            outage_s=float(os.environ.get("BENCH_DISASTER_OUTAGE_S",
+                                          "16")),
+            bind_slo_s=float(os.environ.get("BENCH_DISASTER_BIND_SLO",
+                                            "10")),
+            log=log)
+        log("[bench] " + json.dumps(disaster))
+
     kubemark = None
     if os.environ.get("BENCH_KUBEMARK", "1") != "0" and not only_case:
         # LAST on purpose: the hollow fleet leaves hundreds of daemon
@@ -287,6 +308,7 @@ def main():
         "preemption": preemption,
         "connected_preemption": connected_preemption,
         "scale_fleet": scale_fleet,
+        "disaster_churn": disaster,
         "kubemark": kubemark,
         "pallas": pallas,
         # confirmed correctness-invariant violations across every audited
@@ -296,13 +318,14 @@ def main():
         # as "fine" for rounds
         "invariant_violations": _sum_violations(connected, chaos_churn,
                                                 connected_mesh, explain_ab,
-                                                scale_fleet),
+                                                scale_fleet, disaster),
         # hard SLO verdicts from case-config gates (SchedulingChurn p99 +
         # throughput, ConnectedMesh legs). Missing numbers are failures —
         # the BENCH_r05 parsed-null lesson: a silently absent figure must
         # never read as a pass.
         "slo_failures": _collect_slo_failures(results, connected_mesh,
-                                              explain_ab, scale_fleet),
+                                              explain_ab, scale_fleet,
+                                              disaster),
     }
     _require_invariant_field(out, "bench summary")
     print(json.dumps(out))
@@ -314,7 +337,8 @@ def main():
         audited = {name: c.get("invariant_violations") for name, c in
                    (("connected", connected), ("chaos_churn", chaos_churn),
                     ("connected_mesh", connected_mesh),
-                    ("scale_fleet", scale_fleet)) if c}
+                    ("scale_fleet", scale_fleet),
+                    ("disaster_churn", disaster)) if c}
         print(f"[bench] FATAL: {out['invariant_violations']} correctness-"
               f"invariant violation(s) confirmed by the auditor "
               f"({audited}); repro bundles are on disk — replay with the "
@@ -340,7 +364,7 @@ def main():
 
 
 def _collect_slo_failures(results, connected_mesh, explain_ab=None,
-                          scale_fleet=None) -> list:
+                          scale_fleet=None, disaster=None) -> list:
     """Flatten every case's hard-SLO failure strings, prefixed by case."""
     out = []
     for r in results or []:
@@ -355,6 +379,9 @@ def _collect_slo_failures(results, connected_mesh, explain_ab=None,
     if scale_fleet is not None:
         for msg in scale_fleet.get("slo_failures") or []:
             out.append(f"ScaleFleet: {msg}")
+    if disaster is not None:
+        for msg in disaster.get("slo_failures") or []:
+            out.append(f"DisasterChurn: {msg}")
     return out
 
 

@@ -1,0 +1,296 @@
+"""DisasterChurn: the apiserver dies (SIGKILL) under live churn and the
+whole stack survives its restart.
+
+The canonical control-plane robustness scenario (upstream treats
+etcd/apiserver restart + mass node-unready fallout as exactly this): a
+hollow fleet heartbeats and runs pods, the scheduler binds a sustained
+churn stream, the node-lifecycle controller watches for staleness — and
+mid-window the apiserver subprocess is SIGKILLed, then restarted from
+the SAME ``data_dir`` (WAL + snapshot replay, ``/readyz`` 503 until
+done) on the SAME port. Every layer must heal through its own
+discipline: HTTPClient full-jitter backoff absorbs the refused-
+connection storm, informers relist (410/TooOld on pre-restart rvs),
+fleet batchers back off + re-coalesce + re-assert on reconnect, and the
+node-lifecycle disruption mode keeps the fleet-wide lease staleness the
+outage manufactured from cascading into a taint/evict storm.
+
+Hard gates (missing number = failure, the PR-8 SLO discipline):
+  - every pod that exists at the end is BOUND (none lost, none stuck)
+  - 0 confirmed invariant violations (fail-fast auditor live throughout)
+  - 0 outage-caused evictions, 0 lifecycle taints left on any node —
+    with the disruption mode provably ENGAGED during the outage and
+    RELEASED after heal (protection that never fires protects nothing)
+  - time-to-first-bind-after-restart <= ``bind_slo_s`` (default 10s)
+  - the restarted server reached /readyz 200 (replay completed)
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+import threading
+import time
+
+
+def _pod_churn_loop(client, stop, counter, period_s: float = 0.25) -> None:
+    """Sustained pod churn (namespace ``churn``): a rolling window of
+    short-lived pods. Errors are EXPECTED mid-outage (the apiserver is
+    dead); the loop keeps trying and counts what committed."""
+    import itertools
+
+    from kubernetes_tpu.testing.wrappers import make_pod
+    seq = itertools.count()
+    live: list = []
+    while not stop.is_set():
+        i = next(seq)
+        try:
+            pod = make_pod(f"churn-p{i}", "churn").req(
+                {"cpu": "100m"}).obj()
+            client.pods("churn").create(pod.to_dict())
+            live.append(pod.metadata.name)
+            if len(live) > 4:
+                client.pods("churn").delete(live.pop(0))
+            counter["ops"] = counter.get("ops", 0) + 2
+        except Exception:
+            counter["errors"] = counter.get("errors", 0) + 1
+        stop.wait(period_s)
+
+
+def _unbound(client, namespaces=("default", "churn")) -> list[str]:
+    out = []
+    for ns in namespaces:
+        for p in client.pods(ns).list():
+            if not (p.get("spec") or {}).get("nodeName"):
+                out.append(f"{ns}/{p['metadata']['name']}")
+    return out
+
+
+def _lifecycle_taints(client) -> list[str]:
+    from kubernetes_tpu.controllers.nodelifecycle import (
+        TAINT_NOT_READY, TAINT_UNREACHABLE)
+    out = []
+    for n in client.nodes().list():
+        for t in (n.get("spec") or {}).get("taints") or []:
+            if t.get("key") in (TAINT_NOT_READY, TAINT_UNREACHABLE):
+                out.append(f"{n['metadata']['name']}:{t['key']}")
+    return out
+
+
+def run_disaster_churn(n_hollow: int = 48, n_pods: int = 96,
+                       outage_s: float = 16.0, grace_s: float = 12.0,
+                       heartbeat_period: float = 1.0,
+                       bind_slo_s: float = 10.0,
+                       settle_timeout: float = 120.0,
+                       timeout: float = 240.0,
+                       log=lambda *a: None) -> dict:
+    from benchmarks.connected import _audit_close, _bench_auditor
+    from kubernetes_tpu.chaos.apiserver import ApiServerProcess
+    from kubernetes_tpu.client.clientset import HTTPClient
+    from kubernetes_tpu.client.informer import InformerFactory
+    from kubernetes_tpu.config.types import SchedulerConfiguration
+    from kubernetes_tpu.controllers.nodelifecycle import (
+        MODE_NORMAL, NodeLifecycleController)
+    from kubernetes_tpu.kubelet.kubemark import HollowCluster
+    from kubernetes_tpu.sched.runner import SchedulerRunner
+    from kubernetes_tpu.testing.wrappers import make_pod
+
+    # grace must clear the fleet's lease cadence (min(10, hb*5)) with
+    # margin, or steady state itself flaps unready under suite load
+    lease_period = min(10.0, heartbeat_period * 5)
+    assert grace_s > 2 * lease_period, \
+        f"grace {grace_s}s too tight for lease period {lease_period}s"
+
+    data_dir = tempfile.mkdtemp(prefix="ktpu-disaster-")
+    result: dict = {"case": "DisasterChurn",
+                    "workload": f"{n_hollow}hollow_{n_pods}pods"
+                                f"_{outage_s}s_outage",
+                    "outage_s": outage_s, "grace_s": grace_s,
+                    "data_dir_mode": True}
+    failures: list[str] = []
+    proc = cluster = runner = ctrl = factory = None
+    churn_stop = threading.Event()
+    try:
+        proc = ApiServerProcess(data_dir=data_dir)
+        proc.start()
+        result["readyz_cold_s"] = round(proc.wait_ready(60.0), 3)
+        url = proc.url
+
+        t0 = time.time()
+        cluster = HollowCluster(
+            HTTPClient(url, timeout=60.0), n_hollow, prefix="dz",
+            heartbeat_period=heartbeat_period).start(wait_sync=60.0)
+        result["register_s"] = round(time.time() - t0, 2)
+        log(f"  {n_hollow} hollow nodes registered in "
+            f"{result['register_s']}s")
+
+        # node lifecycle with DISRUPTION PROTECTION: the outage makes
+        # every lease stale past grace at once — exactly the mass-unready
+        # signal the partial/full-disruption modes exist to distrust
+        ctrl = NodeLifecycleController(
+            HTTPClient(url, timeout=30.0), grace_period=grace_s,
+            monitor_period=0.5)
+        factory = InformerFactory(ctrl.client)
+        ctrl.register(factory)
+        factory.start_all()
+        assert factory.wait_for_cache_sync(30.0)
+        ctrl.start()
+
+        runner = SchedulerRunner(
+            HTTPClient(url),
+            SchedulerConfiguration(batch_size=64, max_drain_batches=2))
+        runner.auditor = _bench_auditor(runner, HTTPClient(url))
+        runner.start(wait_sync=60.0)
+
+        client = HTTPClient(url, timeout=60.0)
+        pods = [make_pod(f"dz-{i}", "default")
+                .req({"cpu": "100m", "memory": "64Mi"}).obj().to_dict()
+                for i in range(n_pods)]
+        t_bind = time.time()
+        client.pods("default").create_many(pods)
+        deadline = t_bind + timeout
+        while time.time() < deadline:
+            if not _unbound(client, ("default",)):
+                break
+            time.sleep(0.25)
+        result["initial_bind_s"] = round(time.time() - t_bind, 2)
+        log(f"  initial {n_pods} pods bound at "
+            f"+{result['initial_bind_s']}s")
+
+        churn_stats: dict = {}
+        threading.Thread(target=_pod_churn_loop,
+                         args=(HTTPClient(url, timeout=30.0), churn_stop,
+                               churn_stats),
+                         daemon=True).start()
+        time.sleep(4.0)  # churn warm-up: steady state before the crash
+
+        # ---- the disaster -----------------------------------------------
+        evictions_before = ctrl.evictions
+        engaged_before = ctrl.engaged_count
+        log(f"  SIGKILL apiserver (pid alive={proc.alive}); "
+            f"outage {outage_s}s ...")
+        t_kill = time.time()
+        proc.kill()
+        time.sleep(outage_s)
+        modes_during = ctrl.mode
+        try:
+            restart_ready_s = proc.restart(ready_timeout=60.0)
+            result["readyz_restart_s"] = round(restart_ready_s, 3)
+        except Exception as e:
+            failures.append(f"restart never reached /readyz 200: {e}")
+            raise
+        result["outage_total_s"] = round(time.time() - t_kill, 2)
+        log(f"  restarted from WAL in {result['readyz_restart_s']}s "
+            f"(mode during outage: {modes_during})")
+
+        # time-to-first-bind-after-restart: a fresh probe pod through the
+        # full heal path (informer relist -> queue -> drain -> bind)
+        probe = make_pod("probe-restart", "default").req(
+            {"cpu": "100m"}).obj().to_dict()
+        t_probe = time.time()
+        probe_client = HTTPClient(url, timeout=30.0, retry_attempts=6)
+        probe_client.pods("default").create(probe)
+        bound_at = None
+        while time.time() - t_probe < max(bind_slo_s * 3, 30.0):
+            try:
+                p = probe_client.pods("default").get("probe-restart")
+            except Exception:
+                time.sleep(0.2)  # reconnect blip; the poll budget absorbs it
+                continue
+            if (p.get("spec") or {}).get("nodeName"):
+                bound_at = time.time() - t_probe
+                break
+            time.sleep(0.2)
+        result["first_bind_after_restart_s"] = (
+            round(bound_at, 2) if bound_at is not None else None)
+        log(f"  probe pod bound {result['first_bind_after_restart_s']}s "
+            "after restart")
+
+        # ---- heal + settle ----------------------------------------------
+        settle_deadline = time.time() + settle_timeout
+        while time.time() < settle_deadline and ctrl.mode != MODE_NORMAL:
+            time.sleep(0.5)
+        churn_stop.set()
+        time.sleep(1.0)
+        while time.time() < settle_deadline:
+            # converged = every pod bound AND no lifecycle taint residue
+            # (a 409-delayed taint removal retries on the next sweep —
+            # give it the chance instead of failing on a snapshot race)
+            if not _unbound(client) and not _lifecycle_taints(client):
+                break
+            time.sleep(0.5)
+        unbound = _unbound(client)
+        result["unbound"] = unbound[:20]
+        result["churn_api_ops"] = churn_stats.get("ops", 0)
+        result["churn_errors"] = churn_stats.get("errors", 0)
+        result["fleet"] = cluster.fleet_stats()
+        result["disruption"] = ctrl.disruption_status()
+        taints = _lifecycle_taints(client)
+        result["lifecycle_taints"] = taints[:20]
+        result["outage_evictions"] = ctrl.evictions - evictions_before
+        result.update(_audit_close(runner))
+
+        # ---- the gates (missing number = failure) -----------------------
+        if unbound:
+            failures.append(f"{len(unbound)} pods never bound after the "
+                            f"restart (first: {unbound[:5]})")
+        fb = result["first_bind_after_restart_s"]
+        if not isinstance(fb, (int, float)):
+            failures.append("time-to-first-bind-after-restart missing — "
+                            "the probe pod never bound")
+        elif fb > bind_slo_s:
+            failures.append(f"first bind after restart took {fb}s "
+                            f"(gate {bind_slo_s}s)")
+        if result["outage_evictions"]:
+            failures.append(f"{result['outage_evictions']} outage-caused "
+                            "evictions (disruption mode failed)")
+        if taints:
+            failures.append(f"lifecycle taints survived the heal: "
+                            f"{taints[:5]}")
+        if ctrl.engaged_count <= engaged_before:
+            failures.append("disruption mode never engaged — the outage "
+                            "was not observed as mass-unready (protection "
+                            "untested = failure)")
+        if ctrl.mode != MODE_NORMAL:
+            failures.append(f"disruption mode never released "
+                            f"(still {ctrl.mode})")
+        if result.get("invariant_violations"):
+            failures.append(f"{result['invariant_violations']} confirmed "
+                            "invariant violations")
+        if "readyz_restart_s" not in result:
+            failures.append("readyz-after-restart missing")
+    except Exception as e:  # a dead bench must fail loudly, not silently
+        failures.append(f"bench crashed: {type(e).__name__}: {e}")
+        result.setdefault("invariant_violations", None)
+    finally:
+        churn_stop.set()
+        for closer in (
+                (lambda: runner.stop()) if runner is not None else None,
+                (lambda: ctrl.stop()) if ctrl is not None else None,
+                (lambda: factory.stop_all()) if factory is not None else None,
+                (lambda: cluster.stop()) if cluster is not None else None,
+                (lambda: proc.stop()) if proc is not None else None):
+            if closer is not None:
+                try:
+                    closer()
+                except Exception:
+                    pass
+        shutil.rmtree(data_dir, ignore_errors=True)
+    result["slo_failures"] = failures
+    return result
+
+
+if __name__ == "__main__":
+    import json
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    res = run_disaster_churn(
+        n_hollow=int(os.environ.get("BENCH_DISASTER_NODES", "48")),
+        n_pods=int(os.environ.get("BENCH_DISASTER_PODS", "96")),
+        outage_s=float(os.environ.get("BENCH_DISASTER_OUTAGE_S", "16")),
+        bind_slo_s=float(os.environ.get("BENCH_DISASTER_BIND_SLO", "10")),
+        log=lambda *a: print(*a, file=sys.stderr))
+    print(json.dumps(res))
+    if res.get("slo_failures") or res.get("invariant_violations"):
+        sys.exit(1)

@@ -33,6 +33,8 @@ _EXPORTS = {
     "chaos_point": "hooks",
     "ChaosClient": "api", "ChaosResource": "api", "ChaosWatch": "api",
     "DeviceChaos": "device",
+    "ApiServerProcess": "apiserver", "InProcessApiServer": "apiserver",
+    "free_port": "apiserver",
 }
 
 __all__ = sorted(_EXPORTS) + ["hooks"]

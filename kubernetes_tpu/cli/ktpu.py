@@ -589,35 +589,90 @@ def _fleet_line(fleet: dict) -> str:
             f"(batch {le.get('lastBatch', 0)})\n")
 
 
+def _durability_line(dur: dict) -> str:
+    """One-line apiserver durability summary (data_dir mode): WAL growth
+    since the last snapshot fold, snapshot age, what the last restore
+    cost, and the readyz verdict."""
+    import time as _time
+    snap_ts = dur.get("lastSnapshotTime")
+    age = (f"{max(0.0, _time.time() - float(snap_ts)):.0f}s ago"
+           if snap_ts else "never")
+    replay = dur.get("replayMs")
+    torn = dur.get("tornTailsDropped") or 0
+    return (f"Durability:    WAL {dur.get('walEntriesSinceSnapshot', 0)} "
+            f"entries since snapshot ({age}), last replay "
+            f"{replay if replay is not None else '?'}ms"
+            f" ({dur.get('walEntriesReplayed', 0)} entries"
+            + (f", {torn} torn tail dropped" if torn else "")
+            + f"), readyz {'ok' if dur.get('ready') else 'NOT READY'}\n")
+
+
+def _disruption_line(dis: dict) -> str:
+    """One-line node-lifecycle disruption-mode summary."""
+    mode = dis.get("mode", "Normal")
+    frac = dis.get("unreadyFraction", 0.0)
+    extra = ""
+    if mode != "Normal":
+        extra = (" — EVICTIONS "
+                 + ("HALTED" if dis.get("evictionsHalted")
+                    else "at secondary rate"))
+    return (f"Disruption:    {mode} "
+            f"({frac:.0%} of {dis.get('nodes', 0)} nodes unready; "
+            f"engaged {dis.get('engagedCount', 0)}x, "
+            f"evictions {dis.get('evictions', 0)}, "
+            f"deferred {dis.get('evictionsDeferred', 0)}, "
+            f"taints suppressed {dis.get('taintsSuppressed', 0)})"
+            f"{extra}\n")
+
+
 def cmd_status(client: HTTPClient, args, out) -> int:
     """ktpu status: the connected scheduler's published deployment shape
     (the ``kubernetes-tpu-scheduler-status`` ConfigMap) — most importantly
     the active device mesh the drain/dispatch path runs under."""
+    from kubernetes_tpu.controllers.nodelifecycle import (
+        NODELIFECYCLE_CONFIGMAP)
     from kubernetes_tpu.kubelet.kubemark import FLEET_CONFIGMAP
     from kubernetes_tpu.sched.runner import STATUS_CONFIGMAP
-    # hollow-fleet shape/rates (published by HollowCluster; absent when no
-    # fleet runs against this apiserver)
-    fleet = None
-    try:
-        fcm = client.resource("configmaps", args.namespace).get(
-            FLEET_CONFIGMAP)
-        fleet = json.loads((fcm.get("data") or {}).get("fleet", "{}")
-                           or "{}")
-    except ApiError as e:
-        if e.code != 404:
-            raise
+    from kubernetes_tpu.store.apiserver import APISERVER_CONFIGMAP
+
+    def _aux_cm(name: str, key: str):
+        # sibling status ConfigMaps (fleet / apiserver durability /
+        # nodelifecycle disruption); absent when that component isn't
+        # running against this apiserver
+        try:
+            cm_ = client.resource("configmaps", args.namespace).get(name)
+            return json.loads((cm_.get("data") or {}).get(key, "{}")
+                              or "{}")
+        except ApiError as e:
+            if e.code != 404:
+                raise
+            return None
+
+    fleet = _aux_cm(FLEET_CONFIGMAP, "fleet")
+    durability = _aux_cm(APISERVER_CONFIGMAP, "durability")
+    disruption = _aux_cm(NODELIFECYCLE_CONFIGMAP, "disruption")
     try:
         cm = client.resource("configmaps", args.namespace).get(
             STATUS_CONFIGMAP)
     except ApiError as e:
         if e.code != 404:
             raise
-        if fleet is not None:
-            # a fleet without a scheduler is still worth reporting
+        aux = {k: v for k, v in (("fleet", fleet),
+                                 ("durability", durability),
+                                 ("disruption", disruption))
+               if v is not None}
+        if aux:
+            # a fleet/durable-apiserver/lifecycle-controller without a
+            # scheduler is still worth reporting
             if args.output == "json":
-                out.write(json.dumps({"fleet": fleet}) + "\n")
+                out.write(json.dumps(aux) + "\n")
             else:
-                out.write(_fleet_line(fleet))
+                if durability is not None:
+                    out.write(_durability_line(durability))
+                if disruption is not None:
+                    out.write(_disruption_line(disruption))
+                if fleet is not None:
+                    out.write(_fleet_line(fleet))
             return 0
         out.write("error: no scheduler status published "
                   f"(configmap {STATUS_CONFIGMAP!r} not found in "
@@ -628,6 +683,10 @@ def cmd_status(client: HTTPClient, args, out) -> int:
         st = json.loads(data.get("status", "{}") or "{}")
         if fleet is not None:
             st["fleet"] = fleet
+        if durability is not None:
+            st["durability"] = durability
+        if disruption is not None:
+            st["disruption"] = disruption
         out.write(json.dumps(st) + "\n")
         return 0
     st = json.loads(data.get("status", "{}") or "{}")
@@ -692,6 +751,10 @@ def cmd_status(client: HTTPClient, args, out) -> int:
                   f"({flight.get('pods', 0)} pod timelines, "
                   f"dropped {flight.get('droppedPods', 0)}) — "
                   "ktpu trace dump\n")
+    if durability is not None:
+        out.write(_durability_line(durability))
+    if disruption is not None:
+        out.write(_disruption_line(disruption))
     if fleet is not None:
         out.write(_fleet_line(fleet))
     res = st.get("resilience")

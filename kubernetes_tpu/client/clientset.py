@@ -511,11 +511,23 @@ class HTTPClient(_Handles):
     def __init__(self, base_url: str, timeout: float = 10.0,
                  token: Optional[str] = None,
                  impersonate: Optional[str] = None,
-                 wire: str = "msgpack", user_agent: str = ""):
+                 wire: str = "msgpack", user_agent: str = "",
+                 retry_attempts: int = 3, retry_base_s: float = 0.05,
+                 retry_cap_s: float = 2.0):
         self.base = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token
         self.impersonate = impersonate
+        # Outage discipline: transport-level failures (connection refused/
+        # reset storms while the apiserver restarts) retry up to
+        # ``retry_attempts`` times with capped FULL-JITTER exponential
+        # backoff — a thousand clients re-converging on the second the
+        # server comes back is its own outage. The budget is deliberately
+        # small: the client absorbs blips; callers' own loops (informer
+        # relist backoff, batcher shard backoff) own multi-second outages.
+        self.retry_attempts = max(0, int(retry_attempts))
+        self.retry_base_s = retry_base_s
+        self.retry_cap_s = retry_cap_s
         # identifies the component to the server (upstream clients always
         # send one); APF flow schemas match on it for unauthenticated flows
         self.user_agent = user_agent
@@ -622,8 +634,9 @@ class HTTPClient(_Handles):
         path = url[len(self.base):] or "/"
         all_headers = {"Content-Type": ctype, "Accept": ctype,
                        **self._auth_headers(), **(headers or {})}
-        # One retry on transport-level failures (reset/refused under load
-        # bursts, or a keep-alive socket the server closed between requests).
+        # Transport-level failures (reset/refused under load bursts or a
+        # restarting apiserver, or a keep-alive socket the server closed
+        # between requests) retry with capped full-jitter backoff.
         # A retried NAMED write that actually committed surfaces as
         # 409/AlreadyExists — the expected optimistic-concurrency outcome.
         # generateName creates are NOT idempotent (the server mints a fresh
@@ -691,9 +704,17 @@ class HTTPClient(_Handles):
                 if reused and not stale_retry_used:
                     stale_retry_used = True
                     continue
-                if attempt == 0 and retriable:
-                    attempt = 1
-                    time.sleep(0.05)
+                if attempt < self.retry_attempts and retriable:
+                    # full jitter in (0, base * 2^attempt] capped: during a
+                    # refused/reset storm every waiter picks an independent
+                    # uniform delay, so the reconnect wave spreads instead
+                    # of thundering the restarted server
+                    import random
+                    delay = min(self.retry_cap_s,
+                                self.retry_base_s * (2 ** attempt))
+                    time.sleep(random.uniform(0.0, delay)
+                               or self.retry_base_s / 2)
+                    attempt += 1
                     continue
                 raise
 
@@ -870,9 +891,19 @@ class _HTTPWatch:
             headers["Accept"] = _MSGPACK_CT
         # read timeout doubles as the liveness window: the server heartbeats
         # every ~1s, so a blocking read that times out means a dead peer.
-        self._resp = urllib.request.urlopen(
-            urllib.request.Request(self._url, headers=headers),
-            timeout=self.HEARTBEAT_GRACE)
+        try:
+            self._resp = urllib.request.urlopen(
+                urllib.request.Request(self._url, headers=headers),
+                timeout=self.HEARTBEAT_GRACE)
+        except urllib.error.HTTPError as e:
+            if e.code == 410:
+                # DirectClient parity: a compacted-away resourceVersion
+                # (typical right after an apiserver restart: the restore
+                # floor advanced past every pre-restart rv) raises TooOld
+                # so the informer relists IMMEDIATELY instead of riding
+                # the generic-error backoff through a healing window
+                raise TooOld(f"watch rv compacted: {e.reason}") from None
+            raise
         got_ct = self._resp.headers.get("Content-Type") or ""
         self._unpacker = (_client_msgpack.Unpacker()
                           if _MSGPACK_CT in got_ct else None)

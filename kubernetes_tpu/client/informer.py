@@ -117,6 +117,17 @@ class SharedInformer:
         # assert the healing actually ran, surfaced in ktpu status
         self.relists = 0
         self.last_relist: Optional[float] = None
+        # set while a watch gap is OPEN (stream died / list failing /
+        # TooOld), cleared by the successful relist: consumers whose
+        # decisions hinge on data freshness (node-lifecycle staleness
+        # judgments) check this before trusting the cache's age.
+        # last_gap_end/_duration record the most recently HEALED gap so
+        # those consumers can distinguish a multi-second outage (grant a
+        # fresh grace window) from a routine sub-second TooOld relist
+        # under churn (which must not suppress anything).
+        self.gap_since: Optional[float] = None
+        self.last_gap_end: Optional[float] = None
+        self.last_gap_duration = 0.0
 
     def add_event_handler(self, fn: Callable):
         self._handlers.append(fn)
@@ -151,11 +162,22 @@ class SharedInformer:
                     WATCH_RELISTS.inc(
                         {"resource": getattr(self.resource, "plural", "?")})
                 self._synced.set()
+                gs = self.gap_since
+                if gs is not None:  # list succeeded: the gap healed
+                    self.last_gap_duration = time.time() - gs
+                    self.last_gap_end = time.time()
+                    self.gap_since = None
                 self._watch_loop(rv)
+                if not self._stop.is_set():
+                    # stream died (server restart / truncation): the cache
+                    # ages untracked until the relist above heals it
+                    self.gap_since = self.gap_since or time.time()
                 backoff = 0.1
             except TooOld:
+                self.gap_since = self.gap_since or time.time()
                 continue  # immediate relist
             except Exception:
+                self.gap_since = self.gap_since or time.time()
                 LOOP_ERRORS.inc({"site": "informer_listwatch"})
                 _LOG.debug("list/watch failed; backing off %.1fs",
                            backoff, exc_info=True)

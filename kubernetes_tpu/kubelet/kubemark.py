@@ -32,9 +32,12 @@ import threading
 import time
 import zlib
 
+import random
+
 from kubernetes_tpu.client.informer import SharedInformer
 from kubernetes_tpu.kubelet.kubelet import HollowNode
 from kubernetes_tpu.metrics.registry import (
+    BATCHER_DROPS,
     BATCHER_QUEUE_DEPTH,
     HEARTBEAT_BATCH,
     LEASE_BATCH,
@@ -65,9 +68,25 @@ class _ShardedBatcher:
     bulk requests per period instead of one thundering batch.
 
     Subclasses define ``_items(members, queued)`` (what one sweep sends)
-    and ``_flush(chunk)`` (the bulk transport + heal handling)."""
+    and ``_flush(chunk) -> bool`` (the bulk transport + heal handling).
+
+    Outage discipline (the apiserver dies and comes back): a shard whose
+    flush fails BACKS OFF with full jitter (period doubling per
+    consecutive failure, capped) instead of hot-looping refused
+    connections through the client's own retry budget; push-mode entries
+    (``requeue_failed`` — pod statuses) re-coalesce into the shard queue
+    by key, newest payload winning, bounded by ``max_queued`` with drops
+    counted; member-driven payloads (heartbeats, leases) are NOT
+    requeued — the next sweep regenerates them, so a failed flush can
+    neither duplicate members into the next flush nor resurrect a
+    member removed mid-outage. The first successful flush after an
+    outage fires ``_on_reconnect`` (the heartbeat batcher drops its
+    fingerprints there so every member's status re-asserts promptly)."""
 
     batcher = "?"  # queue-depth gauge label
+    requeue_failed = False  # push-mode batchers re-coalesce failed chunks
+    max_queued = 4096       # bound on re-coalesced entries per shard
+    backoff_cap_s = 10.0    # outage backoff ceiling per shard
 
     def __init__(self, client, period_s: float, shards: int = 4,
                  max_batch: int = 512, phase: float = 0.0):
@@ -79,6 +98,7 @@ class _ShardedBatcher:
         self._locks = [threading.Lock() for _ in range(self.n_shards)]
         self._members: list[dict] = [{} for _ in range(self.n_shards)]
         self._queued: list[dict] = [{} for _ in range(self.n_shards)]
+        self._errs = [0] * self.n_shards  # consecutive flush failures
         self._stop = threading.Event()
         self._t0 = time.monotonic()
         # counters are shared across the K shard threads (and flush_all
@@ -90,6 +110,9 @@ class _ShardedBatcher:
         self.items = 0
         self.last_batch = 0
         self.errors = 0
+        self.drops = 0
+        self.requeued = 0
+        self.reconnects = 0
         self._threads = [
             threading.Thread(target=self._shard_loop, args=(i,), daemon=True)
             for i in range(self.n_shards)]
@@ -126,6 +149,10 @@ class _ShardedBatcher:
         with self._locks[self._shard_of(name)]:
             return self._members[self._shard_of(name)].get(name)
 
+    def _alive(self, name: str) -> bool:
+        k = self.member(name)
+        return k is not None and not getattr(k, "dead", False)
+
     # ---- sweep machinery -------------------------------------------------
 
     def _phase_delay(self, i: int) -> float:
@@ -137,8 +164,20 @@ class _ShardedBatcher:
 
     def _shard_loop(self, i: int) -> None:
         self._stop.wait(self._phase_delay(i))
-        while not self._stop.wait(self.period_s):
+        while not self._stop.wait(self._next_wait(i)):
             self._sweep(i)
+
+    def _next_wait(self, i: int) -> float:
+        """Healthy shards sweep on the period; a shard mid-outage doubles
+        its wait per consecutive failure (capped) with half-range jitter,
+        so a restarted apiserver sees a spread reconnect trickle instead
+        of K shards x N batchers thundering the first second it binds."""
+        errs = self._errs[i]
+        if not errs:
+            return self.period_s
+        backoff = min(self.backoff_cap_s,
+                      self.period_s * (2 ** min(errs, 8)))
+        return backoff * (0.5 + random.random() * 0.5)
 
     def _sweep(self, i: int) -> None:
         # entry building stays under the shard lock: _member_payload
@@ -160,8 +199,52 @@ class _ShardedBatcher:
         BATCHER_QUEUE_DEPTH.set(len(entries), {"batcher": self.batcher,
                                                "shard": str(i)})
         batch = list(entries.items())
+        ok_all = True
         for j in range(0, len(batch), self.max_batch):
-            self._flush(batch[j:j + self.max_batch])
+            chunk = batch[j:j + self.max_batch]
+            if not self._flush(chunk):
+                ok_all = False
+                self._requeue(i, chunk)
+        if not batch:
+            return
+        if ok_all:
+            if self._errs[i]:
+                self._errs[i] = 0
+                with self._stats_lock:
+                    self.reconnects += 1
+                self._on_reconnect(i)
+        else:
+            self._errs[i] += 1
+
+    def _requeue(self, i: int, chunk: list) -> None:
+        """Re-coalesce a failed chunk for the next sweep (push-mode
+        batchers only). Newest-wins: an entry whose key gained a fresher
+        queued payload during the flush keeps the fresh one; members are
+        never requeued (the sweep regenerates their payloads — requeueing
+        would duplicate them, and a member removed mid-outage would
+        resurrect); the queue is bounded, drops counted."""
+        if not self.requeue_failed:
+            return
+        dropped = requeued = 0
+        with self._locks[i]:
+            q = self._queued[i]
+            for name, payload in chunk:
+                if name in q or name in self._members[i]:
+                    continue
+                if len(q) >= self.max_queued:
+                    dropped += 1
+                    continue
+                q[name] = payload
+                requeued += 1
+        if dropped:
+            BATCHER_DROPS.inc({"batcher": self.batcher}, by=dropped)
+        if dropped or requeued:
+            with self._stats_lock:
+                self.drops += dropped
+                self.requeued += requeued
+
+    def _on_reconnect(self, i: int) -> None:
+        """First successful flush after >= 1 failed sweep on shard ``i``."""
 
     def flush_all(self) -> None:
         """Synchronous sweep of every shard (shutdown + tests)."""
@@ -177,7 +260,9 @@ class _ShardedBatcher:
         elapsed = max(time.monotonic() - self._t0, 1e-9)
         return {"shards": self.n_shards, "flushes": self.flushes,
                 "items": self.items, "lastBatch": self.last_batch,
-                "errors": self.errors,
+                "errors": self.errors, "drops": self.drops,
+                "requeued": self.requeued, "reconnects": self.reconnects,
+                "backingOff": sum(1 for e in self._errs if e),
                 "itemsPerS": round(self.items / elapsed, 2)}
 
     def _count(self, n_items: int) -> None:
@@ -195,7 +280,9 @@ class _ShardedBatcher:
     def _member_payload(self, kubelet):
         return None
 
-    def _flush(self, chunk: list) -> None:
+    def _flush(self, chunk: list) -> bool:
+        """Send one chunk; -> False on a transport-level failure (the
+        shard backs off and, for push-mode batchers, requeues)."""
         raise NotImplementedError
 
 
@@ -218,6 +305,10 @@ class _HeartbeatBatcher(_ShardedBatcher):
     thin by the same factor."""
 
     batcher = "heartbeat"
+    # liveness signals must re-assert FAST after an outage: nodelifecycle
+    # measures staleness against its grace period, so the reconnect
+    # backoff ceiling has to sit well under any sane grace
+    backoff_cap_s = 5.0
 
     def __init__(self, client, period_s: float, shards: int = 4,
                  max_batch: int = 512, phase: float = 0.0,
@@ -261,7 +352,18 @@ class _HeartbeatBatcher(_ShardedBatcher):
         self._beats.pop(name, None)
         self._fps.pop(name, None)
 
-    def _flush(self, chunk: list) -> None:
+    def _on_reconnect(self, i: int) -> None:
+        # outage heal: drop shard i's members' fingerprints so every
+        # member's full status re-asserts over the next sweeps — an
+        # apiserver restored from its WAL holds pre-outage conditions, and
+        # a changed-but-fp-suppressed payload would otherwise wait out the
+        # 30-sweep refresh backstop (pops are GIL-atomic, like _flush's)
+        with self._locks[i]:
+            names = list(self._members[i])
+        for name in names:
+            self._fps.pop(name, None)
+
+    def _flush(self, chunk: list) -> bool:
         from kubernetes_tpu.utils.tracing import TRACER
         try:
             with TRACER.span("kubelet/heartbeat", nodes=len(chunk)):
@@ -275,7 +377,7 @@ class _HeartbeatBatcher(_ShardedBatcher):
             for name, _ in chunk:
                 self._fps.pop(name, None)
             self._count_error()
-            return
+            return False
         HEARTBEAT_BATCH.observe(len(chunk))
         self._count(len(chunk))
         missing = [name for (name, _), e in zip(chunk, errs)
@@ -288,6 +390,7 @@ class _HeartbeatBatcher(_ShardedBatcher):
             for name in missing:
                 self._fps.pop(name, None)
             self._reregister(missing)
+        return True
 
     def _reregister(self, names: list[str]) -> None:
         # only LIVE members re-register: a scale-down's delete racing an
@@ -313,11 +416,13 @@ class _LeaseBatcher(_ShardedBatcher):
     GC'd lease) are created in bulk and renew next period."""
 
     batcher = "lease"
+    # THE liveness signal: reconnect backoff capped low (see heartbeat)
+    backoff_cap_s = 5.0
 
     def _member_payload(self, kubelet):
         return time.time()
 
-    def _flush(self, chunk: list) -> None:
+    def _flush(self, chunk: list) -> bool:
         from kubernetes_tpu.utils.tracing import TRACER
         now = time.time()
         items = [(name, rt if rt is not None else now) for name, rt in chunk]
@@ -327,11 +432,15 @@ class _LeaseBatcher(_ShardedBatcher):
                 errs = leases.renew_many(items)
         except Exception:
             self._count_error()
-            return
+            return False
         LEASE_BATCH.observe(len(items))
         self._count(len(items))
+        # only LIVE members get their missing lease created: a scale-down
+        # racing an in-flight flush must not resurrect a removed node's
+        # lease (a one-shot zombie renewTime would keep node-lifecycle
+        # treating the deleted node as alive for a whole grace period)
         missing = [(name, rt) for (name, rt), e in zip(items, errs)
-                   if e and "not found" in e]
+                   if e and "not found" in e and self._alive(name)]
         if missing:
             try:
                 leases.create_many([
@@ -344,6 +453,7 @@ class _LeaseBatcher(_ShardedBatcher):
                     for name, rt in missing])
             except Exception:
                 pass  # AlreadyExists raced another creator; next period wins
+        return True
 
 
 class _StatusBatcher(_ShardedBatcher):
@@ -361,6 +471,11 @@ class _StatusBatcher(_ShardedBatcher):
     section before the apiserver broke a sweat."""
 
     batcher = "status"
+    # a status is pushed ONCE per transition: a flush lost to an apiserver
+    # outage must re-coalesce (newest-wins per pod, bounded, drops
+    # counted) or Running pods would stay Pending until the kubelet's
+    # next full sync long after the server came back
+    requeue_failed = True
 
     def __init__(self, client, flush_s: float = 0.05, max_batch: int = 512,
                  shards: int = 4):
@@ -374,7 +489,7 @@ class _StatusBatcher(_ShardedBatcher):
     def flush(self) -> None:
         self.flush_all()
 
-    def _flush(self, chunk: list) -> None:
+    def _flush(self, chunk: list) -> bool:
         from kubernetes_tpu.utils.tracing import TRACER
         items = [(key.split("/", 1)[0], key.split("/", 1)[1], st)
                  for key, st in chunk]
@@ -382,12 +497,11 @@ class _StatusBatcher(_ShardedBatcher):
             with TRACER.span("kubemark/status_flush", pods=len(items)):
                 self.client.pods("default").update_status_many(items)
         except Exception:
-            # best-effort transport: the next sync re-asserts status
-            # (the kubelet, not the batcher, is the source of truth)
             self._count_error()
-            return
+            return False
         STATUS_BATCH.observe(len(items))
         self._count(len(items))
+        return True
 
 
 class HollowCluster:
@@ -600,20 +714,11 @@ class HollowCluster:
         """Best-effort: write the fleet stats ConfigMap ``ktpu status``
         reads. Publishing must never take the fleet down."""
         import json
-        body = {"apiVersion": "v1", "kind": "ConfigMap",
-                "metadata": {"name": FLEET_CONFIGMAP,
-                             "namespace": "default"},
-                "data": {"fleet": json.dumps(self.fleet_stats())}}
-        cms = self.client.resource("configmaps", "default")
-        try:
-            cur = cms.get(FLEET_CONFIGMAP)
-            cur["data"] = body["data"]
-            cms.update(cur)
-        except Exception:
-            try:
-                cms.create(body)
-            except Exception:
-                pass
+
+        from kubernetes_tpu.utils.configmap import upsert_configmap
+        upsert_configmap(self.client, "default", FLEET_CONFIGMAP,
+                         {"fleet": json.dumps(self.fleet_stats())},
+                         site="fleet_publish")
 
     def _publish_loop(self) -> None:
         while not self._stop.wait(5.0):

@@ -392,6 +392,36 @@ BATCHER_QUEUE_DEPTH = REGISTRY.gauge(
     "kubemark_batcher_queue_depth",
     "Entries queued in a fleet batcher at its last flush, by batcher "
     "(heartbeat | lease | status)")
+BATCHER_DROPS = REGISTRY.counter(
+    "kubemark_batcher_drops_total",
+    "Entries a fleet batcher dropped because its bounded re-coalesce "
+    "queue was full during an apiserver outage, by batcher — dropped "
+    "payloads heal via the next sync/sweep re-assert, but silently so "
+    "no longer")
+
+# Disaster recovery (the apiserver-crash-restart campaign): the durable
+# store's crash-tolerance evidence and the node-lifecycle mass-unready
+# protection that keeps an outage from cascading into eviction storms.
+WAL_TORN_TAIL = REGISTRY.counter(
+    "store_wal_torn_tail_total",
+    "Torn trailing WAL records dropped (and truncated off disk) during "
+    "restore — each one is a write that never committed before a crash "
+    "(SIGKILL mid-append)")
+DISRUPTION_MODE = REGISTRY.gauge(
+    "nodelifecycle_disruption_mode",
+    "Node-lifecycle disruption mode: 0 = Normal, 1 = PartialDisruption "
+    "(unready fraction >= unhealthyZoneThreshold: evictions at the "
+    "reduced secondary rate, or halted in small clusters), 2 = "
+    "FullDisruption (every node unready: taint/evict halted entirely — "
+    "the signal, not the fleet, is presumed broken)")
+NODELIFE_EVICTIONS = REGISTRY.counter(
+    "nodelifecycle_evictions_total",
+    "Pods evicted by the node-lifecycle NoExecute taint path")
+NODELIFE_DEFERRED = REGISTRY.counter(
+    "nodelifecycle_evictions_deferred_total",
+    "Evictions deferred by disruption-mode rate limiting (halted mode "
+    "or the secondary-rate token bucket) — retried by the next monitor "
+    "sweep if the node is still unhealthy")
 
 # Scheduler informer hygiene at fleet scale: node MODIFIEDs whose only
 # news is liveness (heartbeat condition timestamps / lease-driven

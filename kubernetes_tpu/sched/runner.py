@@ -642,29 +642,9 @@ class SchedulerRunner:
     def _publish_configmap(self, name: str, data: dict) -> None:
         """Create-or-update one of the runner's published ConfigMaps.
         Best effort — publishing must never take the scheduler down."""
-        body = {"apiVersion": "v1", "kind": "ConfigMap",
-                "metadata": {"name": name,
-                             "namespace": self.status_namespace},
-                "data": data}
-        cms = self.client.resource("configmaps", self.status_namespace)
-        try:
-            current = cms.get(name)
-            current["data"] = data
-            cms.update(current)
-        except ApiError as e:
-            if e.code != 404:
-                LOOP_ERRORS.inc({"site": "publish_status"})
-                _LOG.debug("%s ConfigMap update failed: %s", name, e)
-                return
-            try:
-                cms.create(body)
-            except ApiError:
-                LOOP_ERRORS.inc({"site": "publish_status"})
-                _LOG.debug("%s ConfigMap create failed", name,
-                           exc_info=True)
-        except Exception:
-            LOOP_ERRORS.inc({"site": "publish_status"})
-            _LOG.debug("%s ConfigMap publish failed", name, exc_info=True)
+        from kubernetes_tpu.utils.configmap import upsert_configmap
+        upsert_configmap(self.client, self.status_namespace, name, data,
+                         site="publish_status")
 
     def _publish_explanations(self, explanations: dict) -> None:
         """Explainer-thread callback: the scheduler-explanations ConfigMap

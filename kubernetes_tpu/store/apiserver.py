@@ -178,13 +178,36 @@ class _HTTPServer(ThreadingHTTPServer):
         super().handle_error(request, client_address)
 
 
+# ``ktpu status`` reads the apiserver's durability block (WAL growth,
+# snapshot age, replay cost, readyz state) from this ConfigMap — published
+# by durable-mode servers only (in-memory stores have nothing to report)
+APISERVER_CONFIGMAP = "kubernetes-tpu-apiserver-status"
+
+
 class APIServer:
     def __init__(self, store: Optional[ObjectStore] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 data_dir: Optional[str] = None):
+                 data_dir: Optional[str] = None,
+                 async_restore: bool = False):
         """``data_dir``: durable mode — the store journals every write and
-        restores state on construction (store.py WAL + snapshot)."""
-        self.store = store or ObjectStore(data_dir=data_dir)
+        restores state on construction (store.py WAL + snapshot).
+        ``async_restore``: defer the WAL replay to a background thread
+        started by ``start()`` — the server binds and serves immediately,
+        answering 503 on ``/readyz`` and every resource path until replay
+        completes (upstream's not-yet-ready startup window)."""
+        self._ready = threading.Event()
+        self._async_restore = async_restore and store is None and bool(data_dir)
+        if store is not None:
+            self.store = store
+        else:
+            self.store = ObjectStore(data_dir=data_dir,
+                                     defer_restore=self._async_restore)
+        if not self._async_restore:
+            # readiness is a property of the RESTORE, not of start():
+            # a synchronously-constructed store is already replayed, and
+            # embedders that serve this handler without start() (the
+            # aggregator's in-process delegate) must not 503
+            self._ready.set()
         from kubernetes_tpu.api.scheme import default_scheme
         # multi-version serving: (kind, served version) -> conversion pair
         # (runtime.Scheme analog, api/scheme.py); storage stays at the hub
@@ -205,6 +228,9 @@ class APIServer:
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        self._restore_thread: Optional[threading.Thread] = None
+        self._publish_thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
 
     # ---- CRDs (apiextensions.k8s.io) -------------------------------------
 
@@ -285,7 +311,16 @@ class APIServer:
     SYSTEM_NAMESPACES = ("default", "kube-system", "kube-public",
                          "kube-node-lease")
 
-    def start(self):
+    def _finish_startup(self):
+        """Restore (async mode), seed system namespaces, flip ready. In
+        async mode this runs on a background thread while the HTTP server
+        already answers 503s; synchronous starts run it inline BEFORE the
+        serve thread, preserving the original ordering."""
+        if self._stopping.is_set():
+            return  # stop() won the race: stay not-ready, touch nothing
+        self.store.finish_restore()
+        if self._stopping.is_set():
+            return
         # the system namespaces always exist (pkg/controlplane's
         # SystemNamespaces controller creates them on startup): namespaced
         # controllers like the root-CA publisher key off Namespace objects
@@ -296,12 +331,38 @@ class APIServer:
                     "status": {"phase": "Active"}})
             except AlreadyExists:
                 pass
+        # durable restore may already hold CRDs the empty pre-restore
+        # rebuild missed
+        self._rebuild_custom()
+        self._ready.set()
+        self.publish_durability()
+
+    def start(self):
+        if not self._async_restore:
+            self._finish_startup()
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        if self._async_restore:
+            self._restore_thread = threading.Thread(
+                target=self._finish_startup, daemon=True,
+                name="apiserver-restore")
+            self._restore_thread.start()
+        if getattr(self.store, "_data_dir", None):
+            self._publish_thread = threading.Thread(
+                target=self._publish_loop, daemon=True,
+                name="apiserver-status-publish")
+            self._publish_thread.start()
         return self
 
     def stop(self):
+        self._stopping.set()
+        if self._restore_thread is not None:
+            # an in-flight deferred restore must settle before the store
+            # closes: store._closed keeps a late finish_restore from
+            # reopening the WAL, but joining avoids even transient reads
+            # against a directory a successor may be replaying
+            self._restore_thread.join(timeout=10.0)
         if self._thread is not None:
             # shutdown() waits on an event only serve_forever() sets —
             # calling it on a never-started server deadlocks forever
@@ -314,8 +375,47 @@ class APIServer:
         self.store.close()
 
     @property
+    def ready(self) -> bool:
+        return self._ready.is_set()
+
+    def wait_ready(self, timeout: float = 30.0) -> bool:
+        return self._ready.wait(timeout)
+
+    @property
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
+
+    # ---- durability status (data_dir mode) -------------------------------
+
+    def durability_status(self) -> dict:
+        st = self.store.durability_stats()
+        st["ready"] = self._ready.is_set()
+        return st
+
+    def publish_durability(self) -> None:
+        """Best-effort write of the durability ConfigMap ``ktpu status``
+        reads (durable mode only — an in-memory store has no WAL to
+        report). Publishing must never take the server down."""
+        if not getattr(self.store, "_data_dir", None) or not self.ready:
+            return
+        body = {"apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": APISERVER_CONFIGMAP,
+                             "namespace": "default"},
+                "data": {"durability": json.dumps(self.durability_status())}}
+        try:
+            try:
+                cur = self.store.get("ConfigMap", "default",
+                                     APISERVER_CONFIGMAP)
+                cur["data"] = body["data"]
+                self.store.update("ConfigMap", cur)
+            except NotFound:
+                self.store.create("ConfigMap", body)
+        except Exception:
+            pass  # a racing writer or a closing store; next tick retries
+
+    def _publish_loop(self) -> None:
+        while not self._stopping.wait(5.0):
+            self.publish_durability()
 
     def enable_flow_control(self, controller=None):
         """Turn on API Priority and Fairness (store/flowcontrol.py)."""
@@ -417,11 +517,36 @@ class APIServer:
             def log_message(self, *a):
                 pass
 
+            def _not_ready(self):
+                """503 until WAL replay completes (async_restore): clients
+                must not read an empty pre-restore store as truth, and
+                /readyz is how orchestrators (and the chaos harness) know
+                the replay finished."""
+                self._drain_body()
+                self._last_code = 503
+                body = json.dumps({
+                    "kind": "Status", "status": "Failure",
+                    "message": "apiserver is not ready: WAL replay in "
+                               "progress", "reason": "ServiceUnavailable",
+                    "code": 503}).encode()
+                self.send_response(503)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After", "1")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _shaped(self, verb: str, fn):
                 # per-REQUEST state: one handler instance serves every
                 # request on a keep-alive connection
                 self._body_consumed = False
                 self._last_code = 200
+                if not server._ready.is_set():
+                    # only liveness + metrics answer during replay;
+                    # /readyz reports the replay itself as 503
+                    path = urlparse(self.path).path
+                    if path not in ("/healthz", "/livez", "/metrics"):
+                        return self._not_ready()
                 """The filter chain, in DefaultBuildHandlerChain order:
                 authn (401) -> audit -> impersonation (403) -> APF (429) ->
                 authz (403) -> handler. Watches are long-running and exempt

@@ -18,16 +18,28 @@ every ``wal_compact_every`` entries. A store opened on an existing data_dir
 restores snapshot + replays the journal tail — an apiserver restart keeps
 all pods/bindings, and watchers relist exactly as clients of a compacted
 etcd would (TooOld on pre-restart resourceVersions).
+
+Crash tolerance: a record commits when its trailing newline reaches the
+file. A SIGKILL mid-append leaves a torn final line; restore drops it
+(counting ``store_wal_torn_tail_total``) AND truncates the file back to
+the last committed record — the WAL reopens in append mode, and a fresh
+entry concatenated onto a torn line would corrupt a COMMITTED record at
+the next restore. ``defer_restore=True`` constructs the store without
+replaying (the apiserver's async-startup mode: serve /readyz 503 while
+``finish_restore()`` runs on a background thread).
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
 import queue
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
+
+_LOG = logging.getLogger("kubernetes_tpu.store")
 
 ADDED, MODIFIED, DELETED, ERROR = "ADDED", "MODIFIED", "DELETED", "ERROR"
 
@@ -143,7 +155,8 @@ class ObjectStore:
     wire shape; metadata.resourceVersion is stamped on every write."""
 
     def __init__(self, data_dir: Optional[str] = None,
-                 wal_compact_every: int = 4096, fsync: bool = False):
+                 wal_compact_every: int = 4096, fsync: bool = False,
+                 defer_restore: bool = False):
         self._lock = threading.Lock()
         self._rv = 0
         self._data: dict[str, dict[tuple[str, str], dict]] = {}
@@ -163,8 +176,31 @@ class ObjectStore:
         self._fsync = fsync
         self._wal = None
         self._wal_count = 0
+        self._closed = False
+        # durability observability (ktpu status Durability line / readyz)
+        self._last_snapshot_ts: Optional[float] = None
+        self._restore_stats: dict = {}
+        self._torn_tails = 0
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
+            if not defer_restore:
+                self.finish_restore()
+
+    def finish_restore(self) -> None:
+        """Replay snapshot + WAL and open the journal for appends. Called
+        from __init__ unless ``defer_restore``; the deferred form lets the
+        apiserver begin serving 503s while a long replay runs on a
+        background thread (readyz flips when this returns). Idempotent."""
+        if self._data_dir is None:
+            return  # nothing to replay, nothing to journal
+        with self._lock:
+            if self._wal is not None or self._closed:
+                # _closed: a deferred restore racing close() (server
+                # stopped before the replay thread ran) must NOT reopen
+                # the WAL — a successor process may already own the file,
+                # and this instance's appends would interleave stale-rv
+                # records into its journal
+                return
             self._restore_locked()
             self._wal = open(self._wal_path, "a", buffering=1)
 
@@ -253,35 +289,85 @@ class ObjectStore:
             self._wal.close()
         self._wal = open(self._wal_path, "w", buffering=1)
         self._wal_count = 0
+        import time as _time
+        self._last_snapshot_ts = _time.time()
 
     def _restore_locked(self):
-        """Snapshot + WAL tail -> memory. Called once from __init__ (no
-        watchers exist yet); a torn trailing WAL line (crash mid-write) is
-        discarded, matching a write that never committed."""
+        """Snapshot + WAL tail -> memory. Called once (no watchers exist
+        yet). A record is committed iff its trailing newline reached the
+        file: a SIGKILL mid-append leaves a torn final line, which is
+        dropped (counted in ``store_wal_torn_tail_total``) AND truncated
+        off the file — the WAL reopens for append, so surviving torn bytes
+        would merge with the next record and corrupt a COMMITTED write at
+        a later restore."""
+        import time as _time
+        t0 = _time.perf_counter()
+        stats: dict = {"snapshotLoaded": False, "walEntriesReplayed": 0,
+                       "tornTailDropped": 0}
         if os.path.exists(self._snap_path):
             with open(self._snap_path) as f:
                 data = json.load(f)
             self._rv = data["rv"]
             self._data = {kind: {tuple(obj_key(o)): o for o in objs}
                           for kind, objs in data["data"].items()}
+            stats["snapshotLoaded"] = True
+            try:
+                self._last_snapshot_ts = os.path.getmtime(self._snap_path)
+            except OSError:
+                pass
         if os.path.exists(self._wal_path):
-            with open(self._wal_path) as f:
-                for line in f:
+            good_end = 0  # byte offset just past the last committed record
+            torn = False
+            with open(self._wal_path, "rb") as f:
+                while True:
+                    line = f.readline()
+                    if not line:
+                        break
+                    if not line.endswith(b"\n"):
+                        torn = True  # mid-append kill: newline never landed
+                        break
                     try:
                         e = json.loads(line)
-                    except json.JSONDecodeError:
-                        break  # torn tail: uncommitted write
-                    rv = int(e["rv"])
+                        rv = int(e["rv"])
+                        op, kind = e["op"], e["kind"]
+                        key = (e["ns"], e["name"])
+                        obj = e.get("obj")
+                    except (ValueError, KeyError, TypeError):
+                        # torn or corrupt record: everything before it is
+                        # committed, nothing after it is trusted
+                        torn = True
+                        break
+                    good_end = f.tell()
                     if rv <= self._rv:
                         # already folded into the snapshot (crash between
                         # snapshot rename and WAL truncate)
                         continue
-                    space = self._data.setdefault(e["kind"], {})
-                    if e["op"] == "set":
-                        space[(e["ns"], e["name"])] = e["obj"]
-                    elif e["op"] == "del":
-                        space.pop((e["ns"], e["name"]), None)
+                    space = self._data.setdefault(kind, {})
+                    if op == "set":
+                        space[key] = obj
+                    elif op == "del":
+                        space.pop(key, None)
                     self._rv = max(self._rv, rv)
+                    stats["walEntriesReplayed"] += 1
+            if torn:
+                from kubernetes_tpu.metrics.registry import WAL_TORN_TAIL
+                WAL_TORN_TAIL.inc()
+                self._torn_tails += 1
+                stats["tornTailDropped"] = 1
+                _LOG.warning(
+                    "WAL %s has a torn tail (crash mid-append): dropping "
+                    "uncommitted bytes past offset %d", self._wal_path,
+                    good_end)
+                try:
+                    os.truncate(self._wal_path, good_end)
+                except OSError:
+                    _LOG.exception("could not truncate torn WAL tail; the "
+                                   "next append may corrupt a record")
+        # compaction cadence counts entries since the last snapshot, and
+        # survives restarts: a WAL that restores long must fold soon
+        self._wal_count = stats["walEntriesReplayed"]
+        stats["replayMs"] = round((_time.perf_counter() - t0) * 1000.0, 2)
+        self._restore_stats = stats
         self._floor_rv = self._rv
         self._reseed_service_ips_locked()
 
@@ -298,6 +384,27 @@ class ObjectStore:
                 seq = max(seq, int(parts[2]) * 250 + int(parts[3]) - 1)
         if seq:
             self._svc_ip_seq = seq
+
+    # ---- durability observability ----------------------------------------
+
+    def durability_stats(self) -> dict:
+        """The Durability block of ``ktpu status`` (published by the
+        apiserver's status ConfigMap in data_dir mode): WAL growth since
+        the last snapshot fold, snapshot age, what the last restore cost
+        and whether it dropped a torn tail."""
+        with self._lock:
+            return {
+                "durable": self._data_dir is not None,
+                "walEntriesSinceSnapshot": self._wal_count,
+                "lastSnapshotTime": self._last_snapshot_ts,
+                "replayMs": self._restore_stats.get("replayMs"),
+                "walEntriesReplayed":
+                    self._restore_stats.get("walEntriesReplayed", 0),
+                "snapshotLoaded":
+                    self._restore_stats.get("snapshotLoaded", False),
+                "tornTailsDropped": self._torn_tails,
+                "rv": self._rv,
+            }
 
     # ---- replication hooks (store/replication.py) ------------------------
 
@@ -773,6 +880,7 @@ class ObjectStore:
 
     def close(self):
         with self._lock:
+            self._closed = True  # a deferred restore must not reopen
             if self._wal is not None:
                 self._wal.close()
                 self._wal = None

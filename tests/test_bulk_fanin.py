@@ -332,6 +332,156 @@ def test_hollow_cluster_batches_heartbeats_and_leases():
         cluster.stop()
 
 
+# ---- 2b. batcher outage discipline (apiserver dies mid-flush) -------------
+
+class _OutageDirect(DirectClient):
+    """DirectClient whose bulk verbs fail while ``down`` — a scripted
+    apiserver outage window. Successful bulk sends are recorded so tests
+    can assert EXACTLY what reached the server after the heal."""
+
+    def __init__(self, store):
+        super().__init__(store)
+        self.down = False
+        self.sent: list = []
+
+    def heartbeat_many(self, items):
+        if self.down:
+            raise ConnectionError("connection refused (outage)")
+        self.sent.append(("heartbeat", [n for n, _ in items]))
+        return super().heartbeat_many(items)
+
+    def renew_many(self, ns, items):
+        if self.down:
+            raise ConnectionError("connection refused (outage)")
+        self.sent.append(("lease", [n for n, _ in items]))
+        return super().renew_many(ns, items)
+
+    def update_status_many(self, items):
+        if self.down:
+            raise ConnectionError("connection refused (outage)")
+        self.sent.append(("status", [f"{ns}/{n}" for ns, n, _ in items]))
+        return super().update_status_many(items)
+
+
+def test_heartbeat_outage_no_duplicates_no_resurrection():
+    """The reflush dedup contract: flushes failing across an outage
+    window must not duplicate members into the post-heal flush, a member
+    removed MID-outage must not reappear, and the reconnect heal clears
+    fingerprints so every survivor's status re-asserts promptly."""
+    store = ObjectStore()
+    client = _OutageDirect(store)
+    stubs = [_StubKubelet(f"ob-{i}") for i in range(6)]
+    client.nodes().create_many([s._node_object() for s in stubs])
+    # refresh_every=1: every sweep is due, so the outage window provably
+    # exercises the failed-flush path (thinned fps would skip it)
+    b = _HeartbeatBatcher(client, period_s=999.0, shards=1,
+                          refresh_every=1)
+    try:
+        for s in stubs:
+            b.add(s)
+        b.flush_all()  # healthy baseline
+        client.sent.clear()
+        client.down = True
+        b.flush_all()  # outage sweep 1: fails
+        b.flush_all()  # outage sweep 2
+        assert b.errors >= 2
+        assert b._errs[0] >= 2
+        b.remove("ob-3")  # scale-down lands while the server is dead
+        client.down = False
+        b.flush_all()  # heal
+        sent_names = [n for verb, names in client.sent
+                      if verb == "heartbeat" for n in names]
+        # every LIVE member exactly once — no duplicates from the failed
+        # sweeps, no resurrection of the removed member
+        assert sorted(sent_names) == sorted(
+            s.node_name for s in stubs if s.node_name != "ob-3"), sent_names
+        assert b.reconnects >= 1
+        assert b._errs[0] == 0
+        # the reconnect heal dropped every member's fingerprint, so the
+        # next sweeps re-assert full status even under thinning
+        assert not any(s.node_name in b._fps for s in stubs)
+    finally:
+        b.stop()
+
+
+def test_status_batcher_requeues_newest_wins_bounded_drops():
+    store = ObjectStore()
+    client = _OutageDirect(store)
+    for name in ("p0", "p1"):
+        client.pods().create(make_pod(name).obj().to_dict())
+    b = _StatusBatcher(client, flush_s=999.0, shards=1)
+    try:
+        client.down = True
+        b.push("default", "p0", {"phase": "Pending"})
+        b.flush_all()  # fails -> re-coalesces p0
+        assert b.requeued == 1 and b.errors == 1
+        # a NEWER status pushed during the outage wins over the requeue
+        b.push("default", "p0", {"phase": "Running"})
+        b.push("default", "p1", {"phase": "Running"})
+        b.flush_all()  # fails again; both re-coalesce
+        client.down = False
+        b.flush_all()
+        assert store.get("Pod", "default", "p0")["status"]["phase"] \
+            == "Running"
+        assert store.get("Pod", "default", "p1")["status"]["phase"] \
+            == "Running"
+        # each pod's status landed exactly once post-heal
+        sent = [k for verb, keys in client.sent if verb == "status"
+                for k in keys]
+        assert sorted(sent) == ["default/p0", "default/p1"]
+        # the requeue never clobbers a fresher queued payload
+        b._queued[0]["default/p2"] = {"phase": "Running"}
+        b._requeue(0, [("default/p2", {"phase": "Pending"})])
+        assert b._queued[0]["default/p2"] == {"phase": "Running"}
+        # bounded: past max_queued the oldest failure is dropped + counted
+        b.max_queued = 3
+        b._queued[0].clear()
+        b._requeue(0, [(f"default/q{i}", {"phase": "Pending"})
+                       for i in range(5)])
+        assert len(b._queued[0]) == 3 and b.drops == 2
+        from kubernetes_tpu.metrics.registry import BATCHER_DROPS
+        assert BATCHER_DROPS.get({"batcher": "status"}) >= 2
+    finally:
+        b.stop()
+
+
+def test_shard_backoff_grows_jittered_and_capped():
+    store = ObjectStore()
+    client = DirectClient(store)
+    b = _StatusBatcher(client, flush_s=0.1, shards=1)
+    try:
+        assert b._next_wait(0) == pytest.approx(0.1)
+        for errs, ceiling in ((1, 0.2), (3, 0.8), (20, b.backoff_cap_s)):
+            b._errs[0] = errs
+            waits = [b._next_wait(0) for _ in range(20)]
+            assert all(0.5 * ceiling - 1e-9 <= w <= ceiling + 1e-9
+                       for w in waits), (errs, waits)
+            assert len(set(waits)) > 1  # jittered, not synchronized
+    finally:
+        b.stop()
+
+
+def test_lease_flush_never_creates_removed_members_lease():
+    """A lease 404 answered after the member was removed must NOT
+    re-create the lease: a zombie renewTime would keep node-lifecycle
+    treating the deleted node as alive for a whole grace period."""
+    store = ObjectStore()
+    client = DirectClient(store)
+    live, gone = _StubKubelet("lz-live"), _StubKubelet("lz-gone")
+    b = _LeaseBatcher(client, period_s=999.0, shards=1)
+    try:
+        b.add(live)
+        b.add(gone)
+        b.remove("lz-gone")  # removed while a flush carrying it in flight
+        now = time.time()
+        assert b._flush([("lz-live", now), ("lz-gone", now)])
+        names = {le["metadata"]["name"]
+                 for le in client.leases("kube-node-lease").list()}
+        assert names == {"lz-live"}
+    finally:
+        b.stop()
+
+
 # ---- 3. node lifecycle: leases keep nodes alive ---------------------------
 
 def test_batched_lease_renewal_keeps_node_ready_while_status_lags():

@@ -1,0 +1,517 @@
+"""Disaster recovery: the apiserver dies and restarts under live clients.
+
+The contracts pinned here (the tier-1 face of the DisasterChurn bench):
+
+1. READYZ GATE — an async-restore apiserver answers 503 on /readyz and
+   every resource path until WAL replay completes, then serves the
+   restored state.
+2. OUTAGE BACKOFF — HTTPClient absorbs refused/reset storms with capped
+   full-jitter retries; a request issued during a short outage succeeds
+   once the server returns.
+3. RELIST ACROSS RESTART — pre-restart resourceVersions get 410/TooOld
+   over HTTP, informers relist (``watch_relists_total`` counts it), and
+   the rebuilt cache equals a fresh list.
+4. SCHEDULER SURVIVES — a connected SchedulerRunner rides the restart:
+   pre-restart bindings persist, post-restart pods bind, and the
+   scheduler cache converges to apiserver truth.
+5. DISRUPTION MODES — mass unreadiness engages partial/full disruption
+   (taints suppressed/removed, evictions halted), heal releases it, and
+   the post-release grace window keeps outage-era staleness from
+   tainting the laggards.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.chaos.apiserver import InProcessApiServer, free_port
+from kubernetes_tpu.client.clientset import DirectClient, HTTPClient
+from kubernetes_tpu.client.informer import InformerFactory, SharedInformer
+from kubernetes_tpu.controllers.nodelifecycle import (
+    MODE_FULL,
+    MODE_NORMAL,
+    MODE_PARTIAL,
+    TAINT_NOT_READY,
+    TAINT_UNREACHABLE,
+    NodeLifecycleController,
+)
+from kubernetes_tpu.metrics.registry import WATCH_RELISTS
+from kubernetes_tpu.store.apiserver import APIServer
+from kubernetes_tpu.store.store import ObjectStore, TooOld
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+pytestmark = pytest.mark.disaster
+
+
+def wait_for(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---- 1. readyz gates until WAL replay completes ---------------------------
+
+def test_readyz_503_until_replay_completes(tmp_path, monkeypatch):
+    d = str(tmp_path / "data")
+    s = APIServer(data_dir=d).start()
+    HTTPClient(s.url).pods().create(
+        make_pod("pre").obj().to_dict())
+    s.stop()
+
+    gate = threading.Event()
+    orig = ObjectStore._restore_locked
+
+    def gated_restore(self):
+        gate.wait(10.0)
+        return orig(self)
+    monkeypatch.setattr(ObjectStore, "_restore_locked", gated_restore)
+    s2 = APIServer(data_dir=d, async_restore=True).start()
+    try:
+        import urllib.error
+        import urllib.request
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(s2.url + "/readyz", timeout=2.0)
+        assert ei.value.code == 503
+        # resource paths are gated too: an empty pre-restore store must
+        # never be served as truth
+        from kubernetes_tpu.client.clientset import ApiError
+        with pytest.raises(ApiError) as ai:
+            HTTPClient(s2.url, retry_attempts=0).pods().list()
+        assert ai.value.code == 503
+        # liveness stays green: the process is alive, just not ready
+        assert urllib.request.urlopen(
+            s2.url + "/healthz", timeout=2.0).status == 200
+        gate.set()
+        assert s2.wait_ready(10.0)
+        assert urllib.request.urlopen(
+            s2.url + "/readyz", timeout=2.0).status == 200
+        pods = HTTPClient(s2.url).pods().list()
+        assert [p["metadata"]["name"] for p in pods] == ["pre"]
+    finally:
+        gate.set()
+        s2.stop()
+
+
+# ---- 2. HTTPClient outage backoff -----------------------------------------
+
+def test_http_client_retries_through_a_short_outage(tmp_path):
+    box = InProcessApiServer(str(tmp_path / "data"))
+    box.start()
+    c = HTTPClient(box.url, retry_attempts=8, retry_base_s=0.1,
+                   retry_cap_s=0.5)
+    c.nodes().create(make_node("n1").obj().to_dict())
+    box.stop(graceful=False)
+
+    def restart_later():
+        time.sleep(0.6)
+        box.start()
+    t = threading.Thread(target=restart_later, daemon=True)
+    t.start()
+    # issued while the server is DOWN: the capped full-jitter retry loop
+    # must carry the request across the dead window
+    nodes = c.nodes().list()
+    assert [n["metadata"]["name"] for n in nodes] == ["n1"]
+    t.join()
+    box.stop()
+
+
+def test_http_client_backoff_is_bounded_and_jittered(monkeypatch):
+    sleeps = []
+    import kubernetes_tpu.client.clientset as cs
+    monkeypatch.setattr(cs.time, "sleep", lambda s: sleeps.append(s))
+    c = HTTPClient(f"http://127.0.0.1:{free_port()}", retry_attempts=4,
+                   retry_base_s=0.1, retry_cap_s=0.25)
+    with pytest.raises(OSError):
+        c.pods().list()
+    # one sleep per retry, each within the capped full-jitter envelope
+    assert len(sleeps) == 4
+    for i, s in enumerate(sleeps):
+        assert 0.0 < s <= min(0.25, 0.1 * (2 ** i)) + 1e-9
+    # generateName creates must NOT burn retries (non-idempotent)
+    sleeps.clear()
+    pod = make_pod("x").obj().to_dict()
+    pod["metadata"].pop("name")
+    pod["metadata"]["generateName"] = "gen-"
+    with pytest.raises(OSError):
+        c.pods().create(pod)
+    assert sleeps == []
+
+
+# ---- 3. watch/informer resumption across a real HTTP restart --------------
+
+def test_pre_restart_rv_gets_too_old_over_http(tmp_path):
+    box = InProcessApiServer(str(tmp_path / "data"))
+    box.start()
+    c = HTTPClient(box.url)
+    for i in range(3):
+        c.pods().create(make_pod(f"p{i}").obj().to_dict())
+    old_rv = int(c.pods().list_rv()[1])
+    box.restart(graceful=False)
+    c2 = HTTPClient(box.url)
+    # the restore floor sits at the replayed rv: every pre-restart rv is
+    # compacted away, and the HTTP 410 must surface as TooOld exactly
+    # like DirectClient so informers relist immediately
+    with pytest.raises(TooOld):
+        c2.pods(None).watch(since_rv=old_rv - 1)
+    w = c2.pods(None).watch(since_rv=int(c2.pods().list_rv()[1]))
+    c2.pods().create(make_pod("after").obj().to_dict())
+    ev = None
+    deadline = time.time() + 5
+    while ev is None and time.time() < deadline:
+        ev = w.get(timeout=0.5)
+    assert ev is not None and ev.object["metadata"]["name"] == "after"
+    w.stop()
+    box.stop()
+
+
+def test_informer_relists_across_restart_and_matches_fresh_list(tmp_path):
+    box = InProcessApiServer(str(tmp_path / "data"))
+    box.start()
+    c = HTTPClient(box.url, retry_attempts=4, retry_base_s=0.05,
+                   retry_cap_s=0.3)
+    for i in range(5):
+        c.pods().create(make_pod(f"p{i}").obj().to_dict())
+    relists_before = WATCH_RELISTS.get({"resource": "pods"})
+    inf = SharedInformer(c.pods("default"))
+    inf.start()
+    assert inf.wait_for_cache_sync(10.0)
+    assert len(inf.store) == 5
+
+    # kill; mutate THROUGH the dead window is impossible, so mutate right
+    # after restart instead — the informer must relist (its watch died,
+    # its next list succeeds) and converge on the post-restart truth
+    box.stop(graceful=False)
+    time.sleep(1.0)  # a real dead window: list attempts fail + back off
+    box.restart(graceful=False)  # second kill-restart exercises the WAL too
+    c.pods().create(make_pod("post-restart").obj().to_dict())
+    c.pods().delete("p0")
+
+    fresh = lambda: {p["metadata"]["name"]: p["metadata"]["resourceVersion"]
+                     for p in c.pods().list()}
+
+    def parity():
+        mine = {o["metadata"]["name"]: o["metadata"]["resourceVersion"]
+                for o in inf.store.list()}
+        return mine == fresh()
+    assert wait_for(parity, timeout=20.0), (
+        sorted(fresh()), sorted(o["metadata"]["name"]
+                                for o in inf.store.list()))
+    assert inf.relists >= 1
+    assert WATCH_RELISTS.get({"resource": "pods"}) - relists_before >= 1
+    inf.stop()
+    box.stop()
+
+
+def test_scheduler_survives_apiserver_restart(tmp_path):
+    """End to end over HTTP: bindings persist the restart (WAL), the
+    relisted scheduler cache equals apiserver truth, and a pod created
+    after the restart is bound by the SAME runner."""
+    from kubernetes_tpu.config.types import SchedulerConfiguration
+    from kubernetes_tpu.sched.runner import SchedulerRunner
+    box = InProcessApiServer(str(tmp_path / "data"))
+    box.start()
+    c = HTTPClient(box.url)
+    for i in range(2):
+        c.nodes().create(make_node(f"n{i}").allocatable(
+            {"cpu": "8", "memory": "16Gi", "pods": "110"}).obj().to_dict())
+    runner = SchedulerRunner(
+        HTTPClient(box.url, retry_attempts=4),
+        SchedulerConfiguration(batch_size=16))
+    runner.start(wait_sync=15.0)
+    try:
+        c.pods().create(make_pod("before").req({"cpu": "100m"})
+                        .obj().to_dict())
+        assert wait_for(lambda: (c.pods().get("before").get("spec") or {})
+                        .get("nodeName"), 20.0)
+
+        box.restart(graceful=False)
+        c2 = HTTPClient(box.url)
+        # pre-restart binding survived the WAL replay
+        assert (c2.pods().get("before")["spec"]).get("nodeName")
+        # post-restart pod binds through the healed informer/queue/drain
+        c2.pods().create(make_pod("after").req({"cpu": "100m"})
+                         .obj().to_dict())
+        assert wait_for(lambda: (c2.pods().get("after").get("spec") or {})
+                        .get("nodeName"), 30.0)
+        # cache == fresh-list parity (the relist rebuilt, not patched)
+        def cache_parity():
+            view = runner.cache.audit_view()
+            api_bound = {f"{p['metadata'].get('namespace', 'default')}/"
+                         f"{p['metadata']['name']}":
+                         (p.get("spec") or {}).get("nodeName")
+                         for p in c2.pods().list()
+                         if (p.get("spec") or {}).get("nodeName")}
+            return (view["bound"] == api_bound
+                    and view["nodes"] == {n["metadata"]["name"]
+                                          for n in c2.nodes().list()})
+        assert wait_for(cache_parity, 20.0), (
+            runner.cache.audit_view(), c2.pods().list())
+        # the heal was a real relist-and-resync, not luck: the runner's
+        # informers relisted at least once across the dead window
+        assert runner._total_relists() >= 1
+    finally:
+        runner.stop()
+        box.stop()
+
+
+# ---- 4. disruption-mode node lifecycle ------------------------------------
+
+def _cluster(n, lease_age=0.0):
+    """DirectClient cluster of ``n`` nodes with Ready=True conditions and
+    kube-node-lease leases aged ``lease_age`` seconds."""
+    store = ObjectStore()
+    client = DirectClient(store)
+    now = time.time()
+    for i in range(n):
+        node = make_node(f"d{i}").allocatable(
+            {"cpu": "8", "pods": "110"}).obj().to_dict()
+        node["status"]["conditions"] = [
+            {"type": "Ready", "status": "True",
+             "lastHeartbeatTime": now - lease_age}]
+        client.nodes().create(node)
+        client.leases("kube-node-lease").create(
+            {"kind": "Lease",
+             "metadata": {"name": f"d{i}", "namespace": "kube-node-lease"},
+             "spec": {"holderIdentity": f"d{i}",
+                      "renewTime": now - lease_age}})
+    return store, client
+
+
+def _start_ctrl(client, **kw):
+    kw.setdefault("grace_period", 1.0)
+    kw.setdefault("monitor_period", 0.1)
+    ctrl = NodeLifecycleController(client, **kw)
+    factory = InformerFactory(client)
+    ctrl.register(factory)
+    factory.start_all()
+    assert factory.wait_for_cache_sync(5.0)
+    ctrl.start()
+    return ctrl, factory
+
+
+def _renew_all(client, n):
+    client.leases("kube-node-lease").renew_many(
+        [(f"d{i}", time.time()) for i in range(n)])
+
+
+def test_full_disruption_halts_taints_and_evictions_then_releases():
+    """Every lease goes stale at once (the apiserver-outage signature):
+    FullDisruption engages, no node is tainted, the bound pod survives;
+    renewals resume -> mode releases -> still no taint storm (the
+    post-release grace window covers the laggards)."""
+    store, client = _cluster(8)
+    pod = make_pod("w").node("d3").obj().to_dict()
+    pod["status"] = {"phase": "Running"}
+    client.pods().create(pod)
+    ctrl, factory = _start_ctrl(client)
+    try:
+        # healthy steady state first (renewals flowing)
+        for _ in range(5):
+            _renew_all(client, 8)
+            time.sleep(0.05)
+        assert ctrl.mode == MODE_NORMAL
+        # outage: ALL renewals stop
+        assert wait_for(lambda: ctrl.mode == MODE_FULL, 10.0), ctrl.mode
+        time.sleep(0.5)  # several sync sweeps under full disruption
+        assert not any((n.get("spec") or {}).get("taints")
+                       for n in client.nodes().list())
+        assert [p["metadata"]["name"]
+                for p in client.pods().list()] == ["w"]
+        assert ctrl.evictions == 0 and ctrl.taints_suppressed > 0
+        # heal: renewals resume for everyone
+        stop = threading.Event()
+
+        def renewer():
+            while not stop.is_set():
+                _renew_all(client, 8)
+                time.sleep(0.05)
+        threading.Thread(target=renewer, daemon=True).start()
+        try:
+            assert wait_for(lambda: ctrl.mode == MODE_NORMAL, 10.0)
+            time.sleep(0.4)
+            assert not any((n.get("spec") or {}).get("taints")
+                           for n in client.nodes().list())
+            assert ctrl.evictions == 0
+            assert ctrl.engaged_count == 1
+        finally:
+            stop.set()
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+def test_post_release_grace_shields_lease_laggards():
+    """Half the fleet's renewals land late after an outage: the release
+    back to Normal must NOT taint the still-stale half — their staleness
+    is outage-era evidence (the exact cascade the first DisasterChurn
+    run caught)."""
+    store, client = _cluster(8)
+    ctrl, factory = _start_ctrl(client, grace_period=1.0)
+    try:
+        assert wait_for(lambda: ctrl.mode == MODE_FULL, 10.0)
+        # only 6/8 renew (fraction 0.25 < threshold -> Normal), d6/d7 stay
+        # stale — laggards whose renewals are still in flight
+        stop = threading.Event()
+
+        def renewer():
+            while not stop.is_set():
+                client.leases("kube-node-lease").renew_many(
+                    [(f"d{i}", time.time()) for i in range(6)])
+                time.sleep(0.05)
+        threading.Thread(target=renewer, daemon=True).start()
+        try:
+            assert wait_for(lambda: ctrl.mode == MODE_NORMAL, 10.0)
+            time.sleep(0.3)  # sync sweeps run under the grace window
+            taints = [t for n in client.nodes().list()
+                      for t in (n.get("spec") or {}).get("taints") or []]
+            assert taints == [], taints
+            # after the FULL grace re-accrues, a genuinely dead node IS
+            # tainted — the shield delays judgment, it does not blind it
+            assert wait_for(lambda: any(
+                t.get("key") == TAINT_UNREACHABLE
+                for t in (client.nodes().get("d7").get("spec") or {})
+                .get("taints") or []), 10.0)
+        finally:
+            stop.set()
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+def test_partial_disruption_small_cluster_halts_large_rate_limits():
+    """fraction >= 0.55 but < 1.0: small clusters halt evictions
+    entirely; large clusters trickle NEW taints at the secondary rate
+    (one immediate token, the rest deferred)."""
+    # small (8 < largeClusterThreshold): halted
+    store, client = _cluster(8)
+    ctrl, factory = _start_ctrl(client)
+    try:
+        # 6/8 stale -> 0.75 >= 0.55, < 1.0 -> Partial
+        stop = threading.Event()
+
+        def renewer():
+            while not stop.is_set():
+                client.leases("kube-node-lease").renew_many(
+                    [(f"d{i}", time.time()) for i in range(2)])
+                time.sleep(0.05)
+        threading.Thread(target=renewer, daemon=True).start()
+        try:
+            assert wait_for(lambda: ctrl.mode == MODE_PARTIAL, 10.0)
+            time.sleep(0.4)
+            assert not any((n.get("spec") or {}).get("taints")
+                           for n in client.nodes().list())
+            assert ctrl.taints_suppressed > 0
+        finally:
+            stop.set()
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+    # large (>= threshold param set low): secondary-rate trickle
+    store, client = _cluster(8)
+    ctrl, factory = _start_ctrl(client, large_cluster_threshold=4,
+                                secondary_eviction_rate_qps=0.001)
+    try:
+        stop = threading.Event()
+
+        def renewer2():
+            while not stop.is_set():
+                client.leases("kube-node-lease").renew_many(
+                    [(f"d{i}", time.time()) for i in range(2)])
+                time.sleep(0.05)
+        threading.Thread(target=renewer2, daemon=True).start()
+        try:
+            assert wait_for(lambda: ctrl.mode == MODE_PARTIAL, 10.0)
+            # one token was banked: exactly one node may be tainted;
+            # everything else defers at 0.001 qps (~never in this test)
+            assert wait_for(lambda: ctrl.evictions_deferred > 0, 10.0)
+            tainted = [n["metadata"]["name"] for n in client.nodes().list()
+                       if (n.get("spec") or {}).get("taints")]
+            assert len(tainted) <= 1, tainted
+        finally:
+            stop.set()
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+def test_small_clusters_never_enter_disruption_mode():
+    """min_disruption_nodes: a 2-node cluster's unready node is its own
+    ground truth — taint + evict proceed exactly as before this PR."""
+    store, client = _cluster(2)
+    pod = make_pod("victim").node("d0").obj().to_dict()
+    pod["status"] = {"phase": "Running"}
+    client.pods().create(pod)
+    ctrl, factory = _start_ctrl(client)
+    try:
+        # both nodes go stale (fraction 1.0) — but n < min_disruption_nodes
+        assert wait_for(lambda: any(
+            t.get("key") == TAINT_UNREACHABLE
+            for t in (client.nodes().get("d0").get("spec") or {})
+            .get("taints") or []), 10.0)
+        assert ctrl.mode == MODE_NORMAL
+        assert wait_for(
+            lambda: not [p for p in client.pods().list()
+                         if p["metadata"]["name"] == "victim"], 10.0)
+        assert ctrl.evictions >= 1
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+def test_disruption_status_configmap_published():
+    store, client = _cluster(4)
+    ctrl, factory = _start_ctrl(client)
+    try:
+        assert wait_for(lambda: ctrl.mode == MODE_FULL, 10.0)
+        from kubernetes_tpu.controllers.nodelifecycle import (
+            NODELIFECYCLE_CONFIGMAP)
+
+        def published():
+            import json as _json
+            try:
+                cm = client.resource("configmaps", "default").get(
+                    NODELIFECYCLE_CONFIGMAP)
+            except Exception:
+                return False
+            dis = _json.loads(cm["data"]["disruption"])
+            return dis["mode"] == MODE_FULL and dis["engagedCount"] >= 1
+        assert wait_for(published, 10.0)
+    finally:
+        ctrl.stop()
+        factory.stop_all()
+
+
+# ---- 5. ktpu status surfaces durability + disruption ----------------------
+
+def test_ktpu_status_durability_and_disruption_lines(tmp_path):
+    import io
+    import json as _json
+
+    from kubernetes_tpu.cli.ktpu import main as ktpu_main
+    box = InProcessApiServer(str(tmp_path / "data"))
+    server = box.start()
+    try:
+        server.publish_durability()
+        # a nodelifecycle controller publishing into the same namespace
+        ctrl = NodeLifecycleController(HTTPClient(box.url))
+        ctrl.publish_status()
+        out = io.StringIO()
+        rc = ktpu_main(["--server", box.url, "status"], out=out)
+        text = out.getvalue()
+        assert rc == 0
+        assert "Durability:" in text and "readyz ok" in text
+        assert "Disruption:    Normal" in text
+        out = io.StringIO()
+        rc = ktpu_main(["--server", box.url, "status", "-o", "json"],
+                       out=out)
+        st = _json.loads(out.getvalue())
+        assert rc == 0
+        assert st["durability"]["durable"] is True
+        assert st["durability"]["ready"] is True
+        assert st["disruption"]["mode"] == MODE_NORMAL
+    finally:
+        box.stop()

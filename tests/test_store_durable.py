@@ -64,6 +64,57 @@ def test_torn_wal_tail_discarded(tmp_path):
     assert [p["metadata"]["name"] for p in pods] == ["ok"]
 
 
+def test_torn_wal_tail_truncated_at_every_byte_offset(tmp_path):
+    """SIGKILL can land mid-append at ANY byte: truncate the WAL at every
+    offset inside its final record and prove restore (a) never crashes,
+    (b) keeps every committed record, (c) admits the final record ONLY
+    when its commit marker — the trailing newline — survived, and (d)
+    counts + truncates the torn bytes off disk so a post-restore append
+    cannot merge into them."""
+    import shutil
+
+    from kubernetes_tpu.metrics.registry import WAL_TORN_TAIL
+    base = str(tmp_path / "base")
+    s = ObjectStore(data_dir=base)
+    for i in range(3):
+        s.create("Pod", make_pod(f"pre-{i}").obj().to_dict())
+    s.create("Pod", make_pod("final").obj().to_dict())  # the last record
+    s.close()
+    wal = open(os.path.join(base, "wal.jsonl"), "rb").read()
+    last_start = wal.rstrip(b"\n").rfind(b"\n") + 1
+    assert 0 < last_start < len(wal)
+    for cut in range(last_start, len(wal) + 1):
+        d = str(tmp_path / f"cut-{cut}")
+        shutil.copytree(base, d)
+        with open(os.path.join(d, "wal.jsonl"), "wb") as f:
+            f.write(wal[:cut])
+        before = WAL_TORN_TAIL.get()
+        s2 = ObjectStore(data_dir=d)
+        names = sorted(p["metadata"]["name"] for p in s2.list("Pod")[0])
+        if cut == len(wal):  # full record incl. newline: committed
+            assert names == ["final", "pre-0", "pre-1", "pre-2"]
+            assert WAL_TORN_TAIL.get() == before
+        elif cut == last_start:  # cut exactly between records: no tear
+            assert names == ["pre-0", "pre-1", "pre-2"]
+            assert WAL_TORN_TAIL.get() == before
+        else:
+            # torn: the final record never committed — dropped, counted,
+            # and the file truncated back to the last committed offset
+            assert names == ["pre-0", "pre-1", "pre-2"], (cut, names)
+            assert WAL_TORN_TAIL.get() == before + 1
+            assert os.path.getsize(os.path.join(d, "wal.jsonl")) \
+                == last_start
+            assert s2.durability_stats()["tornTailsDropped"] == 1
+        # appends after the (possibly truncated) restore stay clean: a
+        # fresh write must survive the NEXT restore intact
+        s2.create("Pod", make_pod("post").obj().to_dict())
+        s2.close()
+        s3 = ObjectStore(data_dir=d)
+        assert "post" in {p["metadata"]["name"] for p in s3.list("Pod")[0]}
+        s3.close()
+        shutil.rmtree(d)
+
+
 def test_generate_name_never_reissued_across_restart(tmp_path):
     d = str(tmp_path / "data")
     s = ObjectStore(data_dir=d)
