@@ -26,7 +26,12 @@ the bench non-zero if any pod is lost under faults),
 BENCH_SCALEFLEET=0 to skip the ScaleFleet sweep (BENCH_SCALE_NODES
 sizes the two-point fleet sweep, default "256 2048"; the 100k campaign
 tier is "1250 10000"; BENCH_SCALE_MAX_GROWTH tunes the sublinear
-control-plane gate), BENCH_DISASTER=0 to skip the DisasterChurn case
+control-plane gate), BENCH_FLEET=0 to skip the FleetChurn case (K
+tenant apiservers through one FleetRunner + one warm resident program;
+BENCH_FLEET_TENANTS default 4, campaign tier 16; BENCH_FLEET_NOISY
+sets the noisy-neighbor churn multiple, BENCH_FLEET_P99 the per-tenant
+bind-p99 ceiling — gates: 100% binds/tenant, 0 violations, 0 XLA
+compiles in the steady window), BENCH_DISASTER=0 to skip the DisasterChurn case
 (apiserver SIGKILL + WAL-replay restart mid-churn; BENCH_DISASTER_NODES/
 PODS/OUTAGE_S size it, BENCH_DISASTER_BIND_SLO bounds time-to-first-
 bind-after-restart — every gate treats a missing number as failure).
@@ -238,6 +243,26 @@ def main():
             log=log)
         log("[bench] " + json.dumps(scale_fleet))
 
+    fleet_churn = None
+    if os.environ.get("BENCH_FLEET", "1") != "0" and not only_case:
+        # K tenant apiservers + hollow fleets through ONE FleetRunner and
+        # one warm resident program: 100% binds per tenant, per-tenant SLO
+        # gates with tenant 0 churning 4x (noisy neighbor), steady-state
+        # resident-ctx rebuilds == 0, fail-fast auditor (cross_tenant
+        # invariant live) — missing number = failure. Default K=4 fast;
+        # campaign tier BENCH_FLEET_TENANTS=16.
+        from benchmarks.fleetchurn import run_fleet_churn
+        log("[bench] fleet churn run ...")
+        fleet_churn = run_fleet_churn(
+            n_tenants=int(os.environ.get("BENCH_FLEET_TENANTS", "4")),
+            nodes_per_tenant=int(os.environ.get("BENCH_FLEET_NODES", "8")),
+            upfront_pods=int(os.environ.get("BENCH_FLEET_PODS", "24")),
+            window_s=float(os.environ.get("BENCH_FLEET_WINDOW_S", "12")),
+            noisy_factor=int(os.environ.get("BENCH_FLEET_NOISY", "4")),
+            p99_slo_s=float(os.environ.get("BENCH_FLEET_P99", "10")),
+            log=log)
+        log("[bench] " + json.dumps(fleet_churn))
+
     disaster = None
     if os.environ.get("BENCH_DISASTER", "1") != "0" and not only_case:
         # apiserver SIGKILL + WAL-replay restart mid-churn: every pod
@@ -308,6 +333,7 @@ def main():
         "preemption": preemption,
         "connected_preemption": connected_preemption,
         "scale_fleet": scale_fleet,
+        "fleet_churn": fleet_churn,
         "disaster_churn": disaster,
         "kubemark": kubemark,
         "pallas": pallas,
@@ -318,14 +344,15 @@ def main():
         # as "fine" for rounds
         "invariant_violations": _sum_violations(connected, chaos_churn,
                                                 connected_mesh, explain_ab,
-                                                scale_fleet, disaster),
+                                                scale_fleet, disaster,
+                                                fleet_churn),
         # hard SLO verdicts from case-config gates (SchedulingChurn p99 +
         # throughput, ConnectedMesh legs). Missing numbers are failures —
         # the BENCH_r05 parsed-null lesson: a silently absent figure must
         # never read as a pass.
         "slo_failures": _collect_slo_failures(results, connected_mesh,
                                               explain_ab, scale_fleet,
-                                              disaster),
+                                              disaster, fleet_churn),
     }
     _require_invariant_field(out, "bench summary")
     print(json.dumps(out))
@@ -338,6 +365,7 @@ def main():
                    (("connected", connected), ("chaos_churn", chaos_churn),
                     ("connected_mesh", connected_mesh),
                     ("scale_fleet", scale_fleet),
+                    ("fleet_churn", fleet_churn),
                     ("disaster_churn", disaster)) if c}
         print(f"[bench] FATAL: {out['invariant_violations']} correctness-"
               f"invariant violation(s) confirmed by the auditor "
@@ -364,7 +392,8 @@ def main():
 
 
 def _collect_slo_failures(results, connected_mesh, explain_ab=None,
-                          scale_fleet=None, disaster=None) -> list:
+                          scale_fleet=None, disaster=None,
+                          fleet_churn=None) -> list:
     """Flatten every case's hard-SLO failure strings, prefixed by case."""
     out = []
     for r in results or []:
@@ -382,6 +411,9 @@ def _collect_slo_failures(results, connected_mesh, explain_ab=None,
     if disaster is not None:
         for msg in disaster.get("slo_failures") or []:
             out.append(f"DisasterChurn: {msg}")
+    if fleet_churn is not None:
+        for msg in fleet_churn.get("slo_failures") or []:
+            out.append(f"FleetChurn: {msg}")
     return out
 
 

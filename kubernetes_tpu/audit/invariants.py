@@ -321,6 +321,53 @@ def check_nominations(snap: AuditSnapshot) -> list[Violation]:
     return out
 
 
+# ---- invariant: cross-tenant placement ------------------------------------
+
+def _tenant_of(obj: dict) -> Optional[str]:
+    from kubernetes_tpu.encode.snapshot import tenant_label_of
+    return tenant_label_of((obj.get("metadata") or {}).get("labels"))
+
+
+def check_cross_tenant(snap: AuditSnapshot) -> list[Violation]:
+    """Fleet isolation is a HARD wall: a pod bound (or nominated) onto a
+    node carrying a different ``kubernetes-tpu.io/tenant`` label than its
+    own is a silent multi-tenancy breach — one tenant's workload consuming
+    a sibling's capacity. Judged from one consistent API list (can't
+    flap, confirm=1). Untenanted clusters have no tenant labels anywhere
+    and the check is a no-op."""
+    node_tenant: dict[str, Optional[str]] = {}
+    any_tenant = False
+    for nd in snap.api_nodes:
+        t = _tenant_of(nd)
+        node_tenant[(nd.get("metadata") or {}).get("name", "")] = t
+        any_tenant = any_tenant or t is not None
+    if not any_tenant:
+        return []
+    out = []
+    for p in snap.api_pods:
+        if _is_terminal(p):
+            continue
+        pt = _tenant_of(p)
+        key = _pod_key(p)
+        for field_, node in (("nodeName", _node_name(p)),
+                             ("nominatedNodeName",
+                              (p.get("status") or {})
+                              .get("nominatedNodeName") or "")):
+            if not node or node not in node_tenant:
+                continue  # existence is cache_parity's job
+            nt = node_tenant[node]
+            if nt != pt:
+                out.append(Violation(
+                    "cross_tenant",
+                    f"pod {key} (tenant {pt!r}) {field_}={node!r} "
+                    f"belongs to tenant {nt!r}",
+                    fingerprint=("cross_tenant", key, field_, node),
+                    objects=[{"pod": key, "podTenant": pt, "field": field_,
+                              "node": node, "nodeTenant": nt}],
+                    confirm=1))
+    return out
+
+
 # ---- invariant: SchedulerCache vs fresh list parity -----------------------
 
 def check_cache_parity(snap: AuditSnapshot) -> list[Violation]:
@@ -422,6 +469,7 @@ ALL_INVARIANTS: list[tuple[str, Callable[[AuditSnapshot], list[Violation]]]] = [
     ("double_bind", check_double_bind),
     ("gang_atomicity", check_gang_atomicity),
     ("nomination_consistency", check_nominations),
+    ("cross_tenant", check_cross_tenant),
     ("cache_parity", check_cache_parity),
     ("ctx_parity", check_ctx_parity),
 ]

@@ -589,6 +589,22 @@ def _fleet_line(fleet: dict) -> str:
             f"(batch {le.get('lastBatch', 0)})\n")
 
 
+def _fleet_sched_line(fs: dict) -> str:
+    """One-line fleet-scheduler summary (sched/fleet.py FleetRunner's
+    per-tenant fairness ConfigMap): tenants, per-tenant pending/bound and
+    the batch-slot share each got from the shared drain pipeline."""
+    tenants = fs.get("tenant") or {}
+    parts = []
+    for t in sorted(tenants, key=lambda s: (len(s), s)):
+        d = tenants[t] or {}
+        parts.append(f"t{t} {d.get('bound', 0)} bound/"
+                     f"{d.get('pending', 0)} pending/"
+                     f"share {d.get('batchShare', 0)}")
+    return (f"Fleet sched:   {fs.get('tenants', 0)} tenants, one warm "
+            f"program — " + ("; ".join(parts) if parts else "no tenants")
+            + "\n")
+
+
 def _durability_line(dur: dict) -> str:
     """One-line apiserver durability summary (data_dir mode): WAL growth
     since the last snapshot fold, snapshot age, what the last restore
@@ -648,7 +664,9 @@ def cmd_status(client: HTTPClient, args, out) -> int:
                 raise
             return None
 
+    from kubernetes_tpu.sched.fleet import FLEET_SCHED_CONFIGMAP
     fleet = _aux_cm(FLEET_CONFIGMAP, "fleet")
+    fleet_sched = _aux_cm(FLEET_SCHED_CONFIGMAP, "fleetSched")
     durability = _aux_cm(APISERVER_CONFIGMAP, "durability")
     disruption = _aux_cm(NODELIFECYCLE_CONFIGMAP, "disruption")
     try:
@@ -658,6 +676,7 @@ def cmd_status(client: HTTPClient, args, out) -> int:
         if e.code != 404:
             raise
         aux = {k: v for k, v in (("fleet", fleet),
+                                 ("fleetSched", fleet_sched),
                                  ("durability", durability),
                                  ("disruption", disruption))
                if v is not None}
@@ -673,6 +692,8 @@ def cmd_status(client: HTTPClient, args, out) -> int:
                     out.write(_disruption_line(disruption))
                 if fleet is not None:
                     out.write(_fleet_line(fleet))
+                if fleet_sched is not None:
+                    out.write(_fleet_sched_line(fleet_sched))
             return 0
         out.write("error: no scheduler status published "
                   f"(configmap {STATUS_CONFIGMAP!r} not found in "
@@ -683,6 +704,8 @@ def cmd_status(client: HTTPClient, args, out) -> int:
         st = json.loads(data.get("status", "{}") or "{}")
         if fleet is not None:
             st["fleet"] = fleet
+        if fleet_sched is not None:
+            st["fleetSched"] = fleet_sched
         if durability is not None:
             st["durability"] = durability
         if disruption is not None:
@@ -757,6 +780,8 @@ def cmd_status(client: HTTPClient, args, out) -> int:
         out.write(_disruption_line(disruption))
     if fleet is not None:
         out.write(_fleet_line(fleet))
+    if fleet_sched is not None:
+        out.write(_fleet_sched_line(fleet_sched))
     res = st.get("resilience")
     if res:
         degraded = (res.get("degradedIndex") or 0) > 0

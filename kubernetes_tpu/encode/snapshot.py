@@ -72,8 +72,27 @@ WILDCARD_IP = "0.0.0.0"
 # (reference: nodeunschedulable/node_unschedulable.go). Pre-interned so its
 # key id is the Python-level constant UNSCHED_TAINT_KEY_ID.
 UNSCHED_TAINT_KEY = "node.kubernetes.io/unschedulable"
+# Fleet tenancy plane (sched/fleet.py): the fleet runner stamps every
+# ingested pod/node/namespace with this label, and the label columns
+# node_labels[:, TENANT_KEY_ID] / pod_labels[:, TENANT_KEY_ID] ARE the
+# tenant_of_node / tenant_of_pod planes — no new tensor field, so churn
+# patches, sharding specs, overlays and the staging arena all carry
+# tenancy for free. Pre-interned so the id is a Python constant and the
+# first tenant-labelled object can never cross a key bucket mid-run.
+# Absent label = -1 on both sides, and -1 == -1 passes, so single-tenant
+# clusters are bit-identical to the pre-fleet behavior.
+TENANT_LABEL = "kubernetes-tpu.io/tenant"
 NODE_NAME_KEY_ID = 0
 UNSCHED_TAINT_KEY_ID = 1
+TENANT_KEY_ID = 2
+
+
+def tenant_label_of(labels: Optional[dict]) -> Optional[str]:
+    """The ONE way to read an object's tenant id from its labels (None =
+    untenanted). Every consumer — oracle filter, victim guard, audit
+    invariant, fleet queue — goes through here so the tenancy convention
+    can never drift between them."""
+    return (labels or {}).get(TENANT_LABEL)
 EMPTY_VALUE_ID = 0  # "" pre-interned: empty taint values / tolerations compare to it
 
 # batch-derived bucket dims of a PodBatch, in row-signature order (the
@@ -316,7 +335,8 @@ class SnapshotEncoder:
     stable and incremental re-encoding stays cheap."""
 
     def __init__(self):
-        self.keys = StringTable([NODE_NAME_LABEL, UNSCHED_TAINT_KEY])
+        self.keys = StringTable([NODE_NAME_LABEL, UNSCHED_TAINT_KEY,
+                                 TENANT_LABEL])
         self.values = StringTable([""])
         self.namespaces = StringTable(["default"])
         self.ips = StringTable([WILDCARD_IP])
@@ -353,8 +373,20 @@ class SnapshotEncoder:
         self._pod_cache: dict[str, list] = {}
         self._pod_cache_max = 65536
         self._pod_epoch = 0
+        # Per-tenant catalog epochs: namespace-label churn in one tenant
+        # must not invalidate every OTHER tenant's precompiled pod records
+        # (a fleet runs K tenants' churn through ONE encoder, and the
+        # global epoch made any tenant's namespace update a fleet-wide
+        # row-cache wipe). A record's effective epoch is the (global,
+        # tenant) pair; volumes/DRA stay global — those catalogs are
+        # genuinely shared.
+        self._tenant_epochs: dict[Optional[str], int] = {}
         self.pod_cache_hits = 0
         self.pod_cache_misses = 0
+        # sticky existing-pod slot bucket (see encode_cluster): E never
+        # shrinks, so churn oscillating around a bucket boundary cannot
+        # recompile the drain programs at alternating widths
+        self._slot_floor = 0
         # sticky batch bucket widths (monotone max across encodes) so row
         # packs prebuilt at informer time keep matching the batch signature;
         # power-of-two buckets only ever grow, exactly like the intern
@@ -371,12 +403,39 @@ class SnapshotEncoder:
         self._volumes = catalog
         self._pod_epoch += 1  # precompiled pod records may embed stale state
 
-    def set_namespaces(self, namespace_labels: dict[str, dict]) -> None:
+    def set_namespaces(self, namespace_labels: dict[str, dict],
+                       changed_tenants=None) -> None:
         """Attach the namespace-name -> labels snapshot used to resolve
         affinity terms' namespaceSelector (GetNamespaceLabelsSnapshot
-        analog)."""
+        analog).
+
+        ``changed_tenants``: optional iterable of tenant ids (values of the
+        ``kubernetes-tpu.io/tenant`` label; None = untenanted) whose
+        namespaces this update touched. When given, only those tenants'
+        pod-record epochs bump — nsSelector resolution is tenant-scoped
+        (encode/termprep.py), so a sibling tenant's records stay valid.
+        Omitted/None = conservative global bump (pre-fleet behavior)."""
         self._namespace_labels = dict(namespace_labels or {})
-        self._pod_epoch += 1  # term namespace resolution may change
+        if changed_tenants is None:
+            self._pod_epoch += 1  # term namespace resolution may change
+        else:
+            for t in changed_tenants:
+                self._tenant_epochs[t] = self._tenant_epochs.get(t, 0) + 1
+
+    def _epoch_for(self, p: Pod) -> tuple:
+        """The (global, tenant) catalog epoch a pod's precompiled record is
+        valid under — per-tenant so one tenant's namespace churn cannot
+        wipe the whole fleet's row cache. Keyed by the POD'S NAMESPACE'S
+        tenant (the same identity ``set_namespaces`` bumps and termprep's
+        nsSelector scoping resolves against); the pod's own label is only
+        the fallback for namespaces absent from the snapshot."""
+        t = tenant_label_of(self._namespace_labels.get(p.metadata.namespace))
+        if t is None:
+            t = tenant_label_of(p.metadata.labels)
+        # the tenant id itself is part of the key: a namespace RELABELLED
+        # to another tenant must miss even when the two tenants' counters
+        # happen to be numerically equal
+        return (self._pod_epoch, t, self._tenant_epochs.get(t, 0))
 
     def set_dra(self, catalog) -> None:
         """Attach the DRA catalog (sched/dra.DraCatalog): device classes
@@ -542,8 +601,18 @@ class SnapshotEncoder:
             requested[node_index[p.spec.node_name]] += \
                 self._request_vector(p, resources)
 
+        # Sticky slot bucket: like the pod-batch row widths, E only ever
+        # GROWS across this encoder's lifetime. The bound-pod count under
+        # churn naturally oscillates around bucket boundaries, and letting
+        # E flap 64<->128 recompiled the drain/gang programs on every
+        # capacity rebuild that crossed — the direct enemy of the
+        # one-warm-program steady state (FleetChurn gates on 0 XLA
+        # compiles). Stickiness costs padded rows, never correctness:
+        # every slot past the fill is invalid.
         E = next_bucket(len(epods) + (max(len(pend), slot_headroom)
-                                      if pending_slots else slot_headroom))
+                                      if pending_slots else slot_headroom),
+                        minimum=self._slot_floor)
+        self._slot_floor = max(self._slot_floor, E)
         epod_node = np.full(E, -1, np.int32)
         epod_ns = np.full(E, -1, np.int32)
         epod_labels = np.full((E, K), -1, np.int32)
@@ -1111,7 +1180,7 @@ class SnapshotEncoder:
             return False
         if len(self._pod_cache) >= self._pod_cache_max:
             self._pod_cache.clear()  # backstop; steady state evicts per key
-        epoch = self._pod_epoch
+        epoch = self._epoch_for(p)
         c = self._compile_pod(p)
         sig = pack = None
         if self._row_sig is not None:
@@ -1161,7 +1230,7 @@ class SnapshotEncoder:
         for p in pods:
             ent = self._pod_cache.get(p.key)
             if (ent is not None and ent[0] is p
-                    and ent[1] == self._pod_epoch):
+                    and ent[1] == self._epoch_for(p)):
                 compiled.append(ent[2])
                 entries.append(ent)
                 self.pod_cache_hits += 1
@@ -1169,7 +1238,7 @@ class SnapshotEncoder:
             # snapshot the epoch BEFORE compiling: a catalog change racing
             # the compile (informer threads bump the epoch without the
             # encode lock) must invalidate this record, not get tagged on it
-            epoch = self._pod_epoch
+            epoch = self._epoch_for(p)
             c = self._compile_pod(p)
             compiled.append(c)
             self.pod_cache_misses += 1

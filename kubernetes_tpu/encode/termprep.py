@@ -83,13 +83,25 @@ def resolve_term_namespaces(
     ``namespace_labels`` maps namespace name -> its labels (the
     GetNamespaceLabelsSnapshot analog). The owning pod's namespace is always
     resolvable even if absent from the map.
+
+    Fleet isolation: when the owning namespace carries the
+    ``kubernetes-tpu.io/tenant`` label, a namespaceSelector only matches
+    namespaces of the SAME tenant — affinity terms must never couple one
+    tenant's pods to a sibling's, no matter how its namespace labels look.
+    Untenanted owners keep the pre-fleet behavior exactly.
     """
     if not term.namespaces and term.namespace_selector is None:
         return None
+    # local import: snapshot.py imports this module at load time
+    from kubernetes_tpu.encode.snapshot import TENANT_LABEL
+    own_tenant = (namespace_labels.get(own_ns) or {}).get(TENANT_LABEL)
     names = set(term.namespaces)
     sel = term.namespace_selector
     if sel is not None:
         for ns, labels in namespace_labels.items():
+            if own_tenant is not None \
+                    and (labels or {}).get(TENANT_LABEL) != own_tenant:
+                continue  # tenant-scoped: selectors never cross tenants
             if label_selector_matches(sel, labels or {}):
                 names.add(ns)
         # A namespace_labels snapshot that doesn't know own_ns would silently

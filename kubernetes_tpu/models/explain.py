@@ -31,15 +31,18 @@ from kubernetes_tpu.sched.oracle import FailReason
 
 # Static filter stack in the ORACLE'S check order (sched/oracle.py
 # _filter_one short-circuits in this order, so first-fail verdicts align
-# bit-for-bit). FILTERS preserves it for the in-tree masks; the relational
-# filters follow, spread before inter-pod, as in the oracle.
-EXPLAIN_FILTERS: tuple[str, ...] = tuple(FILTERS) + (
+# bit-for-bit). Tenant visibility comes FIRST — it is part of run_filters'
+# validity gate, not a disableable plugin, and the oracle checks it before
+# anything else. FILTERS preserves the order for the in-tree masks; the
+# relational filters follow, spread before inter-pod, as in the oracle.
+EXPLAIN_FILTERS: tuple[str, ...] = ("Tenant",) + tuple(FILTERS) + (
     "PodTopologySpread", "InterPodAffinity")
 
 # filter name -> the upstream-style reason fragment its rejections render
 # as (FailReason strings double as the oracle's verdict vocabulary, which
 # keeps the parity tests string-exact).
 FILTER_MESSAGES: dict[str, str] = {
+    "Tenant": FailReason.TENANT,
     "NodeUnschedulable": FailReason.UNSCHEDULABLE,
     "NodeName": FailReason.NODE_NAME,
     "NodeResourcesFit": FailReason.RESOURCES,
@@ -54,6 +57,7 @@ FILTER_MESSAGES: dict[str, str] = {
 # oracle reason string -> filter name (both inter-pod reasons collapse to
 # the one InterPodAffinity plugin, as upstream's plugin registry does).
 REASON_TO_FILTER: dict[str, str] = {
+    FailReason.TENANT: "Tenant",
     FailReason.UNSCHEDULABLE: "NodeUnschedulable",
     FailReason.NODE_NAME: "NodeName",
     FailReason.RESOURCES: "NodeResourcesFit",
@@ -82,7 +86,12 @@ def explain_step(ct: ClusterTensors, pb: PodBatch,
     valid = pb.pod_valid[:, None] & ct.node_valid[None, :]
     outs = []
     for name in EXPLAIN_FILTERS:
-        if not _on(name):
+        if name == "Tenant":
+            # validity-gate member: never disabled by a profile
+            from kubernetes_tpu.ops.filters import tenant_pair_mask
+            tmask = tenant_pair_mask(ct, pb)
+            outs.append(jnp.ones_like(valid) if tmask is None else tmask)
+        elif not _on(name):
             outs.append(jnp.ones_like(valid))
         elif name == "PodTopologySpread":
             outs.append(topology.spread_mask(ct, pb, topo_keys))

@@ -91,7 +91,12 @@ def evaluate(ct: ClusterTensors, pb: PodBatch, seed: int = 0,
                             fit_strategy=fit_strategy)
     if ext_scores is not None:
         scores = jnp.where(feasible, scores + ext_scores, scores)
-    choice, has = select_host(scores, seed=seed)
+    # tenant-local tie-break identity: arange(N) for single-tenant
+    # clusters (bit-identical to the historical index tie-break), the
+    # per-tenant rank under a fleet (ops/filters.tenant_local_rank)
+    from kubernetes_tpu.ops.filters import tenant_local_rank
+    choice, has = select_host(scores, seed=seed,
+                              node_rank=tenant_local_rank(ct))
     return StepResult(choice=choice.astype(jnp.int32),
                       assigned=has & jnp.any(feasible, axis=-1),
                       feasible=feasible, scores=scores)

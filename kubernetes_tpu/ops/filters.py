@@ -21,12 +21,68 @@ import jax.numpy as jnp
 
 from kubernetes_tpu.encode.snapshot import (
     EMPTY_VALUE_ID,
+    TENANT_KEY_ID,
     TOLOPC_EXISTS,
     UNSCHED_TAINT_KEY_ID,
     ClusterTensors,
     PodBatch,
 )
 from kubernetes_tpu.ops.exprs import eval_term_set, gather_values
+
+
+# ---- fleet tenancy plane ---------------------------------------------------
+# tenant_of_node / tenant_of_pod are the pre-interned TENANT label columns
+# of the encodings (encode/snapshot.py TENANT_KEY_ID): -1 = untenanted.
+# Hand-built test tensors may carry a narrower key bucket; the helpers then
+# degrade to "everything same tenant", which IS the single-tenant semantics.
+
+def tenant_of_node(ct: ClusterTensors):
+    """[N] int32 tenant value-id per node, or None when the key bucket
+    predates the tenant column (hand-built tensors)."""
+    if ct.node_labels.shape[1] <= TENANT_KEY_ID:
+        return None
+    return ct.node_labels[:, TENANT_KEY_ID]
+
+
+def tenant_of_pod(pb: PodBatch):
+    if pb.pod_labels.shape[1] <= TENANT_KEY_ID:
+        return None
+    return pb.pod_labels[:, TENANT_KEY_ID]
+
+
+def tenant_pair_mask(ct: ClusterTensors, pb: PodBatch):
+    """[P,N] bool: node n is visible to pod p (same tenant; -1 == -1 keeps
+    untenanted clusters fully visible). None = no tenant plane (all same)."""
+    tv, pv = tenant_of_node(ct), tenant_of_pod(pb)
+    if tv is None or pv is None:
+        return None
+    return pv[:, None] == tv[None, :]
+
+
+def tenant_local_rank(ct: ClusterTensors):
+    """[N] int32: each node's rank AMONG ITS OWN TENANT'S nodes (insertion
+    order). Single-tenant clusters (all tenant ids equal, typically -1)
+    degenerate to ``arange(N)`` exactly — so using this as the tie-break
+    key (ops/scores.select_host) is bit-identical to the historical
+    node-index tie-break, while under a fleet a tenant's nodes keep the
+    SAME ranks they would have in a standalone cluster: fleet-batched
+    placements stay bit-equal to independent per-tenant runs even through
+    score ties."""
+    tv = tenant_of_node(ct)
+    N = ct.node_valid.shape[0]
+    idx = jnp.arange(N, dtype=jnp.int32)
+    if tv is None:
+        return idx
+    order = jnp.lexsort((idx, tv))          # stable group-by tenant value
+    tvs = tv[order]
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), tvs[1:] != tvs[:-1]])
+    # index within segment = position - position-of-segment-start
+    start_pos = jnp.where(seg_start, idx, jnp.int32(0))
+    import jax
+    start_pos = jax.lax.associative_scan(jnp.maximum, start_pos)
+    rank_sorted = (idx - start_pos).astype(jnp.int32)
+    return jnp.zeros(N, jnp.int32).at[order].set(rank_sorted)
 
 
 def fit_mask(ct: ClusterTensors, pb: PodBatch):
@@ -196,8 +252,16 @@ FILTERS = {
 
 
 def run_filters(ct: ClusterTensors, pb: PodBatch, enabled=None):
-    """AND of all enabled filter masks, plus validity gates. -> [P,N] bool."""
+    """AND of all enabled filter masks, plus validity gates. -> [P,N] bool.
+
+    The tenant visibility mask is part of the VALIDITY GATE, not the
+    pluggable filter set: a profile disabling filters must never be able to
+    disable fleet isolation (a pod can simply never see a sibling tenant's
+    nodes, the way it can never see an invalid row)."""
     mask = pb.pod_valid[:, None] & ct.node_valid[None, :]
+    tmask = tenant_pair_mask(ct, pb)
+    if tmask is not None:
+        mask = mask & tmask
     for name, fn in FILTERS.items():
         if enabled is None or name in enabled:
             mask = mask & fn(ct, pb)

@@ -79,6 +79,11 @@ def _static_mask(nodes: list[Node], pod: Pod) -> np.ndarray:
     orc = OracleScheduler(nodes, [])
     out = np.zeros(len(nodes), bool)
     for i, node in enumerate(nodes):
+        # fleet visibility: preemption must never target (and therefore
+        # never evict victims from) a sibling tenant's node
+        if orc._tenant_of(pod.metadata.labels) != orc._tenant_of(
+                node.metadata.labels):
+            continue
         if node.spec.unschedulable and not any(
                 t.tolerates(UNSCHED_TAINT) for t in pod.spec.tolerations):
             continue

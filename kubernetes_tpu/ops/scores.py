@@ -97,20 +97,28 @@ def balanced_allocation(ct: ClusterTensors, pb: PodBatch):
 def image_locality(ct: ClusterTensors, pb: PodBatch):
     """Threshold ramp over summed scaled sizes of pod images present on node.
 
-    scaled size = size_bytes * (#nodes with image / #nodes).
+    scaled size = size_bytes * (#nodes with image / #nodes). Under a fleet,
+    "#nodes" means the POD'S TENANT'S nodes (the tenant visibility mask):
+    a sibling tenant growing its fleet must not shift the spread factor —
+    the per-tenant score is exactly the standalone cluster's.
     """
     CI = pb.pod_images.shape[1]
     if CI == 0 or ct.node_images.shape[1] == 0:
         return jnp.zeros(pb.pod_valid.shape + ct.node_valid.shape, jnp.float32)
+    from kubernetes_tpu.ops.filters import tenant_pair_mask
     # present[n, img_table] via scatter-free compare: [N,I] vs pod [P,CI]
     pod_img = pb.pod_images[:, :, None, None]              # [P,CI,1,1]
     node_img = ct.node_images[None, None, :, :]            # [1,1,N,I]
     present = jnp.any((pod_img == node_img) & (pod_img >= 0), axis=-1)  # [P,CI,N]
-    # spread factor: #nodes having each pod image / total valid nodes
+    # spread factor: #tenant nodes having each pod image / tenant valid nodes
     per_node = jnp.any((pod_img == node_img) & (pod_img >= 0), axis=-1)  # [P,CI,N]
-    num_with = jnp.sum(per_node & ct.node_valid[None, None, :], axis=-1,
+    tmask = tenant_pair_mask(ct, pb)
+    visible = (ct.node_valid[None, :] if tmask is None
+               else ct.node_valid[None, :] & tmask)        # [P,N] (or [1,N])
+    num_with = jnp.sum(per_node & visible[:, None, :], axis=-1,
                        keepdims=True).astype(jnp.float32)               # [P,CI,1]
-    total = jnp.maximum(jnp.sum(ct.node_valid).astype(jnp.float32), 1.0)
+    total = jnp.maximum(jnp.sum(visible, axis=-1)
+                        .astype(jnp.float32), 1.0)[:, None, None]       # [P,1,1]
     IMG = ct.image_sizes.shape[0]
     sizes = ct.image_sizes[jnp.clip(pb.pod_images, 0, max(IMG - 1, 0))]  # [P,CI]
     sizes = jnp.where(pb.pod_images >= 0, sizes, 0.0)
@@ -197,12 +205,17 @@ def combined_score(ct: ClusterTensors, pb: PodBatch, feasible, weights=None,
     return jnp.where(feasible, total, -jnp.inf)
 
 
-def select_host(scores, seed: int = 0):
+def select_host(scores, seed: int = 0, node_rank=None):
     """argmax with seeded deterministic tie-break -> (node idx [P], has_node [P]).
 
     Matches oracle.tie_break exactly; the salt varies per batch position so
     equal-score pods spread across tied nodes instead of piling onto one
     (the reference gets the same effect from per-pod math/rand sampling).
+
+    ``node_rank`` [N] int32: the tie-break identity per node — by default
+    the node's index, under a fleet its TENANT-LOCAL rank
+    (ops/filters.tenant_local_rank), which is identical for single-tenant
+    clusters and keeps fleet tie-breaks bit-equal to standalone runs.
     """
     P, N = scores.shape
     has = jnp.any(jnp.isfinite(scores), axis=-1)
@@ -210,7 +223,9 @@ def select_host(scores, seed: int = 0):
     is_best = scores == best
     salt = ((jnp.uint32(seed) + jnp.arange(P, dtype=jnp.uint32))
             * jnp.uint32(2246822519))
-    tb = ((jnp.arange(N, dtype=jnp.uint32)[None, :] * jnp.uint32(2654435761))
+    ident = (jnp.arange(N, dtype=jnp.uint32) if node_rank is None
+             else node_rank.astype(jnp.uint32))
+    tb = ((ident[None, :] * jnp.uint32(2654435761))
           ^ salt[:, None]) & jnp.uint32(0x3FFFFFFF)
     key = jnp.where(is_best, tb.astype(jnp.int32), jnp.int32(0x7FFFFFFF))
     choice = jnp.argmin(key, axis=-1)

@@ -177,11 +177,19 @@ def _spread_policy_elig(ct: ClusterTensors, pb: PodBatch):
     affinity) and nodeTaintsPolicy (Honor: NoSchedule/NoExecute tolerated;
     Ignore default). XLA CSE dedupes these against the filter pipeline's
     identical masks inside one jit program."""
-    from kubernetes_tpu.ops.filters import node_affinity_mask, taint_toleration_mask
+    from kubernetes_tpu.ops.filters import (node_affinity_mask,
+                                            taint_toleration_mask,
+                                            tenant_pair_mask)
     na = node_affinity_mask(ct, pb)                           # [P,N]
     tt = taint_toleration_mask(ct, pb)                        # [P,N]
     ok = (~pb.sc_honor_affinity[..., None] | na[:, None, :])
     ok &= (~pb.sc_honor_taints[..., None] | tt[:, None, :])
+    # fleet isolation: a sibling tenant's nodes neither count toward skew
+    # nor anchor the global minimum / minDomains — each tenant's spread
+    # math is exactly its standalone cluster's
+    tmask = tenant_pair_mask(ct, pb)
+    if tmask is not None:
+        ok &= tmask[:, None, :]
     return ok & ct.node_valid[None, None, :]
 
 

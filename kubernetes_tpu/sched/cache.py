@@ -293,18 +293,28 @@ class SchedulerCache:
     def update_namespace(self, obj: dict, deleted: bool = False):
         """Track namespace labels so affinity terms' namespaceSelector
         resolves at encode time (GetNamespaceLabelsSnapshot analog)."""
+        from kubernetes_tpu.encode.snapshot import TENANT_LABEL
         with self._lock:
             md = obj.get("metadata") or {}
             name = md.get("name", "")
             if deleted:
-                if self._namespace_labels.pop(name, None) is None:
+                old = self._namespace_labels.pop(name, None)
+                if old is None:
                     return
+                tenants = {(old or {}).get(TENANT_LABEL)}
             else:
                 new = dict(md.get("labels") or {})
-                if self._namespace_labels.get(name) == new:
+                old = self._namespace_labels.get(name)
+                if old == new:
                     return  # label-neutral churn: keep the encoding valid
                 self._namespace_labels[name] = new
-            self._encoder.set_namespaces(self._namespace_labels)
+                # per-tenant catalog-epoch discipline: nsSelector resolution
+                # is tenant-scoped, so only the touched tenants' precompiled
+                # pod records go stale (old AND new tenant when relabelled)
+                tenants = {new.get(TENANT_LABEL),
+                           (old or {}).get(TENANT_LABEL)}
+            self._encoder.set_namespaces(self._namespace_labels,
+                                         changed_tenants=tenants)
             self._generation += 1
             # Pod batches always read the fresh snapshot at encode time; the
             # CLUSTER encoding only goes stale if an existing pod's anti term

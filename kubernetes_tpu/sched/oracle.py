@@ -43,6 +43,7 @@ from kubernetes_tpu.api.types import (
     TopologySpreadConstraint,
 )
 from kubernetes_tpu.encode.scaling import UNLIMITED, scale_allocatable, scale_request
+from kubernetes_tpu.encode.snapshot import tenant_label_of
 from kubernetes_tpu.encode.termprep import (
     affinity_term_selector,
     resolve_term_namespaces,
@@ -114,6 +115,7 @@ def tolerates_all(tolerations: list[Toleration], taints: list[Taint],
 
 
 class FailReason:
+    TENANT = "node(s) belonged to a different tenant"
     UNSCHEDULABLE = "node(s) were unschedulable"
     NODE_NAME = "node(s) didn't match the requested node name"
     RESOURCES = "Insufficient resources"
@@ -137,6 +139,17 @@ class OracleScheduler:
                  dra=None):
         self.states = [NodeState.build(n) for n in nodes]
         self.node_index = {n.metadata.name: i for i, n in enumerate(nodes)}
+        # tenant-local tie-break ranks (ops/filters.tenant_local_rank's
+        # host twin): node i's rank among ITS TENANT's nodes — arange for
+        # single-tenant clusters, so tie-breaks are unchanged there and
+        # bit-equal to standalone runs under a fleet
+        _tcounts: dict = {}
+        self._node_rank: list[int] = []
+        for n in nodes:
+            t = self._tenant_of(n.metadata.labels)
+            r = _tcounts.get(t, 0)
+            _tcounts[t] = r + 1
+            self._node_rank.append(r)
         self.weights = dict(weights or DEFAULT_WEIGHTS)
         self.seed = seed
         self.volumes = volumes  # VolumeCatalog | None
@@ -206,8 +219,16 @@ class OracleScheduler:
 
     # ---- filters ---------------------------------------------------------
 
+    _tenant_of = staticmethod(tenant_label_of)
+
     def _filter_one(self, pod: Pod, st: NodeState, ni: int, ctx: dict) -> Optional[str]:
         node = st.node
+        # fleet visibility gate, FIRST (mirrors run_filters' validity gate
+        # and explain's stack order): a pod only ever sees its own
+        # tenant's nodes; untenanted == untenanted passes, so
+        # single-tenant clusters are unaffected
+        if self._tenant_of(pod.metadata.labels) != self._tenant_of(st.labels):
+            return FailReason.TENANT
         if node.spec.unschedulable and not any(
                 t.tolerates(UNSCHED_TAINT) for t in pod.spec.tolerations):
             return FailReason.UNSCHEDULABLE
@@ -344,6 +365,10 @@ class OracleScheduler:
         (common.go: has the topology key + nodeAffinityPolicy [default Honor]
         + nodeTaintsPolicy [default Ignore])."""
         if sc.topology_key not in st.labels:
+            return False
+        # fleet scoping: a sibling tenant's nodes don't participate in skew
+        # or the global minimum (tensor twin: _spread_policy_elig)
+        if self._tenant_of(pod.metadata.labels) != self._tenant_of(st.labels):
             return False
         if (sc.node_affinity_policy != NODE_INCLUSION_IGNORE
                 and not self._node_affinity_ok(pod, st.node)):
@@ -529,9 +554,16 @@ class OracleScheduler:
         out = np.zeros(N, np.float32)
         if not imgs:
             return out
+        # fleet scoping: the spread factor counts the POD'S TENANT'S nodes
+        # only (tensor twin: ops/scores.image_locality) — a sibling fleet
+        # growing must not shift this pod's locality ramp
+        pt = self._tenant_of(pod.metadata.labels)
+        visible = [self._tenant_of(st.labels) == pt for st in self.states]
+        n_vis = sum(visible)
         have = [set(n.names[0] for n in st.node.status.images if n.names)
                 for st in self.states]
-        num_nodes_with = {im: sum(im in h for h in have) for im in imgs}
+        num_nodes_with = {im: sum(im in h for h, v in zip(have, visible)
+                                  if v) for im in imgs}
         sizes = {}
         for st in self.states:
             for n in st.node.status.images:
@@ -542,7 +574,8 @@ class OracleScheduler:
             ssum = np.float32(0)
             for im in imgs:
                 if im in have[i]:
-                    spread = np.float32(num_nodes_with[im]) / np.float32(max(N, 1))
+                    spread = np.float32(num_nodes_with[im]) / np.float32(
+                        max(n_vis, 1))
                     ssum += np.float32(sizes.get(im, 0)) * spread
             val = (ssum - np.float32(IMG_MIN_THRESHOLD)) / np.float32(
                 max_threshold - IMG_MIN_THRESHOLD)
@@ -630,7 +663,8 @@ class OracleScheduler:
             return None
         best = np.max(scores)
         cands = [i for i in range(len(scores)) if scores[i] == best]
-        return min(cands, key=lambda n: tie_break(n, self.seed, salt))
+        return min(cands, key=lambda n: tie_break(self._node_rank[n],
+                                                  self.seed, salt))
 
     def schedule_one(self, pod: Pod, salt: int = 0):
         """-> (node index or None, reasons). Does NOT assume; caller decides."""

@@ -184,20 +184,31 @@ class SchedulingQueue:
             heapq.heappop(self._active)
         return bool(self._active)
 
+    def _wait_for_work_locked(self, deadline: float) -> bool:
+        """Block (under the lock) until >=1 current pod is in activeQ, the
+        queue closes, or ``deadline`` passes with nothing available.
+        Returns True when work is available — shared by pop_batch and the
+        FleetQueue's fairness-aware override, so the wait/close semantics
+        can never drift between them."""
+        while not self.closed:
+            self._flush_backoff_locked()
+            if self._active_has_current_locked():
+                return True
+            timeout = min(0.05, max(deadline - time.time(), 0.01))
+            self._lock.wait(timeout)
+            if time.time() > deadline \
+                    and not self._active_has_current_locked():
+                return False
+        return self._active_has_current_locked()
+
     def pop_batch(self, max_batch: int = 256, wait: float = 0.5
                   ) -> list[tuple[Pod, int]]:
         """Block until >=1 pod is available, then drain up to max_batch in
         priority order. Returns [(pod, attempts)]."""
         deadline = time.time() + wait
         with self._lock:
-            while not self.closed:
-                self._flush_backoff_locked()
-                if self._active_has_current_locked():
-                    break
-                timeout = min(0.05, max(deadline - time.time(), 0.01))
-                self._lock.wait(timeout)
-                if time.time() > deadline and not self._active_has_current_locked():
-                    return []
+            if not self._wait_for_work_locked(deadline):
+                return []
             out = []
             while self._active and len(out) < max_batch:
                 item = heapq.heappop(self._active)

@@ -60,12 +60,23 @@ class SchedulerRunner:
 
     def __init__(self, client, cfg: Optional[SchedulerConfiguration] = None,
                  identity: str = "kubernetes-tpu-scheduler", registry=None,
-                 status_namespace: str = "default"):
+                 status_namespace: str = "default",
+                 status_name: str = STATUS_CONFIGMAP,
+                 explain_name: str = EXPLAIN_CONFIGMAP,
+                 trace_name: str = TRACE_CONFIGMAP):
         self.client = client
         # where publish_status writes its ConfigMap (same shape as the
         # autoscaler's status_namespace: RBAC commonly restricts writes to
         # the component's own namespace; ktpu -n <ns> status must match)
         self.status_namespace = status_namespace
+        # Per-INSTANCE ConfigMap names: two scheduler identities sharing
+        # one apiserver (fleet tenants, A/B runners) used to clobber each
+        # other's status/explanations/trace through the module-level
+        # constants — publish_status always assumed ONE scheduler per
+        # apiserver. The constants stay the defaults ktpu reads.
+        self.status_name = status_name
+        self.explain_name = explain_name
+        self.trace_name = trace_name
         if hasattr(client, "default_user_agent"):
             client.default_user_agent("kube-scheduler")
         # GIL tuning for the connected deployment shape: informer bursts
@@ -81,8 +92,7 @@ class SchedulerRunner:
 
         self.cfg = cfg or SchedulerConfiguration()
         self.cache = SchedulerCache(assume_ttl=self.cfg.assume_ttl_s)
-        self.queue = SchedulingQueue(backoff_initial=self.cfg.backoff_initial_s,
-                                     backoff_max=self.cfg.backoff_max_s)
+        self.queue = self._build_queue(self.cfg)
         self.scheduler = Scheduler(self.cfg, self.cache, self.queue, self._bind,
                                    registry=registry,
                                    bulk_binder=self._bind_many)
@@ -137,6 +147,17 @@ class SchedulerRunner:
             pre_sweep=self.sweep_stale_nominations,
             post_sweep=self.publish_status,
             relists=self._total_relists)
+
+    def _build_queue(self, cfg: SchedulerConfiguration) -> SchedulingQueue:
+        """Queue factory hook — the FleetRunner (sched/fleet.py) swaps in
+        the fairness-aware FleetQueue here."""
+        return SchedulingQueue(backoff_initial=cfg.backoff_initial_s,
+                               backoff_max=cfg.backoff_max_s)
+
+    def _all_informers(self):
+        """Every SharedInformer this runner owns (the FleetRunner overrides
+        with N tenant factories' worth)."""
+        return list(self.factory._informers.values())
 
     # ---- event handlers (pkg/scheduler/eventhandlers.go analog) ----------
 
@@ -407,7 +428,7 @@ class SchedulerRunner:
 
     def _total_relists(self) -> int:
         return sum(getattr(inf, "relists", 0)
-                   for inf in self.factory._informers.values())
+                   for inf in self._all_informers())
 
     def sweep_stale_nominations(self) -> int:
         """Periodic GC: clear ``status.nominatedNodeName`` from bound or
@@ -490,28 +511,37 @@ class SchedulerRunner:
             raise RuntimeError("leader election owns the loop lifecycle")
         self._start_loop()
 
-    def _start(self, wait_sync: float, start_loop: bool):
-        pods = self.factory.informer("pods", None)
-        pods.add_event_handler(self._on_pod)
-        nodes = self.factory.informer("nodes", None)
-        nodes.add_event_handler(self._on_node)
+    def _wire_informers(self, factory: InformerFactory, wrap=None):
+        """Register every watched resource's handlers on ``factory`` —
+        THE single list of what the scheduler watches. ``wrap(handler,
+        plural)`` adapts handlers (the FleetRunner re-keys each tenant's
+        events through it); a new watched resource added here reaches
+        fleet tenants automatically. Returns the PDB informer (its store
+        feeds preemption's victim selection)."""
+        w = wrap if wrap is not None else (lambda h, _plural: h)
+        factory.informer("pods", None).add_event_handler(
+            w(self._on_pod, "pods"))
+        factory.informer("nodes", None).add_event_handler(
+            w(self._on_node, "nodes"))
         for plural, kind in (("persistentvolumeclaims", "PersistentVolumeClaim"),
                              ("persistentvolumes", "PersistentVolume"),
                              ("storageclasses", "StorageClass")):
-            inf = self.factory.informer(plural, None)
-            inf.add_event_handler(self._on_volume(kind))
+            factory.informer(plural, None).add_event_handler(
+                w(self._on_volume(kind), plural))
         for plural, kind in (("resourceclaims", "ResourceClaim"),
                              ("deviceclasses", "DeviceClass"),
                              ("resourceslices", "ResourceSlice")):
-            inf = self.factory.informer(plural, None)
-            inf.add_event_handler(self._on_dra(kind))
-        ns_inf = self.factory.informer("namespaces", None)
-        ns_inf.add_event_handler(
-            lambda type_, obj, old: self.cache.update_namespace(
-                obj, deleted=(type_ == "DELETED")))
+            factory.informer(plural, None).add_event_handler(
+                w(self._on_dra(kind), plural))
+        factory.informer("namespaces", None).add_event_handler(
+            w(lambda type_, obj, old: self.cache.update_namespace(
+                obj, deleted=(type_ == "DELETED")), "namespaces"))
         # PDBs feed preemption's victim selection (default_preemption.go
         # checks budgets when picking victims)
-        pdb_inf = self.factory.informer("poddisruptionbudgets", None)
+        return factory.informer("poddisruptionbudgets", None)
+
+    def _start(self, wait_sync: float, start_loop: bool):
+        pdb_inf = self._wire_informers(self.factory)
         self.scheduler.pdb_lister = lambda: list(pdb_inf.store.list())
         self.factory.start_all()
         self.factory.wait_for_cache_sync(wait_sync)
@@ -543,7 +573,7 @@ class SchedulerRunner:
         breaker = self.scheduler.breaker
         relists = 0
         last_relist = None
-        for inf in self.factory._informers.values():
+        for inf in self._all_informers():
             relists += getattr(inf, "relists", 0)
             lr = getattr(inf, "last_relist", None)
             if lr and (last_relist is None or lr > last_relist):
@@ -620,7 +650,7 @@ class SchedulerRunner:
                         if self.scheduler.explainer is not None else None),
             "flight": self._flight_status(),
         }
-        self._publish_configmap(STATUS_CONFIGMAP,
+        self._publish_configmap(self.status_name,
                                 {"status": json.dumps(status, indent=1)})
         self._publish_trace()
 
@@ -652,7 +682,7 @@ class SchedulerRunner:
         import json
         import time as _time
         self._publish_configmap(
-            EXPLAIN_CONFIGMAP,
+            self.explain_name,
             {"explanations": json.dumps(explanations),
              "updated": str(_time.time())})
 
@@ -673,7 +703,7 @@ class SchedulerRunner:
             _LOG.debug("trace export failed", exc_info=True)
             return
         self._publish_configmap(
-            TRACE_CONFIGMAP,
+            self.trace_name,
             {"trace": json.dumps(doc), "updated": str(_time.time())})
 
     def _start_loop(self):

@@ -223,6 +223,12 @@ class Scheduler:
         self._resolver_swap_lock = threading.Lock()
         self._use_resolver = _os.environ.get(
             "KTPU_RESOLVER_THREAD", "1") != "0"
+        # Fleet mode (sched/fleet.py FleetRunner sets this): pops are split
+        # into TENANT-HOMOGENEOUS drain chunks so every tenant's pods sit at
+        # batch positions 0..n of their own chunk — the structural property
+        # that makes fleet-batched placements bit-equal to independent
+        # per-tenant runs (same seed, same tie-break salts).
+        self.fleet_mode = False
         # fragment pops parked while the device is busy (see run_once)
         self._staged: list = []
         self._staged_once = False   # a parked fragment merges at most once
@@ -598,10 +604,57 @@ class Scheduler:
                     and not serial and not self._extenders):
                 n_bound += self._schedule_drain(profile, items, headroom)
             else:
-                for i in range(0, len(items), self.cfg.batch_size):
-                    n_bound += self._schedule_group(
-                        profile, items[i:i + self.cfg.batch_size], headroom)
+                for chunk in self._tenant_chunks(items, self.cfg.batch_size):
+                    n_bound += self._schedule_group(profile, chunk, headroom)
         return n_landed + n_bound
+
+    def _tenant_chunks(self, items: list, P: int) -> list[list]:
+        """Split a popped batch into device chunks of up to ``P`` pods.
+        Single-tenant (the default): plain consecutive slices, unchanged.
+        Fleet mode: chunks are TENANT-HOMOGENEOUS — each tenant's pods,
+        in pop (priority) order, fill their own chunks from position 0,
+        so the per-position tie-break salt and the per-chunk balance
+        guard see exactly what a standalone run of that tenant would.
+        The chunk count is bounded by max_drain_batches (one compiled
+        drain width): surplus partial chunks merge into mixed chunks,
+        which stay CORRECT (the tenant gate isolates them) but waive
+        bit-parity — only full per-tenant blocks claim it."""
+        if not self.fleet_mode:
+            return [items[i:i + P] for i in range(0, len(items), P)]
+        from kubernetes_tpu.encode.snapshot import tenant_label_of
+        groups: dict[str, list] = {}
+        order: list[str] = []
+        for it in items:
+            t = tenant_label_of(it[0].metadata.labels) or ""
+            if t not in groups:
+                groups[t] = []
+                order.append(t)
+            groups[t].append(it)
+        if len(order) <= 1:
+            return [items[i:i + P] for i in range(0, len(items), P)]
+        chunks: list[list] = []
+        for t in order:
+            g = groups[t]
+            chunks += [g[i:i + P] for i in range(0, len(g), P)]
+        cap = max(max(1, self.cfg.max_drain_batches), -(-len(items) // P))
+        # Bound the compiled batch axis by merging ADJACENT chunks — the
+        # flattened pod order (and with it the pop's cross-tenant priority
+        # order inside the sequential batch scan) is preserved exactly;
+        # a size-sorted merge would let a larger low-priority chunk fold
+        # its wins into contested capacity ahead of an earlier
+        # higher-priority one.
+        while len(chunks) > cap:
+            best_i = None
+            best = P + 1
+            for i in range(len(chunks) - 1):
+                comb = len(chunks[i]) + len(chunks[i + 1])
+                if comb <= P and comb < best:
+                    best, best_i = comb, i
+            if best_i is None:
+                break  # nothing merges within P: accept the extra width
+            chunks[best_i] = chunks[best_i] + chunks[best_i + 1]
+            del chunks[best_i + 1]
+        return chunks
 
     def _schedule_group(self, profile, items, slot_headroom: int = 0) -> int:
         from kubernetes_tpu.utils.tracing import TRACER
@@ -947,7 +1000,7 @@ class Scheduler:
         if self.cycle_log is not None:
             self._cyc_marks.append(("encode_start",
                                     round(time.time() - t0, 3)))
-        chunks = [items[i:i + P] for i in range(0, len(items), P)]
+        chunks = self._tenant_chunks(items, P)
         with TRACER.span("scheduler/encode_pods", pods=len(pods)) as sp_enc:
             pbs = [self.cache.encode_pods(
                 profile.apply_added_affinity([p for p, _ in c]),
@@ -1679,9 +1732,34 @@ class Scheduler:
                 pdbs=self.pdb_lister(), dra=self.cache.dra_catalog)
         if res is None:
             return None
-        for v in res.victims:
-            self._evict(v)
+        if not self._evict_victims(pod, res.victims):
+            return None
         return res.node_name
+
+    @staticmethod
+    def _pod_tenant(pod: Pod):
+        from kubernetes_tpu.encode.snapshot import tenant_label_of
+        return tenant_label_of(pod.metadata.labels)
+
+    def _evict_victims(self, preemptor: Pod, victims: list) -> bool:
+        """Evict a preemption result's victims — REFUSING the whole result
+        if any victim belongs to a foreign tenant. The tenant gate makes a
+        cross-tenant candidate node unreachable, so this can only fire on
+        scheduler-side corruption; when it does, evicting a sibling
+        tenant's workload is strictly worse than failing this preemptor
+        (the audit invariant + bench fail-fast catch the count)."""
+        pt = self._pod_tenant(preemptor)
+        foreign = [v for v in victims if self._pod_tenant(v) != pt]
+        if foreign:
+            LOOP_ERRORS.inc({"site": "cross_tenant_preempt"})
+            _LOG.error(
+                "REFUSING preemption for %s: victim(s) %s belong to a "
+                "foreign tenant", preemptor.key,
+                ", ".join(v.key for v in foreign))
+            return False
+        for v in victims:
+            self._evict(v)
+        return True
 
     def _preempt_serial(self, nodes, bound, views) -> list:
         """Serial host-scan preemption for a wave: each winner's victims
@@ -1848,12 +1926,10 @@ class Scheduler:
                 results = self._preempt_serial(nodes, bound, views)
             out_serial: list[Optional[str]] = []
             with TRACER.span("preempt/evict"):
-                for res in results:
-                    if res is None:
+                for p, res in zip(pods, results):
+                    if res is None or not self._evict_victims(p, res.victims):
                         out_serial.append(None)
                         continue
-                    for v in res.victims:
-                        self._evict(v)
                     out_serial.append(res.node_name)
             return out_serial
         try:
@@ -1902,12 +1978,10 @@ class Scheduler:
                 namespace_labels=self.cache.namespace_labels)
         out: list[Optional[str]] = []
         with TRACER.span("preempt/evict"):
-            for res in results:
-                if res is None:
+            for p, res in zip(pods, results):
+                if res is None or not self._evict_victims(p, res.victims):
                     out.append(None)
                     continue
-                for v in res.victims:
-                    self._evict(v)
                 out.append(res.node_name)
         return out
 

@@ -1,0 +1,640 @@
+"""Fleet scheduling: K tenant clusters through one warm resident program.
+
+The acceptance spine (sched/fleet.py + the tenant plane in the encoder/
+model stack):
+
+- mask soundness / BIT-PARITY: fleet-batched placements on randomized
+  K-tenant clusters equal K independently-scheduled single-tenant runs
+  (same seeds) — scoring, tie-breaks, spread minima and preemption waves
+  included, with topology label values deliberately SHARED across tenants.
+- isolation: a pod can never see (or preempt on) a sibling tenant's node;
+  the ``cross_tenant`` audit invariant and the scheduler's victim guard
+  are the hard walls behind the mask.
+- fairness: FleetQueue fills the drain in single-tenant blocks, weighted
+  round-robin, short block closes the pop.
+- per-tenant status publishing does not collide (the parameterized
+  ConfigMap-name regression).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.encode.snapshot import (
+    TENANT_KEY_ID,
+    TENANT_LABEL,
+    SnapshotEncoder,
+)
+from kubernetes_tpu.sched.fleet import (
+    FleetQueue,
+    FleetRunner,
+    rekey_for_tenant,
+    split_fleet_name,
+    unrekey_for_tenant,
+)
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+pytestmark = pytest.mark.fleet
+
+ZONES = ("z0", "z1", "z2")  # SHARED across tenants on purpose
+
+
+# ---------------------------------------------------------------------------
+# rekey boundary
+# ---------------------------------------------------------------------------
+
+def test_rekey_pod_roundtrip_and_references():
+    pod = (make_pod("p1", "teamA").req({"cpu": "1"})
+           .pod_anti_affinity("zone", {"app": "x"})
+           .obj().to_dict())
+    pod["spec"]["nodeName"] = "node-3"
+    pod["status"] = {"nominatedNodeName": "node-9"}
+    pod["spec"]["affinity"]["podAntiAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"][0][
+        "namespaces"] = ["teamB"]
+    rk = rekey_for_tenant(3, "pods", pod)
+    assert rk["metadata"]["namespace"] == "t3.teamA"
+    assert rk["spec"]["nodeName"] == "t3.node-3"
+    assert rk["status"]["nominatedNodeName"] == "t3.node-9"
+    assert rk["metadata"]["labels"][TENANT_LABEL] == "3"
+    assert rk["spec"]["affinity"]["podAntiAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"][0][
+        "namespaces"] == ["t3.teamB"]
+    # the ingested original is never mutated (informer stores share it)
+    assert pod["spec"]["nodeName"] == "node-3"
+    assert pod["metadata"].get("labels", {}).get(TENANT_LABEL) is None
+    uk = unrekey_for_tenant(3, "pods", rk)
+    assert uk["metadata"]["namespace"] == "teamA"
+    assert uk["spec"]["nodeName"] == "node-3"
+    assert uk["status"]["nominatedNodeName"] == "node-9"
+    assert TENANT_LABEL not in (uk["metadata"]["labels"] or {})
+
+
+def test_unrekey_volume_and_claim_writebacks():
+    """The binder's write-backs must never leak fleet-internal names into
+    a tenant apiserver: PVC selected-node annotation + volumeName, PV
+    claimRef, and DRA claim allocation nodeName all strip."""
+    pvc = {"metadata": {"name": "c1", "namespace": "t2.default",
+                        "annotations": {
+                            "volume.kubernetes.io/selected-node": "t2.n0"}},
+           "spec": {"volumeName": "t2.pv1", "storageClassName": "t2.fast"}}
+    uk = unrekey_for_tenant(2, "persistentvolumeclaims", pvc)
+    assert uk["metadata"]["namespace"] == "default"
+    assert uk["metadata"]["annotations"][
+        "volume.kubernetes.io/selected-node"] == "n0"
+    assert uk["spec"]["volumeName"] == "pv1"
+    assert uk["spec"]["storageClassName"] == "fast"
+    pv = {"metadata": {"name": "t2.pv1"},
+          "spec": {"storageClassName": "t2.fast",
+                   "claimRef": {"namespace": "t2.default", "name": "c1"}}}
+    uk = unrekey_for_tenant(2, "persistentvolumes", pv)
+    assert uk["metadata"]["name"] == "pv1"
+    assert uk["spec"]["claimRef"]["namespace"] == "default"
+    claim = {"metadata": {"name": "rc", "namespace": "t2.default"},
+             "status": {"allocation": {"nodeName": "t2.n0"}}}
+    uk = unrekey_for_tenant(2, "resourceclaims", claim)
+    assert uk["status"]["allocation"]["nodeName"] == "n0"
+    ev = {"metadata": {"name": "e", "namespace": "t2.default"},
+          "involvedObject": {"kind": "Pod", "name": "p",
+                             "namespace": "t2.default"}}
+    uk = unrekey_for_tenant(2, "events", ev)
+    assert uk["involvedObject"]["namespace"] == "default"
+
+
+def test_rekey_node_and_split():
+    node = make_node("n0").obj().to_dict()
+    rk = rekey_for_tenant(12, "nodes", node)
+    assert rk["metadata"]["name"] == "t12.n0"
+    assert split_fleet_name("t12.n0") == (12, "n0")
+    assert split_fleet_name("n0") == (None, "n0")
+
+
+# ---------------------------------------------------------------------------
+# tenant plane in the encoder + model stack
+# ---------------------------------------------------------------------------
+
+def _tenant_nodes(t, n, cpu="4"):
+    return [Node.from_dict(rekey_for_tenant(t, "nodes", (
+        make_node(f"n{i}")
+        .capacity({"cpu": cpu, "memory": "8Gi", "pods": "32"})
+        .label("kubernetes.io/hostname", f"n{i}")
+        .label("topology.kubernetes.io/zone", ZONES[i % len(ZONES)])
+        .obj().to_dict()))) for i in range(n)]
+
+
+def _tenant_pod(t, wrapper):
+    return Pod.from_dict(rekey_for_tenant(t, "pods",
+                                          wrapper.obj().to_dict()))
+
+
+def test_tenant_plane_rides_the_label_columns():
+    nodes = _tenant_nodes(0, 2) + _tenant_nodes(1, 2)
+    enc = SnapshotEncoder()
+    ct, meta = enc.encode_cluster(nodes, [])
+    tv = np.asarray(ct.node_labels)[:, TENANT_KEY_ID]
+    vals = [meta.values.lookup(int(v)) for v in tv[:4]]
+    assert vals == ["0", "0", "1", "1"]
+    pods = [_tenant_pod(1, make_pod("p0").req({"cpu": "1"}))]
+    pb = enc.encode_pods(pods, meta)
+    pv = np.asarray(pb.pod_labels)[:, TENANT_KEY_ID]
+    assert meta.values.lookup(int(pv[0])) == "1"
+
+
+def test_tenant_mask_gates_filters_and_oracle():
+    from kubernetes_tpu.ops.filters import run_filters
+    nodes = _tenant_nodes(0, 2) + _tenant_nodes(1, 2)
+    enc = SnapshotEncoder()
+    ct, meta = enc.encode_cluster(nodes, [])
+    pods = [_tenant_pod(0, make_pod("a").req({"cpu": "1"})),
+            _tenant_pod(1, make_pod("b").req({"cpu": "1"}))]
+    pb = enc.encode_pods(pods, meta)
+    mask = np.asarray(run_filters(ct, pb))
+    assert mask[0, :2].all() and not mask[0, 2:4].any()
+    assert mask[1, 2:4].all() and not mask[1, :2].any()
+    # oracle mirrors (first-fail vocabulary included)
+    from kubernetes_tpu.sched.oracle import FailReason, OracleScheduler
+    orc = OracleScheduler(nodes, [])
+    m, reasons = orc.feasible(pods[0])
+    assert m[:2] == [True, True] and m[2:] == [False, False]
+    assert reasons[nodes[2].metadata.name] == FailReason.TENANT
+
+
+def test_tenant_local_rank_degenerates_to_arange():
+    from kubernetes_tpu.ops.filters import tenant_local_rank
+    nodes = [make_node(f"n{i}").capacity({"cpu": "1"}).obj()
+             for i in range(5)]
+    enc = SnapshotEncoder()
+    ct, _meta = enc.encode_cluster(nodes, [])
+    rank = np.asarray(tenant_local_rank(ct))
+    np.testing.assert_array_equal(rank, np.arange(ct.node_valid.shape[0]))
+
+
+def test_tenant_local_rank_interleaved():
+    from kubernetes_tpu.ops.filters import tenant_local_rank
+    # interleave two tenants' nodes: ranks must count per tenant
+    n0 = _tenant_nodes(0, 3)
+    n1 = _tenant_nodes(1, 3)
+    nodes = [n0[0], n1[0], n0[1], n1[1], n0[2], n1[2]]
+    enc = SnapshotEncoder()
+    ct, _meta = enc.encode_cluster(nodes, [])
+    rank = np.asarray(tenant_local_rank(ct))[:6]
+    np.testing.assert_array_equal(rank, [0, 0, 1, 1, 2, 2])
+
+
+# ---------------------------------------------------------------------------
+# THE parity gate: fleet-batched == K independent single-tenant runs
+# ---------------------------------------------------------------------------
+
+def _random_workload(rng, t, n_nodes, n_pods):
+    """One tenant's randomized cluster: shared zone values, mixed
+    capacities, pods with random requests, priorities, spread and
+    anti-affinity terms."""
+    nodes = [make_node(f"n{i}")
+             .capacity({"cpu": rng.choice(["2", "4", "8"]),
+                        "memory": "16Gi", "pods": "64"})
+             .label("kubernetes.io/hostname", f"n{i}")
+             .label("topology.kubernetes.io/zone", rng.choice(ZONES))
+             .obj().to_dict() for i in range(n_nodes)]
+    pods = []
+    for i in range(n_pods):
+        w = (make_pod(f"p{i}")
+             .req({"cpu": rng.choice(["250m", "500m", "1"])})
+             .label("app", rng.choice(["a", "b"]))
+             .priority(rng.choice([0, 0, 10])))
+        r = rng.random()
+        if r < 0.3:
+            w = w.spread(1, "topology.kubernetes.io/zone", "DoNotSchedule",
+                         {"app": "a"})
+        elif r < 0.5:
+            w = w.pod_anti_affinity("kubernetes.io/hostname",
+                                    {"app": "b"})
+        pods.append(w.obj().to_dict())
+    return nodes, pods
+
+
+def _drain_assignments(nodes, pod_chunks, batch, seed=7):
+    """Schedule ``pod_chunks`` (ragged, possibly tenant-homogeneous — the
+    live path's Scheduler._tenant_chunks shape) over ``nodes`` with the
+    drain program, every chunk's bucket pinned to ``batch`` (min_p, the
+    scheduler's own discipline). Returns {pod key: node name or None}."""
+    from kubernetes_tpu.models.gang import gang_drain
+    enc = SnapshotEncoder()
+    typed_nodes = [Node.from_dict(n) for n in nodes]
+    batches = [[Pod.from_dict(p) for p in c] for c in pod_chunks]
+    all_pods = [p for c in batches for p in c]
+    ct, meta = enc.encode_cluster(typed_nodes, [], pending_pods=all_pods)
+    pbs = [enc.encode_pods(b, meta, min_p=batch) for b in batches]
+    a, _rounds, _req = gang_drain(ct, pbs, seed=seed,
+                                  topo_keys=meta.topo_keys)
+    out = {}
+    for b, chunk in enumerate(batches):
+        for i, p in enumerate(chunk):
+            ni = int(a[b][i])
+            out[p.key] = meta.node_names[ni] if ni >= 0 else None
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fleet_parity_randomized(seed):
+    """Randomized K-tenant clusters: the fleet-batched drain (tenants
+    concatenated on the node axis, per-tenant batches, shared zone label
+    values) places every tenant's pods EXACTLY where its standalone run
+    does — scores, spread minima, tie-breaks and all."""
+    rng = random.Random(seed)
+    K, batch = 3, 8
+    singles = {}
+    fleet_nodes = []
+    fleet_chunks = []
+    per_tenant = {}
+    for t in range(K):
+        nodes, pods = _random_workload(rng, t, n_nodes=rng.randint(3, 6),
+                                       n_pods=rng.randint(6, 12))
+        per_tenant[t] = (nodes, pods)
+        singles[t] = _drain_assignments(
+            nodes, [pods[i:i + batch] for i in range(0, len(pods), batch)],
+            batch)
+    # fleet leg: INTERLEAVE tenants' nodes (worst case for index-based
+    # tie-breaks), per-tenant ragged chunks in tenant order — exactly the
+    # shape FleetQueue block fill + Scheduler._tenant_chunks produce
+    maxn = max(len(n) for n, _p in per_tenant.values())
+    for i in range(maxn):
+        for t in range(K):
+            nodes, _pods = per_tenant[t]
+            if i < len(nodes):
+                fleet_nodes.append(rekey_for_tenant(t, "nodes", nodes[i]))
+    for t in range(K):
+        _nodes, pods = per_tenant[t]
+        rk = [rekey_for_tenant(t, "pods", p) for p in pods]
+        fleet_chunks += [rk[i:i + batch] for i in range(0, len(rk), batch)]
+    fleet = _drain_assignments(fleet_nodes, fleet_chunks, batch)
+    mismatches = []
+    for t in range(K):
+        _nodes, pods = per_tenant[t]
+        for p in pods:
+            key = f"default/{p['metadata']['name']}"
+            fkey = f"t{t}.default/{p['metadata']['name']}"
+            want = singles[t][key]
+            got = fleet.get(fkey)
+            if got is not None:
+                _tid, got = split_fleet_name(got)
+                assert _tid == t, f"cross-tenant placement: {fkey} -> {got}"
+            if want != got:
+                mismatches.append((fkey, want, got))
+    assert not mismatches, mismatches
+
+
+def test_fleet_preempt_wave_parity():
+    """Preemption-wave parity: per-tenant victims + chosen nodes in the
+    fleet view equal each tenant's standalone wave (and never cross)."""
+    from kubernetes_tpu.sched.preemption import preempt_wave
+
+    def leg(t_ids):
+        nodes, bound, views = [], [], []
+        for t in t_ids:
+            for i in range(2):
+                nd = make_node(f"n{i}").capacity(
+                    {"cpu": "2", "memory": "4Gi", "pods": "8"}) \
+                    .label("kubernetes.io/hostname", f"n{i}").obj().to_dict()
+                nodes.append(Node.from_dict(
+                    rekey_for_tenant(t, "nodes", nd)) if t is not None
+                    else Node.from_dict(nd))
+            for i in range(2):
+                pd = make_pod(f"victim{i}").req({"cpu": "2"}) \
+                    .priority(0).obj().to_dict()
+                pd["spec"]["nodeName"] = f"n{i}"
+                bound.append(Pod.from_dict(
+                    rekey_for_tenant(t, "pods", pd)) if t is not None
+                    else Pod.from_dict(pd))
+            hp = make_pod("vip").req({"cpu": "2"}).priority(100) \
+                .obj().to_dict()
+            views.append(Pod.from_dict(
+                rekey_for_tenant(t, "pods", hp)) if t is not None
+                else Pod.from_dict(hp))
+        return preempt_wave(nodes, bound, views)
+
+    fleet = leg([0, 1])
+    single = leg([None])
+    assert all(r is not None for r in fleet)
+    for t, res in enumerate(fleet):
+        tid, raw = split_fleet_name(res.node_name)
+        assert tid == t
+        assert raw == single[0].node_name
+        assert [split_fleet_name(v.key.split("/")[0])[0]
+                for v in res.victims] == [t] * len(res.victims)
+        assert sorted(v.metadata.name for v in res.victims) == \
+            sorted(v.metadata.name for v in single[0].victims)
+
+
+def test_fleet_gang_atomicity_per_tenant():
+    """Per-tenant gangs (anti-affine members needing distinct hosts) ride
+    the fleet drain atomically: a gang that fits its OWN tenant binds
+    whole; a gang that does NOT fit its tenant binds nobody — even though
+    a sibling tenant has idle nodes that could host the overflow."""
+    from kubernetes_tpu.audit.invariants import GANG_LABEL
+
+    def gang(t, size):
+        out = []
+        for i in range(size):
+            w = (make_pod(f"g{i}").req({"cpu": "1"})
+                 .label(GANG_LABEL, f"gang-{t}")
+                 .label("grp", f"g{t}")
+                 .pod_anti_affinity("kubernetes.io/hostname",
+                                    {"grp": f"g{t}"}))
+            out.append(rekey_for_tenant(t, "pods", w.obj().to_dict()))
+        return out
+
+    # tenant 0: 3 nodes, gang of 3 (fits). tenant 1: 3 nodes, gang of 5
+    # (cannot fit anti-affine members on 3 hosts).
+    nodes = [rekey_for_tenant(t, "nodes", n.to_dict())
+             for t in (0, 1)
+             for n in (make_node(f"n{i}")
+                       .capacity({"cpu": "4", "memory": "8Gi", "pods": "8"})
+                       .label("kubernetes.io/hostname", f"n{i}").obj()
+                       for i in range(3))]
+    chunks = [gang(0, 3), gang(1, 5)]
+    got = _drain_assignments(nodes, chunks, batch=8)
+    t0_placed = [v for k, v in got.items() if k.startswith("t0.")]
+    t1_placed = [v for k, v in got.items() if k.startswith("t1.")]
+    assert all(v is not None for v in t0_placed)
+    assert len({v for v in t0_placed}) == 3          # distinct hosts
+    assert all(v is None or v.startswith("t1.") for v in t1_placed)
+    # at most 3 of tenant 1's 5 anti-affine members can hold a host;
+    # none may spill onto tenant 0's idle nodes
+    assert sum(v is not None for v in t1_placed) <= 3
+
+
+def test_cross_tenant_victim_guard():
+    """Scheduler._evict_victims refuses a preemption result carrying a
+    foreign tenant's victim (belt-and-braces behind the mask)."""
+    from kubernetes_tpu.config.types import SchedulerConfiguration
+    from kubernetes_tpu.sched.cache import SchedulerCache
+    from kubernetes_tpu.sched.queue import SchedulingQueue
+    from kubernetes_tpu.sched.scheduler import Scheduler
+    sch = Scheduler(SchedulerConfiguration(), SchedulerCache(),
+                    SchedulingQueue(), lambda p, n: True)
+    evicted = []
+    sch._evict = lambda v: evicted.append(v.key)
+    preemptor = _tenant_pod(0, make_pod("vip").priority(100))
+    own = _tenant_pod(0, make_pod("mine"))
+    foreign = _tenant_pod(1, make_pod("theirs"))
+    assert sch._evict_victims(preemptor, [own]) is True
+    assert evicted == [own.key]
+    evicted.clear()
+    assert sch._evict_victims(preemptor, [own, foreign]) is False
+    assert evicted == []  # nothing evicted when ANY victim is foreign
+
+
+# ---------------------------------------------------------------------------
+# audit invariant
+# ---------------------------------------------------------------------------
+
+def test_cross_tenant_invariant():
+    from kubernetes_tpu.audit.invariants import (AuditSnapshot,
+                                                 check_cross_tenant)
+    node0 = rekey_for_tenant(0, "nodes", make_node("n0").obj().to_dict())
+    node1 = rekey_for_tenant(1, "nodes", make_node("n0").obj().to_dict())
+    ok_pod = rekey_for_tenant(0, "pods",
+                              make_pod("good").obj().to_dict())
+    ok_pod["spec"]["nodeName"] = "t0.n0"
+    bad_pod = rekey_for_tenant(0, "pods", make_pod("bad").obj().to_dict())
+    bad_pod["spec"]["nodeName"] = "t1.n0"
+    nom_pod = rekey_for_tenant(1, "pods", make_pod("nom").obj().to_dict())
+    nom_pod["status"] = {"nominatedNodeName": "t0.n0"}
+    snap = AuditSnapshot(ts=time.time(), rv=None,
+                         api_pods=[ok_pod, bad_pod, nom_pod],
+                         api_nodes=[node0, node1])
+    v = check_cross_tenant(snap)
+    assert {x.fingerprint[1:3] for x in v} == {
+        ("t0.default/bad", "nodeName"),
+        ("t1.default/nom", "nominatedNodeName")}
+    assert all(x.confirm == 1 for x in v)
+    # untenanted cluster: check is a no-op
+    plain_pod = make_pod("p").obj().to_dict()
+    plain_pod["spec"]["nodeName"] = "x"
+    snap2 = AuditSnapshot(ts=time.time(), rv=None, api_pods=[plain_pod],
+                          api_nodes=[make_node("x").obj().to_dict()])
+    assert check_cross_tenant(snap2) == []
+
+
+# ---------------------------------------------------------------------------
+# fairness plane
+# ---------------------------------------------------------------------------
+
+def _queued(t, name, prio=0):
+    p = make_pod(name, f"t{t}.default").priority(prio).obj()
+    p.metadata.labels[TENANT_LABEL] = str(t)
+    return p
+
+
+def test_fleet_queue_round_robin_blocks():
+    q = FleetQueue(block=4)
+    for t in range(3):
+        for i in range(10):
+            q.add(_queued(t, f"p{i}"))
+    batch = [p.metadata.labels[TENANT_LABEL] for p, _ in
+             q.pop_batch(12, wait=0.1)]
+    assert len(batch) == 12
+    for i in range(0, 12, 4):
+        assert len(set(batch[i:i + 4])) == 1  # single-tenant blocks
+    assert set(batch) == {"0", "1", "2"}      # nobody starved
+
+
+def test_fleet_queue_weighted_and_rotating():
+    q = FleetQueue(block=2, weights={"0": 2})
+    for t in range(2):
+        for i in range(8):
+            q.add(_queued(t, f"p{i}"))
+    batch = [p.metadata.labels[TENANT_LABEL] for p, _ in
+             q.pop_batch(6, wait=0.1)]
+    # tenant 0 carries weight 2: two blocks per rotation vs one
+    assert batch.count("0") == 4 and batch.count("1") == 2
+    # rotation cursor moved: the next pop starts from the other tenant
+    batch2 = [p.metadata.labels[TENANT_LABEL] for p, _ in
+              q.pop_batch(2, wait=0.1)]
+    assert batch2 == ["1", "1"]
+
+
+def test_fleet_queue_short_block_closes_pop():
+    q = FleetQueue(block=4)
+    q.add(_queued(0, "only"))       # tenant 0: 1 pod (short block)
+    for i in range(8):
+        q.add(_queued(1, f"p{i}"))
+    batch = [(p.metadata.labels[TENANT_LABEL], p.metadata.name)
+             for p, _ in q.pop_batch(8, wait=0.1)]
+    # whoever comes first, a short block must be the LAST block popped
+    tenants = [t for t, _ in batch]
+    if tenants[0] == "0":
+        assert batch == [("0", "only")]  # short block closed the pop
+    else:
+        assert tenants[:4] == ["1"] * 4 and batch[4][0] == "0"
+    # leftovers stay queued (priority order intact)
+    rest = q.pop_batch(16, wait=0.1)
+    assert len(batch) + len(rest) == 9
+
+
+def test_fleet_queue_single_tenant_degenerates():
+    q = FleetQueue(block=4)
+    for i in range(6):
+        q.add(make_pod(f"p{i}").priority(i).obj())
+    got = [p.metadata.name for p, _ in q.pop_batch(6, wait=0.1)]
+    assert got == [f"p{i}" for i in range(5, -1, -1)]  # priority desc
+
+
+def test_scheduler_tenant_chunks():
+    from kubernetes_tpu.config.types import SchedulerConfiguration
+    from kubernetes_tpu.sched.cache import SchedulerCache
+    from kubernetes_tpu.sched.queue import SchedulingQueue
+    from kubernetes_tpu.sched.scheduler import Scheduler
+    sch = Scheduler(SchedulerConfiguration(batch_size=4,
+                                           max_drain_batches=4),
+                    SchedulerCache(), SchedulingQueue(), lambda p, n: True)
+    items = [(_queued(t, f"p{i}"), 0) for t in (0, 1) for i in range(6)]
+    # fleet off: plain slicing (mixed chunks)
+    plain = sch._tenant_chunks(items, 4)
+    assert [len(c) for c in plain] == [4, 4, 4]
+    sch.fleet_mode = True
+    chunks = sch._tenant_chunks(items, 4)
+    for c in chunks:
+        tenants = {(p.metadata.labels or {}).get(TENANT_LABEL)
+                   for p, _ in c}
+        assert len(tenants) == 1  # tenant-homogeneous
+    assert sorted(len(c) for c in chunks) == [2, 2, 4, 4]
+
+
+# ---------------------------------------------------------------------------
+# per-tenant catalog epochs
+# ---------------------------------------------------------------------------
+
+def test_tenant_scoped_catalog_epochs():
+    enc = SnapshotEncoder()
+    p0 = _tenant_pod(0, make_pod("a").req({"cpu": "1"}))
+    p1 = _tenant_pod(1, make_pod("b").req({"cpu": "1"}))
+    enc.precompile_pod(p0)
+    enc.precompile_pod(p1)
+    nodes = _tenant_nodes(0, 1) + _tenant_nodes(1, 1)
+    _ct, meta = enc.encode_cluster(nodes, [], pending_pods=[p0, p1])
+    enc.pod_cache_hits = enc.pod_cache_misses = 0
+    # tenant 1's namespace churns: ONLY tenant 1's record invalidates
+    enc.set_namespaces({"t1.default": {TENANT_LABEL: "1", "x": "y"}},
+                       changed_tenants={"1"})
+    enc.encode_pods([p0, p1], meta)
+    assert enc.pod_cache_hits == 1 and enc.pod_cache_misses == 1
+    # a GLOBAL catalog change (volumes) still invalidates everyone
+    enc.pod_cache_hits = enc.pod_cache_misses = 0
+    enc.set_volumes(None)
+    enc.encode_pods([p0, p1], meta)
+    assert enc.pod_cache_hits == 0 and enc.pod_cache_misses == 2
+
+
+# ---------------------------------------------------------------------------
+# status publishing: parameterized ConfigMap names (regression)
+# ---------------------------------------------------------------------------
+
+def test_two_tenant_status_publishers_do_not_collide():
+    """Two scheduler identities on ONE apiserver, publishing concurrently
+    with per-instance ConfigMap names: both survive with their own
+    identity (the old module-constant name made the last writer win)."""
+    from kubernetes_tpu.client.clientset import DirectClient
+    from kubernetes_tpu.sched.runner import SchedulerRunner
+    from kubernetes_tpu.store.store import ObjectStore
+    client = DirectClient(ObjectStore())
+    runners = [
+        SchedulerRunner(client, identity=f"sched-{i}",
+                        status_name=f"scheduler-status-{i}",
+                        explain_name=f"scheduler-explanations-{i}",
+                        trace_name=f"scheduler-trace-{i}")
+        for i in range(2)]
+    try:
+        threads = [threading.Thread(target=r.publish_status)
+                   for r in runners for _ in range(3)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(10.0)
+        for i in range(2):
+            cm = client.resource("configmaps", "default").get(
+                f"scheduler-status-{i}")
+            st = json.loads(cm["data"]["status"])
+            assert st["identity"] == f"sched-{i}"
+    finally:
+        for r in runners:
+            r.stop()
+
+
+# ---------------------------------------------------------------------------
+# connected e2e: 2 tenant apiservers, one FleetRunner
+# ---------------------------------------------------------------------------
+
+def test_fleet_runner_e2e_two_tenants():
+    from kubernetes_tpu.client.clientset import HTTPClient
+    from kubernetes_tpu.config.types import SchedulerConfiguration
+    from kubernetes_tpu.store.apiserver import APIServer
+
+    servers = [APIServer().start() for _ in range(2)]
+    clients = [HTTPClient(s.url) for s in servers]
+    runner = None
+    try:
+        for c in clients:
+            for i in range(3):
+                c.nodes().create(make_node(f"n{i}").capacity(
+                    {"cpu": "4", "memory": "8Gi", "pods": "32"})
+                    .obj().to_dict())
+        runner = FleetRunner(clients,
+                             SchedulerConfiguration(batch_size=8))
+        runner.start(wait_sync=20.0)
+        for c in clients:
+            for i in range(6):
+                c.pods("default").create(
+                    make_pod(f"p{i}", "default").req({"cpu": "100m"})
+                    .obj().to_dict())
+        deadline = time.time() + 90
+        bound = [0, 0]
+        while time.time() < deadline:
+            bound = [sum(1 for p in c.pods("default").list()
+                         if p["spec"].get("nodeName")) for c in clients]
+            if all(b == 6 for b in bound):
+                break
+            time.sleep(0.3)
+        assert bound == [6, 6], bound
+        # node names on each tenant apiserver are RAW (prefix stripped)
+        for t, c in enumerate(clients):
+            for p in c.pods("default").list():
+                assert not p["spec"]["nodeName"].startswith("t"), p
+                assert p["spec"]["nodeName"] in {"n0", "n1", "n2"}
+        # the continuous auditor (cross_tenant live) confirms nothing
+        runner.auditor.run_once()
+        runner.auditor.run_once()
+        assert runner.auditor.total_violations == 0
+        # per-tenant fairness CM lands on EVERY tenant's apiserver (the
+        # background auditor republishes on its cadence; publish NOW for
+        # a deterministic read)
+        runner.publish_status()
+        for c in clients:
+            cm = c.resource("configmaps", "default").get(
+                "kubernetes-tpu-fleet-sched-status")
+            fs = json.loads(cm["data"]["fleetSched"])
+            assert fs["tenants"] == 2
+            assert all(d["bound"] == 6 for d in fs["tenant"].values())
+        # ktpu status renders the Fleet sched line from any tenant
+        import io
+        from kubernetes_tpu.cli.ktpu import main as ktpu_main
+        out = io.StringIO()
+        rc = ktpu_main(["--server", servers[0].url, "status"], out=out)
+        assert rc == 0
+        assert "Fleet sched:   2 tenants, one warm program" in out.getvalue()
+        rc = ktpu_main(["--server", servers[1].url, "status", "-o", "json"],
+                       out=(out2 := io.StringIO()))
+        assert rc == 0
+        assert json.loads(out2.getvalue())["fleetSched"]["tenants"] == 2
+    finally:
+        if runner is not None:
+            runner.kill()
+        for s in servers:
+            s.stop()
