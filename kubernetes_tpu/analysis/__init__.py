@@ -1,0 +1,19 @@
+"""ktpu-lint — project-native static analysis for kubernetes-tpu.
+
+Go's race detector and ``go vet`` did not survive the paper's Go->Python
+translation; this package is their project-native replacement. Entry
+points: ``ktpu lint`` (CLI subcommand), ``python -m kubernetes_tpu.
+analysis`` (standalone), ``tests/test_lint.py`` (tier-1 fail-on-new gate).
+"""
+
+from kubernetes_tpu.analysis.baseline import (
+    DEFAULT_BASELINE,
+    diff,
+    load_baseline,
+    write_baseline,
+)
+from kubernetes_tpu.analysis.engine import Finding, run_analysis
+from kubernetes_tpu.analysis.rules import RULE_CLASSES, make_rules
+
+__all__ = ["Finding", "run_analysis", "RULE_CLASSES", "make_rules",
+           "DEFAULT_BASELINE", "load_baseline", "write_baseline", "diff"]

@@ -1,0 +1,8 @@
+"""``python -m kubernetes_tpu.analysis`` — standalone ktpu-lint."""
+
+import sys
+
+from kubernetes_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,37 @@
+"""ktpu-lint rule registry — each rule is a shipped-and-fixed bug class.
+
+Adding a rule: drop a module here with a Rule subclass, give it the next
+KTL id, register it in ``make_rules``, add fixture tests (one proving it
+fires, one proving ``# ktpu-lint: disable=KTL00N -- reason`` works), and
+regenerate the baseline if it surfaces pre-existing findings.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.analysis.rules.base import Rule
+from kubernetes_tpu.analysis.rules.ktl001_guarded_by import GuardedByRule
+from kubernetes_tpu.analysis.rules.ktl002_silent_swallow import SilentSwallowRule
+from kubernetes_tpu.analysis.rules.ktl003_clock import ClockDisciplineRule
+from kubernetes_tpu.analysis.rules.ktl004_threads import ThreadHygieneRule
+from kubernetes_tpu.analysis.rules.ktl005_donation import DonationDisciplineRule
+from kubernetes_tpu.analysis.rules.ktl006_configmap import ConfigMapWriteRule
+from kubernetes_tpu.analysis.rules.ktl007_metrics import MetricsRegistryRule
+
+RULE_CLASSES: tuple[type[Rule], ...] = (
+    GuardedByRule,
+    SilentSwallowRule,
+    ClockDisciplineRule,
+    ThreadHygieneRule,
+    DonationDisciplineRule,
+    ConfigMapWriteRule,
+    MetricsRegistryRule,
+)
+
+
+def make_rules() -> list[Rule]:
+    """Fresh rule instances (rules carry cross-file state; one set per
+    run)."""
+    return [cls() for cls in RULE_CLASSES]
+
+
+__all__ = ["Rule", "RULE_CLASSES", "make_rules"]

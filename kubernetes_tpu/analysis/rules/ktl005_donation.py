@@ -1,0 +1,112 @@
+"""KTL005 — donation discipline: the PR-11 zero-copy contract, statically.
+
+Two halves of one contract (ab04159, "donate-through dispatch"):
+
+- A ``jax.jit``/``pjit`` with ``donate_argnums`` whose outputs' shardings
+  are NOT pinned (``out_shardings=`` or a ``constrain_cluster`` constraint
+  inside the program) invites XLA to pick different output layouts than
+  the inputs it donated — and then every steady-state cycle pays a silent
+  copy-on-donate reshard instead of aliasing the resident encoding in
+  place. That regression does not fail; it just quietly triples HBM
+  traffic (the exact MULTICHIP_r06 hole PR 11 closed).
+
+- ``jax.device_get`` outside the drain resolver and the parity sentinel:
+  the steady-state cycle's ONLY device->host transfer is the resolver's
+  O(P) winners fetch. A new ``device_get`` on any other path is a new
+  synchronous host round-trip hiding in the pipeline. Deliberate off-hot-
+  path readbacks (preemption wave, explainer, oracle fallbacks) carry a
+  reasoned suppression at the call site — the reason IS the review.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubernetes_tpu.analysis.engine import FileContext
+from kubernetes_tpu.analysis.rules.base import (
+    Rule,
+    dotted_name,
+    keyword_names,
+)
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_DONATE_KW = {"donate_argnums", "donate_argnames"}
+
+# files allowed to device_get: the drain resolver owns the winners fetch,
+# the sentinel re-judges sampled dispatches off the hot path by design
+DEVICE_GET_WHITELIST = (
+    "kubernetes_tpu/sched/scheduler.py",
+    "kubernetes_tpu/audit/sentinel.py",
+)
+# the sharding helpers themselves
+JIT_WHITELIST = ("kubernetes_tpu/parallel/mesh.py",)
+
+
+def _jit_call(node: ast.Call) -> ast.Call | None:
+    """The jit-ish call carrying keywords: the call itself, or the inner
+    target of ``partial(jax.jit, ...)`` (keywords live on the partial)."""
+    name = dotted_name(node.func)
+    if name in _JIT_NAMES:
+        return node
+    if name in ("partial", "functools.partial") and node.args:
+        if dotted_name(node.args[0]) in _JIT_NAMES:
+            return node
+    return None
+
+
+def _decorated_function(ctx: FileContext, call: ast.Call):
+    parent = ctx.parents.get(call)
+    if (isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and call in parent.decorator_list):
+        return parent
+    return None
+
+
+def _mentions_constrain(func: ast.AST) -> bool:
+    for node in ast.walk(func):
+        if isinstance(node, ast.Name) and node.id == "constrain_cluster":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "constrain_cluster":
+            return True
+        if (isinstance(node, ast.ImportFrom)
+                and any(a.name == "constrain_cluster" for a in node.names)):
+            return True
+    return False
+
+
+class DonationDisciplineRule(Rule):
+    id = "KTL005"
+    title = "donation without pinned output shardings / stray device_get"
+
+    def visit(self, ctx: FileContext) -> list[tuple[int, str]]:
+        out: list[tuple[int, str]] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if name.endswith("device_get") and name in ("device_get",
+                                                        "jax.device_get"):
+                if ctx.relpath not in DEVICE_GET_WHITELIST:
+                    out.append((node.lineno,
+                                "device_get outside the resolver/sentinel "
+                                "whitelist — the steady-state cycle's only "
+                                "d2h is the O(P) winners fetch (PR-11 "
+                                "zero-copy contract)"))
+                continue
+            jit = _jit_call(node)
+            if jit is None or ctx.relpath in JIT_WHITELIST:
+                continue
+            kws = keyword_names(jit)
+            if not (kws & _DONATE_KW):
+                continue
+            if "out_shardings" in kws:
+                continue
+            fn = _decorated_function(ctx, jit)
+            if fn is not None and _mentions_constrain(fn):
+                continue
+            out.append((jit.lineno,
+                        "donate_argnums without out_shardings (and no "
+                        "constrain_cluster pin in the program): donation "
+                        "degrades to copy-on-donate when XLA picks "
+                        "different output layouts"))
+        return out

@@ -59,7 +59,7 @@ def active_chaos_seed() -> Optional[int]:
         c = getattr(hooks, "_ACTIVE", None)
         if c is not None:
             return c.schedule.seed
-    except Exception:
+    except Exception:  # ktpu-lint: disable=KTL002 -- the chaos module may legitimately be absent/uninstalled; the env fallback below is the answer either way
         pass
     env = os.environ.get("KTPU_CHAOS_SEED")
     try:
@@ -126,18 +126,18 @@ class InvariantAuditor:
         # skips cache_parity (an outage-lagged cache is healing, not wrong)
         self._relists = relists
         self._last_relists: Optional[int] = None
-        # confirm engine: fingerprint -> consecutive sweeps seen
-        self._streak: dict[tuple, int] = {}
-        self._reported: set = set()
         self._lock = threading.Lock()
+        # confirm engine: fingerprint -> consecutive sweeps seen
+        self._streak: dict[tuple, int] = {}  # guarded by: self._lock
+        self._reported: set = set()  # guarded by: self._lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.sweeps = 0
-        self.last_sweep_ts: Optional[float] = None
-        self.violations: list[Violation] = []
-        self.by_invariant: dict[str, int] = {}
-        self.bundles: list[str] = []
-        self.failed = False
+        self.sweeps = 0  # guarded by: self._lock
+        self.last_sweep_ts: Optional[float] = None  # guarded by: self._lock
+        self.violations: list[Violation] = []  # guarded by: self._lock
+        self.by_invariant: dict[str, int] = {}  # guarded by: self._lock
+        self.bundles: list[str] = []  # guarded by: self._lock
+        self.failed = False  # guarded by: self._lock
 
     # ---- one sweep -------------------------------------------------------
 
@@ -165,7 +165,7 @@ class InvariantAuditor:
         if self._relists is not None:
             try:
                 now = self._relists()
-            except Exception:
+            except Exception:  # ktpu-lint: disable=KTL002 -- a broken relist probe only disables the cache_parity skip heuristic; the sweep itself proceeds
                 now = None
             if now is not None and now != self._last_relists:
                 if self._last_relists is not None:
@@ -205,7 +205,8 @@ class InvariantAuditor:
             _LOG.error("INVARIANT VIOLATION [%s]: %s (repro bundle: %s)",
                        v.invariant, v.detail, path or "<write failed>")
         if fresh and self.fail_fast:
-            self.failed = True
+            with self._lock:  # embedding benches poll .failed cross-thread
+                self.failed = True
             raise InvariantViolationError(fresh)
         return fresh
 

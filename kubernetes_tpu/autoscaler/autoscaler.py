@@ -298,30 +298,15 @@ class ClusterAutoscaler:
         }
 
     def _publish_status(self, summary: dict) -> None:
-        body = {
-            "apiVersion": "v1", "kind": "ConfigMap",
-            "metadata": {"name": STATUS_CONFIGMAP,
-                         "namespace": self.status_namespace},
-            "data": {
-                "status": json.dumps({**self.status(),
-                                      "lastLoop": summary}, indent=1),
-                "lastProbeTime": rfc3339_from_epoch(self.clock.now()),
-            },
-        }
-        cms = self.client.resource("configmaps", self.status_namespace)
-        try:
-            current = cms.get(STATUS_CONFIGMAP)
-            current["data"] = body["data"]
-            cms.update(current)
-        except ApiError as e:
-            if e.code != 404:
-                return  # conflict/unauthorized: status is best-effort
-            try:
-                cms.create(body)
-            except ApiError:
-                pass
-        except Exception:
-            pass  # status publishing never takes the loop down
+        # the shared upsert owns the create/update race + counted failure
+        # handling (best-effort: publishing never takes the loop down)
+        from kubernetes_tpu.utils.configmap import upsert_configmap
+        upsert_configmap(
+            self.client, self.status_namespace, STATUS_CONFIGMAP,
+            {"status": json.dumps({**self.status(),
+                                   "lastLoop": summary}, indent=1),
+             "lastProbeTime": rfc3339_from_epoch(self.clock.now())},
+            site="autoscaler_publish")
 
     # ---- loop ------------------------------------------------------------
 

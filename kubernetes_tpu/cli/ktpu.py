@@ -568,7 +568,7 @@ def cmd_api_resources(client: HTTPClient, args, out) -> int:
         custom = getattr(client, "_custom", {}) or {}
         rows += sorted((p, info) for p, info in custom.items()
                        if p not in ALL_RESOURCES)
-    except Exception:
+    except Exception:  # ktpu-lint: disable=KTL002 -- CLI api-resources augmentation: CRD listing is absent on older servers; the builtin table still prints
         pass
     for plural, info in rows:
         kind, namespaced = info[0], info[1]
@@ -1250,6 +1250,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write the Chrome trace-event JSON here "
                     "(default: stdout)")
 
+    lt = sub.add_parser(
+        "lint", help="project-native static analysis (ktpu-lint)")
+    # mirrors kubernetes_tpu.analysis.cli flags (REMAINDER can't forward
+    # leading optionals); dispatch rebuilds the argv and hands off
+    lt.add_argument("lint_paths", nargs="*")
+    lt.add_argument("--baseline", default=None)
+    lt.add_argument("--write-baseline", action="store_true")
+    lt.add_argument("--no-baseline", action="store_true")
+    lt.add_argument("--json", action="store_true", dest="lint_json")
+    lt.add_argument("--rule", action="append", default=None)
+
     ds = sub.add_parser("deschedule")
     ds.add_argument("action", choices=["run", "status"])
     ds.add_argument("--policy", default=None,
@@ -1265,6 +1276,20 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None, out=None) -> int:
     out = out or sys.stdout
     args = build_parser().parse_args(argv)
+    if args.cmd == "lint":  # no apiserver involved: dispatch before client
+        from kubernetes_tpu.analysis.cli import main as lint_main
+        lint_argv = list(args.lint_paths)
+        if args.baseline:
+            lint_argv += ["--baseline", args.baseline]
+        if args.write_baseline:
+            lint_argv.append("--write-baseline")
+        if args.no_baseline:
+            lint_argv.append("--no-baseline")
+        if args.lint_json:
+            lint_argv.append("--json")
+        for r in args.rule or ():
+            lint_argv += ["--rule", r]
+        return lint_main(lint_argv, out=out)
     client = HTTPClient(args.server, token=args.token,
                         user_agent="ktpu")
     try:

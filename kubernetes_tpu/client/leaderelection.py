@@ -78,7 +78,7 @@ class LeaderElector:
         zombie leader (is_leader stuck True, renewals silently stopped)."""
         try:
             return self.try_acquire_or_renew()
-        except Exception:
+        except Exception:  # ktpu-lint: disable=KTL002 -- failed acquire/renew = not leader this round; the elector loop logs leadership transitions
             return False
 
     def run(self, stop: Optional[threading.Event] = None):

@@ -9,11 +9,14 @@ successes forget. Wiring mirrors ``pkg/controller/controller_utils.go``
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, Optional
 
 from kubernetes_tpu.client.informer import InformerFactory, meta_namespace_key
 from kubernetes_tpu.client.workqueue import RateLimitingQueue
+
+_LOG = logging.getLogger(__name__)
 
 MAX_REQUEUES = 15  # maxRetries in most upstream controllers
 
@@ -114,7 +117,10 @@ class Controller:
             try:
                 self.tick()
             except Exception:
-                pass
+                # the loop survives, but a failing tick is a stalled
+                # controller — it must be visible in the logs
+                _LOG.exception("%s tick failed; retrying next interval",
+                               type(self).__name__)
 
     def stop(self):
         self._stop.set()
@@ -130,6 +136,8 @@ class Controller:
             try:
                 self.sync(key)
             except Exception:
+                _LOG.exception("%s sync of %r failed",
+                               type(self).__name__, key)
                 if self.queue.num_requeues(key) < MAX_REQUEUES:
                     self.queue.add_rate_limited(key)
                 else:

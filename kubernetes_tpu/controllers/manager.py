@@ -184,7 +184,8 @@ class ControllerManager:
                 try:
                     self.gc.sweep()
                 except Exception:
-                    pass
+                    _LOG.exception("garbage-collector sweep failed; "
+                                   "retrying next interval")
 
     def stop(self):
         self._stop.set()

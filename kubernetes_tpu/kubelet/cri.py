@@ -219,7 +219,7 @@ class RemoteRuntime(ContainerRuntime):
         try:
             return self._req("ExecSync", pod_uid=pod_uid,
                              name=name)["exit_code"] == 0
-        except Exception:
+        except Exception:  # ktpu-lint: disable=KTL002 -- exec-probe failure = unhealthy verdict; the probe result is the signal, prober handles transitions
             return False
 
     def set_health(self, pod_uid, name, healthy: bool):

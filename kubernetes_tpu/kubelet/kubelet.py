@@ -14,12 +14,15 @@ condition), status manager in ``pkg/kubelet/status/status_manager.go``
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
 import time
 from typing import Optional
 
 from kubernetes_tpu.api.types import Pod
 from kubernetes_tpu.client.clientset import ApiError
+
+_LOG = logging.getLogger(__name__)
 from kubernetes_tpu.client.informer import SharedInformer
 from kubernetes_tpu.kubelet.pleg import GenericPLEG
 from kubernetes_tpu.kubelet.pod_workers import PodWorkers
@@ -303,7 +306,7 @@ class Kubelet:
             try:
                 self._sync_static_pods()
             except Exception:
-                pass
+                _LOG.exception("static-pod sync failed; retrying next poll")
 
     def _sync_static_pods(self):
         import json as _json
@@ -320,7 +323,7 @@ class Kubelet:
                     else:
                         import yaml
                         manifest = yaml.safe_load(f)
-            except Exception:
+            except Exception:  # ktpu-lint: disable=KTL002 -- torn/invalid manifest file: skip until it parses (writer may be mid-write)
                 continue  # torn/invalid file: skip until it parses
             if not isinstance(manifest, dict) or                     manifest.get("kind") != "Pod":
                 continue
@@ -409,7 +412,7 @@ class Kubelet:
                     if e.code == 404:
                         self._static_mirror_pending.add(uid)
                     continue
-                except Exception:
+                except Exception:  # ktpu-lint: disable=KTL002 -- transient transport error probing a mirror pod; the next resync sweep retries
                     continue  # transient transport error: next sweep
                 cur_hash = ((cur.get("metadata") or {})
                             .get("annotations") or {}).get(
@@ -488,7 +491,7 @@ class Kubelet:
         while not self._stop.is_set():
             try:
                 ev = self.pleg.events.get(timeout=0.2)
-            except Exception:
+            except Exception:  # ktpu-lint: disable=KTL002 -- queue.Empty timeout is the idle tick of the PLEG relist loop
                 continue
             with self._pods_lock:
                 pod = self._pods.get(ev.pod_uid)

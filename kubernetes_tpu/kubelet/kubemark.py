@@ -96,9 +96,9 @@ class _ShardedBatcher:
         self.max_batch = max(1, int(max_batch))
         self._phase = phase
         self._locks = [threading.Lock() for _ in range(self.n_shards)]
-        self._members: list[dict] = [{} for _ in range(self.n_shards)]
-        self._queued: list[dict] = [{} for _ in range(self.n_shards)]
-        self._errs = [0] * self.n_shards  # consecutive flush failures
+        self._members: list[dict] = [{} for _ in range(self.n_shards)]  # guarded by: self._locks[i]
+        self._queued: list[dict] = [{} for _ in range(self.n_shards)]  # guarded by: self._locks[i]
+        self._errs = [0] * self.n_shards  # consecutive flush failures; shard-thread-private (each slot touched only by its own shard loop)
         self._stop = threading.Event()
         self._t0 = time.monotonic()
         # counters are shared across the K shard threads (and flush_all
@@ -106,13 +106,13 @@ class _ShardedBatcher:
         # items total would silently deflate the Fleet rates the bench
         # JSON records — so updates go through _count()
         self._stats_lock = threading.Lock()
-        self.flushes = 0
-        self.items = 0
-        self.last_batch = 0
-        self.errors = 0
-        self.drops = 0
-        self.requeued = 0
-        self.reconnects = 0
+        self.flushes = 0    # guarded by: self._stats_lock
+        self.items = 0      # guarded by: self._stats_lock
+        self.last_batch = 0  # guarded by: self._stats_lock
+        self.errors = 0     # guarded by: self._stats_lock
+        self.drops = 0      # guarded by: self._stats_lock
+        self.requeued = 0   # guarded by: self._stats_lock
+        self.reconnects = 0  # guarded by: self._stats_lock
         self._threads = [
             threading.Thread(target=self._shard_loop, args=(i,), daemon=True)
             for i in range(self.n_shards)]
@@ -258,12 +258,14 @@ class _ShardedBatcher:
 
     def stats(self) -> dict:
         elapsed = max(time.monotonic() - self._t0, 1e-9)
-        return {"shards": self.n_shards, "flushes": self.flushes,
-                "items": self.items, "lastBatch": self.last_batch,
-                "errors": self.errors, "drops": self.drops,
-                "requeued": self.requeued, "reconnects": self.reconnects,
-                "backingOff": sum(1 for e in self._errs if e),
-                "itemsPerS": round(self.items / elapsed, 2)}
+        with self._stats_lock:  # consistent snapshot vs in-flight _count()
+            return {"shards": self.n_shards, "flushes": self.flushes,
+                    "items": self.items, "lastBatch": self.last_batch,
+                    "errors": self.errors, "drops": self.drops,
+                    "requeued": self.requeued,
+                    "reconnects": self.reconnects,
+                    "backingOff": sum(1 for e in self._errs if e),
+                    "itemsPerS": round(self.items / elapsed, 2)}
 
     def _count(self, n_items: int) -> None:
         with self._stats_lock:
@@ -314,8 +316,8 @@ class _HeartbeatBatcher(_ShardedBatcher):
                  max_batch: int = 512, phase: float = 0.0,
                  refresh_every: int = 30):
         self.refresh_every = max(1, int(refresh_every))
-        self._beats: dict[str, int] = {}
-        self._fps: dict[str, tuple] = {}
+        self._beats: dict[str, int] = {}  # guarded by: self._locks[i]
+        self._fps: dict[str, tuple] = {}  # guarded by: self._locks[i]
         super().__init__(client, period_s, shards, max_batch, phase)
 
     @staticmethod
@@ -338,19 +340,19 @@ class _HeartbeatBatcher(_ShardedBatcher):
         # building entries), so _beats/_fps updates never race flush_all;
         # _flush's fp invalidations happen outside the lock but are
         # GIL-atomic dict pops — worst case one redundant refresh
-        beat = self._beats.get(name, 0)
-        self._beats[name] = beat + 1
+        beat = self._beats.get(name, 0)  # ktpu-lint: disable=KTL001 -- _sweep holds the owning shard's lock around every _member_payload call
+        self._beats[name] = beat + 1  # ktpu-lint: disable=KTL001 -- _sweep holds the owning shard's lock around every _member_payload call
         due = ((beat + zlib.crc32(name.encode()) // self.n_shards)
                % self.refresh_every == 0)
-        if not due and self._fps.get(name) == fp:
+        if not due and self._fps.get(name) == fp:  # ktpu-lint: disable=KTL001 -- _sweep holds the owning shard's lock around every _member_payload call
             return _SKIP
-        self._fps[name] = fp
+        self._fps[name] = fp  # ktpu-lint: disable=KTL001 -- _sweep holds the owning shard's lock around every _member_payload call
         return payload
 
     def remove(self, name: str) -> None:
         super().remove(name)
-        self._beats.pop(name, None)
-        self._fps.pop(name, None)
+        self._beats.pop(name, None)  # ktpu-lint: disable=KTL001 -- GIL-atomic pop after membership removal; a racing sweep re-inserts at most one stale beat for a dead member
+        self._fps.pop(name, None)  # ktpu-lint: disable=KTL001 -- GIL-atomic pop after membership removal; a racing sweep re-inserts at most one stale fp for a dead member
 
     def _on_reconnect(self, i: int) -> None:
         # outage heal: drop shard i's members' fingerprints so every
@@ -361,7 +363,7 @@ class _HeartbeatBatcher(_ShardedBatcher):
         with self._locks[i]:
             names = list(self._members[i])
         for name in names:
-            self._fps.pop(name, None)
+            self._fps.pop(name, None)  # ktpu-lint: disable=KTL001 -- GIL-atomic pop outside the shard lock (documented above): worst case one redundant refresh, never a lost one
 
     def _flush(self, chunk: list) -> bool:
         from kubernetes_tpu.utils.tracing import TRACER
@@ -375,7 +377,7 @@ class _HeartbeatBatcher(_ShardedBatcher):
             # otherwise wait out the full refresh backstop before being
             # re-asserted
             for name, _ in chunk:
-                self._fps.pop(name, None)
+                self._fps.pop(name, None)  # ktpu-lint: disable=KTL001 -- GIL-atomic pop outside the shard lock (see _member_payload's contract): worst case one redundant refresh
             self._count_error()
             return False
         HEARTBEAT_BATCH.observe(len(chunk))
@@ -388,7 +390,7 @@ class _HeartbeatBatcher(_ShardedBatcher):
             # heartbeat (and its 404) is what retries the heal — without
             # this the node would stay missing until the refresh backstop
             for name in missing:
-                self._fps.pop(name, None)
+                self._fps.pop(name, None)  # ktpu-lint: disable=KTL001 -- GIL-atomic pop outside the shard lock (see _member_payload's contract): worst case one redundant refresh
             self._reregister(missing)
         return True
 
@@ -404,8 +406,8 @@ class _HeartbeatBatcher(_ShardedBatcher):
             return
         try:
             self.client.nodes().create_many(objs)
-        except Exception:
-            pass  # 409 = adopted/raced; transport errors retry next period
+        except Exception:  # ktpu-lint: disable=KTL002 -- 409 = adopted/raced; transport errors retry via next period's heartbeat 404 path
+            pass
 
 
 class _LeaseBatcher(_ShardedBatcher):
@@ -451,8 +453,8 @@ class _LeaseBatcher(_ShardedBatcher):
                               "leaseDurationSeconds": 40,
                               "renewTime": rt}}
                     for name, rt in missing])
-            except Exception:
-                pass  # AlreadyExists raced another creator; next period wins
+            except Exception:  # ktpu-lint: disable=KTL002 -- AlreadyExists raced another creator; the next period's renew wins either way
+                pass
         return True
 
 
@@ -662,8 +664,8 @@ class HollowCluster:
         kubelet.workers.stop()
         try:
             self.client.nodes().delete(name)
-        except Exception:
-            pass  # already gone (raced with another deleter)
+        except Exception:  # ktpu-lint: disable=KTL002 -- already gone (raced with another deleter); the kubelet is marked dead either way
+            pass
 
     def stop(self):
         self._stop.set()

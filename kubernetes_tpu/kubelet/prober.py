@@ -167,7 +167,7 @@ class ProbeManager:
             return  # startup gates the other probes
         try:
             ok = bool(self.runtime.probe(w.pod_uid, w.container))
-        except Exception:
+        except Exception:  # ktpu-lint: disable=KTL002 -- probe failure = unhealthy verdict consumed below; transitions are recorded by the prober
             ok = False
         changed = False
         if ok:

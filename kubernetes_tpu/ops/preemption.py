@@ -359,6 +359,7 @@ def dry_run_wave(nodes: list[Node], bound_pods: list[Pod],
                              np.ascontiguousarray(static_masks[:Qb]),
                              vic_req, vic_valid, vic_violating, vic_prio,
                              need, prio))
+    # ktpu-lint: disable=KTL005 -- the wave's documented contract (comment above): explicit put in, ONE batched fetch out, zero implicit transfers
     found, zero_evict, cand_nodes, evict_sel = jax.device_get(
         _wave_scan(*staged))
     out = []
@@ -405,6 +406,7 @@ def dry_run_candidates(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
     staged = jax.device_put((allocatable, requested,
                              _static_mask(nodes, pod), vic_req, vic_valid,
                              vic_violating, vic_prio, need))
+    # ktpu-lint: disable=KTL005 -- dry-run candidate ranking: explicit put in, ONE batched fetch out (same wave transfer contract)
     any_f, k_min, viols, maxprio = jax.device_get(_dry_run(*staged))
     out = []
     zero_evict = False

@@ -28,10 +28,10 @@ class SchedulerCache:
         # snapshot() callers (scheduling loop + binder workers' volume path)
         # must not interleave delta pops/encodes on the shared encoder.
         self._encode_lock = threading.Lock()
-        self._nodes: dict[str, Node] = {}
-        self._pods: dict[str, Pod] = {}          # bound (confirmed) pods by key
-        self._assumed: dict[str, tuple[Pod, float]] = {}  # key -> (pod, deadline)
-        self._generation = 0
+        self._nodes: dict[str, Node] = {}  # guarded by: self._lock
+        self._pods: dict[str, Pod] = {}  # guarded by: self._lock
+        self._assumed: dict[str, tuple[Pod, float]] = {}  # guarded by: self._lock
+        self._generation = 0  # guarded by: self._lock
         self._encoder = SnapshotEncoder()
         # churn headroom: free node rows absorb node ADDs as device patches,
         # spare label-value ids absorb the new values they intern (every
@@ -46,33 +46,33 @@ class SchedulerCache:
         # bucket mid-stream: that recompiles the drain inside the window
         self._encoder.ns_headroom = int(
             os.environ.get("KTPU_NS_HEADROOM", "16"))
-        self._cached: Optional[tuple[int, ClusterTensors, SnapshotMeta]] = None
+        self._cached: Optional[tuple[int, ClusterTensors, SnapshotMeta]] = None  # guarded by: self._lock
         self.assume_ttl = assume_ttl
-        self._volumes = None  # VolumeCatalog once any PVC/PV/SC appears
-        self._dra = None      # DraCatalog once any resource.k8s.io object appears
-        self._namespace_labels: dict[str, dict] = {}
+        self._volumes = None  # guarded by: self._lock (VolumeCatalog once any PVC/PV/SC appears)
+        self._dra = None      # guarded by: self._lock (DraCatalog once any resource.k8s.io object appears)
+        self._namespace_labels: dict[str, dict] = {}  # guarded by: self._lock
         # incremental-snapshot delta tracking (Cache.UpdateSnapshot analog):
         # pod churn accumulates here and patches the cached encoding in place;
         # anything structural (node add/remove, volumes) forces a full encode.
-        self._delta_upserts: dict[str, Pod] = {}
-        self._delta_deletes: set[str] = set()
-        self._needs_full = True
+        self._delta_upserts: dict[str, Pod] = {}  # guarded by: self._lock
+        self._delta_deletes: set[str] = set()  # guarded by: self._lock
+        self._needs_full = True  # guarded by: self._lock
         # ---- ordered delta LOG for the device-resident drain context ----
         # Every encoding-relevant mutation appends (seq, op, payload); the
         # drain context replays entries since its last-consumed seq as
         # device-side patches (encode/patch.py) instead of dying on any
         # foreign change. Bounded; a consumer older than the window rebuilds.
-        self._dlog: list[tuple] = []
-        self._dlog_start = 0   # seq of _dlog[0]
-        self._dlog_seq = 0     # seq of the NEXT entry
-        self._snap_seq = 0     # log seq captured with the last snapshot
+        self._dlog: list[tuple] = []  # guarded by: self._lock
+        self._dlog_start = 0   # guarded by: self._lock (seq of _dlog[0])
+        self._dlog_seq = 0     # guarded by: self._lock (seq of the NEXT entry)
+        self._snap_seq = 0     # guarded by: self._lock (log seq captured with the last snapshot)
         self._dlog_max = 100_000
         # encode-relevant node fingerprints: heartbeats that only touch
         # status/conditions must not invalidate the encoding at all
-        self._node_fps: dict[str, tuple] = {}
+        self._node_fps: dict[str, tuple] = {}  # guarded by: self._lock
         # observability: full re-encodes performed by snapshot() (the
         # autoscaler's overlay path depends on snapshot freshness)
-        self._full_encodes = 0
+        self._full_encodes = 0  # guarded by: self._lock
         # active ("pods","nodes") scheduling mesh, or None (single-device).
         # The scheduler installs it (Scheduler.set_mesh); staging helpers
         # below then device_put encodings SHARDED so the drain programs run
@@ -669,8 +669,8 @@ class SchedulerCache:
             return
         try:
             self._encoder.precompile_pod(pod)
-        except Exception:
-            pass  # best-effort: encode_pods compiles it authoritatively
+        except Exception:  # ktpu-lint: disable=KTL002 -- best-effort warm-up; encode_pods recompiles this pod authoritatively on the hot path, so a precompile failure costs latency, never correctness
+            pass
         finally:
             self._encode_lock.release()
 

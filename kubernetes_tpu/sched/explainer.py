@@ -193,6 +193,8 @@ class SchedulingExplainer:
                     raise RuntimeError("device degraded; oracle explain")
                 per_pod = self._judge_tensor(item, views, profile)
             except Exception:
+                _LOG.debug("tensor explain failed; falling back to the "
+                           "oracle judge", exc_info=True)
                 mode = "oracle"
                 per_pod = self._judge_oracle(item, views)
         # per-pod: (histogram, feasible_now, unjudged). The tensor program
@@ -270,6 +272,7 @@ class SchedulingExplainer:
             chunk = views[i:i + MAX_EXPLAIN_BATCH]
             pb = enc.encode_pods(chunk, meta, cache_rows=False)
             with TRACER.span("explain/dispatch", pods=len(chunk)):
+                # ktpu-lint: disable=KTL005 -- background explainer thread, off the scheduling cycle by design (ExplainAB gates its overhead <= 5%)
                 verdicts, valid = jax.device_get(
                     explain_step(ct, pb, topo_keys=meta.topo_keys,
                                  enabled=enabled))

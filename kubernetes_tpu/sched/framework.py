@@ -23,10 +23,13 @@ out-of-tree registries compiled into upstream schedulers.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
+
+_LOG = logging.getLogger(__name__)
 
 # permit verdicts (framework.Code)
 ALLOW, DENY, WAIT = "allow", "deny", "wait"
@@ -154,6 +157,8 @@ def run_pre_bind(plugins: tuple, pod, node_name: str) -> tuple[bool, list]:
         try:
             ok = bool(p.pre_bind(pod, node_name))
         except Exception:
+            _LOG.exception("preBind plugin %r failed; aborting bind",
+                           getattr(p, 'name', p))
             ok = False
         if not ok:
             return False, done
@@ -167,7 +172,9 @@ def run_unreserve(plugins: list, pod, node_name: str) -> None:
             try:
                 p.unreserve(pod, node_name)
             except Exception:
-                pass
+                # best-effort rollback chain: later plugins still unwind
+                _LOG.exception("unreserve plugin %r failed",
+                               getattr(p, 'name', p))
 
 
 def run_post_bind(plugins: tuple, pod, node_name: str) -> None:
@@ -176,4 +183,6 @@ def run_post_bind(plugins: tuple, pod, node_name: str) -> None:
             try:
                 p.post_bind(pod, node_name)
             except Exception:
-                pass
+                # informational hook: the bind already landed
+                _LOG.exception("postBind plugin %r failed",
+                               getattr(p, 'name', p))

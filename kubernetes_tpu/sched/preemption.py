@@ -237,6 +237,7 @@ def tensor_static_masks(nodes, preemptors, ct=None, meta=None,
         from kubernetes_tpu.parallel.mesh import shard_batch, shard_cluster
         with mesh:
             ct_dev = ct if pre_staged else shard_cluster(mesh, ct)
+            # ktpu-lint: disable=KTL005 -- preemption wave readback: explicit staging in / one fetch out is the wave's documented transfer contract
             mask = np.asarray(jax.device_get(_static_filters_program(
                 ct_dev, shard_batch(mesh, pb))))
     else:
@@ -245,6 +246,7 @@ def tensor_static_masks(nodes, preemptors, ct=None, meta=None,
         # steady-state cycle must add zero implicit host->device
         # transfers — the transfer-guard invariant tests pin this
         ct_dev = ct if pre_staged else jax.device_put(ct)
+        # ktpu-lint: disable=KTL005 -- preemption wave readback: explicit staging in / one fetch out is the wave's documented transfer contract
         mask = np.asarray(jax.device_get(_static_filters_program(
             ct_dev, jax.device_put(pb))))
     if node_rows is not None:

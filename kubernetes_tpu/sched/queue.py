@@ -46,16 +46,16 @@ class SchedulingQueue:
     def __init__(self, backoff_initial: float = 1.0, backoff_max: float = 10.0,
                  unschedulable_timeout: float = 60.0):
         self._lock = threading.Condition()
-        self._active: list[_QueuedPod] = []      # heap: (-priority, seq)
-        self._backoff: list[tuple[float, _QueuedPod]] = []  # heap: (expiry, item)
-        self._unschedulable: dict[str, _QueuedPod] = {}
-        self._keys_queued: set[str] = set()
+        self._active: list[_QueuedPod] = []  # guarded by: self._lock (heap: (-priority, seq))
+        self._backoff: list[tuple[float, _QueuedPod]] = []  # guarded by: self._lock (heap: (expiry, item))
+        self._unschedulable: dict[str, _QueuedPod] = {}  # guarded by: self._lock
+        self._keys_queued: set[str] = set()  # guarded by: self._lock
         # key -> CURRENT queued item. Deletion is lazy: delete() drops the
         # entry and consumers skip heap items that are no longer current —
         # eager deletion rebuilt the whole activeQ heap per call, which is
         # O(queue) work per binding-confirmation event (10k bound pods while
         # 10k more sit queued = O(n^2) on the watch thread).
-        self._entries: dict[str, _QueuedPod] = {}
+        self._entries: dict[str, _QueuedPod] = {}  # guarded by: self._lock
         self._seq = itertools.count()
         self.backoff_initial = backoff_initial
         self.backoff_max = backoff_max

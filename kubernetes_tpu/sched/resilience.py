@@ -225,10 +225,10 @@ class ThreadWatchdog:
         self.stall_s = float(stall_s)
         self.clock = clock or REAL_CLOCK
         self._lock = threading.Lock()
-        self._targets: dict[str, _Target] = {}
+        self._targets: dict[str, _Target] = {}  # guarded by: self._lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.restarts = 0
+        self.restarts = 0  # guarded by: self._lock
 
     def register(self, name: str, is_alive: Callable[[], bool],
                  restart: Callable[[], "Optional[bool]"],
@@ -245,7 +245,7 @@ class ThreadWatchdog:
             self._targets[name] = t
 
     def beat(self, name: str) -> None:
-        t = self._targets.get(name)
+        t = self._targets.get(name)  # ktpu-lint: disable=KTL001 -- hot-path GIL-atomic read (resolver/loop threads beat per cycle); a raced registration misses at most one beat
         if t is not None:
             t.last_beat = self.clock.now()
 
@@ -270,7 +270,8 @@ class ThreadWatchdog:
                                  t.name, "dead" if dead else "stalled")
                     did = t.restart()
                     if did is not False:
-                        self.restarts += 1
+                        with self._lock:
+                            self.restarts += 1
                         WATCHDOG_RESTARTS.inc({"thread": t.name})
                         restarted.append(t.name)
                     # reset the beat either way so a signaled-but-alive

@@ -216,11 +216,11 @@ class Scheduler:
         # which means the scheduler never parks inside the device tunnel
         # while informer bursts hold the GIL (the resolve_wait variance of
         # BENCH_r05). The scheduling thread waits on a plain Event instead.
-        self._resolver_q: Optional["queue_mod.Queue"] = None
-        self._resolver_thread: Optional[threading.Thread] = None
         # serializes (queue, thread) swaps between the scheduling thread's
-        # lazy spawn and the watchdog's restart_resolver
+        # lazy spawn, the watchdog's restart_resolver, and close()
         self._resolver_swap_lock = threading.Lock()
+        self._resolver_q: Optional["queue_mod.Queue"] = None  # guarded by: self._resolver_swap_lock
+        self._resolver_thread: Optional[threading.Thread] = None  # guarded by: self._resolver_swap_lock
         self._use_resolver = _os.environ.get(
             "KTPU_RESOLVER_THREAD", "1") != "0"
         # Fleet mode (sched/fleet.py FleetRunner sets this): pops are split
@@ -1242,7 +1242,7 @@ class Scheduler:
                 # degrades to an inline fetch instead of hanging the loop
                 deadline = time.time() + RESOLVE_WAIT_S
                 while not done.wait(0.25):
-                    t = self._resolver_thread
+                    t = self._resolver_thread  # ktpu-lint: disable=KTL001 -- lock-free liveness peek: a stale handle costs one redundant 0.25s wait round, never a wrong resolve
                     dead = t is not None and not t.is_alive()
                     if dead or time.time() > deadline:
                         LOOP_ERRORS.inc({"site": "resolver_wait"})
@@ -2096,10 +2096,11 @@ class Scheduler:
             self._resolve_pending()  # land every in-flight drain's bindings
         except Exception:
             _LOG.exception("resolving in-flight drains at close")
-        if self._resolver_q is not None:
-            self._resolver_q.put(None)  # poison pill; thread is daemon
-            self._resolver_thread = None
-            self._resolver_q = None
+        with self._resolver_swap_lock:  # vs a racing watchdog restart
+            if self._resolver_q is not None:
+                self._resolver_q.put(None)  # poison pill; thread is daemon
+                self._resolver_thread = None
+                self._resolver_q = None
         self.cache.close_staging()  # poison the batch-stager (daemon too)
         if self.sentinel is not None:
             self.sentinel.close()

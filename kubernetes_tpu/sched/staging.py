@@ -83,13 +83,14 @@ class StagingArena:
         self._lock = threading.Lock()
         self._q: "queue_mod.Queue" = queue_mod.Queue()
         self._thread: Optional[threading.Thread] = None
-        self._epoch = 0
-        self._inflight = 0
-        # health counters (ktpu status + bench legs report these)
-        self.swaps = 0        # redeems served from a pre-staged buffer
-        self.fallbacks = 0    # redeems that declined (caller staged inline)
-        self.submits = 0
-        self.bytes_staged = 0
+        self._epoch = 0    # guarded by: self._lock
+        self._inflight = 0  # guarded by: self._lock
+        # health counters (ktpu status + bench legs report these) — shared
+        # between the stager thread, the dispatch thread, and status readers
+        self.swaps = 0        # guarded by: self._lock
+        self.fallbacks = 0    # guarded by: self._lock
+        self.submits = 0      # guarded by: self._lock
+        self.bytes_staged = 0  # guarded by: self._lock
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -142,9 +143,11 @@ class StagingArena:
                 ticket.done.set()
 
     def close(self) -> None:
-        if self._thread is not None:
+        t = self._thread
+        if t is not None:
             self._q.put(None)
             self._thread = None
+            t.join(timeout=2.0)  # drains the poison pill; uploads are short
 
     # ---- submit / redeem -------------------------------------------------
 
@@ -158,8 +161,8 @@ class StagingArena:
             if self._inflight >= self.depth:
                 return None
             self._inflight += 1
+            self.submits += 1
             ticket = StageTicket(self._epoch, mesh)
-        self.submits += 1
         self._ensure_thread()
         self._q.put((ticket, pb_stack))
         return ticket
@@ -181,20 +184,23 @@ class StagingArena:
                     _LOG.warning("batch-stager %s; staging inline",
                                  "died" if (t is None or not t.is_alive())
                                  else f"silent for {timeout:.0f}s")
-                    self.fallbacks += 1
+                    with self._lock:
+                        self.fallbacks += 1
                     return None
             with self._lock:
                 stale = (ticket.epoch != self._epoch
                          or ticket.mesh is not mesh)
-            if stale or ticket.error is not None or ticket.staged is None:
-                self.fallbacks += 1
-                return None
-            self.swaps += 1
-            self.bytes_staged += ticket.nbytes
+                if stale or ticket.error is not None \
+                        or ticket.staged is None:
+                    self.fallbacks += 1
+                    return None
+                self.swaps += 1
+                self.bytes_staged += ticket.nbytes
+                swaps = self.swaps
             from kubernetes_tpu.metrics.registry import (STAGE_BUFFER_REUSE,
                                                          STAGE_BYTES)
             STAGE_BYTES.inc({"path": "arena"}, by=ticket.nbytes)
-            STAGE_BUFFER_REUSE.set(self.swaps)
+            STAGE_BUFFER_REUSE.set(swaps)
             return ticket.staged
         finally:
             ticket.staged = None  # the arena never aliases redeemed buffers
@@ -207,10 +213,11 @@ class StagingArena:
             self._epoch += 1
 
     def stats(self) -> dict:
-        return {"submits": self.submits, "swaps": self.swaps,
-                "fallbacks": self.fallbacks,
-                "bytesStaged": self.bytes_staged,
-                "inflight": self._inflight}
+        with self._lock:
+            return {"submits": self.submits, "swaps": self.swaps,
+                    "fallbacks": self.fallbacks,
+                    "bytesStaged": self.bytes_staged,
+                    "inflight": self._inflight}
 
 
 class ResidentShadow:
@@ -232,33 +239,42 @@ class ResidentShadow:
     Any exception poisons the shadow (``ok`` False) and the wave falls
     back to the device readback — drift degrades to a fetch, never to a
     wrong answer. Parity with the device arrays is pinned by test.
+
+    Thread contract: ``fold_winners`` runs on the RESOLVER thread while
+    ``catch_up``/``apply_patch``/``arrays`` run on the scheduling thread —
+    an unserialized ``pending`` swap could drop a resolve's winner folds
+    on the floor (and a dropped fold is exactly the silent drift the
+    poison discipline exists to prevent), so every access holds the lock.
     """
 
     def __init__(self, allocatable, requested):
-        self.alloc = np.asarray(allocatable).astype(np.int64).copy()
-        self.req = np.asarray(requested).astype(np.int64).copy()
-        self.pending: list[tuple[Any, int]] = []  # (Pod, node row)
-        self.ok = True
+        self._lock = threading.Lock()
+        self.alloc = np.asarray(allocatable).astype(np.int64).copy()  # guarded by: self._lock
+        self.req = np.asarray(requested).astype(np.int64).copy()  # guarded by: self._lock
+        self.pending: list[tuple[Any, int]] = []  # guarded by: self._lock
+        self.ok = True  # guarded by: self._lock
 
     def fold_winners(self, pairs: list) -> None:
         """Record winners mirrored at resolve: [(Pod, node_row)]."""
-        self.pending.extend(pairs)
+        with self._lock:
+            self.pending.extend(pairs)
 
     def catch_up(self, vec_fn) -> None:
         """Fold pending winners' request vectors into ``requested``.
         ``vec_fn(pod) -> [R] int vector`` on the RESIDENT resource axis
         (the same ``_request_vector`` the encode and the device fold's
         batch rows use, so the mirror is bit-consistent)."""
-        if not self.pending:
-            return
-        pending, self.pending = self.pending, []
-        try:
-            for pod, row in pending:
-                self.req[row] += np.asarray(vec_fn(pod), np.int64)
-        except Exception:
-            self.ok = False
-            _LOG.exception("resident shadow catch-up failed; waves fall "
-                           "back to the device readback")
+        with self._lock:
+            if not self.pending:
+                return
+            pending, self.pending = self.pending, []
+            try:
+                for pod, row in pending:
+                    self.req[row] += np.asarray(vec_fn(pod), np.int64)
+            except Exception:
+                self.ok = False
+                _LOG.exception("resident shadow catch-up failed; waves "
+                               "fall back to the device readback")
 
     def apply_patch(self, patch: dict) -> None:
         """Mirror ``_apply_patch``'s requested/allocatable writes.
@@ -271,30 +287,36 @@ class ResidentShadow:
         folds still pending would re-add that contribution to a reused
         row afterward. Un-caught-up pending entries poison the shadow
         rather than silently mis-mirroring."""
-        if self.pending:
-            self.ok = False
-            _LOG.error("resident shadow patch applied with %d winner "
-                       "folds pending; poisoning the shadow (waves fall "
-                       "back to the device readback)", len(self.pending))
-            return
-        try:
-            rows = np.asarray(patch["node_row"])
-            live = rows >= 0
-            if live.any():
-                idx = rows[live]
-                self.alloc[idx] = np.asarray(patch["n_alloc"])[live]
-                reset = np.asarray(patch["n_reset"], bool) & live
-                if reset.any():
-                    self.req[rows[reset]] = 0
-            self.req += np.asarray(patch["req_delta"])
-        except Exception:
-            self.ok = False
-            _LOG.exception("resident shadow patch mirror failed; waves "
-                           "fall back to the device readback")
+        with self._lock:
+            if self.pending:
+                self.ok = False
+                _LOG.error("resident shadow patch applied with %d winner "
+                           "folds pending; poisoning the shadow (waves "
+                           "fall back to the device readback)",
+                           len(self.pending))
+                return
+            try:
+                rows = np.asarray(patch["node_row"])
+                live = rows >= 0
+                if live.any():
+                    idx = rows[live]
+                    self.alloc[idx] = np.asarray(patch["n_alloc"])[live]
+                    reset = np.asarray(patch["n_reset"], bool) & live
+                    if reset.any():
+                        self.req[rows[reset]] = 0
+                self.req += np.asarray(patch["req_delta"])
+            except Exception:
+                self.ok = False
+                _LOG.exception("resident shadow patch mirror failed; "
+                               "waves fall back to the device readback")
 
     def arrays(self):
         """(allocatable, requested) or None when the shadow is poisoned or
-        still behind (pending winners not yet caught up)."""
-        if not self.ok or self.pending:
-            return None
-        return self.alloc, self.req
+        still behind (pending winners not yet caught up). The returned
+        arrays are the live mirrors (not copies): the wave encodes them
+        on the scheduling thread, the same thread every mutator runs on —
+        only ``fold_winners`` is foreign, and it never touches these."""
+        with self._lock:
+            if not self.ok or self.pending:
+                return None
+            return self.alloc, self.req

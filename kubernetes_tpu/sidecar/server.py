@@ -135,6 +135,7 @@ class _Engine:
         from kubernetes_tpu.ops.filters import run_filters
         with self._lock:
             pods, nodes, ct, meta, pb = self._batch(pod_dicts, gen)
+            # ktpu-lint: disable=KTL005 -- sidecar RPC serving path, not the scheduler's steady-state cycle; the response needs host bytes
             mask = np.asarray(jax.device_get(run_filters(
                 ct, pb, enabled=self._enabled())))
             m = mask[:len(pods), :len(nodes)]
@@ -148,6 +149,7 @@ class _Engine:
         with self._lock:
             pods, nodes, ct, meta, pb = self._batch(pod_dicts, gen)
             mask = run_filters(ct, pb, enabled=self._enabled())
+            # ktpu-lint: disable=KTL005 -- sidecar RPC serving path, not the scheduler's steady-state cycle; the response needs host bytes
             scores = np.asarray(jax.device_get(combined_score(
                 ct, pb, mask, weights=self._weights(),
                 fit_strategy=self._profile.get("fit_strategy",

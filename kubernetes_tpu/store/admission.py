@@ -21,6 +21,7 @@ reject — the signature APIServer.admission already dispatches.
 from __future__ import annotations
 
 import itertools
+import logging
 import operator
 import threading
 import time
@@ -28,6 +29,8 @@ from typing import Any, Callable, Optional
 
 from kubernetes_tpu.api.resource import canonical
 from kubernetes_tpu.store.apiserver import AdmissionError
+
+_LOG = logging.getLogger(__name__)
 from kubernetes_tpu.store.store import ObjectStore
 
 DEFAULT_TOLERATION_SECONDS = 300
@@ -73,7 +76,8 @@ class AdmissionChain:
                 try:
                     h(False)
                 except Exception:
-                    pass
+                    _LOG.debug("admission rollback hook failed",
+                               exc_info=True)
             raise
         if hooks:
             obj.setdefault("\x00admission_commits", []).extend(hooks)

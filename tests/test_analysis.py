@@ -1,0 +1,696 @@
+"""ktpu-lint unit tests — fixture snippets per rule.
+
+Every rule gets: one fixture proving it FIRES, one proving the clean form
+passes, and one proving the reasoned suppression comment works (the
+acceptance contract of ISSUE 15). Plus the meta rule (reasonless disable =
+KTL000 + no suppression), fingerprint stability under unrelated edits, and
+the baseline round-trip.
+
+Fixtures are written under a ``kubernetes_tpu/`` tmp directory so
+path-scoped rules (KTL003's clock-disciplined trees, KTL005's whitelists,
+KTL007's registry path) see the same repo-relative shapes they see in the
+real package.
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+import pytest
+
+from kubernetes_tpu.analysis import baseline as baseline_mod
+from kubernetes_tpu.analysis.engine import run_analysis
+
+
+def lint(tmp_path, files: dict[str, str]):
+    """Write {relpath-under-kubernetes_tpu: source} fixtures and run the
+    analyzer; -> list of Finding."""
+    root = tmp_path / "kubernetes_tpu"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_analysis(str(root))
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---- KTL001 guarded-by -----------------------------------------------------
+
+GUARDED = """
+    import threading
+
+    class Batcher:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.flushes = 0  # guarded by: self._lock
+
+        def bump(self):
+            self.flushes += 1{suffix}
+
+        def bump_locked_ok(self):
+            with self._lock:
+                self.flushes += 1
+"""
+
+
+def test_ktl001_fires_on_unlocked_access(tmp_path):
+    found = lint(tmp_path, {"sched/b.py": GUARDED.format(suffix="")})
+    assert rules_of(found) == ["KTL001"]
+    assert "self.flushes" in found[0].message
+
+
+def test_ktl001_clean_under_lock(tmp_path):
+    src = GUARDED.format(suffix="")
+    src = src.replace("            self.flushes += 1\n\n",
+                      "            with self._lock:\n"
+                      "                self.flushes += 1\n\n", 1)
+    assert lint(tmp_path, {"sched/b.py": src}) == []
+
+
+def test_ktl001_suppression_with_reason(tmp_path):
+    found = lint(tmp_path, {"sched/b.py": GUARDED.format(
+        suffix="  # ktpu-lint: disable=KTL001 -- caller holds the lock")})
+    assert found == []
+
+
+def test_ktl001_locked_suffix_and_init_exempt(tmp_path):
+    src = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0  # guarded by: self._lock
+            self.n += 1   # __init__ constructs before sharing
+
+        def _bump_locked(self):
+            self.n += 1   # *_locked convention: caller holds the lock
+    """
+    assert lint(tmp_path, {"sched/c.py": src}) == []
+
+
+def test_ktl001_manual_acquire_counts(tmp_path):
+    src = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0  # guarded by: self._lock
+
+        def try_bump(self):
+            if not self._lock.acquire(blocking=False):
+                return
+            try:
+                self.n += 1
+            finally:
+                self._lock.release()
+    """
+    assert lint(tmp_path, {"sched/c.py": src}) == []
+
+
+def test_ktl001_per_shard_lock_array(tmp_path):
+    src = """
+    import threading
+
+    class Sharded:
+        def __init__(self, k):
+            self._locks = [threading.Lock() for _ in range(k)]
+            self._members = [dict() for _ in range(k)]  # guarded by: self._locks[i]
+
+        def add(self, i, name, v):
+            with self._locks[i]:
+                self._members[i][name] = v
+
+        def bad(self, i):
+            return len(self._members[i])
+    """
+    found = lint(tmp_path, {"kubelet/s.py": src})
+    assert rules_of(found) == ["KTL001"]
+    assert found[0].line > 10  # the unlocked read, not the locked write
+
+
+def test_ktl001_module_counter(tmp_path):
+    src = """
+    import threading
+
+    TOTAL = 0
+    _LOCK = threading.Lock()
+
+    def bad():
+        global TOTAL
+        TOTAL += 1
+
+    def good():
+        global TOTAL
+        with _LOCK:
+            TOTAL += 1
+    """
+    found = lint(tmp_path, {"utils/m.py": src})
+    assert rules_of(found) == ["KTL001"]
+    assert "TOTAL" in found[0].message
+
+
+# ---- KTL002 silent-swallow -------------------------------------------------
+
+def test_ktl002_fires_on_silent_pass(tmp_path):
+    src = """
+    def f(x):
+        try:
+            return x()
+        except Exception:
+            pass
+    """
+    assert rules_of(lint(tmp_path, {"sched/e.py": src})) == ["KTL002"]
+
+
+@pytest.mark.parametrize("body", [
+    "_LOG.exception('boom')",
+    "ERRS.inc({'site': 'f'})",
+    "raise",
+    "self._count_error()",
+    "errors += 1",
+])
+def test_ktl002_trace_forms_pass(tmp_path, body):
+    src = f"""
+    def f(x, self=None, _LOG=None, ERRS=None):
+        errors = 0
+        try:
+            return x()
+        except Exception:
+            {body}
+    """
+    assert lint(tmp_path, {"sched/e.py": src}) == []
+
+
+def test_ktl002_narrow_except_out_of_scope(tmp_path):
+    src = """
+    def f(x):
+        try:
+            return x()
+        except ValueError:
+            pass
+    """
+    assert lint(tmp_path, {"sched/e.py": src}) == []
+
+
+def test_ktl002_suppression_with_reason(tmp_path):
+    src = """
+    def f(x):
+        try:
+            return x()
+        except Exception:  # ktpu-lint: disable=KTL002 -- teardown only
+            pass
+    """
+    assert lint(tmp_path, {"sched/e.py": src}) == []
+
+
+# ---- KTL003 clock discipline -----------------------------------------------
+
+CLOCKY = """
+    import time
+
+    def loop():
+        return time.time()
+"""
+
+
+def test_ktl003_fires_in_disciplined_tree(tmp_path):
+    found = lint(tmp_path, {"controllers/t.py": CLOCKY})
+    assert rules_of(found) == ["KTL003"]
+    assert "time.time" in found[0].message
+
+
+def test_ktl003_from_import_alias(tmp_path):
+    src = """
+    from time import monotonic as mono
+
+    def loop():
+        return mono()
+    """
+    found = lint(tmp_path, {"descheduler/t.py": src})
+    assert rules_of(found) == ["KTL003"]
+
+
+def test_ktl003_other_trees_exempt(tmp_path):
+    assert lint(tmp_path, {"store/t.py": CLOCKY}) == []
+
+
+def test_ktl003_suppression_with_reason(tmp_path):
+    src = """
+    import time
+
+    def loop():
+        return time.time()  # ktpu-lint: disable=KTL003 -- perf span needs the real wall
+    """
+    assert lint(tmp_path, {"autoscaler/t.py": src}) == []
+
+
+# ---- KTL004 thread hygiene -------------------------------------------------
+
+def test_ktl004_fires_without_daemon(tmp_path):
+    src = """
+    import threading
+
+    def go(fn):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    """
+    found = lint(tmp_path, {"utils/th.py": src})
+    assert rules_of(found) == ["KTL004"]
+    assert "daemon" in found[0].message
+
+
+def test_ktl004_fires_without_lifecycle(tmp_path):
+    src = """
+    import threading
+
+    def go(fn):
+        threading.Thread(target=fn, daemon=True).start()
+    """
+    found = lint(tmp_path, {"utils/th.py": src})
+    assert rules_of(found) == ["KTL004"]
+    assert "join" in found[0].message
+
+
+def test_ktl004_clean_with_daemon_and_join(tmp_path):
+    src = """
+    import threading
+
+    def go(fn):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        t.join(timeout=1.0)
+    """
+    assert lint(tmp_path, {"utils/th.py": src}) == []
+
+
+def test_ktl004_watchdog_registration_counts(tmp_path):
+    src = """
+    import threading
+
+    def go(fn, watchdog):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        watchdog.register("worker", t.is_alive, lambda: None)
+    """
+    assert lint(tmp_path, {"utils/th.py": src}) == []
+
+
+def test_ktl004_suppression_with_reason(tmp_path):
+    src = """
+    import threading
+
+    def go(fn):
+        threading.Thread(target=fn).start()  # ktpu-lint: disable=KTL004 -- fixture thread, joined by the harness
+    """
+    assert lint(tmp_path, {"utils/th.py": src}) == []
+
+
+# ---- KTL005 donation discipline ---------------------------------------------
+
+def test_ktl005_donate_without_pin_fires(tmp_path):
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(ct):
+        return ct
+    """
+    found = lint(tmp_path, {"models/g.py": src})
+    assert rules_of(found) == ["KTL005"]
+    assert "copy-on-donate" in found[0].message
+
+
+def test_ktl005_out_shardings_pins(tmp_path):
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,), out_shardings=None)
+    def step(ct):
+        return ct
+    """
+    assert lint(tmp_path, {"models/g.py": src}) == []
+
+
+def test_ktl005_constrain_cluster_pins(tmp_path):
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,), static_argnames=("mesh",))
+    def step(ct, mesh=None):
+        from kubernetes_tpu.parallel.mesh import constrain_cluster
+        if mesh is not None:
+            ct = constrain_cluster(mesh, ct)
+        return ct
+    """
+    assert lint(tmp_path, {"models/g.py": src}) == []
+
+
+def test_ktl005_device_get_outside_whitelist(tmp_path):
+    src = """
+    import jax
+
+    def peek(x):
+        return jax.device_get(x)
+    """
+    found = lint(tmp_path, {"ops/p.py": src})
+    assert rules_of(found) == ["KTL005"]
+    assert "zero-copy" in found[0].message
+
+
+def test_ktl005_device_get_whitelisted_resolver(tmp_path):
+    src = """
+    import jax
+
+    def resolve(x):
+        return jax.device_get(x)
+    """
+    assert lint(tmp_path, {"sched/scheduler.py": src}) == []
+
+
+def test_ktl005_suppression_with_reason(tmp_path):
+    src = """
+    import jax
+
+    def peek(x):
+        return jax.device_get(x)  # ktpu-lint: disable=KTL005 -- off-hot-path debug readback
+    """
+    assert lint(tmp_path, {"ops/p.py": src}) == []
+
+
+# ---- KTL006 ConfigMap writes ------------------------------------------------
+
+def test_ktl006_chained_write_fires(tmp_path):
+    src = """
+    def publish(client, ns, body):
+        client.resource("configmaps", ns).update(body)
+    """
+    found = lint(tmp_path, {"sched/cmw.py": src})
+    assert rules_of(found) == ["KTL006"]
+
+
+def test_ktl006_var_assigned_write_fires(tmp_path):
+    src = """
+    def publish(client, ns, body):
+        cms = client.resource("configmaps", ns)
+        cms.create(body)
+    """
+    found = lint(tmp_path, {"sched/cmw.py": src})
+    assert rules_of(found) == ["KTL006"]
+
+
+def test_ktl006_reads_and_other_resources_pass(tmp_path):
+    src = """
+    def read(client, ns, name, body):
+        cm = client.resource("configmaps", ns).get(name)
+        client.resource("pods", ns).create(body)
+        return cm
+    """
+    assert lint(tmp_path, {"sched/cmw.py": src}) == []
+
+
+def test_ktl006_upsert_module_exempt(tmp_path):
+    src = """
+    def upsert_configmap(client, ns, body):
+        client.resource("configmaps", ns).update(body)
+    """
+    assert lint(tmp_path, {"utils/configmap.py": src}) == []
+
+
+def test_ktl006_suppression_with_reason(tmp_path):
+    src = """
+    def publish(client, ns, body):
+        client.resource("configmaps", ns).update(body)  # ktpu-lint: disable=KTL006 -- reconcile must raise to requeue
+    """
+    assert lint(tmp_path, {"sched/cmw.py": src}) == []
+
+
+# ---- KTL007 metrics registry ------------------------------------------------
+
+REGISTRY_FIXTURE = """
+    class _R:
+        def counter(self, name, help_=""):
+            return self
+
+        def inc(self, labels=None, by=1.0):
+            pass
+
+    REGISTRY = _R()
+    ERRS = REGISTRY.counter("loop_errors_total")
+"""
+
+
+def test_ktl007_construction_outside_registry_fires(tmp_path):
+    src = """
+    from kubernetes_tpu.metrics.registry import REGISTRY
+
+    MINE = REGISTRY.counter("my_rogue_total")
+    """
+    found = lint(tmp_path, {"metrics/registry.py": REGISTRY_FIXTURE,
+                            "sched/rogue.py": src})
+    assert rules_of(found) == ["KTL007"]
+    assert "outside metrics/registry.py" in found[0].message
+
+
+def test_ktl007_inconsistent_labels_fire(tmp_path):
+    use = """
+    from kubernetes_tpu.metrics.registry import ERRS
+
+    def a():
+        ERRS.inc({"site": "a"})
+
+    def b():
+        ERRS.inc({"site": "b"})
+
+    def c():
+        ERRS.inc()   # the minority no-label series
+    """
+    found = lint(tmp_path, {"metrics/registry.py": REGISTRY_FIXTURE,
+                            "sched/use.py": use})
+    assert rules_of(found) == ["KTL007"]
+    assert "loop_errors_total" in found[0].message
+    assert found[0].path.endswith("sched/use.py")
+
+
+def test_ktl007_consistent_labels_pass(tmp_path):
+    use = """
+    from kubernetes_tpu.metrics.registry import ERRS
+
+    def a():
+        ERRS.inc({"site": "a"})
+
+    def b():
+        ERRS.inc({"site": "b"})
+    """
+    assert lint(tmp_path, {"metrics/registry.py": REGISTRY_FIXTURE,
+                           "sched/use.py": use}) == []
+
+
+def test_ktl007_suppression_with_reason(tmp_path):
+    use = """
+    from kubernetes_tpu.metrics.registry import ERRS
+
+    def a():
+        ERRS.inc({"site": "a"})
+
+    def b():
+        ERRS.inc({"site": "b"})
+
+    def c():
+        ERRS.inc()  # ktpu-lint: disable=KTL007 -- aggregate tick, intentionally unlabeled
+    """
+    assert lint(tmp_path, {"metrics/registry.py": REGISTRY_FIXTURE,
+                           "sched/use.py": use}) == []
+
+
+# ---- KTL000 meta rule --------------------------------------------------------
+
+def test_reasonless_disable_is_ktl000_and_suppresses_nothing(tmp_path):
+    src = """
+    def f(x):
+        try:
+            return x()
+        except Exception:  # ktpu-lint: disable=KTL002
+            pass
+    """
+    found = lint(tmp_path, {"sched/e.py": src})
+    assert sorted(rules_of(found)) == ["KTL000", "KTL002"]
+
+
+def test_own_line_suppression_covers_next_line(tmp_path):
+    src = """
+    import jax
+
+    def peek(x):
+        # ktpu-lint: disable=KTL005 -- debug readback, never on the cycle
+        return jax.device_get(x)
+    """
+    assert lint(tmp_path, {"ops/p.py": src}) == []
+
+
+# ---- fingerprints + baseline -------------------------------------------------
+
+def test_fingerprint_stable_under_unrelated_edits(tmp_path):
+    base = GUARDED.format(suffix="")
+    f1 = lint(tmp_path, {"sched/b.py": base})
+    edited = "'''a new module docstring'''\nX = 1\n" + textwrap.dedent(base)
+    (tmp_path / "kubernetes_tpu" / "sched" / "b.py").write_text(edited)
+    f2 = run_analysis(str(tmp_path / "kubernetes_tpu"))
+    assert [f.fingerprint for f in f1] == [f.fingerprint for f in f2]
+    assert f1[0].line != f2[0].line  # the line moved; the identity did not
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = lint(tmp_path, {"sched/e.py": """
+    def f(x):
+        try:
+            return x()
+        except Exception:
+            pass
+    """})
+    assert len(findings) == 1
+    bl = tmp_path / "baseline.json"
+    baseline_mod.write_baseline(findings, str(bl))
+    fps = baseline_mod.load_baseline(str(bl))
+    assert fps == {findings[0].fingerprint}
+    # baselined finding is not NEW
+    new, fixed = baseline_mod.diff(findings, fps)
+    assert new == [] and fixed == 0
+    # a fresh finding IS new; a fixed one is counted
+    findings2 = lint(tmp_path, {"sched/e2.py": """
+    def g(x):
+        try:
+            return x()
+        except Exception:
+            pass
+    """})
+    assert [f.path for f in findings2] == ["kubernetes_tpu/sched/e.py",
+                                           "kubernetes_tpu/sched/e2.py"]
+    new, fixed = baseline_mod.diff(findings2, fps)
+    assert [f.path for f in new] == ["kubernetes_tpu/sched/e2.py"]
+    assert fixed == 0
+    # fixing the original (file gone) counts as baselined-and-fixed
+    (tmp_path / "kubernetes_tpu" / "sched" / "e.py").unlink()
+    findings3 = run_analysis(str(tmp_path / "kubernetes_tpu"))
+    new, fixed = baseline_mod.diff(findings3, fps)
+    assert len(new) == 1 and fixed == 1
+
+
+def test_missing_baseline_means_everything_new(tmp_path):
+    assert baseline_mod.load_baseline(str(tmp_path / "nope.json")) == set()
+
+
+def test_ktl001_closure_in_with_is_not_lock_held(tmp_path):
+    """A thread-target closure defined INSIDE `with self._lock:` runs
+    after the lock is released — indentation must not exempt it."""
+    src = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0  # guarded by: self._lock
+
+        def start(self):
+            with self._lock:
+                def worker():
+                    self.n += 1
+                threading.Thread(target=worker, daemon=True).start()
+    """
+    found = lint(tmp_path, {"sched/c.py": src})
+    assert "KTL001" in [f.rule for f in found]
+
+
+def test_ktl001_closure_in_init_is_not_exempt(tmp_path):
+    src = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0  # guarded by: self._lock
+
+            def worker():
+                self.n += 1
+            threading.Thread(target=worker, daemon=True).start()
+    """
+    found = lint(tmp_path, {"sched/c.py": src})
+    assert "KTL001" in [f.rule for f in found]
+
+
+def test_subtree_scan_keeps_package_relpaths(tmp_path):
+    """Scanning a package SUBTREE anchors relpaths (and so fingerprints,
+    path-scoped rules, baseline matches) exactly like a full-package
+    scan — __init__.py chains mark the package top."""
+    root = tmp_path / "kubernetes_tpu"
+    (root / "controllers").mkdir(parents=True)
+    (root / "__init__.py").write_text("")
+    (root / "controllers" / "__init__.py").write_text("")
+    (root / "controllers" / "t.py").write_text(textwrap.dedent(CLOCKY))
+    full = run_analysis(str(root))
+    sub = run_analysis(str(root / "controllers"))
+    assert [f.rule for f in sub] == ["KTL003"]  # path scope still applies
+    assert ([(f.path, f.fingerprint) for f in sub]
+            == [(f.path, f.fingerprint) for f in full])
+
+
+def test_dangling_suppression_is_ktl000(tmp_path):
+    src = """
+    def f(x):
+        return x()  # ktpu-lint: disable=KTL002 -- excuse for nothing
+    """
+    found = lint(tmp_path, {"sched/d.py": src})
+    assert [f.rule for f in found] == ["KTL000"]
+    assert "stale exemption" in found[0].message
+
+
+def test_dangling_scan_respects_rule_filter(tmp_path):
+    """A --rule-filtered run must not condemn other rules' suppressions
+    as dangling (their rules never ran)."""
+    from kubernetes_tpu.analysis.rules import make_rules
+    root = tmp_path / "kubernetes_tpu"
+    (root / "sched").mkdir(parents=True)
+    (root / "sched" / "d.py").write_text(textwrap.dedent("""
+    import time
+
+    def f(x):
+        return time.time()  # ktpu-lint: disable=KTL003 -- real wall on purpose
+    """))
+    only_002 = [r for r in make_rules() if r.id == "KTL002"]
+    assert run_analysis(str(root), rules=only_002) == []
+
+
+def test_write_baseline_rejects_rule_filter(tmp_path, capsys):
+    from kubernetes_tpu.analysis.cli import main
+    root = tmp_path / "kubernetes_tpu"
+    (root / "sched").mkdir(parents=True)
+    (root / "sched" / "e.py").write_text("x = 1\n")
+    rc = main([str(root), "--rule", "KTL003", "--write-baseline"])
+    assert rc == 2
+    assert "cannot be combined" in capsys.readouterr().out
+
+
+def test_cli_json_summary(tmp_path, capsys):
+    from kubernetes_tpu.analysis.cli import main
+    root = tmp_path / "kubernetes_tpu"
+    (root / "sched").mkdir(parents=True)
+    (root / "sched" / "e.py").write_text(
+        "def f(x):\n    try:\n        return x()\n"
+        "    except Exception:\n        pass\n")
+    rc = main([str(root), "--no-baseline", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert '"findings_new": 1' in out
+    # --write-baseline then rerun: gate closes
+    bl = tmp_path / "bl.json"
+    assert main([str(root), "--baseline", str(bl), "--write-baseline"]) == 0
+    assert main([str(root), "--baseline", str(bl), "--json"]) == 0
