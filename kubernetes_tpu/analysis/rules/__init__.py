@@ -16,6 +16,7 @@ from kubernetes_tpu.analysis.rules.ktl004_threads import ThreadHygieneRule
 from kubernetes_tpu.analysis.rules.ktl005_donation import DonationDisciplineRule
 from kubernetes_tpu.analysis.rules.ktl006_configmap import ConfigMapWriteRule
 from kubernetes_tpu.analysis.rules.ktl007_metrics import MetricsRegistryRule
+from kubernetes_tpu.analysis.rules.ktl008_atomicio import AtomicCommitRule
 
 RULE_CLASSES: tuple[type[Rule], ...] = (
     GuardedByRule,
@@ -25,6 +26,7 @@ RULE_CLASSES: tuple[type[Rule], ...] = (
     DonationDisciplineRule,
     ConfigMapWriteRule,
     MetricsRegistryRule,
+    AtomicCommitRule,
 )
 
 

@@ -16,7 +16,6 @@ live state is legitimately in flux). A confirmed violation:
 
 from __future__ import annotations
 
-import json
 import logging
 import os
 import tempfile
@@ -81,8 +80,10 @@ def write_bundle(audit_dir: str, name: str, payload: dict) -> Optional[str]:
         os.makedirs(audit_dir, exist_ok=True)
         fname = f"audit-{time.time():.3f}-{name}.json"
         path = os.path.join(audit_dir, fname)
-        with open(path, "w") as f:
-            json.dump(payload, f, indent=1, default=str)
+        from kubernetes_tpu.utils.atomicio import atomic_write_json
+        # the bundle is evidence of a violation: a torn half-bundle from a
+        # crash mid-write would be evidence that lies — commit atomically
+        atomic_write_json(path, payload, indent=1, default=str)
         bundles = sorted(f for f in os.listdir(audit_dir)
                          if f.startswith("audit-") and f.endswith(".json"))
         for old in bundles[:-MAX_BUNDLES]:

@@ -221,12 +221,24 @@ class ParitySentinel:
         self._spawn_lock = threading.Lock()
         self._n_drain = 0
         self._n_wave = 0
+        self._force_drain = False
         self.samples: dict[str, int] = {"drain": 0, "wave": 0}
         self.divergences = 0
         self.skipped = 0
         self.last_divergence: Optional[dict] = None
 
     # ---- scheduling-thread half -----------------------------------------
+
+    def force_next(self) -> None:
+        """Arm a one-shot guaranteed sample: the next JUDGEABLE drain
+        dispatch is parity-checked regardless of the every-Kth modulus.
+        The runner arms this after a warm-from-cache boot, so a
+        deserialized executable's FIRST answer is canary-judged — a
+        corrupted-but-loadable program trips the breaker (``parity``)
+        before a second batch trusts it. The flag stays armed across
+        skipped dispatches (disabled-filter profiles, unjudgeable churn)
+        and clears only when a capture actually happens."""
+        self._force_drain = True
 
     def maybe_capture_drain(self, cache, profile, level: str,
                             ctx_seq: int) -> Optional[dict]:
@@ -247,10 +259,11 @@ class ParitySentinel:
         are correctly NOT exempt. In fact fused folds make MORE dispatches
         judgeable: node churn that used to sit pending (strict-mode skip)
         is consumed by the dispatch itself."""
-        if self.every <= 0:
+        if self.every <= 0 and not self._force_drain:
             return None
         self._n_drain += 1
-        if self._n_drain % self.every:
+        if (not self._force_drain and self.every > 0
+                and self._n_drain % self.every):
             return None
         if profile.enabled_filters is not None:
             self.skipped += 1
@@ -262,6 +275,7 @@ class ParitySentinel:
         if exempt is None:
             self.skipped += 1
             return None
+        self._force_drain = False
         return {"site": "drain", "level": level, "ts": time.time(),
                 "nodes": cache.list_nodes(),
                 "bound": cache.bound_pods(include_assumed=True),

@@ -35,6 +35,7 @@ _EXPORTS = {
     "DeviceChaos": "device",
     "ApiServerProcess": "apiserver", "InProcessApiServer": "apiserver",
     "free_port": "apiserver",
+    "SchedulerProcess": "scheduler",
 }
 
 __all__ = sorted(_EXPORTS) + ["hooks"]

@@ -641,6 +641,24 @@ def _disruption_line(dis: dict) -> str:
             f"{extra}\n")
 
 
+def _aot_cache_line(ac: dict) -> str:
+    """One-line durable compile-cache summary (sched/aotcache.py stats):
+    what's on disk, how this boot used it, and whether anything had to be
+    swept or recompiled."""
+    if not ac.get("enabled"):
+        return "Compile cache: off (no aotCacheDir configured)\n"
+    if ac.get("error"):
+        return f"Compile cache: on — {ac['error']}\n"
+    mb = (ac.get("bytes") or 0) / 1e6
+    boot_ms = ac.get("bootLoadMs")
+    return (f"Compile cache: {ac.get('entries', 0)} entries "
+            f"({mb:.1f} MB) — boot loaded {ac.get('bootEntries', 0)} in "
+            f"{boot_ms if boot_ms is not None else '?'}ms, "
+            f"hits {ac.get('hits', 0)}, misses {ac.get('misses', 0)}, "
+            f"errors {ac.get('errors', 0)}, "
+            f"invalidations {ac.get('invalidations', 0)}\n")
+
+
 def cmd_status(client: HTTPClient, args, out) -> int:
     """ktpu status: the connected scheduler's published deployment shape
     (the ``kubernetes-tpu-scheduler-status`` ConfigMap) — most importantly
@@ -774,6 +792,9 @@ def cmd_status(client: HTTPClient, args, out) -> int:
                   f"({flight.get('pods', 0)} pod timelines, "
                   f"dropped {flight.get('droppedPods', 0)}) — "
                   "ktpu trace dump\n")
+    aot = st.get("aotCache")
+    if aot is not None:
+        out.write(_aot_cache_line(aot))
     if durability is not None:
         out.write(_durability_line(durability))
     if disruption is not None:

@@ -193,6 +193,19 @@ class SchedulerConfiguration:
     # scheduler_unschedulable_reasons_total. Zero dispatches added to the
     # drain cycle. KTPU_EXPLAIN=0 overrides at scheduler construction.
     explainer_enabled: bool = True
+    # ---- durable AOT executable cache (sched/aotcache.py) ----------------
+    # Directory for the persisted compiled-executable cache: every program
+    # the warm ladder compiles is serialized there, and a restarted
+    # scheduler loads instead of compiling — zero-compile cold start. The
+    # directory is fingerprint-guarded (jax/jaxlib/XLA/device + lowering
+    # knobs) and checksum-scanned at boot; any damaged entry degrades to a
+    # counted recompile. None = disabled (the tier-1 default). YAML
+    # ``aotCacheDir``; the KTPU_AOT_CACHE env var overrides ("0"/"off"
+    # disables).
+    aot_cache_dir: Optional[str] = None
+    # Size bound for the cache directory; oldest-read entries rotate out
+    # past it (counted under scheduler_aot_cache_invalidations_total).
+    aot_cache_max_mb: int = 512
 
     def profile_for(self, scheduler_name: str) -> Optional[Profile]:
         for p in self.profiles:
@@ -229,9 +242,15 @@ class SchedulerConfiguration:
             ("auditFailFast", "audit_fail_fast"),
             ("paritySampleEvery", "parity_sample_every"),
             ("explainerEnabled", "explainer_enabled"),
+            ("aotCacheMaxMB", "aot_cache_max_mb"),
         ]:
             if yaml_key in d:
                 setattr(cfg, attr, type(getattr(cfg, attr))(d[yaml_key]))
+        if "aotCacheDir" in d:
+            # Optional[str]: the generic type-cast list above would turn
+            # None into the string "None"
+            v = d["aotCacheDir"]
+            cfg.aot_cache_dir = str(v) if v else None
         if "meshShape" in d:
             from kubernetes_tpu.parallel.mesh import parse_mesh_shape
             try:
@@ -299,6 +318,8 @@ def validate(cfg: SchedulerConfiguration):
         raise ValidationError("auditIntervalSeconds must be > 0")
     if cfg.parity_sample_every < 0:
         raise ValidationError("paritySampleEvery must be >= 0 (0 = off)")
+    if cfg.aot_cache_max_mb < 1:
+        raise ValidationError("aotCacheMaxMB must be >= 1")
     if cfg.mesh_shape is not None:
         if len(cfg.mesh_shape) != 2:
             raise ValidationError(

@@ -423,6 +423,32 @@ NODELIFE_DEFERRED = REGISTRY.counter(
     "or the secondary-rate token bucket) — retried by the next monitor "
     "sweep if the node is still unhealthy")
 
+# Warm-from-birth (sched/aotcache.py): the durable compiled-executable
+# cache a restarted scheduler boots from instead of paying the full
+# warm_drain compile ladder. Errors/invalidations are COUNTED degrades
+# — a corrupt or stale entry recompiles, never crashes.
+AOT_CACHE_ERRORS = REGISTRY.counter(
+    "scheduler_aot_cache_errors_total",
+    "Durable executable-cache entries rejected at boot or load "
+    "(checksum mismatch, truncation, unreadable file), by reason — "
+    "each one degraded to a counted recompile")
+AOT_CACHE_INVALIDATIONS = REGISTRY.counter(
+    "scheduler_aot_cache_invalidations_total",
+    "Executable-cache entries invalidated wholesale (toolchain/config "
+    "fingerprint mismatch) or rotated out by the size bound, by reason")
+AOT_CACHE_ENTRIES = REGISTRY.gauge(
+    "scheduler_aot_cache_entries",
+    "Live entries in the durable executable cache after the last "
+    "boot scan / seal")
+AOT_CACHE_BYTES = REGISTRY.gauge(
+    "scheduler_aot_cache_bytes",
+    "Bytes held by the durable executable cache after the last boot "
+    "scan / seal")
+AOT_CACHE_BOOT_MS = REGISTRY.gauge(
+    "scheduler_aot_cache_boot_load_ms",
+    "Milliseconds the last activation spent fingerprinting, integrity-"
+    "scanning and arming the durable executable cache")
+
 # Scheduler informer hygiene at fleet scale: node MODIFIEDs whose only
 # news is liveness (heartbeat condition timestamps / lease-driven
 # refreshes) are skipped BEFORE decode — they must not wake the

@@ -731,10 +731,18 @@ def _apply_patch(ct_all: ClusterTensors, patch: dict) -> ClusterTensors:
 
     Reference shape: the incremental half of ``Cache.UpdateSnapshot``
     (pkg/scheduler/internal/cache/cache.go) — churn moves only what changed."""
-    BIG = jnp.int32(1 << 30)  # out-of-range: scatter mode="drop" ignores
+    # Out-of-range sentinel: scatter mode="drop" ignores the row. UNSIGNED
+    # on purpose — signed scatter indices make jnp emit a negative-wrap
+    # `select(i < 0, i + dim, i)` that is dead here (idx() already maps
+    # negatives to BIG), and the dead branch's `dim` constant proved
+    # trace-unstable across interpreter runs. A flipped dead constant
+    # re-keys the persistent executable cache, so a restarted scheduler
+    # would pay a genuine recompile for a program it already has on disk.
+    # Unsigned indices skip the wrap lowering entirely.
+    BIG = jnp.uint32(1 << 30)
 
     def idx(a):
-        return jnp.where(a < 0, BIG, a)
+        return jnp.where(a < 0, BIG, a.astype(jnp.uint32))
 
     ps = idx(patch["pod_slot"])
     ns_ = idx(patch["node_row"])

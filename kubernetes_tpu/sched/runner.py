@@ -91,11 +91,44 @@ class SchedulerRunner:
             sys.setswitchinterval(float(si))
 
         self.cfg = cfg or SchedulerConfiguration()
+        # durable AOT executable cache: armed BEFORE the Scheduler exists so
+        # every jit this process ever compiles — warm ladder, staging
+        # helpers, first-touch programs — persists, and a restarted
+        # scheduler boots warm from disk (sched/aotcache.py). Activation
+        # never raises on cache damage; a cache too broken to use degrades
+        # to plain recompiles.
+        self.aot_cache = None
+        from kubernetes_tpu.sched.aotcache import (AotExecutableCache,
+                                                   cache_knobs,
+                                                   resolve_cache_dir)
+        cache_dir = resolve_cache_dir(self.cfg)
+        if cache_dir:
+            try:
+                self.aot_cache = AotExecutableCache(
+                    cache_dir, knobs=cache_knobs(self.cfg),
+                    max_bytes=self.cfg.aot_cache_max_mb * 1024 * 1024)
+                self.aot_cache.activate()
+            except Exception:
+                # the cache is an accelerant, never a dependency: a scheduler
+                # that cannot arm it runs cold, it does not stay down
+                from kubernetes_tpu.metrics.registry import AOT_CACHE_ERRORS
+                AOT_CACHE_ERRORS.inc({"reason": "activate"})
+                _LOG.exception("AOT cache activation failed at %s; "
+                               "running without executable persistence",
+                               cache_dir)
+                self.aot_cache = None
         self.cache = SchedulerCache(assume_ttl=self.cfg.assume_ttl_s)
         self.queue = self._build_queue(self.cfg)
         self.scheduler = Scheduler(self.cfg, self.cache, self.queue, self._bind,
                                    registry=registry,
                                    bulk_binder=self._bind_many)
+        if (self.aot_cache is not None and self.aot_cache.boot.get("entries")
+                and self.scheduler.sentinel is not None):
+            # warm-from-cache canary: the FIRST drain answer produced by a
+            # deserialized executable is parity-judged regardless of the
+            # every-Kth modulus — a wrong program trips the breaker
+            # (reason="parity") before a second batch trusts it
+            self.scheduler.sentinel.force_next()
         from kubernetes_tpu.utils.events import EventRecorder
         self.scheduler.recorder = EventRecorder(client, "default-scheduler")
         self.scheduler._evict = self._evict  # preemption deletes via API
@@ -545,6 +578,23 @@ class SchedulerRunner:
         self.scheduler.pdb_lister = lambda: list(pdb_inf.store.list())
         self.factory.start_all()
         self.factory.wait_for_cache_sync(wait_sync)
+        # Boot resync: a predecessor that died mid-cycle leaves stale
+        # nominations (and half-executed gang plans) in the API. Sweeping
+        # HERE — after the informers synced, before the loop binds anything
+        # — means the first scheduling cycle judges clean state instead of
+        # waiting for the first 30s audit cadence to GC it. Bound-pod state
+        # needs no sweep: the informer sync itself rebuilt the cache from
+        # the API's nodeName truth, so duplicate binds are structurally
+        # impossible (_on_pod confirms, never re-binds).
+        try:
+            cleared = self.sweep_stale_nominations()
+            if cleared:
+                _LOG.info("boot resync: cleared %d stale nomination(s) "
+                          "left by a prior incarnation", cleared)
+        except Exception:
+            LOOP_ERRORS.inc({"site": "nomination_gc"})
+            _LOG.warning("boot-resync nomination sweep failed; the audit "
+                         "cadence retries", exc_info=True)
 
         if self.cfg.leader_elect:
             elector = LeaderElector(self.client.leases(), LeaderElectionConfig(
@@ -649,6 +699,7 @@ class SchedulerRunner:
             "explain": (self.scheduler.explainer.stats()
                         if self.scheduler.explainer is not None else None),
             "flight": self._flight_status(),
+            "aotCache": self._aot_cache_status(),
         }
         self._publish_configmap(self.status_name,
                                 {"status": json.dumps(status, indent=1)})
@@ -668,6 +719,22 @@ class SchedulerRunner:
         st = FLIGHT.stats()
         st["spanDrops"] = TRACER.dropped
         return st
+
+    def _aot_cache_status(self):
+        """Executable-cache block for the status ConfigMap (``ktpu status``
+        renders the "Compile cache:" line from it). Publishing rides the
+        audit cadence, so seal here too: entries jax wrote since the last
+        seal become checksum-verifiable at the next boot (cheap no-op when
+        the entry set is unchanged)."""
+        if self.aot_cache is None:
+            return {"enabled": False}
+        try:
+            self.aot_cache.seal()
+            return self.aot_cache.stats()
+        except Exception:
+            LOOP_ERRORS.inc({"site": "publish_status"})
+            _LOG.debug("AOT cache status failed", exc_info=True)
+            return {"enabled": True, "error": "stats unavailable"}
 
     def _publish_configmap(self, name: str, data: dict) -> None:
         """Create-or-update one of the runner's published ConfigMaps.

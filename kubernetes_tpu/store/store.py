@@ -279,12 +279,8 @@ class ObjectStore:
         rename (atomic on POSIX), truncate the WAL."""
         blob = {kind: list(space.values())
                 for kind, space in self._data.items()}
-        tmp = self._snap_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"rv": self._rv, "data": blob}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._snap_path)
+        from kubernetes_tpu.utils.atomicio import atomic_write_json
+        atomic_write_json(self._snap_path, {"rv": self._rv, "data": blob})
         if self._wal is not None:
             self._wal.close()
         self._wal = open(self._wal_path, "w", buffering=1)

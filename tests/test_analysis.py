@@ -512,6 +512,64 @@ def test_ktl007_suppression_with_reason(tmp_path):
                            "sched/use.py": use}) == []
 
 
+# ---- KTL008 rename commits --------------------------------------------------
+
+def test_ktl008_os_replace_fires(tmp_path):
+    src = """
+    import os
+
+    def commit(tmp, path):
+        os.replace(tmp, path)
+    """
+    found = lint(tmp_path, {"sched/persist.py": src})
+    assert rules_of(found) == ["KTL008"]
+    assert "atomicio" in found[0].message
+
+
+def test_ktl008_rename_and_shutil_move_fire(tmp_path):
+    src = """
+    import os
+    import shutil
+
+    def commit(tmp, path):
+        os.rename(tmp, path)
+        shutil.move(tmp, path)
+    """
+    found = lint(tmp_path, {"store/persist.py": src})
+    assert rules_of(found) == ["KTL008", "KTL008"]
+
+
+def test_ktl008_helper_and_non_commit_io_pass(tmp_path):
+    helper = """
+    import os
+
+    def atomic_write(path, data):
+        os.replace(path + ".tmp", path)
+    """
+    clean = """
+    import os
+
+    from kubernetes_tpu.utils.atomicio import atomic_write
+
+    def commit(path, data):
+        atomic_write(path, data)
+        os.unlink(path + ".bak")
+        os.makedirs("x", exist_ok=True)
+    """
+    assert lint(tmp_path, {"utils/atomicio.py": helper,
+                           "sched/persist.py": clean}) == []
+
+
+def test_ktl008_suppression_with_reason(tmp_path):
+    src = """
+    import os
+
+    def rotate(old, new):
+        os.rename(old, new)  # ktpu-lint: disable=KTL008 -- log rotation of scratch output, not a durable commit
+    """
+    assert lint(tmp_path, {"sched/persist.py": src}) == []
+
+
 # ---- KTL000 meta rule --------------------------------------------------------
 
 def test_reasonless_disable_is_ktl000_and_suppresses_nothing(tmp_path):

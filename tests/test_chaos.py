@@ -610,6 +610,70 @@ def test_crash_recovery_mid_flight_reconverges():
         r2.stop()
 
 
+@pytest.mark.disaster
+def test_crash_recovery_warm_from_cache_restart_parity(tmp_path):
+    """The cache-ON leg of crash recovery. Same scenario as above — bind
+    outage, SIGKILL mid-flight, stale state — but every incarnation
+    shares one durable AOT cache dir, and the restarted scheduler must
+    deliver the SAME placements with ZERO genuine XLA compiles: after
+    ``jax.clear_caches()`` wipes the in-process dispatch caches, every
+    program it runs has to load from disk (``hits`` proves it did)."""
+    import jax
+
+    from kubernetes_tpu.sched.aotcache import AotExecutableCache
+    cache_dir = str(tmp_path / "aot")
+    cfg = lambda: SchedulerConfiguration(  # noqa: E731
+        batch_size=4, backoff_initial_s=0.02, backoff_max_s=0.1,
+        bind_retries=0, aot_cache_dir=cache_dir)
+    try:
+        # earlier tests may have warmed this process's jit dispatch
+        # caches; drop them so the reference run genuinely compiles (and
+        # therefore persists) every program
+        jax.clear_caches()
+        # ---- reference run: pins placements AND populates the cache
+        store_ref = ObjectStore()
+        ref_client = DirectClient(store_ref)
+        _forced_workload(ref_client)
+        r_ref = SchedulerRunner(DirectClient(store_ref), cfg())
+        assert r_ref.aot_cache is not None
+        r_ref.start()
+        assert wait_for(lambda: _all_bound(ref_client, 8), timeout=30)
+        r_ref.stop()
+        expected = _placements(ref_client)
+        assert r_ref.aot_cache.seal(force=True) >= 1  # durable entries
+
+        # ---- incarnation 1: bind layer down, killed mid-flight
+        store = ObjectStore()
+        truth = DirectClient(store)
+        _forced_workload(truth)
+        outage = FaultSchedule([Fault("api.bind", "error", 0, 10**6, 503)])
+        r1 = SchedulerRunner(ChaosClient(DirectClient(store), outage),
+                             cfg())
+        r1.start()
+        assert wait_for(lambda: outage.peek("api.bind") >= 1, timeout=20)
+        r1.kill()
+        assert not any(_placements(truth).values())
+
+        # ---- the restart: dispatch caches gone, only the disk survives
+        jax.clear_caches()
+        r2 = SchedulerRunner(DirectClient(store), cfg())
+        assert r2.aot_cache is not None
+        assert r2.aot_cache.boot["entries"] >= 1  # warm from birth
+        r2.start()
+        try:
+            assert wait_for(lambda: _all_bound(truth, 8),
+                            timeout=30), _placements(truth)
+            assert _placements(truth) == expected
+            stats = r2.aot_cache.stats()
+            assert stats["realCompiles"] == 0, stats
+            assert stats["hits"] >= 1, stats  # loaded, not re-derived
+        finally:
+            r2.stop()
+    finally:
+        AotExecutableCache.disarm()
+        jax.clear_caches()
+
+
 def test_leader_elector_survives_api_storm_and_callback_failure():
     """Satellite regression: the elector thread used to die silently when
     a callback raised or transport errors leaked; now it backs off,

@@ -288,6 +288,8 @@ def test_publish_status_and_ktpu_status():
         assert "in flight" in text
         # zero-copy staging health (sched/staging.py arena)
         assert "Staging:" in text and "arena on" in text
+        # no aotCacheDir configured -> the cache reports itself off
+        assert "Compile cache: off" in text
         out = io.StringIO()
         rc = ktpu_main(["--server", server.url, "status", "-o", "json"],
                        out=out)
@@ -299,8 +301,46 @@ def test_publish_status_and_ktpu_status():
         assert st["pipelineInflight"] == 0 and st["fusedFold"] is True
         assert st["staging"]["enabled"] is True
         assert st["staging"]["fallbacks"] == 0
+        assert st["aotCache"] == {"enabled": False}
         runner.scheduler.close()
     finally:
+        server.stop()
+
+
+def test_ktpu_status_compile_cache_line(tmp_path):
+    """With an aotCacheDir configured the status surface reports the
+    durable compile cache: entry/byte counts and this boot's load, in
+    both the text line and the -o json block."""
+    from kubernetes_tpu.cli.ktpu import main as ktpu_main
+    from kubernetes_tpu.client.clientset import HTTPClient
+    from kubernetes_tpu.config.types import SchedulerConfiguration
+    from kubernetes_tpu.sched.aotcache import AotExecutableCache
+    from kubernetes_tpu.sched.runner import SchedulerRunner
+    from kubernetes_tpu.store.apiserver import APIServer
+    server = APIServer().start()
+    try:
+        cfg = SchedulerConfiguration.from_dict(
+            {"aotCacheDir": str(tmp_path / "aot")})
+        runner = SchedulerRunner(HTTPClient(server.url), cfg)
+        assert runner.aot_cache is not None
+        runner.publish_status()
+        out = io.StringIO()
+        assert ktpu_main(["--server", server.url, "status"], out=out) == 0
+        text = out.getvalue()
+        assert "Compile cache: 0 entries" in text
+        assert "boot loaded 0" in text
+        out = io.StringIO()
+        assert ktpu_main(["--server", server.url, "status", "-o", "json"],
+                         out=out) == 0
+        import json
+        ac = json.loads(out.getvalue())["aotCache"]
+        assert ac["enabled"] is True
+        assert ac["entries"] == 0 and ac["bootEntries"] == 0
+        assert ac["bootLoadMs"] is not None
+        assert ac["errors"] == 0 and ac["invalidations"] == 0
+        runner.scheduler.close()
+    finally:
+        AotExecutableCache.disarm()
         server.stop()
 
 
