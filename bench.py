@@ -31,7 +31,12 @@ tenant apiservers through one FleetRunner + one warm resident program;
 BENCH_FLEET_TENANTS default 4, campaign tier 16; BENCH_FLEET_NOISY
 sets the noisy-neighbor churn multiple, BENCH_FLEET_P99 the per-tenant
 bind-p99 ceiling — gates: 100% binds/tenant, 0 violations, 0 XLA
-compiles in the steady window), BENCH_DISASTER=0 to skip the DisasterChurn case
+compiles in the steady window), BENCH_SLICECARVE=0 to skip the
+SliceCarve case (contiguous ICI sub-slice churn over a labeled torus;
+BENCH_SLICE_GRID/SHAPE/WINDOW_S/FRAG size it — gates: every gang lands
+one contiguous box, 0 violations, 0 XLA compiles in the steady window,
+0 carve-parity divergences at every=1),
+BENCH_DISASTER=0 to skip the DisasterChurn case
 (apiserver SIGKILL + WAL-replay restart mid-churn; BENCH_DISASTER_NODES/
 PODS/OUTAGE_S size it, BENCH_DISASTER_BIND_SLO bounds time-to-first-
 bind-after-restart — every gate treats a missing number as failure).
@@ -263,6 +268,22 @@ def main():
             log=log)
         log("[bench] " + json.dumps(fleet_churn))
 
+    slice_carve = None
+    if os.environ.get("BENCH_SLICECARVE", "1") != "0" and not only_case:
+        # contiguous-slice churn over a labeled torus: every gang must
+        # land one contiguous box, with 0 violations (slice_contiguity
+        # armed), 0 XLA compiles in the steady window, and every device
+        # carve parity-confirmed against the numpy oracle carver
+        from benchmarks.slicecarve import run_slice_carve
+        log("[bench] slice carve run ...")
+        slice_carve = run_slice_carve(
+            grid=os.environ.get("BENCH_SLICE_GRID", "4x4x2"),
+            shape=os.environ.get("BENCH_SLICE_SHAPE", "2x2x2"),
+            window_s=float(os.environ.get("BENCH_SLICE_WINDOW_S", "10")),
+            n_fragment=int(os.environ.get("BENCH_SLICE_FRAG", "4")),
+            log=log)
+        log("[bench] " + json.dumps(slice_carve))
+
     disaster = None
     if os.environ.get("BENCH_DISASTER", "1") != "0" and not only_case:
         # apiserver SIGKILL + WAL-replay restart mid-churn: every pod
@@ -334,6 +355,7 @@ def main():
         "connected_preemption": connected_preemption,
         "scale_fleet": scale_fleet,
         "fleet_churn": fleet_churn,
+        "slice_carve": slice_carve,
         "disaster_churn": disaster,
         "kubemark": kubemark,
         "pallas": pallas,
@@ -345,14 +367,15 @@ def main():
         "invariant_violations": _sum_violations(connected, chaos_churn,
                                                 connected_mesh, explain_ab,
                                                 scale_fleet, disaster,
-                                                fleet_churn),
+                                                fleet_churn, slice_carve),
         # hard SLO verdicts from case-config gates (SchedulingChurn p99 +
         # throughput, ConnectedMesh legs). Missing numbers are failures —
         # the BENCH_r05 parsed-null lesson: a silently absent figure must
         # never read as a pass.
         "slo_failures": _collect_slo_failures(results, connected_mesh,
                                               explain_ab, scale_fleet,
-                                              disaster, fleet_churn),
+                                              disaster, fleet_churn,
+                                              slice_carve),
     }
     _require_invariant_field(out, "bench summary")
     print(json.dumps(out))
@@ -366,6 +389,7 @@ def main():
                     ("connected_mesh", connected_mesh),
                     ("scale_fleet", scale_fleet),
                     ("fleet_churn", fleet_churn),
+                    ("slice_carve", slice_carve),
                     ("disaster_churn", disaster)) if c}
         print(f"[bench] FATAL: {out['invariant_violations']} correctness-"
               f"invariant violation(s) confirmed by the auditor "
@@ -393,7 +417,7 @@ def main():
 
 def _collect_slo_failures(results, connected_mesh, explain_ab=None,
                           scale_fleet=None, disaster=None,
-                          fleet_churn=None) -> list:
+                          fleet_churn=None, slice_carve=None) -> list:
     """Flatten every case's hard-SLO failure strings, prefixed by case."""
     out = []
     for r in results or []:
@@ -414,6 +438,9 @@ def _collect_slo_failures(results, connected_mesh, explain_ab=None,
     if fleet_churn is not None:
         for msg in fleet_churn.get("slo_failures") or []:
             out.append(f"FleetChurn: {msg}")
+    if slice_carve is not None:
+        for msg in slice_carve.get("slo_failures") or []:
+            out.append(f"SliceCarve: {msg}")
     return out
 
 

@@ -293,6 +293,67 @@ def check_gang_atomicity(snap: AuditSnapshot) -> list[Violation]:
     return out
 
 
+# ---- invariant: slice contiguity (topology/) -------------------------------
+
+def check_slice_contiguity(snap: AuditSnapshot) -> list[Violation]:
+    """A FULLY bound gang that declared a slice shape
+    (``kubernetes-tpu.io/slice-shape``) must occupy one CONTIGUOUS torus
+    sub-slice of that shape — the whole point of the carver. Judged from
+    one consistent API list against the nodes' topology labels
+    (topology/slicing.is_contiguous_slice is the truth predicate), so a
+    violation cannot flap: confirm=1. Partially bound gangs are
+    gang_atomicity's business; members on unlabeled nodes ARE a violation
+    here (a slice member off the grid is never contiguous)."""
+    from kubernetes_tpu.topology.slicing import (coords_of_labels, grid_dims,
+                                                 is_contiguous_slice,
+                                                 parse_shape, shape_str)
+    node_coords: dict[str, Optional[tuple]] = {}
+    for nd in snap.api_nodes:
+        md = nd.get("metadata") or {}
+        node_coords[md.get("name", "")] = coords_of_labels(md.get("labels"))
+    dims = grid_dims([c for c in node_coords.values() if c is not None])
+    gangs: dict[str, list] = {}
+    shapes: dict[str, tuple] = {}
+    for p in snap.api_pods:
+        if _is_terminal(p):
+            continue
+        labels = ((p.get("metadata") or {}).get("labels")) or {}
+        shape = parse_shape(labels.get("kubernetes-tpu.io/slice-shape"))
+        if shape is None:
+            continue
+        g = labels.get(GANG_LABEL) or f"pod:{_pod_key(p)}"
+        gangs.setdefault(g, []).append(p)
+        shapes[g] = shape
+    out = []
+    for g, members in sorted(gangs.items()):
+        if not all(_node_name(p) for p in members):
+            continue  # partial gangs belong to gang_atomicity
+        shape = shapes[g]
+        if len(members) != shape[0] * shape[1] * shape[2]:
+            # not a full complement: a gang mid-deletion (members already
+            # gone from the API) or mid-creation looks exactly like this
+            # from one list — judging it would flap on ordinary churn
+            continue
+        coords = [node_coords.get(_node_name(p)) for p in members]
+        ok = (dims is not None and None not in coords
+              and is_contiguous_slice(coords, shape, dims))
+        if not ok:
+            out.append(Violation(
+                "slice_contiguity",
+                f"gang {g!r} declares slice {shape_str(shape)} but its "
+                f"{len(members)} bound member(s) do not form a contiguous "
+                "torus sub-slice",
+                fingerprint=("slice_contiguity", g),
+                objects=[{"gang": g, "shape": shape_str(shape),
+                          "grid": (shape_str(dims) if dims else None),
+                          "placements": sorted(
+                              {_pod_key(p): [_node_name(p),
+                                             node_coords.get(_node_name(p))]
+                               for p in members}.items())}],
+                confirm=1))
+    return out
+
+
 # ---- invariant: nomination consistency ------------------------------------
 
 def check_nominations(snap: AuditSnapshot) -> list[Violation]:
@@ -468,6 +529,7 @@ ALL_INVARIANTS: list[tuple[str, Callable[[AuditSnapshot], list[Violation]]]] = [
     ("node_overcommit", check_node_overcommit),
     ("double_bind", check_double_bind),
     ("gang_atomicity", check_gang_atomicity),
+    ("slice_contiguity", check_slice_contiguity),
     ("nomination_consistency", check_nominations),
     ("cross_tenant", check_cross_tenant),
     ("cache_parity", check_cache_parity),

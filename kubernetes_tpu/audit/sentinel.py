@@ -141,6 +141,28 @@ def verify_drain_winners(nodes, bound, winners, prior_winners,
     return problems
 
 
+def verify_carve_assignments(nodes, bound, assignments, members,
+                             dra=None) -> list[str]:
+    """Re-run the numpy oracle carver (sched/oracle.py plan_slices over
+    topology/carve.numpy_grids) on the captured host views and demand
+    BIT-EQUAL member -> node assignments for every gang the device carved.
+    The carve is deterministic end to end — same grids, same max-wins
+    scatter, same first-fit flat order — so ANY difference is a
+    divergence, never a tie-break."""
+    from kubernetes_tpu.sched.oracle import OracleScheduler
+    orc = OracleScheduler(nodes, bound, dra=dra)
+    plans = orc.plan_slices(members, validate=False)
+    problems: list[str] = []
+    for gang, got in sorted(assignments.items()):
+        want = plans.get(gang)
+        if want != got:
+            problems.append(
+                f"carve for gang {gang!r} diverged: device placed "
+                f"{sorted(got.items())}, the oracle carver says "
+                f"{sorted(want.items()) if want else None}")
+    return problems
+
+
 def verify_wave_results(nodes, bound, views, results,
                         namespace_labels=None) -> list[str]:
     """Judge one preemption wave's results with the oracle, in the wave's
@@ -221,8 +243,9 @@ class ParitySentinel:
         self._spawn_lock = threading.Lock()
         self._n_drain = 0
         self._n_wave = 0
+        self._n_carve = 0
         self._force_drain = False
-        self.samples: dict[str, int] = {"drain": 0, "wave": 0}
+        self.samples: dict[str, int] = {"drain": 0, "wave": 0, "carve": 0}
         self.divergences = 0
         self.skipped = 0
         self.last_divergence: Optional[dict] = None
@@ -322,6 +345,29 @@ class ParitySentinel:
                      "views": list(views), "results": list(results),
                      "ns_labels": namespace_labels})
 
+    def maybe_submit_carve(self, nodes, bound, assignments, members,
+                           dra=None, level: str = "single") -> None:
+        """Every Kth carved group batch: the scheduler hands over the
+        typed host views its snapshot encoded (capture by reference — the
+        product treats pod subtrees as immutable) plus the device carver's
+        member -> node picks per gang. The checker replays the numpy
+        oracle carver and demands bit-equality."""
+        if self.every <= 0:
+            return
+        self._n_carve += 1
+        if self._n_carve % self.every:
+            return
+        if self._q.qsize() >= self._max_backlog:
+            self.skipped += 1
+            return
+        self.samples["carve"] += 1
+        PARITY_SAMPLES.inc({"site": "carve"})
+        self._ensure_thread()
+        self._q.put({"site": "carve", "level": level, "ts": time.time(),
+                     "nodes": list(nodes), "bound": list(bound),
+                     "assignments": dict(assignments),
+                     "members": list(members), "dra": dra})
+
     # ---- checker thread --------------------------------------------------
 
     def _ensure_thread(self) -> None:
@@ -355,6 +401,10 @@ class ParitySentinel:
                 item["prior_winners"],
                 exempt=item.get("exempt", frozenset()),
                 namespace_labels=item.get("ns_labels"))
+        elif item["site"] == "carve":
+            problems = verify_carve_assignments(
+                item["nodes"], item["bound"], item["assignments"],
+                item["members"], dra=item.get("dra"))
         else:
             problems = verify_wave_results(
                 item["nodes"], item["bound"], item["views"],
@@ -374,6 +424,8 @@ class ParitySentinel:
             {"ts": item["ts"], "site": site, "level": level,
              "chaosSeed": active_chaos_seed(),
              "problems": problems,
+             "carve": {g: sorted(a.items()) for g, a
+                       in item.get("assignments", {}).items()},
              "winners": [(p.key, n) for p, n in item.get("winners", [])],
              "priorWinners": [(p.key, n)
                               for p, n in item.get("prior_winners", [])],

@@ -659,6 +659,28 @@ def _aot_cache_line(ac: dict) -> str:
             f"invalidations {ac.get('invalidations', 0)}\n")
 
 
+def _topology_line(topo: dict) -> str:
+    """One-line slice-carving summary (scheduler.topology_status): the ICI
+    grid extent, per-requested-shape carveability + fragmentation, and the
+    carve counters."""
+    shapes = topo.get("shapes") or {}
+    parts = []
+    for s, cov in sorted(shapes.items()):
+        frag = cov.get("fragmentationPct")
+        parts.append(f"{s}: {cov.get('origins', 0)} carveable"
+                     + (f", {frag}% fragmented" if frag is not None else ""))
+    carves = topo.get("carves") or {}
+    return (f"Topology:      {topo.get('grid', '?')} grid "
+            f"({topo.get('nodes', 0)} nodes, "
+            f"{topo.get('freeCells', 0)} free cells)"
+            + (" — " + "; ".join(parts) if parts else "")
+            + (f" — carves {carves.get('carved', 0)} ok / "
+               f"{carves.get('failed', 0)} failed / "
+               f"{carves.get('slicePreempts', 0)} slice-preempts"
+               if carves else "")
+            + "\n")
+
+
 def cmd_status(client: HTTPClient, args, out) -> int:
     """ktpu status: the connected scheduler's published deployment shape
     (the ``kubernetes-tpu-scheduler-status`` ConfigMap) — most importantly
@@ -795,6 +817,9 @@ def cmd_status(client: HTTPClient, args, out) -> int:
     aot = st.get("aotCache")
     if aot is not None:
         out.write(_aot_cache_line(aot))
+    topo = st.get("topology")
+    if topo is not None:
+        out.write(_topology_line(topo))
     if durability is not None:
         out.write(_durability_line(durability))
     if disruption is not None:

@@ -25,6 +25,7 @@ from kubernetes_tpu.descheduler.planner import (
 from kubernetes_tpu.descheduler.strategies import (
     STRATEGY_BUILDERS,
     gang_consolidation_candidates,
+    slice_defrag_candidates,
 )
 
 __all__ = [
@@ -32,5 +33,5 @@ __all__ = [
     "DeschedulerConfiguration", "EvictionPlan", "GANG_LABEL",
     "GangDefragPlan", "STATUS_CONFIGMAP", "STRATEGY_BUILDERS",
     "gang_consolidation_candidates", "plan_evictions",
-    "plan_evictions_naive", "plan_gang_defrag",
+    "plan_evictions_naive", "plan_gang_defrag", "slice_defrag_candidates",
 ]

@@ -161,10 +161,17 @@ class Descheduler:
                 _LOG.warning("unknown descheduler strategy %r", name)
                 continue
             kwargs = dict(args)
-            if "encoder" in inspect.signature(builder).parameters:
+            params = inspect.signature(builder).parameters
+            if "encoder" in params:
                 # share the loop's persistent encoder: stable intern ids and
                 # no full re-encode (or shape recompile) per periodic cycle
                 kwargs.setdefault("encoder", self.encoder)
+            if "pending" in params:
+                # demand-driven strategies (SliceDefrag) read the pending
+                # set: what to free is defined by who is waiting
+                kwargs.setdefault("pending", pending)
+            if "pdbs" in params:
+                kwargs.setdefault("pdbs", pdbs)
             candidates.extend(builder(nodes, bound, **kwargs))
         # None stays None: the planner falls back to the bound pods for PDB
         # arithmetic — an empty list would make every covered budget compute

@@ -364,10 +364,99 @@ def gang_consolidation_candidates(nodes: list[Node], bound_pods: list[Pod],
     return out
 
 
+def slice_defrag_candidates(nodes: list[Node], bound_pods: list[Pod],
+                            pending: Optional[list[Pod]] = None,
+                            max_victim_priority: Optional[int] = None,
+                            pdbs: Optional[list[dict]] = None,
+                            all_pod_dicts: Optional[list[dict]] = None,
+                            ) -> list[CandidateSet]:
+    """SliceDefrag: defrag TOWARD CONTIGUITY. For each pending slice gang
+    (``kubernetes-tpu.io/slice-shape``) the carver's eviction plane names
+    the cheapest contiguous victim set — the fewest-evictions box that
+    frees one whole placement of the requested shape — and that box
+    becomes ONE candidate set (victims = the box's residents, re-placement
+    must avoid the box being freed). Reuses the scheduler's exact pooling
+    (topology/carve.numpy_grids + select_eviction), so the descheduler
+    frees the SAME box the carver will pick next cycle. The gang-seat
+    protections of gang consolidation carry over: bound GANG_LABEL pods
+    are never victims, victims never outrank the pending gang, and a box
+    whose drain alone overdraws a PDB is discarded."""
+    from kubernetes_tpu.topology.carve import numpy_grids, select_eviction
+    from kubernetes_tpu.topology.slicing import (coords_of_labels,
+                                                 grid_dims, shape_of_labels,
+                                                 shape_str)
+    coords = [coords_of_labels(n.metadata.labels) for n in nodes]
+    dims = grid_dims([c for c in coords if c is not None])
+    if dims is None or not pending:
+        return []
+    gangs: dict[str, list[Pod]] = {}
+    shapes: dict[str, tuple] = {}
+    for p in pending:
+        shape = shape_of_labels(p.metadata.labels)
+        if shape is None:
+            continue
+        g = p.metadata.labels.get(GANG_LABEL) or f"pod:{p.key}"
+        gangs.setdefault(g, []).append(p)
+        shapes[g] = shape
+
+    budgets: list = []
+    if pdbs:
+        from kubernetes_tpu.api.policy import _matches, pdb_budgets
+        if all_pod_dicts is None:
+            all_pod_dicts = [p.to_dict() for p in bound_pods]
+        budgets = pdb_budgets(pdbs, all_pod_dicts)
+
+    def _overdraws(victims: list[Pod]) -> bool:
+        for pdb, pns, _name, allowed in budgets:
+            sel = (pdb.get("spec") or {}).get("selector")
+            n = sum(1 for p in victims if p.metadata.namespace == pns
+                    and _matches(sel, p.metadata.labels))
+            if n > allowed:
+                return True
+        return False
+
+    res = _residents(nodes, bound_pods)
+    out: list[CandidateSet] = []
+    claimed: set[int] = set()
+    for g in sorted(gangs):
+        shape = shapes[g]
+        if len(gangs[g]) != shape[0] * shape[1] * shape[2]:
+            continue  # malformed gang: the scheduler explains, not us
+        prio = (min(p.spec.priority for p in gangs[g])
+                if max_victim_priority is None else max_victim_priority)
+        free, evict_ok, n_pods = [], [], []
+        for i, n in enumerate(nodes):
+            pods = res[n.metadata.name]
+            usable = not n.spec.unschedulable and i not in claimed
+            clean = all(evictable(p)
+                        and GANG_LABEL not in p.metadata.labels
+                        and p.spec.priority <= prio for p in pods)
+            free.append(usable and not pods)
+            evict_ok.append(usable and clean)
+            n_pods.append(len(pods))
+        sel = select_eviction(numpy_grids(coords, free, evict_ok, n_pods,
+                                          dims, shape))
+        if sel is None:
+            continue
+        node_idxs, _cells, cost = sel
+        box_names = {nodes[i].metadata.name for i in node_idxs}
+        victims = [p for i in node_idxs for p in res[nodes[i].metadata.name]]
+        if not victims or _overdraws(victims):
+            continue
+        claimed.update(node_idxs)
+        out.append(CandidateSet(
+            name=f"slicedefrag/{g}", strategy="SliceDefrag",
+            victims=victims, exclude_targets=box_names,
+            reason=(f"free a contiguous {shape_str(shape)} box for gang "
+                    f"{g} ({int(cost)} eviction(s))")))
+    return out
+
+
 STRATEGY_BUILDERS = {
     "HighNodeUtilization": high_node_utilization,
     "LowNodeUtilization": low_node_utilization,
     "RemovePodsViolatingNodeAffinity": pods_violating_node_affinity,
     "RemovePodsViolatingTopologySpread": pods_violating_topology_spread,
     "RemoveDuplicates": remove_duplicates,
+    "SliceDefrag": slice_defrag_candidates,
 }

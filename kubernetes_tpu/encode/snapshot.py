@@ -82,9 +82,22 @@ UNSCHED_TAINT_KEY = "node.kubernetes.io/unschedulable"
 # Absent label = -1 on both sides, and -1 == -1 passes, so single-tenant
 # clusters are bit-identical to the pre-fleet behavior.
 TENANT_LABEL = "kubernetes-tpu.io/tenant"
+# ICI-torus coordinate plane (topology/): nodes advertise their position
+# on the wrap-around mesh via these labels, and — same trick as tenancy —
+# the label COLUMNS node_labels[:, TOPO_*_KEY_ID] combined with the
+# existing label_value_num numeric-parse plane ARE the coordinate fields.
+# No new tensor member, so churn patches, overlays and AOT signatures are
+# untouched and the carver's occupancy grid is always current. Pre-interned
+# so the ids are Python constants visible to jitted code.
+TOPO_X_LABEL = "kubernetes-tpu.io/topology-x"
+TOPO_Y_LABEL = "kubernetes-tpu.io/topology-y"
+TOPO_Z_LABEL = "kubernetes-tpu.io/topology-z"
 NODE_NAME_KEY_ID = 0
 UNSCHED_TAINT_KEY_ID = 1
 TENANT_KEY_ID = 2
+TOPO_X_KEY_ID = 3
+TOPO_Y_KEY_ID = 4
+TOPO_Z_KEY_ID = 5
 
 
 def tenant_label_of(labels: Optional[dict]) -> Optional[str]:
@@ -336,7 +349,8 @@ class SnapshotEncoder:
 
     def __init__(self):
         self.keys = StringTable([NODE_NAME_LABEL, UNSCHED_TAINT_KEY,
-                                 TENANT_LABEL])
+                                 TENANT_LABEL, TOPO_X_LABEL, TOPO_Y_LABEL,
+                                 TOPO_Z_LABEL])
         self.values = StringTable([""])
         self.namespaces = StringTable(["default"])
         self.ips = StringTable([WILDCARD_IP])

@@ -52,6 +52,10 @@ FILTER_MESSAGES: dict[str, str] = {
     "VolumeBinding": FailReason.VOLUME,
     "PodTopologySpread": FailReason.SPREAD,
     "InterPodAffinity": FailReason.POD_AFFINITY,
+    # oracle-judge-only pseudo-filter (topology/): slice-shaped pods judged
+    # via the oracle carver's coverage plane — not in EXPLAIN_FILTERS (the
+    # tensor judge's stack), but failed_scheduling_message renders it
+    "SliceCarve": FailReason.SLICE_UNAVAILABLE,
 }
 
 # oracle reason string -> filter name (both inter-pod reasons collapse to
@@ -68,6 +72,7 @@ REASON_TO_FILTER: dict[str, str] = {
     FailReason.SPREAD: "PodTopologySpread",
     FailReason.POD_AFFINITY: "InterPodAffinity",
     FailReason.POD_ANTI_AFFINITY: "InterPodAffinity",
+    FailReason.SLICE_UNAVAILABLE: "SliceCarve",
 }
 
 

@@ -110,6 +110,30 @@ class DraCatalog:
                 out[key] = out.get(key, 0) + n
         return out
 
+    @staticmethod
+    def claim_slice_shape(claim: dict) -> Optional[tuple]:
+        """A SLICE-SHAPED claim: ``spec.devices.requests[].sliceShape``
+        ("2x2x4") asks for a contiguous ICI sub-slice instead of count
+        fungible devices — the claims-bridge half of topology/ (the label
+        route is kubernetes-tpu.io/slice-shape). First parseable shape
+        wins; a claim may carry ordinary count requests besides it."""
+        from kubernetes_tpu.topology.slicing import parse_shape
+        devices = ((claim.get("spec") or {}).get("devices") or {})
+        for req in devices.get("requests") or []:
+            shape = parse_shape(req.get("sliceShape"))
+            if shape is not None:
+                return shape
+        return None
+
+    def pod_slice_shape(self, pod: Pod) -> Optional[tuple]:
+        """The slice shape requested by any of the pod's claims (routes
+        the pod into the carver exactly like the slice-shape label)."""
+        for claim in self.pod_claims(pod):
+            shape = self.claim_slice_shape(claim)
+            if shape is not None:
+                return shape
+        return None
+
     def pod_allocated_node(self, pod: Pod) -> Optional[str]:
         """If any referenced claim is already allocated, the pod is pinned
         to that node (the allocation's node selector)."""
@@ -138,6 +162,28 @@ class DraCatalog:
                 out[key] = out.get(key, 0) + count
         return out
 
+    def node_topology(self, node_name: str) -> Optional[tuple]:
+        """(x, y, z) published by the node's ResourceSlice device
+        attributes (``topology-x/y/z`` ints — topology/slicing.TOPO_ATTRS),
+        the inventory-side mirror of the node labels. First device carrying
+        all three axes wins."""
+        from kubernetes_tpu.topology.slicing import TOPO_ATTRS
+        for s in self.slices.values():
+            spec = s.get("spec") or {}
+            if spec.get("nodeName", "") != node_name:
+                continue
+            for dev in spec.get("devices") or []:
+                attrs = dev.get("attributes") or {}
+                try:
+                    coord = tuple(int(attrs[a].get("int")
+                                      if isinstance(attrs[a], dict)
+                                      else attrs[a]) for a in TOPO_ATTRS)
+                except (KeyError, TypeError, ValueError):
+                    continue
+                if all(c >= 0 for c in coord):
+                    return coord
+        return None
+
     def class_names(self) -> set[str]:
         """Every device class referenced by any slice or claim (defines
         which synthetic resources exist this snapshot)."""
@@ -151,12 +197,24 @@ class DraCatalog:
         return names
 
 
-def allocation_patch(claim: dict, node_name: str, pod: Pod) -> dict:
+def allocation_patch(claim: dict, node_name: str, pod: Pod,
+                     coords: Optional[tuple] = None,
+                     shape: Optional[tuple] = None) -> dict:
     """The claim object with allocation + reservedFor recorded (what the
-    scheduler writes in PreBind — dynamicresources.go bindClaim)."""
+    scheduler writes in PreBind — dynamicresources.go bindClaim). For a
+    carved slice member the allocation also records WHERE in the torus the
+    pod landed (``topology.coordinates``) and the gang's requested shape —
+    the provenance the audit invariant and operators read back."""
     out = dict(claim)
     status = dict(claim.get("status") or {})
-    status["allocation"] = {"nodeName": node_name}
+    allocation: dict = {"nodeName": node_name}
+    if coords is not None:
+        from kubernetes_tpu.topology.slicing import shape_str
+        topo: dict = {"coordinates": list(coords)}
+        if shape is not None:
+            topo["sliceShape"] = shape_str(shape)
+        allocation["topology"] = topo
+    status["allocation"] = allocation
     status["reservedFor"] = [{"resource": "pods",
                               "name": pod.metadata.name,
                               "uid": pod.metadata.uid}]

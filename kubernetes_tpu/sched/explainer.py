@@ -120,6 +120,32 @@ class SchedulingExplainer:
                      "ns_labels": cache.namespace_labels()})
         return True
 
+    def submit_direct(self, pod, message: str, filters: dict,
+                      n_nodes: int, profile: str = "") -> bool:
+        """A READY-MADE verdict from the scheduling thread — the carve
+        path's "0/N origins can host a 2x2x4 slice" message, which no
+        per-node judge can reconstruct (the free nodes individually pass;
+        it's their composition into a contiguous box that failed).
+        Recorded + published on the checker thread so ``ktpu why`` sees
+        it; the EVENT stays with the caller (the scheduler already
+        emitted the same message)."""
+        now = time.time()  # ktpu-lint: disable=KTL003 -- same wall-clock re-explain throttle as submit() above (baselined); entries carry wall ts for ktpu why
+        if now - self._last_explained.get(pod.key, 0.0) < REEXPLAIN_INTERVAL_S:
+            return True
+        if self._q.qsize() >= self._max_backlog:
+            self.skipped += 1
+            return False
+        self._last_explained[pod.key] = now
+        self.samples += 1
+        self._ensure_thread()
+        self._q.put({"direct": True, "key": pod.key,
+                     "entry": {"message": message,
+                               "filters": dict(filters),
+                               "nodes": n_nodes, "feasibleNow": 0,
+                               "unjudged": 0, "mode": "carve", "ts": now,
+                               "profile": profile}})
+        return True
+
     # ---- results surface -------------------------------------------------
 
     def explanations(self) -> dict[str, dict]:
@@ -164,7 +190,10 @@ class SchedulingExplainer:
                 self._q.task_done()
                 return
             try:
-                self._explain(item)
+                if item.get("direct"):
+                    self._record_direct(item)
+                else:
+                    self._explain(item)
             except Exception:
                 # a broken explanation is counted and logged, never raised
                 # into silence — and never into the scheduling loop either
@@ -177,6 +206,36 @@ class SchedulingExplainer:
 
     def _profile(self, name: str):
         return self.cfg.profile_for(name)
+
+    def _record_direct(self, item: dict) -> None:
+        """Store + publish one submit_direct verdict (checker thread)."""
+        entry = item["entry"]
+        hist = entry.get("filters") or {}
+        if hist:
+            dominant = max(hist.items(), key=lambda kv: kv[1])[0]
+            UNSCHEDULABLE_REASONS.inc({"filter": dominant})
+        EXPLAIN_SAMPLES.inc({"mode": entry.get("mode", "carve")})
+        self.pods_explained += 1
+        with self._lock:
+            self._explanations.pop(item["key"], None)
+            self._explanations[item["key"]] = entry
+            while len(self._explanations) > self._max_entries:
+                self._explanations.popitem(last=False)
+            snap = dict(self._explanations)
+        if self.publisher is not None:
+            try:
+                self.publisher(snap)
+            except Exception:
+                LOOP_ERRORS.inc({"site": "explainer_publish"})
+                _LOG.warning("explanations publish failed", exc_info=True)
+
+    @staticmethod
+    def _slice_shape(pod):
+        """Label-based shape detection only: the capture carries no DRA
+        catalog, and a claim-routed slice pod still explains usefully
+        through the generic judges."""
+        from kubernetes_tpu.topology.slicing import shape_of_labels
+        return shape_of_labels(pod.metadata.labels)
 
     def _explain(self, item: dict) -> None:
         from kubernetes_tpu.models.explain import failed_scheduling_message
@@ -191,6 +250,11 @@ class SchedulingExplainer:
             try:
                 if item["level"] == "oracle":
                     raise RuntimeError("device degraded; oracle explain")
+                if any(self._slice_shape(v) is not None for v in views):
+                    # slice-shaped pods: only the oracle judge carries the
+                    # SliceCarve pseudo-filter (the carver's coverage
+                    # plane) — the tensor stack has no such mask
+                    raise RuntimeError("slice-shaped pod; oracle explain")
                 per_pod = self._judge_tensor(item, views, profile)
             except Exception:
                 _LOG.debug("tensor explain failed; falling back to the "
@@ -206,7 +270,9 @@ class SchedulingExplainer:
         per_pod = [(h, f, 0) for h, f in per_pod]
         if (mode == "oracle" and profile is not None
                 and profile.enabled_filters is not None):
-            enabled = set(profile.enabled_filters)
+            # SliceCarve is not a disableable plugin — a profile's filter
+            # allowlist must not demote its verdicts to "unjudged"
+            enabled = set(profile.enabled_filters) | {"SliceCarve"}
             per_pod = [
                 ({f: c for f, c in hist.items() if f in enabled}, feasible,
                  sum(c for f, c in hist.items() if f not in enabled))
@@ -289,6 +355,10 @@ class SchedulingExplainer:
         from kubernetes_tpu.sched.oracle import OracleScheduler
         orc = OracleScheduler(item["nodes"], item["bound"],
                               namespace_labels=item["ns_labels"])
+        # arm the per-node SliceCarve gate (opt-in on the oracle): nodes
+        # outside every carveable placement of a pod's requested shape
+        # report SLICE_UNAVAILABLE instead of a misleading per-node pass
+        orc.slice_explain = True
         out = []
         for pod in views:
             mask, reasons = orc.feasible(pod)

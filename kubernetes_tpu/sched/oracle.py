@@ -127,6 +127,8 @@ class FailReason:
     POD_ANTI_AFFINITY = "node(s) didn't satisfy existing pods anti-affinity rules"
     VOLUME = "node(s) had volume node affinity conflict"
     CLAIM = "pod has missing/unresolved ResourceClaims"
+    SLICE_UNAVAILABLE = ("node(s) were outside every carveable slice of "
+                         "the requested shape")
 
 
 class OracleScheduler:
@@ -178,6 +180,15 @@ class OracleScheduler:
         from kubernetes_tpu.sched.volumebinding import cluster_volume_state
         self._vol_rwo, self._vol_attach, self._vol_rwop = cluster_volume_state(
             [p for st in self.states for p in st.pods], volumes)
+        # topology slice carving (topology/): node coordinates + grid extent
+        # for the oracle carver; the per-node SliceCarve explain gate is
+        # OPT-IN (the explainer arms it) because preemption's per-node
+        # re-filter frees a slice one cell at a time — a default-on gate
+        # would veto its own repair
+        from kubernetes_tpu.topology.slicing import coords_of_labels, grid_dims
+        self._coords = [coords_of_labels(n.metadata.labels) for n in nodes]
+        self._dims = grid_dims([c for c in self._coords if c is not None])
+        self.slice_explain = False
 
     @staticmethod
     def _has_required_anti(p: Pod) -> bool:
@@ -234,6 +245,9 @@ class OracleScheduler:
             return FailReason.UNSCHEDULABLE
         if pod.spec.node_name and pod.spec.node_name != node.metadata.name:
             return FailReason.NODE_NAME
+        sl = ctx.get("slice_ok")
+        if sl is not None and not sl[ni]:
+            return FailReason.SLICE_UNAVAILABLE
         if self.dra is not None and pod.spec.resource_claims:
             if not self.dra.pod_claims_ready(pod):
                 return FailReason.CLAIM  # template-generated claim not yet made
@@ -333,8 +347,17 @@ class OracleScheduler:
         from kubernetes_tpu.sched.volumebinding import compile_pod_volumes
         vol = (compile_pod_volumes(pod, self.volumes, self._vol_rwop)
                if self.volumes is not None else None)
+        slice_ok = None
+        if self.slice_explain:
+            shape = self._slice_shape_of(pod)
+            if shape is not None:
+                from kubernetes_tpu.topology import carve as carve_mod
+                slice_ok = carve_mod.covered_nodes(
+                    self.oracle_carve([pod], shape, set()),
+                    len(self.states))
         return dict(spread=spread, aff=aff_counts, bootstrap=bootstrap,
-                    anti=anti_counts, sym=sym_veto, vol=vol)
+                    anti=anti_counts, sym=sym_veto, vol=vol,
+                    slice_ok=slice_ok)
 
     def _node_affinity_ok(self, pod: Pod, node: Node) -> bool:
         labels, fields = node.metadata.labels, node_fields(node.metadata.name)
@@ -656,6 +679,100 @@ class OracleScheduler:
                     raw[i] += np.float32(w) * np.float32(counts.get(dv, 0))
         return _minmax_normalize(raw, mask)
 
+    # ---- topology slice carving (topology/) ------------------------------
+
+    def _slice_shape_of(self, pod: Pod):
+        """The pod's requested slice shape: the slice-shape label, else a
+        slice-shaped ResourceClaim when a DRA catalog is attached."""
+        from kubernetes_tpu.topology.slicing import shape_of_labels
+        s = shape_of_labels(pod.metadata.labels)
+        if s is None and self.dra is not None:
+            s = self.dra.pod_slice_shape(pod)
+        return s
+
+    def _slice_member_req(self, pods: list[Pod]) -> dict:
+        """Conservative homogeneous gang view: elementwise MAX of the
+        members' scaled requests (the device carver mirrors this over
+        pb.requests rows)."""
+        req: dict = {}
+        for p in pods:
+            for r, q in self._eff_requests(p).items():
+                req[r] = max(req.get(r, 0), scale_request(r, q))
+        return req
+
+    def oracle_carve(self, members: list[Pod], shape: tuple,
+                     claimed: set):
+        """The numpy oracle carver: per-node host verdicts from the CURRENT
+        NodeStates fed to topology/carve.numpy_grids — the bit-parity twin
+        of the device's carve_step (asserted by the parity tests and the
+        sentinel's carve site). ``claimed`` holds node indices earlier
+        gangs of the same cycle already took."""
+        from kubernetes_tpu.topology import carve as carve_mod
+        if self._dims is None or not members:
+            return None
+        member_req = self._slice_member_req(members)
+        tenant = self._tenant_of(members[0].metadata.labels)
+        free, evictable, n_pods = [], [], []
+        for i, st in enumerate(self.states):
+            usable = (self._coords[i] is not None
+                      and tenant == self._tenant_of(st.labels)
+                      and not st.node.spec.unschedulable
+                      and i not in claimed)
+            fits_free = all(q <= st.allocatable.get(r, 0)
+                            - st.requested.get(r, 0)
+                            for r, q in member_req.items())
+            fits_alone = all(q <= st.allocatable.get(r, 0)
+                             for r, q in member_req.items())
+            free.append(usable and fits_free)
+            evictable.append(usable and fits_alone)
+            n_pods.append(len(st.pods))
+        return carve_mod.numpy_grids(self._coords, free, evictable,
+                                     n_pods, self._dims, shape)
+
+    def plan_slices(self, pods: list[Pod], validate: bool = True) -> dict:
+        """Carve every slice gang among ``pods`` in the device path's exact
+        order (sorted gang ids; earlier gangs' cells claimed against later
+        ones; members in sorted-key order <-> C-order box cells) ->
+        {gang id: {pod key: node name} or None}. With ``validate`` every
+        member must ALSO pass the full oracle filter stack on its cell
+        (schedule_all uses this, so an oracle-mode cycle never places an
+        infeasible member); the parity sentinel replays with
+        validate=False to judge the CARVE alone — the device's gang
+        program applies its own filters after the carve pins."""
+        from kubernetes_tpu.topology import carve as carve_mod
+        from kubernetes_tpu.topology.slicing import GANG_LABEL
+        groups: dict[str, list[Pod]] = {}
+        shapes: dict[str, tuple] = {}
+        for p in pods:
+            shape = self._slice_shape_of(p)
+            if shape is None:
+                continue
+            g = (p.metadata.labels or {}).get(GANG_LABEL) or f"pod:{p.key}"
+            groups.setdefault(g, []).append(p)
+            shapes[g] = shape
+        plans: dict[str, Optional[dict]] = {}
+        claimed: set = set()
+        for g in sorted(groups):
+            members = sorted(groups[g], key=lambda p: p.key)
+            shape = shapes[g]
+            asg = None
+            if len(members) == shape[0] * shape[1] * shape[2]:
+                res = self.oracle_carve(members, shape, claimed)
+                asg = carve_mod.select_assignment(res)
+            if asg is not None and validate:
+                for m, p in enumerate(members):
+                    if self._filter_one(p, self.states[asg[m]], asg[m],
+                                        self._pod_ctx(p)) is not None:
+                        asg = None
+                        break
+            if asg is None:
+                plans[g] = None
+                continue
+            claimed.update(asg)
+            plans[g] = {p.key: self.states[asg[m]].node.metadata.name
+                        for m, p in enumerate(members)}
+        return plans
+
     # ---- cycle -----------------------------------------------------------
 
     def select_host(self, scores: np.ndarray, salt: int = 0) -> Optional[int]:
@@ -687,7 +804,27 @@ class OracleScheduler:
         stays the pod's original batch position. Results in input order."""
         order = sorted(range(len(pods)), key=lambda i: (-pods[i].spec.priority, i))
         out: list[Optional[int]] = [None] * len(pods)
+        # slice gangs first: carve + assume up front, so no ordinary pod in
+        # this batch can nibble a planned cell's capacity between the carve
+        # and the member's turn in priority order (contiguous placements
+        # are the scarcest resource in the batch)
+        slice_nodes: dict[str, Optional[int]] = {}
+        if any(self._slice_shape_of(p) is not None for p in pods):
+            plans = self.plan_slices(pods)
+            picked: dict[str, str] = {}
+            for plan in plans.values():
+                picked.update(plan or {})
+            for p in pods:
+                if self._slice_shape_of(p) is None:
+                    continue
+                ni = self.node_index.get(picked.get(p.key, ""))
+                if ni is not None:
+                    self.assume(p, ni)
+                slice_nodes[p.key] = ni
         for i in order:
+            if pods[i].key in slice_nodes:
+                out[i] = slice_nodes[pods[i].key]
+                continue
             ni, _ = self.schedule_one(pods[i], salt=i)
             if ni is not None:
                 self.assume(pods[i], ni)

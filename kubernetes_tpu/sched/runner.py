@@ -359,11 +359,26 @@ class SchedulerRunner:
         dra = self.cache.dra_catalog
         if dra is not None and pod.spec.resource_claims:
             from kubernetes_tpu.sched.dra import allocation_patch
+            from kubernetes_tpu.topology.slicing import (coords_of_labels,
+                                                         shape_of_labels)
+            # carved-slice provenance: the allocation records the torus
+            # coordinate the member landed on (node labels first, the
+            # slice inventory's attributes as fallback) + requested shape
+            node = self.cache.get_node(node_name)
+            coords = (coords_of_labels(node.metadata.labels)
+                      if node is not None else None)
+            if coords is None:
+                coords = dra.node_topology(node_name)
+            shape = (shape_of_labels(pod.metadata.labels)
+                     or dra.pod_slice_shape(pod))
             for claim in dra.pod_claims(pod):
                 if ((claim.get("status") or {}).get("allocation")):
                     continue  # already allocated (shared or re-bind)
                 ns = (claim.get("metadata") or {}).get("namespace", "default")
-                patched = allocation_patch(claim, node_name, pod)
+                patched = allocation_patch(
+                    claim, node_name, pod,
+                    coords=coords if shape is not None else None,
+                    shape=shape)
                 try:
                     self._retry(lambda: self.client.resource(
                         "resourceclaims", ns).update_status(patched))
@@ -700,6 +715,9 @@ class SchedulerRunner:
                         if self.scheduler.explainer is not None else None),
             "flight": self._flight_status(),
             "aotCache": self._aot_cache_status(),
+            # topology/ slice-carving surface: grid extent, carveable
+            # origins per requested shape, fragmentation %, carve counters
+            "topology": self.scheduler.topology_status(),
         }
         self._publish_configmap(self.status_name,
                                 {"status": json.dumps(status, indent=1)})

@@ -1,0 +1,571 @@
+"""Topology-aware slice carving (topology/).
+
+Contracts pinned here:
+
+1. PARITY — the device carver (topology/carve.carve_step) and the numpy
+   oracle carver (sched/oracle.oracle_carve over numpy_grids) produce
+   BIT-EQUAL score planes and identical assignments/evictions across
+   randomized fragmented/wrap-around/rotated clusters, and the
+   ParitySentinel's carve site confirms it live (0 divergences).
+2. INTEGRATION — slice gangs ride the normal group path: carve pins via
+   ext_mask, the gang binds one CONTIGUOUS torus box, slice preemption
+   evicts the cheapest contiguous victim set, and a failed carve explains
+   itself ("0/N origins can host a 2x2x4 slice: ...") through the event,
+   the explanations surface (ktpu why), and FailReason.SLICE_UNAVAILABLE.
+3. PERIPHERY — the slice_contiguity audit invariant, the SliceDefrag
+   descheduler strategy, the DRA claims bridge (sliceShape requests,
+   ResourceSlice topology attributes, allocation coordinates), and the
+   ktpu status Topology line.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.config.types import SchedulerConfiguration
+from kubernetes_tpu.encode.snapshot import TENANT_KEY_ID, SnapshotEncoder
+from kubernetes_tpu.sched.cache import SchedulerCache
+from kubernetes_tpu.sched.dra import DraCatalog, allocation_patch
+from kubernetes_tpu.sched.oracle import FailReason, OracleScheduler
+from kubernetes_tpu.sched.queue import SchedulingQueue
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+from kubernetes_tpu.topology import (
+    GANG_LABEL,
+    SLICE_SHAPE_LABEL,
+    carve_device,
+    coords_of_labels,
+    covered_nodes,
+    coverage_stats,
+    grid_dims,
+    is_contiguous_slice,
+    numpy_grids,
+    parse_shape,
+    rotations,
+    select_assignment,
+    select_eviction,
+    shape_str,
+    topology_labels,
+)
+
+pytestmark = pytest.mark.topology
+
+
+def _grid_node(name, x, y, z, cpu="4"):
+    nb = make_node(name).capacity({"cpu": cpu, "memory": "8Gi",
+                                   "pods": "16"})
+    for k, v in topology_labels(x, y, z).items():
+        nb = nb.label(k, v)
+    return nb
+
+
+def _grid_nodes(X, Y, Z, cpu="4"):
+    return [_grid_node(f"n{x}{y}{z}", x, y, z, cpu=cpu).obj()
+            for x in range(X) for y in range(Y) for z in range(Z)]
+
+
+def _slice_gang(gang, shape, cpu="2", prio=0):
+    want = shape[0] * shape[1] * shape[2]
+    out = []
+    for m in range(want):
+        pb = (make_pod(f"{gang}-{m}").req({"cpu": cpu})
+              .labels({GANG_LABEL: gang,
+                       SLICE_SHAPE_LABEL: shape_str(shape)}))
+        if prio:
+            pb = pb.priority(prio)
+        out.append(pb.obj())
+    return out
+
+
+def _sched(nodes, bound=(), batch_size=8):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in bound:
+        cache.add_pod(p)
+    queue = SchedulingQueue(backoff_initial=0.05)
+    log = []
+    cfg = SchedulerConfiguration(batch_size=batch_size)
+    sched = Scheduler(cfg, cache, queue,
+                      lambda pod, node: log.append(
+                          (pod.metadata.name, node)) or True)
+    return sched, cache, queue, log
+
+
+def _drive(sched, queue, pods, rounds=4):
+    for p in pods:
+        queue.add(p)
+    for _ in range(rounds):
+        sched.run_once(wait=0.01)
+    sched.wait_for_bindings()
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def event(self, obj, type_, reason, message):
+        self.events.append((obj.key, type_, reason, message))
+
+    def flush(self):
+        pass
+
+
+# ---- 1. slicing primitives -----------------------------------------------
+
+def test_parse_shape_and_rotations():
+    assert parse_shape("2x2x4") == (2, 2, 4)
+    assert parse_shape(" 1X2x3 ") == (1, 2, 3)
+    for bad in (None, "", "2x2", "2x2x0", "ax2x2", "2x2x2x2", "-1x2x2"):
+        assert parse_shape(bad) is None
+    # rotations: sorted unique axis permutations, filtered to the grid
+    assert rotations((2, 2, 4), (4, 4, 4)) == ((2, 2, 4), (2, 4, 2),
+                                               (4, 2, 2))
+    # an extent can't exceed its axis — wrap-around would double-count
+    assert rotations((2, 1, 1), (2, 1, 1)) == ((2, 1, 1),)
+    assert rotations((2, 2, 2), (2, 2, 1)) == ()
+
+
+def test_is_contiguous_slice_wraparound():
+    dims = (4, 1, 1)
+    # wrap-around box {3, 0} is contiguous on the torus
+    assert is_contiguous_slice([(3, 0, 0), (0, 0, 0)], (2, 1, 1), dims)
+    assert not is_contiguous_slice([(0, 0, 0), (2, 0, 0)], (2, 1, 1), dims)
+    # duplicates are never a slice
+    assert not is_contiguous_slice([(0, 0, 0), (0, 0, 0)], (2, 1, 1), dims)
+
+
+def test_carve_wraparound_and_rotation():
+    # 4x1x1 torus, cells 1 and 2 occupied: ONLY the wrap-around origin
+    # x=3 hosts a 2x1x1 slice
+    coords = [(0, 0, 0), (1, 0, 0), (2, 0, 0), (3, 0, 0)]
+    free = [True, False, False, True]
+    res = numpy_grids(coords, free, [True] * 4, [0, 1, 1, 0],
+                      (4, 1, 1), (2, 1, 1))
+    assert select_assignment(res) == [3, 0]
+    # rotation: a 2x2x1 request fits a 1x2x2 grid only rotated
+    coords = [(0, y, z) for y in range(2) for z in range(2)]
+    res = numpy_grids(coords, [True] * 4, [True] * 4, [0] * 4,
+                      (1, 2, 2), (2, 2, 1))
+    assert res.rots == ((1, 2, 2),)
+    assert sorted(select_assignment(res)) == [0, 1, 2, 3]
+
+
+def test_select_eviction_prefers_cheapest_box():
+    # 4x1x1: boxes {0,1} cost 5, {1,2} cost 4, {2,3} cost 1, {3,0} cost 2
+    coords = [(x, 0, 0) for x in range(4)]
+    free = [False, False, False, True]
+    res = numpy_grids(coords, free, [True] * 4, [2, 3, 1, 0],
+                      (4, 1, 1), (2, 1, 1))
+    nodes, cells, cost = select_eviction(res)
+    assert (nodes, cost) == ([2, 3], 1.0)
+    assert cells == [(2, 0, 0), (3, 0, 0)]
+
+
+def test_coverage_and_covered_nodes():
+    coords = [(x, 0, 0) for x in range(4)]
+    free = [True, True, False, False]
+    res = numpy_grids(coords, free, [True] * 4, [0, 0, 1, 1],
+                      (4, 1, 1), (2, 1, 1))
+    # only origin 0 fits; its box covers nodes 0 and 1
+    assert covered_nodes(res, 4) == [True, True, False, False]
+    stats = coverage_stats(res)
+    assert stats["origins"] == 1
+    assert stats["fragmentationPct"] == 0.0  # every free cell is covered
+    assert coverage_stats(None) == {"origins": 0, "fragmentationPct": None}
+
+
+# ---- 2. device <-> oracle carve bit-parity --------------------------------
+
+@pytest.mark.parametrize("seed", range(5))
+def test_carve_parity_fuzz(seed):
+    """Randomized fragmented/wrap-around/rotated clusters: the device
+    carve's score planes, node grid, and host-side selections are
+    BIT-EQUAL to the numpy oracle carver's."""
+    rng = random.Random(4000 + seed)
+    X, Y, Z = rng.randint(2, 4), rng.randint(1, 3), rng.randint(1, 2)
+    nodes, k = [], 0
+    for x in range(X):
+        for y in range(Y):
+            for z in range(Z):
+                if rng.random() < 0.15:
+                    continue  # hole in the torus
+                nb = _grid_node(f"n{k}", x, y, z,
+                                cpu=rng.choice(["2", "4", "8"]))
+                if rng.random() < 0.1:
+                    nb = nb.unschedulable()
+                nodes.append(nb.obj())
+                k += 1
+    if rng.random() < 0.5:  # a node with no coordinates at all
+        nodes.append(make_node(f"n{k}").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": "16"}).obj())
+    if not any(coords_of_labels(n.metadata.labels) for n in nodes):
+        pytest.skip("degenerate sample: no labeled nodes")
+    names = [n.metadata.name for n in nodes]
+    bound = []
+    for i in range(rng.randint(0, 2 * len(nodes))):
+        p = make_pod(f"b{i}").req(
+            {"cpu": rng.choice(["500m", "1", "2", "3"])}).obj()
+        p.spec.node_name = rng.choice(names)
+        bound.append(p)
+    shape = rng.choice([(1, 1, 1), (2, 1, 1), (1, 2, 1), (2, 2, 1),
+                        (1, 2, 2), (3, 1, 1)])
+    gang = _slice_gang("g", shape, cpu=rng.choice(["500m", "1", "2"]))
+    gang.sort(key=lambda p: p.key)
+
+    enc = SnapshotEncoder()
+    ct, meta = enc.encode_cluster(nodes, bound, pending_pods=gang)
+    pb = enc.encode_pods(gang, meta)
+    member_req = np.asarray(pb.requests)[:len(gang)].max(axis=0)
+    tenant = int(np.asarray(pb.pod_labels)[0, TENANT_KEY_ID])
+    dims = grid_dims([c for c in (coords_of_labels(n.metadata.labels)
+                                  for n in nodes) if c is not None])
+    claimed = np.zeros(ct.node_valid.shape[0], bool)
+    dev = carve_device(ct, member_req, tenant, claimed, dims, shape)
+
+    orc = OracleScheduler(nodes, bound)
+    ora = orc.oracle_carve(gang, shape, set())
+
+    assert (dev is None) == (ora is None)
+    if dev is None:
+        return
+    np.testing.assert_array_equal(dev.node_grid, ora.node_grid)
+    np.testing.assert_array_equal(dev.free_grid, ora.free_grid)
+    np.testing.assert_array_equal(dev.fits, ora.fits)
+    np.testing.assert_array_equal(dev.cost, ora.cost)
+    assert dev.rots == ora.rots and dev.dims == ora.dims
+    assert select_assignment(dev) == select_assignment(ora)
+    assert select_eviction(dev) == select_eviction(ora)
+
+
+# ---- 3. scheduler integration --------------------------------------------
+
+def _fragmented_cluster():
+    """4x4x1 grid with 5 nodes nearly full: carving must route around
+    them."""
+    nodes = _grid_nodes(4, 4, 1)
+    fillers = []
+    for i, nn in enumerate(["n000", "n010", "n110", "n220", "n330"]):
+        p = make_pod(f"filler{i}").req({"cpu": "3"}).obj()
+        p.spec.node_name = nn
+        fillers.append(p)
+    return nodes, fillers
+
+
+def test_gang_binds_contiguous_slice():
+    nodes, fillers = _fragmented_cluster()
+    sched, cache, queue, log = _sched(nodes, bound=fillers)
+    sched.sentinel.every = 1  # judge every carve
+    gang = _slice_gang("g1", (2, 2, 1))
+    _drive(sched, queue, gang)
+    assert len(log) == 4, log
+    by_name = {n.metadata.name: n for n in nodes}
+    placed = [coords_of_labels(by_name[nn].metadata.labels)
+              for _p, nn in log]
+    assert is_contiguous_slice(placed, (2, 2, 1), (4, 4, 1)), placed
+    full = {f.spec.node_name for f in fillers}
+    assert all(nn not in full for _p, nn in log)
+    # the sentinel's carve site replayed the oracle carver: no divergence
+    sched.sentinel.drain()
+    assert sched.sentinel.samples["carve"] >= 1
+    assert sched.sentinel.divergences == 0
+    # status surface
+    topo = sched.topology_status()
+    assert topo["grid"] == "4x4x1" and topo["nodes"] == 16
+    assert topo["carves"]["carved"] == 1
+    assert "2x2x1" in topo["shapes"]
+    from kubernetes_tpu.cli.ktpu import _topology_line
+    line = _topology_line(topo)
+    assert line.startswith("Topology:      4x4x1 grid (16 nodes")
+    assert "carves 1 ok / 0 failed / 0 slice-preempts" in line
+
+
+def test_failed_carve_emits_origin_breakdown_and_explanation():
+    # every node hosts a filler: 4-3=1 CPU free, members need 2 — no
+    # origin can be carved free, but evicting any box's fillers frees one
+    nodes = _grid_nodes(2, 1, 1)
+    fillers = []
+    for i, n in enumerate(nodes):
+        p = make_pod(f"filler{i}").req({"cpu": "3"}).obj()
+        p.spec.node_name = n.metadata.name
+        fillers.append(p)
+    sched, cache, queue, log = _sched(nodes, bound=fillers)
+    rec = _Recorder()
+    sched.recorder = rec
+    gang = _slice_gang("g1", (2, 1, 1))  # prio 0: no preemption
+    _drive(sched, queue, gang, rounds=1)
+    assert log == []
+    want = ("0/2 origins can host a 2x1x1 slice: 0 free cell(s) on the "
+            "2x1x1 torus are too fragmented; freeing the cheapest origin "
+            "costs 2 eviction(s)")
+    msgs = [m for _k, t, r, m in rec.events
+            if (t, r) == ("Warning", "FailedScheduling")]
+    assert msgs and all(m == want for m in msgs), rec.events
+    # the verdict also lands in the explanations surface (ktpu why)
+    sched.explainer.drain()
+    exp = sched.explainer.explain_of(gang[0].key)
+    assert exp is not None and exp["mode"] == "carve"
+    assert exp["message"] == want
+    assert exp["filters"] == {"SliceCarve": 2}
+    with sched._carve_lock:
+        assert sched._carve_stats["failed"] >= 1
+
+
+def test_slice_preemption_evicts_contiguous_victim_set():
+    nodes = _grid_nodes(2, 2, 1)
+    victims = []
+    for i, n in enumerate(nodes):
+        p = make_pod(f"victim{i}").req({"cpu": "3"}).obj()
+        p.spec.node_name = n.metadata.name
+        victims.append(p)
+    sched, cache, queue, log = _sched(nodes, bound=victims)
+    gang = _slice_gang("hi", (2, 2, 1), prio=100)
+    _drive(sched, queue, gang, rounds=4)
+    # all four victims evicted (the whole box), the gang bound contiguous
+    assert all(not cache.is_bound(v.key) for v in victims)
+    assert len(log) == 4, log
+    by_name = {n.metadata.name: n for n in nodes}
+    placed = [coords_of_labels(by_name[nn].metadata.labels)
+              for _p, nn in log]
+    assert is_contiguous_slice(placed, (2, 2, 1), (2, 2, 1)), placed
+    with sched._carve_lock:
+        assert sched._carve_stats["slicePreempts"] == 1
+
+
+def test_slice_fail_message_short_circuit_order():
+    pod = make_pod("p").obj()
+    msg = Scheduler._slice_fail_message(
+        {"res": None, "dims": (4, 4, 1), "shape": (2, 2, 1),
+         "nodes": [], "members": [pod]})
+    assert msg == ("0/0 origins can host a 2x2x1 slice: gang has 1 "
+                   "member(s), the shape needs 4")
+    msg = Scheduler._slice_fail_message(
+        {"res": None, "dims": None, "shape": (2, 2, 1), "nodes": [],
+         "members": [pod] * 4})
+    assert "no node carries kubernetes-tpu.io/topology-{x,y,z}" in msg
+    msg = Scheduler._slice_fail_message(
+        {"res": None, "dims": (1, 1, 1), "shape": (2, 2, 1), "nodes": [],
+         "members": [pod] * 4})
+    assert "no rotation of the shape fits the 1x1x1 grid" in msg
+
+
+def test_slice_chunks_keep_gangs_whole():
+    nodes = _grid_nodes(2, 2, 1)
+    sched, _cache, _queue, _log = _sched(nodes, batch_size=4)
+    g1 = [(p, 0) for p in _slice_gang("a", (2, 1, 1))]
+    g2 = [(p, 0) for p in _slice_gang("b", (2, 1, 1))]
+    big = [(p, 0) for p in _slice_gang("c", (2, 2, 2))]  # 8 > batch_size
+    chunks = sched._slice_chunks(g1 + g2 + big)
+    assert [len(c) for c in chunks] == [4, 8]
+    assert {p.metadata.labels[GANG_LABEL] for p, _ in chunks[0]} == {"a",
+                                                                    "b"}
+    assert {p.metadata.labels[GANG_LABEL] for p, _ in chunks[1]} == {"c"}
+
+
+# ---- 4. oracle path + explain vocabulary ---------------------------------
+
+def test_oracle_schedule_all_places_slice_first():
+    nodes = _grid_nodes(2, 2, 1)
+    gang = sorted(_slice_gang("g", (2, 2, 1)), key=lambda p: p.key)
+    plain = make_pod("plain").req({"cpu": "1"}).obj()
+    orc = OracleScheduler(nodes, [])
+    out = orc.schedule_all(gang + [plain])
+    assert all(ni is not None for ni in out)
+    placed = [coords_of_labels(nodes[ni].metadata.labels)
+              for ni in out[:4]]
+    assert is_contiguous_slice(placed, (2, 2, 1), (2, 2, 1)), placed
+    # and matches the standalone plan
+    plans = OracleScheduler(nodes, []).plan_slices(gang)
+    assert plans["g"] == {p.key: nodes[ni].metadata.name
+                          for p, ni in zip(gang, out[:4])}
+
+
+def test_oracle_slice_unavailable_reason_through_explainer():
+    """Degraded/oracle explains: nodes outside every carveable placement
+    report FailReason.SLICE_UNAVAILABLE (the SliceCarve pseudo-filter),
+    not a misleading per-node pass."""
+    from kubernetes_tpu.models.explain import (FILTER_MESSAGES,
+                                               REASON_TO_FILTER)
+    assert REASON_TO_FILTER[FailReason.SLICE_UNAVAILABLE] == "SliceCarve"
+    assert FILTER_MESSAGES["SliceCarve"] == FailReason.SLICE_UNAVAILABLE
+    # 3x1x1 grid with the middle cell missing and x=0 full: no 2x1x1 box
+    nodes = [_grid_node("a", 0, 0, 0).obj(), _grid_node("b", 2, 0, 0).obj()]
+    filler = make_pod("filler").req({"cpu": "4"}).obj()
+    filler.spec.node_name = "a"
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    cache.add_pod(filler)
+    from kubernetes_tpu.sched.explainer import SchedulingExplainer
+    rec = _Recorder()
+    cfg = SchedulerConfiguration()
+    ex = SchedulingExplainer(cfg, lambda: rec)
+    pod = _slice_gang("g", (2, 1, 1))[0]
+    assert ex.submit(cache, cfg.profiles[0], "single", [pod])
+    ex.drain()
+    exp = ex.explain_of(pod.key)
+    assert exp is not None and exp["mode"] == "oracle"
+    assert exp["filters"] == {"SliceCarve": 2}
+    assert exp["message"] == (
+        "0/2 nodes are available: 2 node(s) were outside every carveable "
+        "slice of the requested shape.")
+    ex.close()
+
+
+# ---- 5. sentinel carve site ----------------------------------------------
+
+def test_verify_carve_assignments_refutes_tampering():
+    from kubernetes_tpu.audit.sentinel import verify_carve_assignments
+    nodes = _grid_nodes(2, 2, 1)
+    gang = sorted(_slice_gang("g", (2, 1, 1)), key=lambda p: p.key)
+    plans = OracleScheduler(nodes, []).plan_slices(gang, validate=False)
+    good = {"g": plans["g"]}
+    assert verify_carve_assignments(nodes, [], good, gang) == []
+    bad = {"g": {k: ("n110" if v != "n110" else "n000")
+                 for k, v in plans["g"].items()}}
+    problems = verify_carve_assignments(nodes, [], bad, gang)
+    assert problems and "diverged" in problems[0]
+
+
+# ---- 6. audit invariant ---------------------------------------------------
+
+def _store_with(nodes, pods):
+    from kubernetes_tpu.client.clientset import DirectClient
+    from kubernetes_tpu.store.store import ObjectStore
+    store = ObjectStore()
+    client = DirectClient(store)
+    for n in nodes:
+        client.nodes().create(n.to_dict())
+    for p in pods:
+        client.pods().create(p.to_dict())
+    return store
+
+
+def test_slice_contiguity_invariant(tmp_path):
+    from kubernetes_tpu.audit.auditor import InvariantAuditor
+    from kubernetes_tpu.client.clientset import DirectClient
+    nodes = _grid_nodes(4, 1, 1)
+
+    def bound_gang(xs):
+        pods = _slice_gang("g", (2, 1, 1), cpu="1")
+        for p, x in zip(pods, xs):
+            p.spec.node_name = f"n{x}00"
+        return pods
+
+    ok = _store_with(nodes, bound_gang([0, 1]))
+    auditor = InvariantAuditor(client=DirectClient(ok),
+                               audit_dir=str(tmp_path))
+    assert [v for v in auditor.run_once()
+            if v.invariant == "slice_contiguity"] == []
+
+    broken = _store_with(nodes, bound_gang([0, 2]))
+    auditor = InvariantAuditor(client=DirectClient(broken),
+                               audit_dir=str(tmp_path))
+    fresh = [v for v in auditor.run_once()
+             if v.invariant == "slice_contiguity"]
+    assert len(fresh) == 1 and "g" in fresh[0].detail
+
+
+# ---- 7. descheduler SliceDefrag ------------------------------------------
+
+def test_slice_defrag_names_cheapest_contiguous_box():
+    from kubernetes_tpu.descheduler import slice_defrag_candidates
+    nodes = _grid_nodes(2, 2, 1)
+    residents = []
+    for i, n in enumerate(nodes):
+        p = make_pod(f"r{i}").req({"cpu": "1"}).obj()
+        p.spec.node_name = n.metadata.name
+        residents.append(p)
+    pending = _slice_gang("g", (2, 2, 1), prio=10)
+    cands = slice_defrag_candidates(nodes, residents, pending=pending)
+    assert len(cands) == 1
+    c = cands[0]
+    assert c.strategy == "SliceDefrag" and c.name == "slicedefrag/g"
+    assert sorted(v.metadata.name for v in c.victims) == [
+        f"r{i}" for i in range(4)]
+    assert c.exclude_targets == {n.metadata.name for n in nodes}
+    # a GANG_LABEL resident poisons its box: no candidate on a 1x1 grid
+    # of protected pods
+    protected = [p for p in residents]
+    for p in protected:
+        p.metadata.labels[GANG_LABEL] = "other"
+    assert slice_defrag_candidates(nodes, protected, pending=pending) == []
+
+
+# ---- 8. DRA claims bridge -------------------------------------------------
+
+def _slice_claim(name, shape="2x2x1"):
+    return {"apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"devices": {"requests": [
+                {"name": "r0", "deviceClassName": "tpu", "count": 1,
+                 "sliceShape": shape}]}}}
+
+
+def _topo_slice(name, node, x, y, z):
+    return {"apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+            "metadata": {"name": name},
+            "spec": {"nodeName": node,
+                     "devices": [{"name": "d0", "deviceClassName": "tpu",
+                                  "count": 1,
+                                  "attributes": {
+                                      "topology-x": {"int": x},
+                                      "topology-y": {"int": y},
+                                      "topology-z": {"int": z}}}]}}
+
+
+def test_dra_slice_claims_bridge():
+    cat = DraCatalog.from_lists(
+        claims=[_slice_claim("c1")],
+        slices=[_topo_slice("s1", "n0", 1, 2, 0)])
+    assert DraCatalog.claim_slice_shape(_slice_claim("x")) == (2, 2, 1)
+    assert DraCatalog.claim_slice_shape(
+        {"spec": {"devices": {"requests": [{"count": 2}]}}}) is None
+    pod = make_pod("p").req({"cpu": "100m"}).obj()
+    pod.spec.resource_claims = [{"name": "dev", "resourceClaimName": "c1"}]
+    assert cat.pod_slice_shape(pod) == (2, 2, 1)
+    assert cat.node_topology("n0") == (1, 2, 0)
+    assert cat.node_topology("n-missing") is None
+    # allocation provenance: carved coordinates + shape recorded
+    out = allocation_patch(_slice_claim("c1"), "n0", pod,
+                           coords=(1, 2, 0), shape=(2, 2, 1))
+    alloc = out["status"]["allocation"]
+    assert alloc["nodeName"] == "n0"
+    assert alloc["topology"] == {"coordinates": [1, 2, 0],
+                                 "sliceShape": "2x2x1"}
+    # no coords -> no topology key (ordinary device claims unchanged)
+    out = allocation_patch(_slice_claim("c1"), "n0", pod)
+    assert "topology" not in out["status"]["allocation"]
+
+
+def test_dra_claim_routes_pod_into_carver():
+    """A slice-shaped ResourceClaim routes the pod into the carver with
+    no slice-shape label at all."""
+    nodes = _grid_nodes(2, 1, 1)
+    cat = DraCatalog.from_lists(claims=[_slice_claim("c1", "2x1x1")],
+                                slices=[])
+    orc = OracleScheduler(nodes, [], dra=cat)
+    pod = make_pod("claimed").req({"cpu": "1"}).obj()
+    pod.spec.resource_claims = [{"name": "dev", "resourceClaimName": "c1"}]
+    assert orc._slice_shape_of(pod) == (2, 1, 1)
+    sched, _cache, _queue, _log = _sched(nodes)
+    sched.cache._dra = cat
+    assert sched._slice_shape_of(pod) == (2, 1, 1)
+
+
+# ---- 9. status line -------------------------------------------------------
+
+def test_topology_line_renders_shapes_and_counters():
+    from kubernetes_tpu.cli.ktpu import _topology_line
+    line = _topology_line(
+        {"grid": "4x4x4", "nodes": 64, "freeCells": 12,
+         "shapes": {"2x2x4": {"origins": 3, "fragmentationPct": 25.0}},
+         "carves": {"carved": 7, "failed": 1, "slicePreempts": 2}})
+    assert line == ("Topology:      4x4x4 grid (64 nodes, 12 free cells) "
+                    "— 2x2x4: 3 carveable, 25.0% fragmented — carves "
+                    "7 ok / 1 failed / 2 slice-preempts\n")
+    # no coordinates published -> scheduler reports None -> no line
+    nodes = [make_node("plain").capacity({"cpu": "4", "pods": "8"}).obj()]
+    sched, _c, _q, _l = _sched(nodes)
+    assert sched.topology_status() is None
