@@ -39,7 +39,12 @@ one contiguous box, 0 violations, 0 XLA compiles in the steady window,
 BENCH_DISASTER=0 to skip the DisasterChurn case
 (apiserver SIGKILL + WAL-replay restart mid-churn; BENCH_DISASTER_NODES/
 PODS/OUTAGE_S size it, BENCH_DISASTER_BIND_SLO bounds time-to-first-
-bind-after-restart — every gate treats a missing number as failure).
+bind-after-restart), BENCH_WATCHSTORM=0 to skip the WatchStorm case
+(>=10k watchers vs 1 leader + 2 read replicas;
+BENCH_WATCHSTORM_WATCHERS/PODS size it, BENCH_WATCHSTORM_SPAN_GROWTH
+gates leader fan-out span growth, BENCH_WATCHSTORM_HEAL_SLO bounds a
+SIGKILLed replica's rebirth — every gate treats a missing number as
+failure).
 """
 
 from __future__ import annotations
@@ -302,6 +307,28 @@ def main():
             log=log)
         log("[bench] " + json.dumps(disaster))
 
+    watch_storm = None
+    if os.environ.get("BENCH_WATCHSTORM", "1") != "0" and not only_case:
+        # read-replica serving plane under a watch storm: >=10k watchers
+        # against 1 leader + 2 replicas — leader fan-out span growth
+        # <= 1.2x with >= 2/3 replica-served share, gap-free streams
+        # (signature-identical per cohort), 0 drops, staleness bound
+        # honored, replica SIGKILL mid-churn heals with zero loss.
+        # BENCH_WATCHSTORM_WATCHERS/PODS size it; before kubemark for the
+        # same daemon-thread-pollution reason as the others
+        from benchmarks.watchstorm import run_watch_storm
+        log("[bench] watch storm run ...")
+        watch_storm = run_watch_storm(
+            n_watchers=int(os.environ.get("BENCH_WATCHSTORM_WATCHERS",
+                                          "10500")),
+            churn_pods=int(os.environ.get("BENCH_WATCHSTORM_PODS", "600")),
+            span_growth_max=float(os.environ.get(
+                "BENCH_WATCHSTORM_SPAN_GROWTH", "1.2")),
+            heal_slo_s=float(os.environ.get("BENCH_WATCHSTORM_HEAL_SLO",
+                                            "90")),
+            log=log)
+        log("[bench] " + json.dumps(watch_storm))
+
     kubemark = None
     if os.environ.get("BENCH_KUBEMARK", "1") != "0" and not only_case:
         # LAST on purpose: the hollow fleet leaves hundreds of daemon
@@ -357,6 +384,7 @@ def main():
         "fleet_churn": fleet_churn,
         "slice_carve": slice_carve,
         "disaster_churn": disaster,
+        "watch_storm": watch_storm,
         "kubemark": kubemark,
         "pallas": pallas,
         # confirmed correctness-invariant violations across every audited
@@ -367,7 +395,8 @@ def main():
         "invariant_violations": _sum_violations(connected, chaos_churn,
                                                 connected_mesh, explain_ab,
                                                 scale_fleet, disaster,
-                                                fleet_churn, slice_carve),
+                                                fleet_churn, slice_carve,
+                                                watch_storm),
         # hard SLO verdicts from case-config gates (SchedulingChurn p99 +
         # throughput, ConnectedMesh legs). Missing numbers are failures —
         # the BENCH_r05 parsed-null lesson: a silently absent figure must
@@ -375,7 +404,7 @@ def main():
         "slo_failures": _collect_slo_failures(results, connected_mesh,
                                               explain_ab, scale_fleet,
                                               disaster, fleet_churn,
-                                              slice_carve),
+                                              slice_carve, watch_storm),
     }
     _require_invariant_field(out, "bench summary")
     print(json.dumps(out))
@@ -390,7 +419,8 @@ def main():
                     ("scale_fleet", scale_fleet),
                     ("fleet_churn", fleet_churn),
                     ("slice_carve", slice_carve),
-                    ("disaster_churn", disaster)) if c}
+                    ("disaster_churn", disaster),
+                    ("watch_storm", watch_storm)) if c}
         print(f"[bench] FATAL: {out['invariant_violations']} correctness-"
               f"invariant violation(s) confirmed by the auditor "
               f"({audited}); repro bundles are on disk — replay with the "
@@ -417,7 +447,8 @@ def main():
 
 def _collect_slo_failures(results, connected_mesh, explain_ab=None,
                           scale_fleet=None, disaster=None,
-                          fleet_churn=None, slice_carve=None) -> list:
+                          fleet_churn=None, slice_carve=None,
+                          watch_storm=None) -> list:
     """Flatten every case's hard-SLO failure strings, prefixed by case."""
     out = []
     for r in results or []:
@@ -441,6 +472,9 @@ def _collect_slo_failures(results, connected_mesh, explain_ab=None,
     if slice_carve is not None:
         for msg in slice_carve.get("slo_failures") or []:
             out.append(f"SliceCarve: {msg}")
+    if watch_storm is not None:
+        for msg in watch_storm.get("slo_failures") or []:
+            out.append(f"WatchStorm: {msg}")
     return out
 
 

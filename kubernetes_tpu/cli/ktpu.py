@@ -681,6 +681,21 @@ def _topology_line(topo: dict) -> str:
             + "\n")
 
 
+def _frontdoor_line(fd: dict) -> str:
+    """One-line read-replica serving-plane summary (the front-door
+    publisher's ConfigMap): who leads, how many replicas serve reads,
+    watcher spread, worst replay lag, and slow-consumer drops."""
+    nodes = fd.get("nodes") or []
+    reachable = sum(1 for n in nodes if n.get("reachable"))
+    return (f"Front door:    leader {fd.get('leader') or '<unknown>'} + "
+            f"{fd.get('replicas', '0')} read replicas "
+            f"({reachable}/{len(nodes)} reachable) — "
+            f"{fd.get('watchersTotal', '0')} watchers over "
+            f"{fd.get('shardsPerKind', '0')} shards/kind, "
+            f"max replay lag {fd.get('maxReplayLagMs', '0')}ms, "
+            f"drops {fd.get('dropsTotal', '0')}\n")
+
+
 def cmd_status(client: HTTPClient, args, out) -> int:
     """ktpu status: the connected scheduler's published deployment shape
     (the ``kubernetes-tpu-scheduler-status`` ConfigMap) — most importantly
@@ -704,11 +719,33 @@ def cmd_status(client: HTTPClient, args, out) -> int:
                 raise
             return None
 
+    def _frontdoor_cm():
+        # the front-door ConfigMap is flat str->str (scalar summary keys
+        # + a JSON "nodes" list), published to kube-system by default
+        from kubernetes_tpu.store.frontdoor import (FRONTDOOR_CONFIGMAP,
+                                                    FRONTDOOR_NAMESPACE)
+        for ns_ in dict.fromkeys((FRONTDOOR_NAMESPACE, args.namespace)):
+            try:
+                cm_ = client.resource("configmaps",
+                                      ns_).get(FRONTDOOR_CONFIGMAP)
+            except ApiError as e:
+                if e.code != 404:
+                    raise
+                continue
+            data = dict(cm_.get("data") or {})
+            try:
+                data["nodes"] = json.loads(data.get("nodes", "[]") or "[]")
+            except json.JSONDecodeError:
+                data["nodes"] = []
+            return data
+        return None
+
     from kubernetes_tpu.sched.fleet import FLEET_SCHED_CONFIGMAP
     fleet = _aux_cm(FLEET_CONFIGMAP, "fleet")
     fleet_sched = _aux_cm(FLEET_SCHED_CONFIGMAP, "fleetSched")
     durability = _aux_cm(APISERVER_CONFIGMAP, "durability")
     disruption = _aux_cm(NODELIFECYCLE_CONFIGMAP, "disruption")
+    frontdoor = _frontdoor_cm()
     try:
         cm = client.resource("configmaps", args.namespace).get(
             STATUS_CONFIGMAP)
@@ -718,7 +755,8 @@ def cmd_status(client: HTTPClient, args, out) -> int:
         aux = {k: v for k, v in (("fleet", fleet),
                                  ("fleetSched", fleet_sched),
                                  ("durability", durability),
-                                 ("disruption", disruption))
+                                 ("disruption", disruption),
+                                 ("frontdoor", frontdoor))
                if v is not None}
         if aux:
             # a fleet/durable-apiserver/lifecycle-controller without a
@@ -726,6 +764,8 @@ def cmd_status(client: HTTPClient, args, out) -> int:
             if args.output == "json":
                 out.write(json.dumps(aux) + "\n")
             else:
+                if frontdoor is not None:
+                    out.write(_frontdoor_line(frontdoor))
                 if durability is not None:
                     out.write(_durability_line(durability))
                 if disruption is not None:
@@ -750,6 +790,8 @@ def cmd_status(client: HTTPClient, args, out) -> int:
             st["durability"] = durability
         if disruption is not None:
             st["disruption"] = disruption
+        if frontdoor is not None:
+            st["frontdoor"] = frontdoor
         out.write(json.dumps(st) + "\n")
         return 0
     st = json.loads(data.get("status", "{}") or "{}")
@@ -820,6 +862,8 @@ def cmd_status(client: HTTPClient, args, out) -> int:
     topo = st.get("topology")
     if topo is not None:
         out.write(_topology_line(topo))
+    if frontdoor is not None:
+        out.write(_frontdoor_line(frontdoor))
     if durability is not None:
         out.write(_durability_line(durability))
     if disruption is not None:

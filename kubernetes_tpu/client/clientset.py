@@ -506,15 +506,33 @@ class HTTPClient(_Handles):
     """urllib transport against an APIServer URL. ``token``: bearer token
     presented on every request (the service-identity credential —
     rest.Config.BearerToken); ``impersonate``: acts-as user name sent via
-    Impersonate-User (requires the real user to hold ``impersonate``)."""
+    Impersonate-User (requires the real user to hold ``impersonate``).
 
-    def __init__(self, base_url: str, timeout: float = 10.0,
+    Endpoint spreading (the read-replica serving plane): ``base_url`` may
+    be a list of URLs or one comma-separated string. Reads and watches
+    spread across all endpoints (sticky per thread / per watch, rotating
+    with full-jitter failover on transport errors); writes go to the
+    tracked leader, re-routing on a 421 NotLeader's X-KTPU-Leader hint.
+    With a single endpoint nothing changes."""
+
+    def __init__(self, base_url, timeout: float = 10.0,
                  token: Optional[str] = None,
                  impersonate: Optional[str] = None,
                  wire: str = "msgpack", user_agent: str = "",
                  retry_attempts: int = 3, retry_base_s: float = 0.05,
                  retry_cap_s: float = 2.0):
-        self.base = base_url.rstrip("/")
+        if isinstance(base_url, (list, tuple)):
+            eps = [str(u).strip().rstrip("/") for u in base_url]
+        else:
+            eps = [u.strip().rstrip("/") for u in str(base_url).split(",")]
+        self.endpoints: list[str] = [e for e in eps if e]
+        if not self.endpoints:
+            raise ValueError("HTTPClient needs at least one endpoint")
+        self.base = self.endpoints[0]
+        # where writes go: starts at the first endpoint, follows 421
+        # X-KTPU-Leader hints thereafter (benign cross-thread race: every
+        # thread converges on whatever hint landed last)
+        self._leader = self.base
         self.timeout = timeout
         self.token = token
         self.impersonate = impersonate
@@ -550,25 +568,66 @@ class HTTPClient(_Handles):
         if not self.user_agent:
             self.user_agent = name
 
-    def _conn(self):
-        conn = getattr(self._local, "conn", None)
+    def _conns(self) -> dict:
+        conns = getattr(self._local, "conns", None)
+        if conns is None:
+            conns = self._local.conns = {}
+        return conns
+
+    def _conn(self, base: Optional[str] = None):
+        base = base or self.base
+        conns = self._conns()
+        conn = conns.get(base)
         if conn is None:
             from urllib.parse import urlsplit
-            parts = urlsplit(self.base)
+            parts = urlsplit(base)
             cls = (_NoDelayHTTPSConnection if parts.scheme == "https"
                    else _NoDelayHTTPConnection)
             conn = cls(parts.hostname, parts.port, timeout=self.timeout)
-            self._local.conn = conn
+            conns[base] = conn
         return conn
 
-    def _drop_conn(self):
-        conn = getattr(self._local, "conn", None)
+    def _drop_conn(self, base: Optional[str] = None):
+        conn = self._conns().pop(base or self.base, None)
         if conn is not None:
             try:
                 conn.close()
             except Exception:  # ktpu-lint: disable=KTL002 -- closing an already-broken pooled connection; the caller opens a fresh one
                 pass
-            self._local.conn = None
+
+    # ---- endpoint spreading ----------------------------------------------
+
+    def _read_endpoint(self) -> str:
+        """Sticky per-thread read endpoint, spread uniformly at first use —
+        list+watch from one thread land on the same replica, and the fleet
+        of client threads spreads across the whole serving plane."""
+        if len(self.endpoints) == 1:
+            return self.endpoints[0]
+        base = getattr(self._local, "read_base", None)
+        if base is None or base not in self.endpoints:
+            import random
+            base = random.choice(self.endpoints)
+            self._local.read_base = base
+        return base
+
+    def _rotate_read_endpoint(self, dead: str) -> str:
+        """Failover: move this thread's stickiness off a dead endpoint."""
+        if len(self.endpoints) > 1:
+            others = [e for e in self.endpoints if e != dead]
+            import random
+            self._local.read_base = random.choice(others)
+            return self._local.read_base
+        return dead
+
+    def _rotate_leader(self, dead: str) -> str:
+        """The tracked leader is unreachable: try the next endpoint — any
+        follower answers the retried write with 421 + the real leader."""
+        if dead in self.endpoints and len(self.endpoints) > 1:
+            i = self.endpoints.index(dead)
+            self._leader = self.endpoints[(i + 1) % len(self.endpoints)]
+        elif dead not in self.endpoints:
+            self._leader = self.endpoints[0]
+        return self._leader
 
     def _auth_headers(self) -> dict:
         h = {}
@@ -646,19 +705,25 @@ class HTTPClient(_Handles):
         retriable = not (method == "POST" and isinstance(body, dict)
                          and (body.get("metadata") or {}).get("generateName")
                          and not (body.get("metadata") or {}).get("name"))
+        # endpoint routing: reads spread (sticky per thread), writes chase
+        # the leader. A 421 NotLeader re-routes without burning the
+        # transport-retry budget (the write never started server-side).
+        target = (self._read_endpoint() if method == "GET"
+                  else self._leader)
+        leader_hops = 0
         if not retriable:
-            self._drop_conn()
+            self._drop_conn(target)
         stale_retry_used = False
         attempt = 0
         while True:
-            reused = getattr(self._local, "conn", None) is not None
-            conn = self._conn()
+            reused = target in self._conns()
+            conn = self._conn(target)
             try:
                 conn.request(method, path, body=data, headers=all_headers)
                 resp = conn.getresponse()
                 payload = resp.read()
                 if resp.will_close:
-                    self._drop_conn()
+                    self._drop_conn(target)
                 is_mp = _MSGPACK_CT in (resp.getheader("Content-Type") or "")
                 if resp.status >= 400:
                     try:
@@ -667,6 +732,20 @@ class HTTPClient(_Handles):
                     except Exception:  # ktpu-lint: disable=KTL002 -- error-body parse fallback; msg defaults to the HTTP status code below
                         status = {}
                     msg = status.get("message", f"HTTP {resp.status}")
+                    if resp.status == 421 and leader_hops < 3:
+                        # follower answered a write: chase the leader hint,
+                        # or rotate (+ a short jittered pause) when there is
+                        # none yet (election in flight)
+                        leader_hops += 1
+                        hint = (resp.getheader("X-KTPU-Leader")
+                                or "").rstrip("/")
+                        if hint and hint != target:
+                            self._leader = target = hint
+                        else:
+                            import random
+                            time.sleep(random.uniform(0.01, 0.1))
+                            target = self._rotate_leader(target)
+                        continue
                     if (resp.status == 400 and mp is not None
                             and "invalid JSON body" in msg):
                         # Server can't speak msgpack (no module there): it
@@ -696,7 +775,7 @@ class HTTPClient(_Handles):
                 raise
             except (http.client.HTTPException, ConnectionError, OSError,
                     TimeoutError):
-                self._drop_conn()
+                self._drop_conn(target)
                 # A failure on a REUSED socket is almost always a stale
                 # keep-alive the server closed between requests: retry on a
                 # fresh connection WITHOUT burning the transport-retry
@@ -714,6 +793,13 @@ class HTTPClient(_Handles):
                                 self.retry_base_s * (2 ** attempt))
                     time.sleep(random.uniform(0.0, delay)
                                or self.retry_base_s / 2)
+                    # a dead endpoint shouldn't eat the whole retry budget:
+                    # reads hop to a sibling replica, writes rotate toward
+                    # (eventually) the live leader
+                    if method == "GET":
+                        target = self._rotate_read_endpoint(target)
+                    else:
+                        target = self._rotate_leader(target)
                     attempt += 1
                     continue
                 raise
@@ -883,27 +969,44 @@ class _HTTPWatch:
     HEARTBEAT_GRACE = 5.0  # server heartbeats ~1s; silence beyond this = dead
 
     def __init__(self, client: HTTPClient, plural: str, ns, since_rv: int):
-        self._url = client._path(plural, ns,
-                                 query=f"watch=true&resourceVersion={since_rv}")
+        path = client._path(
+            plural, ns,
+            query=f"watch=true&resourceVersion={since_rv}")[len(client.base):]
         self.closed = False
         headers = client._auth_headers()
         if client._mp is not None:
             headers["Accept"] = _MSGPACK_CT
+        # Watches spread like reads: try the thread's sticky endpoint first,
+        # fail over through the remaining replicas on transport errors. A
+        # 410 anywhere is authoritative (rv compaction is replicated state,
+        # identical on every node) so it is NOT retried elsewhere.
+        bases = [client._read_endpoint()]
+        bases += [b for b in client.endpoints if b not in bases]
         # read timeout doubles as the liveness window: the server heartbeats
         # every ~1s, so a blocking read that times out means a dead peer.
-        try:
-            self._resp = urllib.request.urlopen(
-                urllib.request.Request(self._url, headers=headers),
-                timeout=self.HEARTBEAT_GRACE)
-        except urllib.error.HTTPError as e:
-            if e.code == 410:
-                # DirectClient parity: a compacted-away resourceVersion
-                # (typical right after an apiserver restart: the restore
-                # floor advanced past every pre-restart rv) raises TooOld
-                # so the informer relists IMMEDIATELY instead of riding
-                # the generic-error backoff through a healing window
-                raise TooOld(f"watch rv compacted: {e.reason}") from None
-            raise
+        last_err: Exception = OSError("no endpoints")
+        for base in bases:
+            self._url = base + path
+            try:
+                self._resp = urllib.request.urlopen(
+                    urllib.request.Request(self._url, headers=headers),
+                    timeout=self.HEARTBEAT_GRACE)
+                break
+            except urllib.error.HTTPError as e:
+                if e.code == 410:
+                    # DirectClient parity: a compacted-away resourceVersion
+                    # (typical right after an apiserver restart: the restore
+                    # floor advanced past every pre-restart rv) raises TooOld
+                    # so the informer relists IMMEDIATELY instead of riding
+                    # the generic-error backoff through a healing window
+                    raise TooOld(f"watch rv compacted: {e.reason}") from None
+                raise
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    TimeoutError) as e:
+                client._rotate_read_endpoint(base)
+                last_err = e
+        else:
+            raise last_err
         got_ct = self._resp.headers.get("Content-Type") or ""
         self._unpacker = (_client_msgpack.Unpacker()
                           if _MSGPACK_CT in got_ct else None)

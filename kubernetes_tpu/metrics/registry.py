@@ -514,3 +514,23 @@ DESCHEDULER_PLAN_BATCH = REGISTRY.gauge(
 DESCHEDULER_LOOP_DURATION = REGISTRY.histogram(
     "descheduler_loop_duration_seconds",
     "One descheduler cycle by phase (plan|evict)")
+
+# The read-replica serving plane ("front door"): sharded watch fan-out with
+# bounded per-watcher queues on every apiserver, follower replicas serving
+# list/watch with a bounded-staleness contract.
+WATCH_DROPS = REGISTRY.counter(
+    "apiserver_watch_drops_total",
+    "Watchers force-disconnected because their bounded event queue "
+    "overflowed (slow consumer), by kind — each drop closes the stream "
+    "with an ERROR event, forcing the client to relist")
+WATCH_CLIENTS = REGISTRY.gauge(
+    "apiserver_watch_clients",
+    "Currently-registered watchers by kind, summed over fan-out shards")
+REPLICA_LAG = REGISTRY.gauge(
+    "apiserver_replica_replay_lag_seconds",
+    "Read replica commit-replay lag: seconds since this follower was last "
+    "caught up to the leader's commit index (0 while current; grows when "
+    "the leader is unreachable or replay falls behind)")
+READ_REQUESTS = REGISTRY.counter(
+    "apiserver_read_requests_total",
+    "Read requests (GET/list/watch) served, by role (leader|replica)")

@@ -38,8 +38,9 @@ MSGPACK_CT = "application/x-msgpack"
 _LOG = logging.getLogger(__name__)
 
 from kubernetes_tpu.api.selectors import compile_list_selector
-from kubernetes_tpu.metrics.registry import REGISTRY
+from kubernetes_tpu.metrics.registry import READ_REQUESTS, REGISTRY, REPLICA_LAG
 from kubernetes_tpu.store.flowcontrol import RejectedError
+from kubernetes_tpu.store.replication import NotLeader, QuorumLost
 from kubernetes_tpu.store.store import (
     AlreadyExists,
     Conflict,
@@ -225,6 +226,15 @@ class APIServer:
         # The lock serializes validate+write: collision checks are
         # check-then-act and handler threads race (ThreadingHTTPServer).
         self.custom_resources: dict[str, tuple[str, bool]] = {}
+        # Read-replica serving plane ("front door"): when the store is a
+        # ReplicatedStore, this server may be fronting a FOLLOWER — reads
+        # and watches serve locally (with an X-KTPU-Replay-Lag header),
+        # writes surface NotLeader as 421 + X-KTPU-Leader so clients
+        # re-route. api_urls maps raft node ids -> apiserver base URLs
+        # (NotLeader.leader_hint carries the raft PEER url, which no API
+        # client can use); max_replay_lag_s bounds staleness for /readyz.
+        self.api_urls: dict[str, str] = {}
+        self.max_replay_lag_s = 2.0
         self._crd_lock = threading.RLock()
         self._rebuild_custom()  # durable restore may already hold CRDs
         self._httpd = _HTTPServer((host, port), self._make_handler())
@@ -334,6 +344,10 @@ class APIServer:
                     "status": {"phase": "Active"}})
             except AlreadyExists:
                 pass
+            except NotLeader:
+                # a front-door REPLICA must not seed: bootstrap writes are
+                # the leader's, and replication delivers them here
+                break
         # durable restore may already hold CRDs the empty pre-restore
         # rebuild missed
         self._rebuild_custom()
@@ -387,6 +401,44 @@ class APIServer:
     @property
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
+
+    # ---- front door (read-replica serving plane) -------------------------
+
+    @property
+    def raft(self):
+        """The RaftNode when the store is a ReplicatedStore, else None."""
+        return getattr(self.store, "node", None)
+
+    @property
+    def role(self) -> str:
+        node = self.raft
+        return "replica" if node is not None and not node.is_leader() \
+            else "leader"
+
+    def replay_lag_s(self) -> Optional[float]:
+        """Replica staleness in seconds; None when this server is the
+        leader (or unreplicated) — the X-KTPU-Replay-Lag header and the
+        lag-gated /readyz both key on this."""
+        node = self.raft
+        if node is None or node.is_leader():
+            return None
+        lag = node.replica_lag()
+        REPLICA_LAG.set(lag)
+        return lag
+
+    def frontdoor_status(self) -> dict:
+        """One replica's slice of the front-door picture: role, replay
+        lag, and the store's watch fan-out stats (served at GET
+        /frontdoor/status; the leader's publisher aggregates these into
+        the kubernetes-tpu-frontdoor-status ConfigMap)."""
+        node = self.raft
+        lag = self.replay_lag_s()
+        return {"role": self.role,
+                "node": getattr(node, "node_id", None),
+                "replayLagMs": (None if lag is None
+                                else round(lag * 1000.0, 3)),
+                "ready": self.ready,
+                "watch": self.store.watch_stats()}
 
     # ---- durability status (data_dir mode) -------------------------------
 
@@ -541,11 +593,44 @@ class APIServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _quorum_routed(self, fn):
+                """Replication-aware error mapping, wrapped around every
+                handler when the store is replicated: a FOLLOWER answers
+                mutations with 421 + an X-KTPU-Leader hint (the spread
+                client re-routes and retries; reads never get here), and
+                a leader that cannot establish quorum answers 503."""
+                def run():
+                    try:
+                        return fn()
+                    except NotLeader:
+                        node = server.raft
+                        hint = server.api_urls.get(
+                            getattr(node, "leader_id", None) or "")
+                        self._drain_body()
+                        self._last_code = 421
+                        body = json.dumps({
+                            "kind": "Status", "status": "Failure",
+                            "message": "not the leader"
+                                       + (f"; try {hint}" if hint else ""),
+                            "reason": "NotLeader", "code": 421}).encode()
+                        self.send_response(421)
+                        self.send_header("Content-Type", "application/json")
+                        if hint:
+                            self.send_header("X-KTPU-Leader", hint)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    except QuorumLost as e:
+                        return self._error(503, str(e), "ServiceUnavailable")
+                return run
+
             def _shaped(self, verb: str, fn):
                 # per-REQUEST state: one handler instance serves every
                 # request on a keep-alive connection
                 self._body_consumed = False
                 self._last_code = 200
+                if server.raft is not None:
+                    fn = self._quorum_routed(fn)
                 if not server._ready.is_set():
                     # only liveness + metrics answer during replay;
                     # /readyz reports the replay itself as 503
@@ -702,6 +787,13 @@ class APIServer:
                     body, ctype = json.dumps(obj).encode(), "application/json"
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
+                lag = server.replay_lag_s()
+                if lag is not None:
+                    # staleness is part of the response contract on a
+                    # replica: every consumer can see how far behind the
+                    # data it just read might be
+                    self.send_header("X-KTPU-Replay-Lag",
+                                     f"{lag * 1000.0:.3f}")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -776,12 +868,26 @@ class APIServer:
             def _do_GET(self):
                 path = urlparse(self.path).path
                 if path in ("/healthz", "/readyz", "/livez"):
+                    if path == "/readyz":
+                        # a replica whose replay lag exceeds the staleness
+                        # budget is NOT ready: load balancers and the
+                        # spread client must stop routing reads to it
+                        # until it catches back up (healthz/livez stay
+                        # 200 — the process is alive, just stale)
+                        lag = server.replay_lag_s()
+                        if lag is not None and lag > server.max_replay_lag_s:
+                            return self._error(
+                                503, f"replica replay lag {lag:.2f}s "
+                                     f"exceeds {server.max_replay_lag_s}s",
+                                "ServiceUnavailable")
                     body = b"ok"
                     self.send_response(200)
                     self.send_header("Content-Length", "2")
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                if path == "/frontdoor/status":
+                    return self._send_json(200, server.frontdoor_status())
                 if path == "/debug/traces":
                     # OTLP/JSON export of the process tracer's spans;
                     # ?format=chrome serves Chrome trace-event JSON instead
@@ -815,6 +921,7 @@ class APIServer:
                 if r is None:
                     return self._error(404, f"unknown path {path}")
                 plural, kind, ns, name, sub = r
+                READ_REQUESTS.inc({"role": server.role})
                 qs = parse_qs(urlparse(self.path).query)
                 if sub == "scale" and name:
                     if kind not in SCALABLE_KINDS:
@@ -978,6 +1085,10 @@ class APIServer:
                              "object": from_hub(e.object)}).encode() + b"\n"
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
+                lag = server.replay_lag_s()
+                if lag is not None:
+                    self.send_header("X-KTPU-Replay-Lag",
+                                     f"{lag * 1000.0:.3f}")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 try:

@@ -85,6 +85,13 @@ class RaftNode:
         self._rv_cache = self._log_base
         self._match: dict[str, int] = {p: 0 for p in peers}
         self.commit_rv = 0
+        # Last instant this node confirmed it had applied everything up to
+        # the leader's commit index (append handler, raft-lock domain).
+        # replica_lag() = now - this; the replica /readyz gates on it.
+        # None until the FIRST confirmation: a node born empty is
+        # infinitely stale, not fresh — it must not serve reads before
+        # replication has ever spoken to it.
+        self._caught_up_mono: Optional[float] = None
         self._stop = threading.Event()
         store.subscribe_journal(self._on_journal)
 
@@ -148,6 +155,20 @@ class RaftNode:
             if self.role != "leader":
                 raise NotLeader(self.leader_id and
                                 self.peers.get(self.leader_id))
+
+    def replica_lag(self) -> float:
+        """Replay staleness bound: seconds since this node last confirmed
+        it was applied up to the leader's commit index. 0.0 on the leader
+        (it IS the commit frontier). Grows without bound while the leader
+        is unreachable or replay falls behind — a read replica's /readyz
+        gates on this staying under its staleness budget, which is what
+        makes \"bounded staleness\" a contract instead of a hope."""
+        with self._lock:
+            if self.role == "leader":
+                return 0.0
+            if self._caught_up_mono is None:
+                return float("inf")
+            return max(0.0, time.monotonic() - self._caught_up_mono)
 
     def wait_commit(self, rv: int, timeout: float = 5.0) -> None:
         """Block until ``rv`` is quorum-replicated (call after a mutation
@@ -290,6 +311,7 @@ class RaftNode:
                 self.commit_rv = max(self.commit_rv,
                                      min(int(req["commit_rv"]),
                                          self._log_base))
+                self._caught_up_mono = time.monotonic()
             return {"ok": True, "term": req["term"],
                     "match_rv": int(req["snapshot"]["rv"])}
         prev = int(req.get("prev_rv", 0))
@@ -306,6 +328,9 @@ class RaftNode:
         with self._lock:
             self._rv_cache = max(self._rv_cache, new_rv)
             self.commit_rv = max(self.commit_rv, int(req["commit_rv"]))
+            if new_rv >= self.commit_rv:
+                # applied through the leader's commit frontier: current
+                self._caught_up_mono = time.monotonic()
         return {"ok": True, "term": req["term"],
                 "match_rv": self.store.snapshot_rv()}
 
@@ -438,6 +463,18 @@ class ReplicatedStore:
     def bind_many(self, *a, **kw):
         return self._gated(self.inner.bind_many, *a, **kw)
 
-    # everything else (reads, watches, metadata) passes through
+    def update_status_many(self, *a, **kw):
+        return self._gated(self.inner.update_status_many, *a, **kw)
+
+    def heartbeat_many(self, *a, **kw):
+        return self._gated(self.inner.heartbeat_many, *a, **kw)
+
+    def renew_leases(self, *a, **kw):
+        return self._gated(self.inner.renew_leases, *a, **kw)
+
+    # everything else (reads, watches, metadata) passes through.
+    # EVERY mutating verb must be gated above: one slipping through here
+    # would mutate a FOLLOWER's store locally — divergence the next
+    # snapshot resync silently papers over.
     def __getattr__(self, name):
         return getattr(self.inner, name)

@@ -36,8 +36,11 @@ import logging
 import os
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
+
+from kubernetes_tpu.metrics.registry import WATCH_CLIENTS, WATCH_DROPS
 
 _LOG = logging.getLogger("kubernetes_tpu.store")
 
@@ -47,6 +50,21 @@ ADDED, MODIFIED, DELETED, ERROR = "ADDED", "MODIFIED", "DELETED", "ERROR"
 # analog). Sized so a reconnecting watcher survives a full binding storm
 # (create+bind = 2 events/pod) at the 10k-pod benchmark scale.
 REPLAY_WINDOW = 32768
+
+# Watcher fan-out shards per kind: registration, removal and slow-consumer
+# handling contend on a shard's own lock, never the store lock — watcher
+# churn at 10k-client scale stays off the write path. Emission nests shard
+# locks inside the store lock (store -> shard, never the reverse).
+WATCH_SHARDS = 8
+
+# Bounded per-watcher queue (reference analog: cacher.go's per-watcher
+# channel budget). A consumer that falls this many events behind is
+# disconnected with an ERROR event and a counted drop — it relists, exactly
+# as it would after etcd compaction — instead of growing an unbounded queue
+# and stalling shard siblings. A watch() whose replay backlog already
+# exceeds this budget gets TooOld up front (a relist hands it the same
+# state cheaper).
+WATCH_QUEUE_MAX = 4096
 
 
 class Conflict(Exception):
@@ -115,6 +133,84 @@ def obj_key(obj: dict) -> tuple[str, str]:
     return (md.get("namespace") or "", md["name"])
 
 
+class _WatchShard:
+    """One independently-locked slice of a kind's watcher registry.
+
+    The fan-out path (holding the store lock) takes each shard lock in
+    turn; everything else — register, drop, slow-consumer eviction, stats
+    — touches only this shard's lock. Lock order is store -> shard; no
+    shard method ever takes the store lock, so a storm of watchers
+    connecting/disconnecting serializes against 1/K of the registry and
+    never against writers."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # guarded by: self.lock
+        self.queues: list[queue.Queue] = []
+        self.drops = 0  # guarded by: self.lock
+
+    def add(self, q: "queue.Queue[Event]") -> None:
+        with self.lock:
+            self.queues.append(q)
+
+    def discard(self, q: "queue.Queue[Event]") -> bool:
+        with self.lock:
+            if q in self.queues:
+                self.queues.remove(q)
+                return True
+            return False
+
+    def stats(self) -> tuple[int, int]:
+        """-> (live watcher queues, cumulative slow-consumer drops)."""
+        with self.lock:
+            return len(self.queues), self.drops
+
+    @staticmethod
+    def _overflow(q: "queue.Queue[Event]", rv: int) -> None:
+        """Slow consumer: drain its queue and leave a single ERROR event —
+        the stream closes and the client relists, identical to compaction.
+        Draining here is safe: the producer side is this shard pass (we
+        hold the shard lock) and the consumer only ever removes."""
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        try:
+            q.put_nowait(Event(ERROR, {}, rv))
+        except queue.Full:
+            pass  # consumer raced the drain; it still sees the severed stream
+
+    def emit(self, evs) -> int:
+        """Fan events to every queue in this shard; overflowing watchers
+        are evicted with a counted drop. Returns drops this pass."""
+        rv = evs[-1].resource_version
+        dropped = []
+        with self.lock:
+            for q in self.queues:
+                try:
+                    for ev in evs:
+                        q.put_nowait(ev)
+                except queue.Full:
+                    self._overflow(q, rv)
+                    dropped.append(q)
+            for q in dropped:
+                self.queues.remove(q)
+            self.drops += len(dropped)
+        return len(dropped)
+
+    def invalidate(self, rv: int) -> None:
+        """Checkpoint restore / snapshot install: every stream on this
+        shard is severed with ERROR (consumers must relist)."""
+        with self.lock:
+            for q in self.queues:
+                try:
+                    q.put_nowait(Event(ERROR, {}, rv))
+                except queue.Full:
+                    self._overflow(q, rv)
+            self.queues.clear()
+
+
 class Watcher:
     def __init__(self, store: "ObjectStore", kind: str, q: "queue.Queue[Event]"):
         self._store = store
@@ -169,7 +265,16 @@ class ObjectStore:
         # checkpoint blob).
         self._compacted: dict[str, int] = {}
         self._floor_rv = 0
-        self._watchers: dict[str, list[queue.Queue]] = {}
+        # Watcher registry: per kind, WATCH_SHARDS independently-locked
+        # fan-out shards. Writes (creating a kind's shard list) happen under
+        # the store lock; a shard list, once created, is never replaced —
+        # invalidation clears queues in place — so _drop_watcher may read
+        # the dict without the store lock (watcher churn must never contend
+        # with the write path).
+        self._shards: dict[str, list[_WatchShard]] = {}
+        self._watch_seq = 0      # guarded by: self._lock
+        self._fanout_ns = 0      # guarded by: self._lock
+        self._fanout_events = 0  # guarded by: self._lock
         self._data_dir = data_dir
         self._journal_subs: list = []  # replication taps (under the lock)
         self._wal_compact_every = wal_compact_every
@@ -220,9 +325,11 @@ class ObjectStore:
 
     def _emit_many_locked(self, kind: str, evs: list[Event]):
         """Batched watch fan-out: one history append + trim and ONE pass
-        over the watcher list for a whole bulk verb's events, instead of
+        per fan-out shard for a whole bulk verb's events, instead of
         per-event bookkeeping. Semantically identical to N _emit_locked
-        calls — every watcher still receives every event in order."""
+        calls — every surviving watcher still receives every event in
+        order; a watcher whose bounded queue overflows is evicted with an
+        ERROR event and a counted drop (it relists, compaction-style)."""
         if not evs:
             return
         hist = self._history.setdefault(kind, [])
@@ -231,9 +338,17 @@ class ObjectStore:
             cut = len(hist) - REPLAY_WINDOW
             self._compacted[kind] = hist[cut - 1].resource_version
             del hist[:cut]
-        for q in self._watchers.get(kind, []):
-            for ev in evs:
-                q.put(ev)
+        shards = self._shards.get(kind)
+        if not shards:
+            return
+        t0 = time.perf_counter_ns()
+        dropped = 0
+        for shard in shards:
+            dropped += shard.emit(evs)
+        self._fanout_ns += time.perf_counter_ns() - t0
+        self._fanout_events += len(evs)
+        if dropped:
+            WATCH_DROPS.inc({"kind": kind}, by=dropped)
 
     def _emit_locked(self, kind: str, ev: Event):
         # Event payloads SHARE the authoritative object: the store never
@@ -244,20 +359,20 @@ class ObjectStore:
         # list() honor by returning copies. A binding storm emits tens of
         # thousands of events; the per-event detach copy was measurable
         # against the whole connected path.
-        hist = self._history.setdefault(kind, [])
-        hist.append(ev)
-        if len(hist) > REPLAY_WINDOW:
-            cut = len(hist) - REPLAY_WINDOW
-            self._compacted[kind] = hist[cut - 1].resource_version
-            del hist[:cut]
-        for q in self._watchers.get(kind, []):
-            q.put(ev)
+        self._emit_many_locked(kind, [ev])
 
     def _drop_watcher(self, kind: str, q):
-        with self._lock:
-            ws = self._watchers.get(kind, [])
-            if q in ws:
-                ws.remove(q)
+        # shard-lock only: 10k clients connecting/disconnecting must not
+        # contend with writers holding the store lock
+        shards = self._shards.get(kind, ())
+        for shard in shards:
+            if shard.discard(q):
+                break
+        self._set_watch_gauge(kind, shards)
+
+    @staticmethod
+    def _set_watch_gauge(kind: str, shards) -> None:
+        WATCH_CLIENTS.set(sum(s.stats()[0] for s in shards), {"kind": kind})
 
     # ---- durability ------------------------------------------------------
 
@@ -821,17 +936,53 @@ class ObjectStore:
 
     def watch(self, kind: str, since_rv: int = 0) -> Watcher:
         """Watch events with rv > since_rv. Raises TooOld if the replay window
-        no longer covers since_rv (caller must relist, Reflector-style)."""
+        no longer covers since_rv (caller must relist, Reflector-style) — or
+        if the replay backlog alone would overflow the watcher's bounded
+        queue (a relist hands the caller the same state cheaper than a
+        replay that immediately evicts it)."""
         with self._lock:
-            q: queue.Queue = queue.Queue()
             hist = self._history.get(kind, [])
             if since_rv < max(self._floor_rv, self._compacted.get(kind, 0)):
                 raise TooOld(f"{kind} rv {since_rv} compacted")
-            for ev in hist:
-                if ev.resource_version > since_rv:
-                    q.put(ev)
-            self._watchers.setdefault(kind, []).append(q)
+            pending = [ev for ev in hist if ev.resource_version > since_rv]
+            if len(pending) >= WATCH_QUEUE_MAX:
+                raise TooOld(f"{kind} rv {since_rv}: replay backlog "
+                             f"{len(pending)} exceeds watcher queue budget")
+            q: queue.Queue = queue.Queue(maxsize=WATCH_QUEUE_MAX)
+            for ev in pending:
+                q.put_nowait(ev)
+            shards = self._shards.setdefault(
+                kind, [_WatchShard() for _ in range(WATCH_SHARDS)])
+            self._watch_seq += 1
+            shards[self._watch_seq % WATCH_SHARDS].add(q)
+            self._set_watch_gauge(kind, shards)
             return Watcher(self, kind, q)
+
+    def watch_stats(self) -> dict:
+        """Front-door observability: live watcher counts, shard fan-out,
+        cumulative slow-consumer drops, and the fan-out span (ns spent
+        pushing events into watcher queues + events fanned) — the
+        WatchStorm bench gates leader fan-out growth on ns/event."""
+        with self._lock:
+            shard_map = {k: list(v) for k, v in self._shards.items()}
+            fanout_ns, fanout_events = self._fanout_ns, self._fanout_events
+        watchers: dict[str, int] = {}
+        drops: dict[str, int] = {}
+        for kind, shards in shard_map.items():
+            n = d = 0
+            for s in shards:
+                sn, sd = s.stats()
+                n, d = n + sn, d + sd
+            if n:
+                watchers[kind] = n
+            if d:
+                drops[kind] = d
+        return {"watchers": watchers,
+                "watchersTotal": sum(watchers.values()),
+                "shardsPerKind": WATCH_SHARDS,
+                "queueMax": WATCH_QUEUE_MAX,
+                "drops": drops, "dropsTotal": sum(drops.values()),
+                "fanoutNs": fanout_ns, "fanoutEvents": fanout_events}
 
     # ---- checkpoint ------------------------------------------------------
 
@@ -866,10 +1017,9 @@ class ObjectStore:
         self._history.clear()
         self._compacted = {}
         self._floor_rv = self._rv
-        for qs in self._watchers.values():
-            for q in qs:
-                q.put(Event(ERROR, {}, self._rv))
-        self._watchers = {}
+        for shards in self._shards.values():
+            for shard in shards:
+                shard.invalidate(self._rv)
         self._reseed_service_ips_locked()
         if self._wal is not None:
             self._compact_wal_locked()

@@ -1,0 +1,264 @@
+"""The front door: follower apiservers serving reads, leader routing,
+client endpoint spreading, and cross-replica watch semantics.
+
+Reference role: apiserver replicas over etcd — any replica serves
+list/watch from its (replicated) cache with a bounded-staleness
+contract; linearizable mutations go through the leader. The watch rv
+vocabulary is IDENTICAL across replicas (rvs are minted once, under the
+raft log), so a watcher can hop replicas without renumbering."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.client.clientset import HTTPClient, TooOld
+from kubernetes_tpu.client.informer import SharedInformer
+from kubernetes_tpu.store.frontdoor import (FRONTDOOR_CONFIGMAP,
+                                            FRONTDOOR_NAMESPACE,
+                                            FrontDoorCluster,
+                                            FrontDoorPublisher,
+                                            aggregate_frontdoor)
+
+
+pytestmark = pytest.mark.watchstorm
+
+
+def wait_until(fn, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+def _cm(name, v="1"):
+    return {"kind": "ConfigMap", "metadata": {"name": name},
+            "data": {"v": v}}
+
+
+def _raw_get(url, path):
+    """(status, headers, body) without client-side routing/retry."""
+    try:
+        with urllib.request.urlopen(url + path, timeout=5.0) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    fd = FrontDoorCluster(3).start()
+    yield fd
+    fd.stop()
+
+
+def test_replica_serves_reads_with_lag_header(cluster):
+    c = cluster.client()
+    c.resource("configmaps", "default").create(_cm("fd-read"))
+    replica_url = cluster.replica_apis[0].url
+    path = "/api/v1/namespaces/default/configmaps/fd-read"
+    assert wait_until(lambda: _raw_get(replica_url, path)[0] == 200)
+    code, headers, body = _raw_get(replica_url, path)
+    assert code == 200
+    assert json.loads(body)["metadata"]["name"] == "fd-read"
+    # staleness is part of the replica's response contract
+    lag_ms = float(headers["X-KTPU-Replay-Lag"])
+    assert 0.0 <= lag_ms < 5000.0
+    # the leader never advertises a lag (it IS the truth)
+    _, leader_headers, _ = _raw_get(cluster.leader_api.url, path)
+    assert "X-KTPU-Replay-Lag" not in leader_headers
+
+
+def test_write_on_replica_answers_421_with_leader_hint(cluster):
+    replica_url = cluster.replica_apis[0].url
+    req = urllib.request.Request(
+        replica_url + "/api/v1/namespaces/default/configmaps",
+        data=json.dumps(_cm("fd-reject")).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5.0)
+    assert ei.value.code == 421
+    assert ei.value.headers["X-KTPU-Leader"] == cluster.leader_api.url
+    assert json.loads(ei.value.read())["reason"] == "NotLeader"
+
+
+def test_spread_client_write_chases_leader(cluster):
+    """A client whose FIRST endpoint is a replica still writes: the 421
+    hint re-routes the request to the leader transparently."""
+    leader_url = cluster.leader_api.url
+    endpoints = [api.url for api in cluster.replica_apis] + [leader_url]
+    c = HTTPClient(endpoints)
+    assert c._leader == endpoints[0]  # starts pointed at a replica
+    c.resource("configmaps", "default").create(_cm("fd-chase"))
+    assert c._leader == leader_url  # learned the hint
+    got = c.resource("configmaps", "default").get("fd-chase")
+    assert got["metadata"]["name"] == "fd-chase"
+
+
+def test_watch_rv_vocabulary_identical_across_replicas(cluster):
+    """Events watched on a REPLICA carry the same resourceVersions a
+    fresh LEADER list reports — rvs are minted once under the raft log,
+    so streams are portable across the front door."""
+    writer = cluster.client()
+    replica = HTTPClient(cluster.replica_apis[0].url)
+    cms = writer.resource("configmaps", "default")
+    _, rv0 = cms.list_rv()
+    w = replica.resource("configmaps", "default").watch(since_rv=rv0)
+    names = [f"fd-rv-{i}" for i in range(5)]
+    for n in names:
+        cms.create(_cm(n))
+    seen = {}
+    deadline = time.monotonic() + 15.0
+    while len(seen) < len(names) and time.monotonic() < deadline:
+        ev = w.get(timeout=1.0)
+        if ev is not None and ev.object["metadata"]["name"] in set(names):
+            seen[ev.object["metadata"]["name"]] = ev.resource_version
+    w.stop()
+    leader_c = HTTPClient(cluster.leader_api.url)
+    leader_rvs = {o["metadata"]["name"]:
+                  int(o["metadata"]["resourceVersion"])
+                  for o in leader_c.resource("configmaps", "default").list()
+                  if o["metadata"]["name"] in set(names)}
+    assert seen == leader_rvs
+
+
+def test_replica_readyz_gates_on_replay_lag(cluster):
+    replica_api = cluster.replica_apis[0]
+    assert _raw_get(replica_api.url, "/readyz")[0] == 200
+    try:
+        # an impossible staleness budget: any positive lag exceeds it
+        replica_api.max_replay_lag_s = -1.0
+        assert wait_until(
+            lambda: _raw_get(replica_api.url, "/readyz")[0] == 503,
+            timeout=5.0)
+        # liveness is NOT staleness: the process stays alive
+        assert _raw_get(replica_api.url, "/livez")[0] == 200
+        # the leader has no lag to gate on
+        assert _raw_get(cluster.leader_api.url, "/readyz")[0] == 200
+    finally:
+        replica_api.max_replay_lag_s = 2.0
+
+
+def test_frontdoor_status_and_read_role_accounting(cluster):
+    from kubernetes_tpu.metrics.registry import READ_REQUESTS
+    leader_reads = READ_REQUESTS.get({"role": "leader"})
+    replica_reads = READ_REQUESTS.get({"role": "replica"})
+    path = "/api/v1/namespaces/default/configmaps"
+    assert _raw_get(cluster.leader_api.url, path)[0] == 200
+    assert _raw_get(cluster.replica_apis[0].url, path)[0] == 200
+    assert READ_REQUESTS.get({"role": "leader"}) > leader_reads
+    assert READ_REQUESTS.get({"role": "replica"}) > replica_reads
+    st = json.loads(_raw_get(cluster.replica_apis[0].url,
+                             "/frontdoor/status")[2])
+    assert st["role"] == "replica"
+    assert st["replayLagMs"] is not None
+    assert st["watch"]["shardsPerKind"] >= 1
+    st = json.loads(_raw_get(cluster.leader_api.url,
+                             "/frontdoor/status")[2])
+    assert st["role"] == "leader" and st["replayLagMs"] is None
+
+
+def test_publisher_writes_frontdoor_configmap(cluster):
+    c = cluster.client()
+    pub = FrontDoorPublisher(c, cluster.endpoints)
+    assert pub.publish_once()
+    cm = c.resource("configmaps", FRONTDOOR_NAMESPACE).get(
+        FRONTDOOR_CONFIGMAP)
+    data = cm["data"]
+    assert data["leader"] == cluster.leader_api.url
+    assert data["replicas"] == "2"
+    nodes = json.loads(data["nodes"])
+    assert len(nodes) == 3
+    assert sum(1 for n in nodes if n["reachable"]) == 3
+    assert {n["role"] for n in nodes} == {"leader", "replica"}
+
+
+def test_ktpu_status_frontdoor_line(cluster):
+    """``ktpu status`` renders the published front-door picture — and the
+    ``--server`` flag takes the whole endpoint list (comma-separated), so
+    the CLI itself rides the spread client."""
+    import io
+
+    from kubernetes_tpu.cli.ktpu import main as ktpu_main
+    c = cluster.client()
+    assert FrontDoorPublisher(c, cluster.endpoints).publish_once()
+    out = io.StringIO()
+    rc = ktpu_main(["--server", ",".join(cluster.endpoints), "status"],
+                   out=out)
+    text = out.getvalue()
+    assert rc == 0
+    assert "Front door:" in text
+    assert "2 read replicas" in text
+    assert "3/3 reachable" in text
+    out = io.StringIO()
+    rc = ktpu_main(["--server", cluster.leader_api.url, "status",
+                    "-o", "json"], out=out)
+    assert rc == 0
+    st = json.loads(out.getvalue())
+    assert st["frontdoor"]["replicas"] == "2"
+    assert st["frontdoor"]["leader"] == cluster.leader_api.url
+    assert len(st["frontdoor"]["nodes"]) == 3
+
+
+def test_aggregate_renders_unreachable_nodes():
+    data = aggregate_frontdoor({
+        "http://a": {"role": "leader", "node": "n0", "ready": True,
+                     "replayLagMs": None,
+                     "watch": {"watchersTotal": 3, "dropsTotal": 1,
+                               "shardsPerKind": 8}},
+        "http://b": None})
+    assert data["leader"] == "http://a"
+    assert data["replicas"] == "0"
+    assert data["watchersTotal"] == "3" and data["dropsTotal"] == "1"
+    nodes = {n["url"]: n for n in json.loads(data["nodes"])}
+    assert nodes["http://b"] == {"url": "http://b", "reachable": False}
+
+
+def test_spread_reads_survive_replica_loss_and_compaction():
+    """Disruption leg (own cluster — it wounds a replica): reads keep
+    succeeding through endpoint rotation after a replica apiserver dies,
+    a compacted replica answers watches with 410 -> client TooOld, and
+    an informer against that replica relists to the SAME state a fresh
+    leader list reports."""
+    fd = FrontDoorCluster(3).start()
+    try:
+        writer = fd.client()
+        cms = writer.resource("configmaps", "default")
+        for i in range(5):
+            cms.create(_cm(f"dis-{i}"))
+        victim, survivor = fd.replica_apis[0], fd.replica_apis[1]
+        surv_store = survivor.store.inner
+        assert wait_until(
+            lambda: len(surv_store.list("ConfigMap")[0]) == 5)
+        # ---- compaction: the survivor's history floor advances (the
+        # post-snapshot-resync state) -> watch rv=1 is 410/TooOld, and
+        # an informer heals by relisting
+        surv_store.load_snapshot_blob(surv_store.snapshot_blob())
+        replica_c = HTTPClient(survivor.url)
+        with pytest.raises(TooOld):
+            replica_c.resource("configmaps", "default").watch(since_rv=1)
+        inf = SharedInformer(
+            replica_c.resource("configmaps", "default")).start()
+        assert inf.wait_for_cache_sync(15.0)
+        leader_list = {o["metadata"]["name"]:
+                       o["metadata"]["resourceVersion"]
+                       for o in writer.resource("configmaps",
+                                                "default").list()}
+        informer_view = {o["metadata"]["name"]:
+                         o["metadata"]["resourceVersion"]
+                         for o in inf.store.list()}
+        assert informer_view == leader_list
+        inf.stop()
+        # ---- replica loss: kill one replica's apiserver; every read on
+        # the spread client still lands (sticky endpoints rotate away)
+        victim.stop()
+        reader = fd.client()
+        for _ in range(8):
+            got = reader.resource("configmaps", "default").get("dis-0")
+            assert got["metadata"]["name"] == "dis-0"
+    finally:
+        fd.stop()

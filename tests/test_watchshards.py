@@ -1,0 +1,128 @@
+"""Sharded watch fan-out with bounded per-watcher queues.
+
+Reference role: the watch cache's per-watcher channel budget
+(``cacher.go``) — a slow consumer is force-disconnected and relists,
+instead of growing an unbounded queue that stalls every sibling. The
+shard structure keeps watcher churn (register/drop at 10k-client scale)
+off the store's write lock.
+"""
+
+import pytest
+
+from kubernetes_tpu.metrics.registry import WATCH_DROPS
+from kubernetes_tpu.store.store import (WATCH_QUEUE_MAX, WATCH_SHARDS,
+                                        ObjectStore, TooOld)
+
+pytestmark = pytest.mark.watchstorm
+
+
+def _cm(name, v="1"):
+    return {"kind": "ConfigMap", "metadata": {"name": name},
+            "data": {"v": v}}
+
+
+def _flood(store, n, start=0):
+    for i in range(start, start + n):
+        store.create("ConfigMap", _cm(f"c{i}"))
+
+
+def test_events_flow_through_shards_in_order():
+    store = ObjectStore()
+    w = store.watch("ConfigMap", since_rv=0)
+    _flood(store, 20)
+    rvs = []
+    for _ in range(20):
+        ev = w.get(timeout=2.0)
+        assert ev is not None
+        rvs.append(ev.resource_version)
+    assert rvs == sorted(rvs)
+    w.stop()
+
+
+def test_watchers_spread_across_shards():
+    store = ObjectStore()
+    watchers = [store.watch("ConfigMap", since_rv=0)
+                for _ in range(WATCH_SHARDS * 4)]
+    occupancy = [shard.stats()[0] for shard in store._shards["ConfigMap"]]
+    assert sum(occupancy) == WATCH_SHARDS * 4
+    # round-robin placement: every shard carries its equal share
+    assert occupancy == [4] * WATCH_SHARDS
+    for w in watchers:
+        w.stop()
+    assert store.watch_stats()["watchersTotal"] == 0
+
+
+def test_slow_watcher_evicted_with_counted_drop():
+    """The stalled-consumer regression gate: a watcher that never drains
+    overflows its bounded queue, is evicted with a counted drop, and the
+    stream closes — while a healthy sibling keeps receiving every event."""
+    store = ObjectStore()
+    stalled = store.watch("ConfigMap", since_rv=0)
+    healthy = store.watch("ConfigMap", since_rv=0)
+    drops_before = WATCH_DROPS.get({"kind": "ConfigMap"})
+    # fill both queues to EXACTLY the budget (no overflow yet) ...
+    _flood(store, WATCH_QUEUE_MAX)
+    seen = 0
+    while healthy.get(timeout=0.2) is not None:
+        seen += 1
+    assert seen == WATCH_QUEUE_MAX
+    # ... then one more event: the healthy (drained) watcher receives it,
+    # the stalled (still-full) watcher tips over and is evicted
+    _flood(store, 1, start=WATCH_QUEUE_MAX)
+    ev = healthy.get(timeout=2.0)
+    assert ev is not None
+    assert ev.object["metadata"]["name"] == f"c{WATCH_QUEUE_MAX}"
+    # the stalled watcher's stream is severed: ERROR surfaces as a
+    # closed watcher (get -> None, closed=True), the relist signal
+    drained = 0
+    while stalled.get(timeout=0.2) is not None:
+        drained += 1
+    assert stalled.closed
+    assert drained < WATCH_QUEUE_MAX  # queue was truncated, not delivered
+    stats = store.watch_stats()
+    assert stats["drops"]["ConfigMap"] == 1
+    assert stats["dropsTotal"] == 1
+    assert WATCH_DROPS.get({"kind": "ConfigMap"}) == drops_before + 1
+    # the evicted watcher no longer occupies a shard slot
+    assert stats["watchers"]["ConfigMap"] == 1
+    healthy.stop()
+
+
+def test_replay_backlog_beyond_queue_budget_is_too_old():
+    """A watch() whose replay alone would overflow the bounded queue gets
+    TooOld up front — the relist hands it the same state cheaper than a
+    replay that immediately evicts it."""
+    store = ObjectStore()
+    _flood(store, WATCH_QUEUE_MAX + 10)
+    with pytest.raises(TooOld):
+        store.watch("ConfigMap", since_rv=0)
+    # a caught-up watcher is fine
+    _, rv = store.list("ConfigMap")
+    w = store.watch("ConfigMap", since_rv=rv)
+    store.create("ConfigMap", _cm("after"))
+    ev = w.get(timeout=2.0)
+    assert ev is not None and ev.object["metadata"]["name"] == "after"
+    w.stop()
+
+
+def test_fanout_span_accounting():
+    store = ObjectStore()
+    w = store.watch("ConfigMap", since_rv=0)
+    _flood(store, 10)
+    stats = store.watch_stats()
+    assert stats["fanoutEvents"] >= 10
+    assert stats["fanoutNs"] > 0
+    w.stop()
+
+
+def test_snapshot_install_severs_every_shard():
+    store = ObjectStore()
+    watchers = [store.watch("ConfigMap", since_rv=0) for _ in range(12)]
+    _flood(store, 3)
+    blob = store.snapshot_blob()
+    store.load_snapshot_blob(blob)
+    for w in watchers:
+        while w.get(timeout=0.5) is not None:
+            pass
+        assert w.closed  # ERROR delivered -> consumer must relist
+    assert store.watch_stats()["watchersTotal"] == 0
