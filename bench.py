@@ -44,7 +44,12 @@ bind-after-restart), BENCH_WATCHSTORM=0 to skip the WatchStorm case
 BENCH_WATCHSTORM_WATCHERS/PODS size it, BENCH_WATCHSTORM_SPAN_GROWTH
 gates leader fan-out span growth, BENCH_WATCHSTORM_HEAL_SLO bounds a
 SIGKILLed replica's rebirth — every gate treats a missing number as
-failure).
+failure), BENCH_SCENARIO=0 to skip the ScenarioReplay case (cluster
+time machine: BENCH_SCENARIO=builtin:<name> or a .trace.jsonl path
+picks the trace, default builtin:smoke; BENCH_SCENARIO_SPEED warps
+replay time, BENCH_SCENARIO_SEED seeds the generator — gates: 100% of
+trace-resident pods bound, per-phase p99 attempt latency present,
+deterministic dispatch order, the manifest's own sloGates).
 """
 
 from __future__ import annotations
@@ -329,6 +334,26 @@ def main():
             log=log)
         log("[bench] " + json.dumps(watch_storm))
 
+    scenario = None
+    _scen = os.environ.get("BENCH_SCENARIO", "1")
+    if _scen != "0" and not only_case:
+        # cluster time machine: replay a production-shaped trace
+        # (builtin:<name> or a .trace.jsonl path — committed fixture, WAL
+        # capture, or audit-bundle conversion) through the connected
+        # stack under the fail-fast auditor. Gates: 100% of trace-
+        # resident pods bound, per-phase p99 attempt latency present,
+        # deterministic dispatch order, the manifest's own sloGates —
+        # missing numbers fail. BENCH_SCENARIO=1 runs builtin:smoke;
+        # BENCH_SCENARIO_SPEED warps replay time (default 4x compressed).
+        from benchmarks.scenario import run_scenario_replay
+        log("[bench] scenario replay run ...")
+        scenario = run_scenario_replay(
+            spec="builtin:smoke" if _scen == "1" else _scen,
+            speed=float(os.environ.get("BENCH_SCENARIO_SPEED", "4")),
+            seed=int(os.environ.get("BENCH_SCENARIO_SEED", "0")),
+            log=log)
+        log("[bench] " + json.dumps(scenario))
+
     kubemark = None
     if os.environ.get("BENCH_KUBEMARK", "1") != "0" and not only_case:
         # LAST on purpose: the hollow fleet leaves hundreds of daemon
@@ -385,6 +410,7 @@ def main():
         "slice_carve": slice_carve,
         "disaster_churn": disaster,
         "watch_storm": watch_storm,
+        "scenario_replay": scenario,
         "kubemark": kubemark,
         "pallas": pallas,
         # confirmed correctness-invariant violations across every audited
@@ -396,7 +422,7 @@ def main():
                                                 connected_mesh, explain_ab,
                                                 scale_fleet, disaster,
                                                 fleet_churn, slice_carve,
-                                                watch_storm),
+                                                watch_storm, scenario),
         # hard SLO verdicts from case-config gates (SchedulingChurn p99 +
         # throughput, ConnectedMesh legs). Missing numbers are failures —
         # the BENCH_r05 parsed-null lesson: a silently absent figure must
@@ -404,7 +430,8 @@ def main():
         "slo_failures": _collect_slo_failures(results, connected_mesh,
                                               explain_ab, scale_fleet,
                                               disaster, fleet_churn,
-                                              slice_carve, watch_storm),
+                                              slice_carve, watch_storm,
+                                              scenario),
     }
     _require_invariant_field(out, "bench summary")
     print(json.dumps(out))
@@ -420,7 +447,8 @@ def main():
                     ("fleet_churn", fleet_churn),
                     ("slice_carve", slice_carve),
                     ("disaster_churn", disaster),
-                    ("watch_storm", watch_storm)) if c}
+                    ("watch_storm", watch_storm),
+                    ("scenario_replay", scenario)) if c}
         print(f"[bench] FATAL: {out['invariant_violations']} correctness-"
               f"invariant violation(s) confirmed by the auditor "
               f"({audited}); repro bundles are on disk — replay with the "
@@ -448,7 +476,7 @@ def main():
 def _collect_slo_failures(results, connected_mesh, explain_ab=None,
                           scale_fleet=None, disaster=None,
                           fleet_churn=None, slice_carve=None,
-                          watch_storm=None) -> list:
+                          watch_storm=None, scenario=None) -> list:
     """Flatten every case's hard-SLO failure strings, prefixed by case."""
     out = []
     for r in results or []:
@@ -475,6 +503,9 @@ def _collect_slo_failures(results, connected_mesh, explain_ab=None,
     if watch_storm is not None:
         for msg in watch_storm.get("slo_failures") or []:
             out.append(f"WatchStorm: {msg}")
+    if scenario is not None:
+        for msg in scenario.get("slo_failures") or []:
+            out.append(f"ScenarioReplay: {msg}")
     return out
 
 

@@ -2,7 +2,11 @@
 
 Mirrors the shape of scheduler_perf's YAML-driven workloads
 (test/integration/scheduler_perf/config/performance-config.yaml):
-createNodes -> createPods with templated specs. Deterministic via seed.
+createNodes -> createPods with templated specs. Deterministic via seed:
+every generator derives ALL randomness from its own ``random.Random(seed)``
+(never the module-level RNG), so the same (params, seed) yields the same
+objects — pinned by the same-seed-twice test, and relied on by the
+scenario engine, which reuses these shapes as trace template pools.
 """
 
 from __future__ import annotations
@@ -119,7 +123,6 @@ def huge_cluster(pods: int = 4096, nodes: int = 16384, seed: int = 0):
     factored O(N+V) formulation instead of one-hot matmuls — the 50k-node
     scaling design point. Hard AND soft spread constraints so both the
     filter and scoring factored paths execute."""
-    import random
     rng = random.Random(seed)
     ns = []
     for i in range(nodes):

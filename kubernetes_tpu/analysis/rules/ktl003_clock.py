@@ -6,7 +6,9 @@ stabilization, autoscaler cooldowns, TTL sweeps, lease grace) that called
 through its window — slow at best, flaky under load at worst.
 ``utils/clock.py`` exists so tests advance a FakeClock instead; this rule
 stops new direct wall-clock reads from growing back into the
-clock-disciplined trees (controllers/, sched/, descheduler/, autoscaler/).
+clock-disciplined trees (controllers/, sched/, descheduler/, autoscaler/,
+scenario/ — the trace driver replays on an injected Clock so a FakeClock
+can warp through a scenario without sleeping).
 
 ``time.sleep`` counts too: a sleeping control loop is an untestable one
 (waits belong on stop Events / injectable periods).
@@ -23,7 +25,8 @@ _BANNED = {"time", "monotonic", "sleep", "perf_counter"}
 
 # package-relative dir prefixes under clock discipline
 DIRS = ("kubernetes_tpu/controllers/", "kubernetes_tpu/sched/",
-        "kubernetes_tpu/descheduler/", "kubernetes_tpu/autoscaler/")
+        "kubernetes_tpu/descheduler/", "kubernetes_tpu/autoscaler/",
+        "kubernetes_tpu/scenario/")
 
 # files inside those trees allowed direct clock access (the clock sources
 # themselves, and perf spans that must read the real wall by definition)

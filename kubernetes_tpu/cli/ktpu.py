@@ -696,6 +696,19 @@ def _frontdoor_line(fd: dict) -> str:
             f"drops {fd.get('dropsTotal', '0')}\n")
 
 
+def _scenario_line(sc: dict) -> str:
+    """One-line scenario-driver digest from the
+    ``kubernetes-tpu-scenario-status`` ConfigMap."""
+    return (f"Scenario:      {sc.get('trace', '<unnamed>')} "
+            f"{sc.get('state', '?')}"
+            + (f" (phase {sc['phase']})" if sc.get("phase") else "")
+            + f" — {sc.get('eventsDispatched', 0)}/"
+              f"{sc.get('eventsTotal', 0)} events, "
+              f"{sc.get('podsBound', 0)}/{sc.get('podsResident', 0)} "
+              f"bound, skew max {sc.get('skewMaxMs', 0)}ms, "
+              f"speed {sc.get('speed', 1.0)}x\n")
+
+
 def cmd_status(client: HTTPClient, args, out) -> int:
     """ktpu status: the connected scheduler's published deployment shape
     (the ``kubernetes-tpu-scheduler-status`` ConfigMap) — most importantly
@@ -740,11 +753,13 @@ def cmd_status(client: HTTPClient, args, out) -> int:
             return data
         return None
 
+    from kubernetes_tpu.scenario.driver import SCENARIO_CONFIGMAP
     from kubernetes_tpu.sched.fleet import FLEET_SCHED_CONFIGMAP
     fleet = _aux_cm(FLEET_CONFIGMAP, "fleet")
     fleet_sched = _aux_cm(FLEET_SCHED_CONFIGMAP, "fleetSched")
     durability = _aux_cm(APISERVER_CONFIGMAP, "durability")
     disruption = _aux_cm(NODELIFECYCLE_CONFIGMAP, "disruption")
+    scenario = _aux_cm(SCENARIO_CONFIGMAP, "scenario")
     frontdoor = _frontdoor_cm()
     try:
         cm = client.resource("configmaps", args.namespace).get(
@@ -756,6 +771,7 @@ def cmd_status(client: HTTPClient, args, out) -> int:
                                  ("fleetSched", fleet_sched),
                                  ("durability", durability),
                                  ("disruption", disruption),
+                                 ("scenario", scenario),
                                  ("frontdoor", frontdoor))
                if v is not None}
         if aux:
@@ -774,6 +790,8 @@ def cmd_status(client: HTTPClient, args, out) -> int:
                     out.write(_fleet_line(fleet))
                 if fleet_sched is not None:
                     out.write(_fleet_sched_line(fleet_sched))
+                if scenario is not None:
+                    out.write(_scenario_line(scenario))
             return 0
         out.write("error: no scheduler status published "
                   f"(configmap {STATUS_CONFIGMAP!r} not found in "
@@ -790,6 +808,8 @@ def cmd_status(client: HTTPClient, args, out) -> int:
             st["durability"] = durability
         if disruption is not None:
             st["disruption"] = disruption
+        if scenario is not None:
+            st["scenario"] = scenario
         if frontdoor is not None:
             st["frontdoor"] = frontdoor
         out.write(json.dumps(st) + "\n")
@@ -872,6 +892,8 @@ def cmd_status(client: HTTPClient, args, out) -> int:
         out.write(_fleet_line(fleet))
     if fleet_sched is not None:
         out.write(_fleet_sched_line(fleet_sched))
+    if scenario is not None:
+        out.write(_scenario_line(scenario))
     res = st.get("resilience")
     if res:
         degraded = (res.get("degradedIndex") or 0) > 0
@@ -952,6 +974,75 @@ def cmd_why(client: HTTPClient, args, out) -> int:
         out.write(f"  note: {explanation['feasibleNow']} node(s) were "
                   "feasible when re-judged — retry may succeed\n")
     return 0
+
+
+def cmd_scenario(client, args, out) -> int:
+    """ktpu scenario generate|record|replay|describe: the cluster time
+    machine. generate/record/describe are local file operations (no
+    apiserver — main() dispatches them before building a client); replay
+    drives the trace against the connected apiserver/scheduler stack."""
+    from kubernetes_tpu.scenario import (BUILTINS, ScenarioDriver, Trace,
+                                         TraceFormatError, builtin_trace,
+                                         trace_from_bundle, trace_from_wal)
+
+    def _resolve(spec: str) -> Trace:
+        if spec.startswith("builtin:"):
+            return builtin_trace(spec[len("builtin:"):], seed=args.seed)
+        if spec in BUILTINS:  # bare builtin name is unambiguous enough
+            return builtin_trace(spec, seed=args.seed)
+        return Trace.load(spec)
+
+    try:
+        if args.action == "generate":
+            if not args.target:
+                out.write("error: generate needs a builtin name "
+                          f"(catalog: {', '.join(sorted(BUILTINS))})\n")
+                return 1
+            trace = _resolve(args.target)
+            path = args.out_path or f"{trace.manifest.name}.trace.jsonl"
+            trace.save(path)
+            out.write(f"wrote {len(trace)} events to {path}\n")
+            out.write(json.dumps(trace.describe(), indent=1) + "\n")
+            return 0
+        if args.action == "record":
+            if bool(args.from_wal) == bool(args.from_bundle):
+                out.write("error: record needs exactly one of "
+                          "--from-wal WAL.jsonl / "
+                          "--from-bundle BUNDLE.json\n")
+                return 1
+            if args.from_wal:
+                trace = trace_from_wal(args.from_wal,
+                                       chaos_seed=args.chaos_seed)
+            else:
+                trace = trace_from_bundle(args.from_bundle)
+            path = args.out_path or f"{trace.manifest.name}.trace.jsonl"
+            trace.save(path)
+            out.write(f"captured {len(trace)} events to {path}\n")
+            out.write(json.dumps(trace.describe(), indent=1) + "\n")
+            return 0
+        if args.action == "describe":
+            if not args.target:
+                out.write("error: describe needs a trace path or "
+                          "builtin:<name>\n")
+                return 1
+            out.write(json.dumps(_resolve(args.target).describe(),
+                                 indent=1) + "\n")
+            return 0
+        # replay: the live path — client is a real HTTPClient here
+        if not args.target:
+            out.write("error: replay needs a trace path or "
+                      "builtin:<name>\n")
+            return 1
+        trace = _resolve(args.target)
+        driver = ScenarioDriver(client, trace, speed=args.speed,
+                                status_namespace=args.namespace,
+                                bind_timeout_s=args.bind_timeout)
+        result = driver.run()
+        out.write(json.dumps(result, indent=1) + "\n")
+        return 0 if result["completed"] else 1
+    except (TraceFormatError, KeyError, OSError) as e:
+        out.write(f"error: {e}\n")
+        return 1
 
 
 def cmd_trace(client: HTTPClient, args, out) -> int:
@@ -1351,6 +1442,35 @@ def build_parser() -> argparse.ArgumentParser:
     lt.add_argument("--json", action="store_true", dest="lint_json")
     lt.add_argument("--rule", action="append", default=None)
 
+    sn = sub.add_parser(
+        "scenario", help="cluster time machine: generate, record, "
+        "replay, and describe production-shaped traces")
+    sn.add_argument("action",
+                    choices=["generate", "record", "replay", "describe"])
+    sn.add_argument("target", nargs="?", default=None,
+                    help="builtin:<name> (or bare builtin name) or a "
+                    ".trace.jsonl path")
+    sn.add_argument("--seed", type=int, default=0,
+                    help="generator seed (builtins only)")
+    sn.add_argument("--out", dest="out_path", default=None,
+                    help="output trace path "
+                    "(default <name>.trace.jsonl)")
+    sn.add_argument("--speed", type=float, default=1.0,
+                    help="replay time warp (2 = twice as fast; "
+                    "0 = as fast as possible)")
+    sn.add_argument("--bind-timeout", type=float, default=120.0,
+                    help="replay: seconds to wait for resident pods "
+                    "to bind")
+    sn.add_argument("--from-wal", dest="from_wal", default=None,
+                    help="record: capture from a durable store's "
+                    "wal.jsonl")
+    sn.add_argument("--from-bundle", dest="from_bundle", default=None,
+                    help="record: convert an audit repro bundle JSON")
+    sn.add_argument("--chaos-seed", dest="chaos_seed", type=int,
+                    default=None,
+                    help="record --from-wal: arm this fault-schedule "
+                    "seed in the captured manifest")
+
     ds = sub.add_parser("deschedule")
     ds.add_argument("action", choices=["run", "status"])
     ds.add_argument("--policy", default=None,
@@ -1380,6 +1500,10 @@ def main(argv=None, out=None) -> int:
         for r in args.rule or ():
             lint_argv += ["--rule", r]
         return lint_main(lint_argv, out=out)
+    if args.cmd == "scenario" and args.action != "replay":
+        # generate/record/describe are pure file operations: dispatch
+        # before the client so they work with no apiserver running
+        return cmd_scenario(None, args, out)
     client = HTTPClient(args.server, token=args.token,
                         user_agent="ktpu")
     try:
@@ -1445,6 +1569,8 @@ def main(argv=None, out=None) -> int:
             return cmd_why(client, args, out)
         if args.cmd == "trace":
             return cmd_trace(client, args, out)
+        if args.cmd == "scenario":
+            return cmd_scenario(client, args, out)
         if args.cmd == "deschedule":
             return cmd_deschedule(client, args, out)
     except ApiError as e:

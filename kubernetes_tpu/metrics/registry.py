@@ -534,3 +534,21 @@ REPLICA_LAG = REGISTRY.gauge(
 READ_REQUESTS = REGISTRY.counter(
     "apiserver_read_requests_total",
     "Read requests (GET/list/watch) served, by role (leader|replica)")
+
+# The cluster time machine (kubernetes_tpu/scenario/driver.py): trace
+# replay against the connected stack. Skew is the driver's own dispatch
+# punctuality (how far behind the trace's scheduled offsets it ran);
+# attempt latency is create-dispatch to observed-bound per trace pod,
+# labeled by trace phase — the per-phase p99 the scenario SLO gates read.
+SCENARIO_EVENTS = REGISTRY.counter(
+    "scenario_events_total",
+    "Trace events dispatched by the scenario driver, by verb and "
+    "result (ok|error)")
+SCENARIO_SKEW = REGISTRY.histogram(
+    "scenario_dispatch_skew_seconds",
+    "Per-event dispatch skew: actual dispatch time minus the trace's "
+    "scheduled (time-warped) offset")
+SCENARIO_ATTEMPT = REGISTRY.histogram(
+    "scenario_attempt_latency_seconds",
+    "Trace-pod scheduling attempt latency (create dispatch to the "
+    "driver observing the binding), by trace phase")

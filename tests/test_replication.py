@@ -45,13 +45,24 @@ def _cluster(n=3):
     return nodes
 
 
-def _leader(nodes, timeout=30.0):
-    # Election budget sized for FULL-SUITE load, not a quiet interpreter:
-    # the raft tickers share the GIL with hundreds of suite threads, so
-    # silence detection (1.2s) + prevote round trips (2s timeouts) can
-    # stretch a single election attempt to multiple seconds, and split
-    # votes retry from scratch. 10s flaked under load (passed alone);
-    # the wider budget only costs time when something is actually wrong.
+def _load_budget(base: float) -> float:
+    """Load-aware time budget: scale ``base`` by how crowded this
+    interpreter actually is. The raft tickers share the GIL with every
+    thread the rest of the suite leaked (hundreds under the full run —
+    conftest only polices non-daemon leaks), so silence detection (1.2s)
+    + prevote round trips (2s timeouts) stretch a single election attempt
+    to multiple seconds and split votes retry from scratch. A fixed
+    budget is either flaky under load or slow alone; this one is sized by
+    the live thread count, so the quiet single-test run stays fast and
+    the full-suite run gets the headroom it demonstrably needs (flaked
+    since PR 1; PR 12 only widened the constants)."""
+    import threading
+    return base * min(4.0, max(1.0, len(threading.enumerate()) / 40.0))
+
+
+def _leader(nodes, timeout=None):
+    if timeout is None:
+        timeout = _load_budget(30.0)
     deadline = time.time() + timeout
     while time.time() < deadline:
         leaders = [nd for nd in nodes
@@ -117,12 +128,13 @@ def test_leader_failover_and_continued_writes():
         # and the group still commits (2/3 alive = quorum); the commit
         # gate itself gets the suite-load budget too
         ReplicatedStore(new_leader,
-                        commit_timeout=15.0).create("ConfigMap",
-                                                    _cm("post"))
+                        commit_timeout=_load_budget(15.0)).create(
+                            "ConfigMap", _cm("post"))
         other = next(nd for nd in survivors if nd is not new_leader)
         assert wait_until(lambda: any(
             o["metadata"]["name"] == "post"
-            for o in other.store.list("ConfigMap")[0]), timeout=15.0)
+            for o in other.store.list("ConfigMap")[0]),
+            timeout=_load_budget(15.0))
     finally:
         for nd in nodes:
             nd.stop()
