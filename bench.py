@@ -49,7 +49,13 @@ time machine: BENCH_SCENARIO=builtin:<name> or a .trace.jsonl path
 picks the trace, default builtin:smoke; BENCH_SCENARIO_SPEED warps
 replay time, BENCH_SCENARIO_SEED seeds the generator — gates: 100% of
 trace-resident pods bound, per-phase p99 attempt latency present,
-deterministic dispatch order, the manifest's own sloGates).
+deterministic dispatch order, the manifest's own sloGates),
+BENCH_PLANNER=0 to skip the PlannerLoop case (three planners, one
+cluster image: autoscaler + descheduler + gang defrag riding the
+scheduler's device-resident encoding; BENCH_PLANNER_NODES/CYCLES size
+it — gates: 0 XLA compiles and 0 cold full encodes in the steady
+window, overlay hits advance for every planner, resident-vs-cold plan
+parity bit-equal).
 """
 
 from __future__ import annotations
@@ -334,6 +340,22 @@ def main():
             log=log)
         log("[bench] " + json.dumps(watch_storm))
 
+    planner_loop = None
+    if os.environ.get("BENCH_PLANNER", "1") != "0" and not only_case:
+        # three planners, one cluster image: the BackgroundPlanner cadence
+        # drives autoscaler + descheduler + gang defrag against the
+        # scheduler's device-resident encoding — gates: 0 XLA compiles and
+        # 0 cold full encodes across the measured window, every planner's
+        # overlay hits advance, resident-vs-cold plans bit-equal, 0
+        # invariant violations — missing number = failure
+        from benchmarks.plannerloop import run_planner_loop
+        log("[bench] planner loop run ...")
+        planner_loop = run_planner_loop(
+            n_nodes=int(os.environ.get("BENCH_PLANNER_NODES", "8")),
+            window_cycles=int(os.environ.get("BENCH_PLANNER_CYCLES", "6")),
+            log=log)
+        log("[bench] " + json.dumps(planner_loop))
+
     scenario = None
     _scen = os.environ.get("BENCH_SCENARIO", "1")
     if _scen != "0" and not only_case:
@@ -410,6 +432,7 @@ def main():
         "slice_carve": slice_carve,
         "disaster_churn": disaster,
         "watch_storm": watch_storm,
+        "planner_loop": planner_loop,
         "scenario_replay": scenario,
         "kubemark": kubemark,
         "pallas": pallas,
@@ -422,7 +445,8 @@ def main():
                                                 connected_mesh, explain_ab,
                                                 scale_fleet, disaster,
                                                 fleet_churn, slice_carve,
-                                                watch_storm, scenario),
+                                                watch_storm, planner_loop,
+                                                scenario),
         # hard SLO verdicts from case-config gates (SchedulingChurn p99 +
         # throughput, ConnectedMesh legs). Missing numbers are failures —
         # the BENCH_r05 parsed-null lesson: a silently absent figure must
@@ -431,7 +455,8 @@ def main():
                                               explain_ab, scale_fleet,
                                               disaster, fleet_churn,
                                               slice_carve, watch_storm,
-                                              scenario),
+                                              scenario,
+                                              planner_loop=planner_loop),
     }
     _require_invariant_field(out, "bench summary")
     print(json.dumps(out))
@@ -476,7 +501,8 @@ def main():
 def _collect_slo_failures(results, connected_mesh, explain_ab=None,
                           scale_fleet=None, disaster=None,
                           fleet_churn=None, slice_carve=None,
-                          watch_storm=None, scenario=None) -> list:
+                          watch_storm=None, scenario=None,
+                          planner_loop=None) -> list:
     """Flatten every case's hard-SLO failure strings, prefixed by case."""
     out = []
     for r in results or []:
@@ -506,6 +532,9 @@ def _collect_slo_failures(results, connected_mesh, explain_ab=None,
     if scenario is not None:
         for msg in scenario.get("slo_failures") or []:
             out.append(f"ScenarioReplay: {msg}")
+    if planner_loop is not None:
+        for msg in planner_loop.get("slo_failures") or []:
+            out.append(f"PlannerLoop: {msg}")
     return out
 
 

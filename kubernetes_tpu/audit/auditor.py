@@ -138,6 +138,7 @@ class InvariantAuditor:
         self.violations: list[Violation] = []  # guarded by: self._lock
         self.by_invariant: dict[str, int] = {}  # guarded by: self._lock
         self.bundles: list[str] = []  # guarded by: self._lock
+        self.traces: list[str] = []  # guarded by: self._lock
         self.failed = False  # guarded by: self._lock
 
     # ---- one sweep -------------------------------------------------------
@@ -199,17 +200,62 @@ class InvariantAuditor:
                     self.by_invariant.get(v.invariant, 0) + 1
             path = write_bundle(self.audit_dir, v.invariant,
                                 self._bundle_payload(v, snap))
+            trace_path = None
             if path:
                 with self._lock:
                     self.bundles.append(path)
                     del self.bundles[:-MAX_BUNDLES]
-            _LOG.error("INVARIANT VIOLATION [%s]: %s (repro bundle: %s)",
-                       v.invariant, v.detail, path or "<write failed>")
+                trace_path = self._emit_trace(path)
+                if trace_path:
+                    with self._lock:
+                        self.traces.append(trace_path)
+                        del self.traces[:-MAX_BUNDLES]
+            _LOG.error("INVARIANT VIOLATION [%s]: %s (repro bundle: %s, "
+                       "incident trace: %s)",
+                       v.invariant, v.detail, path or "<write failed>",
+                       trace_path or "<none>")
         if fresh and self.fail_fast:
             with self._lock:  # embedding benches poll .failed cross-thread
                 self.failed = True
             raise InvariantViolationError(fresh)
         return fresh
+
+    def _emit_trace(self, bundle_path: str) -> Optional[str]:
+        """Auto-emit the replayable incident trace next to the repro
+        bundle — the same conversion ``ktpu scenario record --from-bundle``
+        runs, so every tripped invariant ships with a scenario replay of
+        its pending batch under the violation-time chaos seed. Best
+        effort: the bundle is the evidence, the trace is a convenience."""
+        try:
+            from kubernetes_tpu.scenario.record import (
+                TraceFormatError,
+                trace_from_bundle,
+            )
+            try:
+                trace = trace_from_bundle(bundle_path)
+            except TraceFormatError:
+                return None  # no pending batch: nothing to replay
+            fname = os.path.basename(bundle_path)
+            path = os.path.join(
+                os.path.dirname(bundle_path),
+                "incident-" + fname[len("audit-"):-len(".json")]
+                + ".trace.jsonl")
+            trace.save(path)
+            # rotate incident traces alongside their bundles
+            traces = sorted(
+                f for f in os.listdir(os.path.dirname(bundle_path))
+                if f.startswith("incident-") and f.endswith(".trace.jsonl"))
+            for old in traces[:-MAX_BUNDLES]:
+                try:
+                    os.remove(os.path.join(os.path.dirname(bundle_path),
+                                           old))
+                except OSError:
+                    pass
+            return path
+        except Exception:
+            LOOP_ERRORS.inc({"site": "audit_trace"})
+            _LOG.exception("incident trace emit failed (%s)", bundle_path)
+            return None
 
     def _bundle_payload(self, v: Violation, snap: AuditSnapshot) -> dict:
         pending_batch = [p for p in snap.api_pods
@@ -300,4 +346,5 @@ class InvariantAuditor:
                 "byInvariant": dict(self.by_invariant),
                 "bundleDir": self.audit_dir,
                 "bundles": list(self.bundles[-5:]),
+                "incidentTraces": list(self.traces[-5:]),
             }

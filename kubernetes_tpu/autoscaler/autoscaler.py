@@ -59,7 +59,8 @@ class ClusterAutoscaler:
                  scale_down_unneeded_s: float = 0.0,
                  seed: int = 0,
                  pending_source: Optional[Callable[[], list[Pod]]] = None,
-                 clock=None, status_namespace: str = "default"):
+                 clock=None, status_namespace: str = "default",
+                 resident=None):
         from kubernetes_tpu.utils import sanity
         problems = sanity.check_node_groups(provider.groups())
         if problems:
@@ -78,6 +79,10 @@ class ClusterAutoscaler:
         self.pending_source = pending_source
         self.clock = clock or REAL_CLOCK
         self.status_namespace = status_namespace
+        # resident fast path (encode/overlay.ResidentPlanner): when set,
+        # both simulations ride the scheduler's device-resident encoding;
+        # declines fall back to self.encoder cold
+        self.resident = resident
         self.encoder = SnapshotEncoder()  # persistent: stable intern ids
         self._cooldown_until: dict[str, float] = {}
         self._backoff_until: dict[str, float] = {}
@@ -150,7 +155,8 @@ class ClusterAutoscaler:
         if not eligible:
             return []
         options = simulate_scale_up(nodes, bound, pending, eligible,
-                                    headroom=headroom, encoder=self.encoder)
+                                    headroom=headroom, encoder=self.encoder,
+                                    resident=self.resident)
         choice = EXPANDERS[self.expander](options, seed=self.seed)
         if choice is None:
             return []
@@ -202,7 +208,8 @@ class ClusterAutoscaler:
         plan = simulate_scale_down(
             nodes, bound, candidates,
             utilization_threshold=self.utilization_threshold,
-            pdbs=pdbs, all_pod_dicts=pod_dicts, encoder=self.encoder)
+            pdbs=pdbs, all_pod_dicts=pod_dicts, encoder=self.encoder,
+            resident=self.resident)
         # unneeded-window gate (scale-down-unneeded-time): a node must stay
         # provably removable for the whole window before reclaim
         removable = []

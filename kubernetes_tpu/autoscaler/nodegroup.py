@@ -37,17 +37,38 @@ class NodeGroup:
     priority: int = 0                   # priority expander rank (higher wins)
     cooldown_s: float = 0.0             # min gap between scale-ups
     backoff_s: float = 30.0             # hold-off after a failed provision
+    # tenant-scoped pool: templates stamp the tenant label, so a scale-up
+    # simulation for tenant A's pending pods only matches A's templates
+    # (the tenant-pair filter vetoes cross-tenant placements device-side
+    # and cold-side identically)
+    tenant: Optional[str] = None
+    # DRA device classes this group's nodes expose: class -> device count.
+    # Stamped as dra:<class> allocatable, so scale-up simulation answers
+    # claim-carrying pending pods — a group without the device never looks
+    # like relief for a pod that needs it.
+    device_capacity: dict = field(default_factory=dict)
 
     def template_node(self, node_name: str) -> Node:
         """A concrete Node stamped from the template (labels copied so the
         caller can't alias the template's dicts)."""
         import dataclasses
+        from kubernetes_tpu.encode.snapshot import TENANT_LABEL
+        labels = {**self.template.metadata.labels,
+                  "kubernetes.io/hostname": node_name,
+                  NODE_GROUP_LABEL: self.name}
+        if self.tenant:
+            labels[TENANT_LABEL] = self.tenant
         meta = dataclasses.replace(
-            self.template.metadata, name=node_name,
-            labels={**self.template.metadata.labels,
-                    "kubernetes.io/hostname": node_name,
-                    NODE_GROUP_LABEL: self.name})
-        return dataclasses.replace(self.template, metadata=meta)
+            self.template.metadata, name=node_name, labels=labels)
+        node = dataclasses.replace(self.template, metadata=meta)
+        if self.device_capacity:
+            alloc = dict(node.status.allocatable)
+            for cls, count in self.device_capacity.items():
+                alloc[f"dra:{cls}"] = str(count)
+            node = dataclasses.replace(
+                node, status=dataclasses.replace(node.status,
+                                                 allocatable=alloc))
+        return node
 
 
 def load_node_group(d: dict) -> NodeGroup:
@@ -60,6 +81,9 @@ def load_node_group(d: dict) -> NodeGroup:
         priority=int(d.get("priority", 0)),
         cooldown_s=float(d.get("cooldownSeconds", 0.0)),
         backoff_s=float(d.get("backoffSeconds", 30.0)),
+        tenant=d.get("tenant") or None,
+        device_capacity={str(k): int(v)
+                         for k, v in (d.get("deviceCapacity") or {}).items()},
     )
 
 

@@ -97,10 +97,62 @@ def _binpack(requests: np.ndarray, fits: np.ndarray, capacity: np.ndarray,
     return placed, len(opened), waste
 
 
+def _waste_idx(resources: list) -> list[int]:
+    return [resources.index(r) for r in ("cpu", "memory") if r in resources]
+
+
+def _pack_options(groups: list[NodeGroup], headroom: Optional[dict],
+                  requests: np.ndarray, mask_lk: np.ndarray,
+                  caps: np.ndarray, waste_idx: list[int],
+                  ) -> list[ScaleUpOption]:
+    """Shared host core of scale-up: per-group binpack over a combined
+    feasibility matrix ``mask_lk`` [P, N_live + K] — live-node columns
+    first, one template column per group after. Identical whether the
+    mask came from the cold overlay encode or the resident dispatch, so
+    the two paths can only disagree if the masks do (the parity tests'
+    contract)."""
+    real_n = mask_lk.shape[1] - len(groups)
+    # a pod with a feasible existing node that also has resource room isn't
+    # the autoscaler's problem (mask already includes the fit filter)
+    fits_existing = mask_lk[:, :real_n].any(axis=1)
+    options = []
+    for k, g in enumerate(groups):
+        room = (headroom or {}).get(g.name, g.max_size)
+        if room <= 0:
+            continue
+        fits = mask_lk[:, real_n + k] & ~fits_existing
+        placed, opened, waste = _binpack(requests, fits, caps[k], room,
+                                         waste_idx)
+        if placed:
+            options.append(ScaleUpOption(group=g, pod_indices=placed,
+                                         nodes_needed=opened, waste=waste))
+    return options
+
+
+def _scale_up_resident(resident, nodes, bound_pods, pending, groups,
+                       templates, headroom) -> Optional[list[ScaleUpOption]]:
+    """Scale-up against the device-resident cluster image: template planes
+    overlay the resident encoding and ONE warm jitted dispatch answers all
+    (pending pod x candidate) questions. None on decline (the caller then
+    runs the cold encode below, producing an identical option list)."""
+    ctx = resident.plan_view(nodes, bound_pods, planner="autoscaler")
+    if ctx is None:
+        return None
+    out = resident.overlay_mask(ctx, templates, pending)
+    if out is None:
+        return None
+    mask_lk, caps, reqs = out
+    opts = _pack_options(groups, headroom, reqs, mask_lk, caps,
+                         _waste_idx(ctx["plan_meta"].resources))
+    resident.hit(ctx)
+    return opts
+
+
 def simulate_scale_up(nodes: list[Node], bound_pods: list[Pod],
                       pending: list[Pod], groups: list[NodeGroup],
                       headroom: Optional[dict[str, int]] = None,
                       encoder: Optional[SnapshotEncoder] = None,
+                      resident=None,
                       ) -> list[ScaleUpOption]:
     """Evaluate every candidate group's expansion against the pending set.
 
@@ -113,39 +165,34 @@ def simulate_scale_up(nodes: list[Node], bound_pods: list[Pod],
     Pods that already fit on an EXISTING node are excluded — scale-up must
     not provision for pods the scheduler merely hasn't reached yet
     (upstream filters these out via its scheduling simulation too).
+
+    ``resident`` (an encode/overlay.ResidentPlanner) short-circuits the
+    cold encode entirely in steady state: the simulation runs as one warm
+    dispatch on the scheduler's device-resident sharded encoding and the
+    whole body below is skipped. Any staleness or bucket overflow declines
+    back here — bit-identical either way.
     """
     if not pending or not groups:
         return []
+    templates = [g.template_node(f"{g.name}-hypothetical") for g in groups]
+    if resident is not None:
+        opts = _scale_up_resident(resident, nodes, bound_pods, pending,
+                                  groups, templates, headroom)
+        if opts is not None:
+            return opts
     enc = encoder or SnapshotEncoder()
     ct, meta = enc.encode_cluster(nodes, bound_pods, pending_pods=pending,
                                   pending_slots=False)
-    templates = [g.template_node(f"{g.name}-hypothetical") for g in groups]
     ct_over, rows = enc.with_hypothetical(ct, meta, templates)
     pb = enc.encode_pods(pending, meta)
     mask = np.asarray(run_filters(ct_over, pb))        # ONE call, all K
     P = len(pending)
     requests = np.asarray(pb.requests[:P], np.int64)
-
-    # a pod with a feasible existing node that also has resource room isn't
-    # the autoscaler's problem (mask already includes the fit filter)
     real_n = len(meta.node_names)
-    fits_existing = mask[:P, :real_n].any(axis=1)
-
-    waste_idx = [meta.resources.index(r) for r in ("cpu", "memory")
-                 if r in meta.resources]
-    options = []
-    for g, row in zip(groups, rows):
-        room = (headroom or {}).get(g.name, g.max_size)
-        if room <= 0:
-            continue
-        cap = np.asarray(ct_over.allocatable[row], np.int64)
-        fits = mask[:P, row] & ~fits_existing
-        placed, opened, waste = _binpack(requests, fits, cap, room,
-                                         waste_idx)
-        if placed:
-            options.append(ScaleUpOption(group=g, pod_indices=placed,
-                                         nodes_needed=opened, waste=waste))
-    return options
+    mask_lk = np.concatenate([mask[:P, :real_n], mask[:P][:, rows]], axis=1)
+    caps = np.asarray(ct_over.allocatable, np.int64)[rows]
+    return _pack_options(groups, headroom, requests, mask_lk, caps,
+                         _waste_idx(meta.resources))
 
 
 def drain_exempt(annotations: dict, owner_references: list) -> bool:
@@ -177,83 +224,40 @@ def _utilization(free: np.ndarray, alloc: np.ndarray,
     return best
 
 
-def simulate_scale_down(nodes: list[Node], bound_pods: list[Pod],
-                        candidates: list[str],
-                        utilization_threshold: float = 0.5,
-                        pdbs: Optional[list[dict]] = None,
-                        all_pod_dicts: Optional[list[dict]] = None,
-                        encoder: Optional[SnapshotEncoder] = None,
-                        ) -> ScaleDownPlan:
-    """Prove which candidate nodes can drain: every resident pod must fit
-    on some OTHER node per the tensor filters AND the remaining capacity
-    ledger (one shared ledger across candidates, so reclaiming two nodes in
-    one loop never double-books the survivors' room), and no eviction may
-    violate a PodDisruptionBudget (controllers/disruption.py semantics via
-    ``disruptions_allowed_for``).
-
-    All candidates' residents evaluate in ONE ``run_filters`` call.
-    """
-    from kubernetes_tpu.api.policy import _matches, compute_pdb_status
-
-    plan = ScaleDownPlan()
-    cand = [c for c in candidates]
-    if not cand:
-        return plan
-    enc = encoder or SnapshotEncoder()
-    ct, meta = enc.encode_cluster(nodes, bound_pods, pending_slots=False)
-    real_n = len(meta.node_names)
-    free = _free_matrix(ct, real_n)
-    alloc = np.asarray(ct.allocatable[:real_n], np.int64)
-    res_idx = [meta.resources.index(r) for r in ("cpu", "memory")
-               if r in meta.resources]
-
-    residents: dict[str, list[Pod]] = {c: [] for c in cand}
-    for p in bound_pods:
-        if p.spec.node_name in residents and not _daemon_or_mirror_pod(p):
-            residents[p.spec.node_name].append(p)
-
-    # utilization gate first — a busy node needs no re-placement proof
+def _scale_down_gate(plan: ScaleDownPlan, cand: list[str],
+                     node_index: dict, free: np.ndarray, alloc: np.ndarray,
+                     res_idx: list[int], threshold: float) -> list[str]:
+    """Utilization gate — a busy node needs no re-placement proof. Blocks
+    go on ``plan``; survivors come back in candidate order."""
     eligible = []
     for c in cand:
-        ni = meta.node_index.get(c)
+        ni = node_index.get(c)
         if ni is None:
             plan.blocked[c] = "unknown node"
             continue
         util = _utilization(free[ni], alloc[ni], res_idx)
-        if util > utilization_threshold:
+        if util > threshold:
             plan.blocked[c] = f"utilization {util:.2f} above threshold"
             continue
         eligible.append(c)
-    if not eligible:
-        return plan
+    return eligible
 
-    all_res = [p for c in eligible for p in residents[c]]
-    if all_res:
-        import dataclasses
-        # re-placement view: the evicted pod's replacement won't carry
-        # spec.nodeName, so the NodeName pin must not constrain the proof
-        unpinned = [dataclasses.replace(
-            p, spec=dataclasses.replace(p.spec, node_name=""))
-            for p in all_res]
-        pb = enc.encode_pods(unpinned, meta)
-        mask = np.asarray(run_filters(ct, pb))          # ONE call, all nodes
-        reqs = np.asarray(pb.requests[:len(all_res)], np.int64)
-    else:
-        mask = np.zeros((0, real_n), bool)
-        reqs = np.zeros((0, len(meta.resources)), np.int64)
-    offsets = {}
-    i = 0
-    for c in eligible:
-        offsets[c] = i
-        i += len(residents[c])
 
+def _scale_down_walk(plan: ScaleDownPlan, eligible: list[str],
+                     residents: dict, node_index: dict, node_names: list,
+                     free: np.ndarray, mask: np.ndarray, reqs: np.ndarray,
+                     offsets: dict, pdbs, pod_dicts) -> None:
+    """Shared host core of the scale-down proof: PDB budget charging plus
+    the shared capacity ledger walk. Identical across the cold and
+    resident paths — only the mask/reqs/free inputs differ in provenance,
+    never in value (the parity tests' contract)."""
+    from kubernetes_tpu.api.policy import _matches, compute_pdb_status
+
+    real_n = len(node_names)
     # PDB budgets: compute each budget's live disruptionsAllowed ONCE, then
     # CHARGE it per approved eviction — N guarded pods against a budget with
     # one disruption left must not each see "1 remaining" and all pass
     # (the Eviction API would 429 mid-drain after needless evictions).
-    pod_dicts = all_pod_dicts
-    if pod_dicts is None and pdbs:
-        pod_dicts = [p.to_dict() for p in bound_pods]
     pdb_state: list[tuple[dict, str, str, int]] = []
     for pdb in (pdbs or []):
         pmd = pdb.get("metadata") or {}
@@ -271,7 +275,7 @@ def simulate_scale_down(nodes: list[Node], bound_pods: list[Pod],
     receivers: set[int] = set()
     for c in eligible:
         res = residents[c]
-        ni = meta.node_index[c]
+        ni = node_index[c]
         if ni in receivers:
             # an earlier candidate's proof parked pods here; removing this
             # node too would invalidate that proof
@@ -308,7 +312,7 @@ def simulate_scale_down(nodes: list[Node], bound_pods: list[Pod],
                 if np.all(req <= trial[t]):
                     trial[t] -= req
                     trial_receivers.add(t)
-                    moves.append((p.key, meta.node_names[t]))
+                    moves.append((p.key, node_names[t]))
                     break
             else:
                 reason = f"pod {p.key} fits nowhere else"
@@ -322,4 +326,129 @@ def simulate_scale_down(nodes: list[Node], bound_pods: list[Pod],
         charged = trial_charge
         plan.removable.append(c)
         plan.placements[c] = moves
+
+
+def _unpin(pods: list[Pod]) -> list[Pod]:
+    """Re-placement view: the evicted pod's replacement won't carry
+    spec.nodeName, so the NodeName pin must not constrain the proof."""
+    import dataclasses
+    return [dataclasses.replace(
+        p, spec=dataclasses.replace(p.spec, node_name=""))
+        for p in pods]
+
+
+def _scale_down_resident(resident, nodes, bound_pods, cand, residents,
+                         threshold, pdbs, all_pod_dicts,
+                         ) -> Optional[ScaleDownPlan]:
+    """Scale-down against the device-resident cluster image: totals from
+    the host shadow, the re-placement mask from ONE warm jitted dispatch.
+    None on decline (the caller then runs the cold encode, producing an
+    identical plan)."""
+    ctx = resident.plan_view(nodes, bound_pods, planner="autoscaler")
+    if ctx is None:
+        return None
+    arrays = resident.cluster_arrays(ctx)
+    if arrays is None:
+        return None
+    alloc, req = arrays
+    free = alloc - req
+    pm = ctx["plan_meta"]
+    res_idx = _waste_idx(pm.resources)
+    plan = ScaleDownPlan()
+    eligible = _scale_down_gate(plan, cand, pm.node_index, free, alloc,
+                                res_idx, threshold)
+    if not eligible:
+        resident.hit(ctx)
+        return plan
+    all_res = [p for c in eligible for p in residents[c]]
+    ms = resident.mask_scores(ctx, _unpin(all_res))
+    if ms is None:
+        return None
+    mask, _scores, reqs = ms
+    offsets = {}
+    i = 0
+    for c in eligible:
+        offsets[c] = i
+        i += len(residents[c])
+    pod_dicts = all_pod_dicts
+    if pod_dicts is None and pdbs:
+        pod_dicts = [p.to_dict() for p in bound_pods]
+    _scale_down_walk(plan, eligible, residents, pm.node_index,
+                     pm.node_names, free, mask, reqs, offsets, pdbs,
+                     pod_dicts)
+    resident.hit(ctx)
+    return plan
+
+
+def simulate_scale_down(nodes: list[Node], bound_pods: list[Pod],
+                        candidates: list[str],
+                        utilization_threshold: float = 0.5,
+                        pdbs: Optional[list[dict]] = None,
+                        all_pod_dicts: Optional[list[dict]] = None,
+                        encoder: Optional[SnapshotEncoder] = None,
+                        resident=None,
+                        ) -> ScaleDownPlan:
+    """Prove which candidate nodes can drain: every resident pod must fit
+    on some OTHER node per the tensor filters AND the remaining capacity
+    ledger (one shared ledger across candidates, so reclaiming two nodes in
+    one loop never double-books the survivors' room), and no eviction may
+    violate a PodDisruptionBudget (controllers/disruption.py semantics via
+    ``disruptions_allowed_for``).
+
+    All candidates' residents evaluate in ONE ``run_filters`` call.
+
+    ``resident`` (an encode/overlay.ResidentPlanner) serves the whole
+    proof from the scheduler's device-resident encoding in steady state —
+    totals from the host shadow, the mask from one warm dispatch, no cold
+    encode. Declines fall through to the body below, bit-identically.
+    """
+    plan = ScaleDownPlan()
+    cand = [c for c in candidates]
+    if not cand:
+        return plan
+
+    residents: dict[str, list[Pod]] = {c: [] for c in cand}
+    for p in bound_pods:
+        if p.spec.node_name in residents and not _daemon_or_mirror_pod(p):
+            residents[p.spec.node_name].append(p)
+
+    if resident is not None:
+        out = _scale_down_resident(resident, nodes, bound_pods, cand,
+                                   residents, utilization_threshold, pdbs,
+                                   all_pod_dicts)
+        if out is not None:
+            return out
+
+    enc = encoder or SnapshotEncoder()
+    ct, meta = enc.encode_cluster(nodes, bound_pods, pending_slots=False)
+    real_n = len(meta.node_names)
+    free = _free_matrix(ct, real_n)
+    alloc = np.asarray(ct.allocatable[:real_n], np.int64)
+    res_idx = _waste_idx(meta.resources)
+
+    eligible = _scale_down_gate(plan, cand, meta.node_index, free, alloc,
+                                res_idx, utilization_threshold)
+    if not eligible:
+        return plan
+
+    all_res = [p for c in eligible for p in residents[c]]
+    if all_res:
+        pb = enc.encode_pods(_unpin(all_res), meta)
+        mask = np.asarray(run_filters(ct, pb))          # ONE call, all nodes
+        reqs = np.asarray(pb.requests[:len(all_res)], np.int64)
+    else:
+        mask = np.zeros((0, real_n), bool)
+        reqs = np.zeros((0, len(meta.resources)), np.int64)
+    offsets = {}
+    i = 0
+    for c in eligible:
+        offsets[c] = i
+        i += len(residents[c])
+
+    pod_dicts = all_pod_dicts
+    if pod_dicts is None and pdbs:
+        pod_dicts = [p.to_dict() for p in bound_pods]
+    _scale_down_walk(plan, eligible, residents, meta.node_index,
+                     meta.node_names, free, mask, reqs, offsets, pdbs,
+                     pod_dicts)
     return plan

@@ -709,6 +709,22 @@ def _scenario_line(sc: dict) -> str:
               f"speed {sc.get('speed', 1.0)}x\n")
 
 
+def _planner_line(pl: dict) -> str:
+    """One-line background-planner digest from the
+    ``kubernetes-tpu-planner-status`` ConfigMap: per-planner overlay
+    hit/decline counts plus the steady-window compile total."""
+    planners = pl.get("planners") or {}
+    parts = []
+    for name in ("autoscaler", "descheduler", "gangDefrag"):
+        p = planners.get(name) or {}
+        parts.append(f"{name} {p.get('hits', 0)}/{p.get('declines', 0)}")
+    interval = pl.get("intervalSeconds")
+    return (f"Planners:      {pl.get('cycles', 0)} cycles"
+            + (f" @ {interval}s" if interval is not None else "")
+            + f" — hits/declines: {', '.join(parts)} — "
+              f"steady compiles {pl.get('steadyCompiles', 0)}\n")
+
+
 def cmd_status(client: HTTPClient, args, out) -> int:
     """ktpu status: the connected scheduler's published deployment shape
     (the ``kubernetes-tpu-scheduler-status`` ConfigMap) — most importantly
@@ -754,12 +770,14 @@ def cmd_status(client: HTTPClient, args, out) -> int:
         return None
 
     from kubernetes_tpu.scenario.driver import SCENARIO_CONFIGMAP
+    from kubernetes_tpu.sched.bgplanner import PLANNER_CONFIGMAP
     from kubernetes_tpu.sched.fleet import FLEET_SCHED_CONFIGMAP
     fleet = _aux_cm(FLEET_CONFIGMAP, "fleet")
     fleet_sched = _aux_cm(FLEET_SCHED_CONFIGMAP, "fleetSched")
     durability = _aux_cm(APISERVER_CONFIGMAP, "durability")
     disruption = _aux_cm(NODELIFECYCLE_CONFIGMAP, "disruption")
     scenario = _aux_cm(SCENARIO_CONFIGMAP, "scenario")
+    planner = _aux_cm(PLANNER_CONFIGMAP, "status")
     frontdoor = _frontdoor_cm()
     try:
         cm = client.resource("configmaps", args.namespace).get(
@@ -772,6 +790,7 @@ def cmd_status(client: HTTPClient, args, out) -> int:
                                  ("durability", durability),
                                  ("disruption", disruption),
                                  ("scenario", scenario),
+                                 ("planner", planner),
                                  ("frontdoor", frontdoor))
                if v is not None}
         if aux:
@@ -792,6 +811,8 @@ def cmd_status(client: HTTPClient, args, out) -> int:
                     out.write(_fleet_sched_line(fleet_sched))
                 if scenario is not None:
                     out.write(_scenario_line(scenario))
+                if planner is not None:
+                    out.write(_planner_line(planner))
             return 0
         out.write("error: no scheduler status published "
                   f"(configmap {STATUS_CONFIGMAP!r} not found in "
@@ -810,6 +831,8 @@ def cmd_status(client: HTTPClient, args, out) -> int:
             st["disruption"] = disruption
         if scenario is not None:
             st["scenario"] = scenario
+        if planner is not None:
+            st["planner"] = planner
         if frontdoor is not None:
             st["frontdoor"] = frontdoor
         out.write(json.dumps(st) + "\n")
@@ -894,6 +917,8 @@ def cmd_status(client: HTTPClient, args, out) -> int:
         out.write(_fleet_sched_line(fleet_sched))
     if scenario is not None:
         out.write(_scenario_line(scenario))
+    if planner is not None:
+        out.write(_planner_line(planner))
     res = st.get("resilience")
     if res:
         degraded = (res.get("degradedIndex") or 0) > 0

@@ -76,6 +76,9 @@ class DeschedulerConfiguration:
     gang_defrag: bool = True
     gang_max_drain_nodes: int = 8
     requeue_bare_pods: bool = True
+    # tenant name -> max victims this tenant may contribute per cycle
+    # (enforced device-side in ONE quota-plane dispatch; absent = unlimited)
+    tenant_drain_quotas: dict = field(default_factory=dict)
     # strategy name -> kwargs for its builder (descheduler/strategies.py)
     strategies: dict = field(
         default_factory=lambda: dict(DEFAULT_STRATEGIES))
@@ -92,6 +95,10 @@ class DeschedulerConfiguration:
         ]:
             if yaml_key in d:
                 setattr(cfg, attr, type(getattr(cfg, attr))(d[yaml_key]))
+        if "tenantDrainQuotas" in d:
+            cfg.tenant_drain_quotas = {
+                str(k): int(v)
+                for k, v in (d["tenantDrainQuotas"] or {}).items()}
         if "profiles" in d:
             # profiles: [{name, strategies: {Name: {args}|null}}] — flattened
             # into one strategy map (single-framework runtime)
@@ -118,12 +125,17 @@ class Descheduler:
     not at the autoscaler's next observation)."""
 
     def __init__(self, client, config: Optional[DeschedulerConfiguration] = None,
-                 clock=None, autoscaler=None, status_namespace: str = "default"):
+                 clock=None, autoscaler=None, status_namespace: str = "default",
+                 resident=None):
         self.client = client
         self.config = config or DeschedulerConfiguration()
         self.clock = clock or REAL_CLOCK
         self.autoscaler = autoscaler
         self.status_namespace = status_namespace
+        # resident fast path (encode/overlay.ResidentPlanner): when set,
+        # the planner's one encode+mask rides the scheduler's device-
+        # resident encoding; declines fall back to self.encoder cold
+        self.resident = resident
         self.encoder = SnapshotEncoder()   # persistent: stable intern ids
         self._last: dict = {"cycle": None}
         self._stop = threading.Event()
@@ -183,7 +195,8 @@ class Descheduler:
             nodes, bound, candidates, pdbs=pdbs,
             all_pod_dicts=bound_dicts,
             encoder=self.encoder,
-            max_evictions=self.config.max_evictions_per_cycle)
+            max_evictions=self.config.max_evictions_per_cycle,
+            resident=self.resident)
         DESCHEDULER_PLAN_BATCH.set(plan.batch_victims,
                                    {"phase": "strategies"})
         gang_plans = []
@@ -196,7 +209,50 @@ class Descheduler:
             # gangless cycle: zero the gauge, or it reports the previous
             # cycle's batch forever (see _plan_gangs)
             DESCHEDULER_PLAN_BATCH.set(0, {"phase": "gangDefrag"})
+        self._apply_tenant_quotas(plan, gang_plans)
         return plan, gang_plans
+
+    def _apply_tenant_quotas(self, plan: EvictionPlan,
+                             gang_plans: list[GangDefragPlan]) -> None:
+        """Per-tenant drain-slot quotas, enforced DEVICE-SIDE: every
+        accepted victim rides one quota-plane dispatch
+        (encode/overlay.tenant_quota_mask) in execution order — strategy
+        sets first, then gangs, matching ``_execute``. A set containing
+        any victim ranked past its tenant's cap blocks WHOLE (half a
+        drain helps nobody); its victims still consume their slots, so
+        admission stays a pure function of the one dispatch's verdicts —
+        no host-side re-ranking or re-check. Unlabeled victims and
+        tenants without a configured quota are unlimited."""
+        quotas_cfg = self.config.tenant_drain_quotas
+        if not quotas_cfg:
+            return
+        from kubernetes_tpu.encode.overlay import tenant_quota_mask
+        from kubernetes_tpu.encode.snapshot import TENANT_LABEL
+        tenants = sorted(quotas_cfg)
+        t_index = {t: i for i, t in enumerate(tenants)}
+        quotas = [int(quotas_cfg[t]) for t in tenants]
+        sets = [(s, None) for s in plan.accepted]
+        sets += [(gp.accepted, gp) for gp in gang_plans
+                 if gp.accepted is not None]
+        victims = [p for s, _gp in sets for p in s.victims]
+        if not victims:
+            return
+        ids = [t_index.get(p.metadata.labels.get(TENANT_LABEL, ""), -1)
+               for p in victims]
+        allowed = tenant_quota_mask(ids, quotas)     # ONE dispatch
+        i = 0
+        for s, gp in sets:
+            n = len(s.victims)
+            ok = bool(allowed[i:i + n].all())
+            i += n
+            if ok:
+                continue
+            if gp is None:
+                plan.accepted = [x for x in plan.accepted if x is not s]
+                plan.blocked[s.name] = "tenant drain quota exceeded"
+            else:
+                gp.accepted = None
+                gp.blocked[s.name] = "tenant drain quota exceeded"
 
     def _plan_gangs(self, nodes, bound, pending, pdbs, bound_dicts,
                     already: int = 0, ledger=None,
@@ -227,7 +283,8 @@ class Descheduler:
                 max_evictions=max(budget, 0),
                 # one cycle, one ledger: this gang plans against the
                 # strategy plan's and every earlier gang's committed moves
-                ledger=ledger, claimed=claimed)
+                ledger=ledger, claimed=claimed,
+                resident=self.resident)
             ledger = gp.ledger or ledger
             batch_total += gp.batch_victims
             if gp.accepted is not None:
@@ -408,6 +465,7 @@ class Descheduler:
             "strategies": sorted(self.config.strategies),
             "gangDefrag": self.config.gang_defrag,
             "maxEvictionsPerCycle": self.config.max_evictions_per_cycle,
+            "tenantDrainQuotas": dict(self.config.tenant_drain_quotas),
             "lastCycle": self._last["cycle"],
         }
 

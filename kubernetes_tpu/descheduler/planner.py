@@ -173,15 +173,58 @@ class _Ledger:
         return None
 
 
+def _resident_encode_and_mask(resident, nodes, bound_pods, batch, planner):
+    """The `_encode_and_mask` question answered from the device-resident
+    cluster image: totals from the host shadow, feasibility + scores from
+    ONE warm jitted dispatch (no cold full encode). Victims stay resident
+    in the image — exactly the cold path's conservative semantics, so no
+    `without_pods` subtraction is needed; capacity release remains the
+    host ledger's job. None on decline."""
+    from types import SimpleNamespace
+    ctx = resident.plan_view(nodes, bound_pods, planner=planner)
+    if ctx is None:
+        return None
+    arrays = resident.cluster_arrays(ctx)
+    if arrays is None:
+        return None
+    alloc, req = arrays
+    ct_like = SimpleNamespace(allocatable=alloc, requested=req)
+    pm = ctx["plan_meta"]
+    if not batch:
+        resident.hit(ctx)
+        return None, ct_like, pm, np.zeros((0, 0), bool), None, None
+    ms = resident.mask_scores(ctx, batch, enabled=REPLACEMENT_FILTERS,
+                              want_scores=True)
+    if ms is None:
+        return None
+    mask, scores, reqs = ms
+    order = np.argsort(-scores, axis=1, kind="stable")
+    resident.hit(ctx)
+    return None, ct_like, pm, mask, order, reqs
+
+
 def _encode_and_mask(nodes: list[Node], bound_pods: list[Pod],
                      victims: list[Pod], extra_pods: list[Pod],
-                     encoder: Optional[SnapshotEncoder]):
+                     encoder: Optional[SnapshotEncoder], resident=None,
+                     planner: str = "descheduler"):
     """ONE encode + ONE run_filters + ONE combined_score over the union of
     all candidate victims plus any extra (gang) pods. This is the hot path
     the acceptance criterion pins: no per-candidate-set loop touches the
-    device."""
-    enc = encoder or SnapshotEncoder()
+    device.
+
+    With ``resident`` (an encode/overlay.ResidentPlanner) the cold encode
+    is skipped entirely in steady state — the same mask/scores come from
+    one warm dispatch on the scheduler's resident encoding; any decline
+    falls through to the cold path below, bit-identically. The returned
+    encoder slot is None on the resident path (nothing downstream uses
+    it)."""
     batch = _unpinned(victims) + list(extra_pods)
+    if resident is not None:
+        out = _resident_encode_and_mask(resident, nodes, bound_pods, batch,
+                                        planner)
+        if out is not None:
+            return out
+    enc = encoder or SnapshotEncoder()
     ct, meta = enc.encode_cluster(nodes, bound_pods, pending_pods=batch,
                                   pending_slots=False)
     if not batch:
@@ -197,12 +240,40 @@ def _encode_and_mask(nodes: list[Node], bound_pods: list[Pod],
     return enc, ct, meta, mask, order, reqs
 
 
+def _rebase_ledger(ledger: "_Ledger", meta, ct) -> None:
+    """Re-anchor a prior plan's committed ledger onto a fresh encode's
+    indexing (plans chained within ONE cycle — node set and order are
+    identical across the cycle's encodes). The fresh encode's RESOURCE
+    axis may still differ from the ledger's — a resident-path plan chained
+    into a cold-path plan sees the resident axis first, the cold union
+    axis second. Shared columns keep the ledger's committed free values
+    (same baseline within a cycle, so deltas carry over); a column only
+    the new axis has saw zero deltas by construction (no prior victim or
+    gang pod requested a resource its encode didn't know), so its fresh
+    ``alloc - requested`` baseline is exact."""
+    old_res = list(ledger.meta.resources)
+    new_res = list(meta.resources)
+    if old_res != new_res:
+        real_n = len(meta.node_names)
+        alloc = np.asarray(ct.allocatable[:real_n], np.int64)
+        req = np.asarray(ct.requested[:real_n], np.int64)
+        free2 = alloc - req
+        old_idx = {r: i for i, r in enumerate(old_res)}
+        for j, r in enumerate(new_res):
+            i = old_idx.get(r)
+            if i is not None:
+                free2[:, j] = ledger.free[:, i]
+        ledger.free = free2
+    ledger.meta = meta
+
+
 def plan_evictions(nodes: list[Node], bound_pods: list[Pod],
                    candidate_sets: list[CandidateSet],
                    pdbs: Optional[list[dict]] = None,
                    all_pod_dicts: Optional[list[dict]] = None,
                    encoder: Optional[SnapshotEncoder] = None,
-                   max_evictions: Optional[int] = None) -> EvictionPlan:
+                   max_evictions: Optional[int] = None,
+                   resident=None) -> EvictionPlan:
     """Validate every candidate set against one shared re-placement
     simulation. A set is accepted only when EVERY victim (not already
     claimed by an earlier accepted set) has a provable new home on a
@@ -211,6 +282,10 @@ def plan_evictions(nodes: list[Node], bound_pods: list[Pod],
     Sets evaluate in the given order; ``max_evictions`` caps the cycle's
     total eviction budget (sets that would exceed it block, they are not
     partially executed — half a drain helps nobody).
+
+    ``resident`` routes the one encode+mask through the scheduler's
+    device-resident encoding when fresh (see ``_encode_and_mask``) —
+    identical plans, zero cold encodes in steady state.
     """
     plan = EvictionPlan(batch_sets=len(candidate_sets))
     if not candidate_sets:
@@ -226,7 +301,7 @@ def plan_evictions(nodes: list[Node], bound_pods: list[Pod],
     if pdbs and all_pod_dicts is None:
         all_pod_dicts = [p.to_dict() for p in bound_pods]
     enc, ct, meta, mask, order, reqs = _encode_and_mask(
-        nodes, bound_pods, union, [], encoder)
+        nodes, bound_pods, union, [], encoder, resident=resident)
     ledger = _Ledger(ct, meta, pdbs, all_pod_dicts)
     plan.ledger = ledger
     claimed: set[str] = set()
@@ -304,9 +379,9 @@ def plan_evictions_naive(nodes: list[Node], bound_pods: list[Pod],
         if shared is None:
             shared = _Ledger(ct, meta, pdbs, all_pod_dicts)
         else:
-            # re-anchor the fresh encode's row indexing onto the shared
-            # ledger state (node sets are identical across encodes here)
-            shared.meta = meta
+            # re-anchor the fresh encode's row/resource indexing onto the
+            # shared ledger state (node sets are identical across encodes)
+            _rebase_ledger(shared, meta, ct)
         seen = {p.key: i for i, p in enumerate(cs.victims)}
         verdict = _try_set(cs, shared, meta, mask, order, reqs, seen,
                            claimed)
@@ -361,7 +436,8 @@ def plan_gang_defrag(nodes: list[Node], bound_pods: list[Pod],
                      encoder: Optional[SnapshotEncoder] = None,
                      max_evictions: Optional[int] = None,
                      ledger: Optional[_Ledger] = None,
-                     claimed: Optional[set] = None) -> GangDefragPlan:
+                     claimed: Optional[set] = None,
+                     resident=None) -> GangDefragPlan:
     """Pick the FEWEST-EVICTIONS candidate set under which (a) every victim
     provably re-places on a surviving node and (b) every gang member then
     fits (drained nodes included — consolidation frees them FOR the gang).
@@ -402,13 +478,14 @@ def plan_gang_defrag(nodes: list[Node], bound_pods: list[Pod],
     if pdbs and all_pod_dicts is None:
         all_pod_dicts = [p.to_dict() for p in bound_pods]
     enc, ct, meta, mask, order, reqs = _encode_and_mask(
-        nodes, bound_pods, union, gang_pods, encoder)
+        nodes, bound_pods, union, gang_pods, encoder, resident=resident,
+        planner="gangDefrag")
     g0 = len(union)
     if ledger is not None:
-        # re-anchor the fresh encode's row indexing onto the prior plan's
-        # committed state (node set and order are identical within a cycle)
+        # re-anchor the fresh encode's row/resource indexing onto the prior
+        # plan's committed state (node set/order are identical in a cycle)
         base = ledger
-        base.meta = meta
+        _rebase_ledger(base, meta, ct)
     else:
         base = _Ledger(ct, meta, pdbs, all_pod_dicts)
     plan.ledger = base

@@ -1,0 +1,542 @@
+"""Resident overlay planning — three planners, one cluster image.
+
+The live scheduling path keeps ONE device-resident sharded cluster encoding
+(the drain context: encode once, fold winners device-side, patch churn).
+The background planners — autoscaler scale-up/scale-down simulation,
+descheduler eviction validation, gang-defrag prefix probing — historically
+re-encoded the whole cluster cold, single-device, private-encoder, every
+cycle. This module points them at the resident image instead:
+
+- ``ResidentPlanner`` adapts the scheduler's drain context into the three
+  planners' shapes: a row permutation onto the planner's observed node
+  list, host alloc/requested mirrors served by the staging shadow (zero
+  device round-trips in steady state), and derived pod batches encoded
+  against the RESIDENT meta under the cache's encode lock.
+- The jitted programs below answer every planner question as ONE warm
+  dispatch on the resident tensors: ``_plan_mask_program`` (feasibility
+  mask + optional scores for eviction re-placement and scale-down),
+  ``_overlay_mask_program`` (K node-group template rows appended to the
+  node axis for scale-up — ``with_hypothetical`` without leaving the
+  device), and ``_quota_program`` (the per-tenant drain-slot quota plane).
+- Anything the resident image cannot answer EXACTLY — tainted context,
+  mesh-epoch mismatch, unfolded deltas, node/bound-set skew vs. the
+  planner's observation, a template or batch that overflows a resident
+  bucket, a pod requesting a resource off the resident axis — DECLINES
+  (counted, per planner, per reason) and the caller runs its existing
+  cold-encode path. Plans are bit-identical either way: the parity tests
+  in tests/test_planner.py fuzz exactly this equivalence.
+
+Two algebraic facts make the overlay exact rather than approximate:
+
+1. Nominee reservations: the resident image may carry an M-bucketed
+   nominee plane; the planners' cold encodes carry M=0. Zeroing
+   ``nom_valid`` makes the fit filter's reservation prefix-sums the
+   identity (every slot's priority collapses to -inf, reserved requests to
+   zero), which is bit-identical to an M=0 encode.
+2. Resource-axis superset: the resident axis may carry resources no
+   current pod requests (historic bound pods). Such a column contributes
+   requested=0 for every pod and node, so fit comparisons, score
+   fractions (fixed cpu/memory columns) and ledger arithmetic are
+   unchanged by the extra column.
+
+The ``label_value_num`` / ``image_sizes`` tables are the one part of the
+resident image allowed to go stale (interning appends host-side between
+full encodes), so every program takes FRESHLY built tables as inputs —
+tiny replicated vectors, rebuilt per dispatch under the encode lock.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+from dataclasses import dataclass
+from functools import partial
+from types import SimpleNamespace
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.encode.dictionary import next_bucket
+from kubernetes_tpu.encode.scaling import UNLIMITED, scale_allocatable
+from kubernetes_tpu.encode.snapshot import EFFECTC, NODE_NAME_LABEL
+from kubernetes_tpu.metrics.registry import SCHEDULER_PLANNER_OVERLAY
+from kubernetes_tpu.ops.filters import run_filters
+from kubernetes_tpu.ops.scores import combined_score
+
+_LOG = logging.getLogger(__name__)
+
+# every ClusterTensors field with the node bucket as axis 0 — the set the
+# template overlay widens (matches encode_cluster's node-side fill)
+_NODE_AXIS_FIELDS = (
+    "allocatable", "requested", "node_valid", "unschedulable",
+    "node_labels", "taint_key", "taint_val", "taint_effect", "taint_valid",
+    "port_proto", "port_port", "port_ip", "port_valid", "node_images",
+    "used_rwo", "used_rwo_valid", "attach_used", "attach_limit",
+)
+
+
+@dataclass
+class PlanMeta:
+    """Host-side stand-in for ``SnapshotMeta`` on resident planner paths:
+    the live node list in the PLANNER's observation order plus the resident
+    resource axis — exactly the fields the host-side ledgers, binpacks and
+    move records consume (``node_names``/``node_index``/``resources``)."""
+
+    resources: list
+    node_names: list
+    node_index: dict
+    generation: int = 0
+
+
+# ---- jitted planner programs ------------------------------------------------
+
+@partial(jax.jit, static_argnames=("enabled", "want_scores"))
+def _plan_mask_program(ct, pb, label_value_num, image_sizes, enabled,
+                       want_scores):
+    """Feasibility mask (and optionally scores) for a derived pod batch
+    against the resident image: fresh intern tables swapped in, nominee
+    plane neutralized (identical to an M=0 cold encode — see module doc)."""
+    ct = ct.replace(label_value_num=label_value_num, image_sizes=image_sizes,
+                    nom_valid=jnp.zeros_like(ct.nom_valid))
+    mask = run_filters(ct, pb, enabled)
+    if not want_scores:
+        return mask
+    return mask, combined_score(ct, pb, mask)
+
+
+def _overlay_ct(ct, planes):
+    """Append the K-bucketed template planes to every node-axis field and
+    swap in the fresh tables — ``with_hypothetical`` as a traced program."""
+    ext = {f: jnp.concatenate([getattr(ct, f), planes[f]], axis=0)
+           for f in _NODE_AXIS_FIELDS}
+    return ct.replace(label_value_num=planes["label_value_num"],
+                      image_sizes=planes["image_sizes"], **ext)
+
+
+@jax.jit
+def _overlay_ct_program(ct, planes):
+    return _overlay_ct(ct, planes)
+
+
+@jax.jit
+def _overlay_mask_program(ct, planes, pb):
+    ct2 = _overlay_ct(ct, planes)
+    ct2 = ct2.replace(nom_valid=jnp.zeros_like(ct2.nom_valid))
+    return run_filters(ct2, pb)
+
+
+@jax.jit
+def _quota_program(victim_tenant, quotas):
+    """allowed[v] = this victim's 0-based rank among ITS tenant's victims
+    (in eviction order) is below the tenant's quota. -1 tenant or -1 quota
+    = unlimited. ONE dispatch decides the whole cycle's quota verdicts."""
+    T = quotas.shape[0]
+    hot = victim_tenant[:, None] == jnp.arange(T, dtype=victim_tenant.dtype)
+    rank = jnp.cumsum(hot.astype(jnp.int32), axis=0) - 1
+    my_rank = jnp.sum(jnp.where(hot, rank, 0), axis=1)
+    lim = jnp.where(quotas < 0, jnp.int32(UNLIMITED), quotas)
+    my_lim = jnp.where(victim_tenant >= 0,
+                       lim[jnp.clip(victim_tenant, 0, T - 1)],
+                       jnp.int32(UNLIMITED))
+    return my_rank < my_lim
+
+
+@jax.jit
+def _without_program(ct, slot_rows, node_rows, req_delta):
+    # padding entries carry out-of-bounds indices, which JAX scatters DROP
+    requested = ct.requested.at[node_rows].add(-req_delta)
+    epod_valid = ct.epod_valid.at[slot_rows].set(False)
+    return ct.replace(requested=requested, epod_valid=epod_valid)
+
+
+def tenant_quota_mask(tenant_ids: list, quotas: list) -> np.ndarray:
+    """Device-side per-tenant drain-slot quota plane. ``tenant_ids``: one
+    int per victim in eviction order — an index into ``quotas`` (-1 =
+    untenanted/unquotaed -> unlimited); ``quotas``: per-tenant eviction
+    caps (-1 = unlimited). Returns the allowed[V] verdicts — the caller
+    blocks any set containing a disallowed victim, with no host-side
+    re-derivation of the arithmetic (power-of-two buckets keep the
+    program warm across cycles)."""
+    V = next_bucket(len(tenant_ids), minimum=1)
+    T = next_bucket(len(quotas), minimum=1)
+    vt = np.full(V, -1, np.int32)
+    vt[:len(tenant_ids)] = np.asarray(tenant_ids, np.int32)
+    q = np.full(T, -1, np.int32)
+    q[:len(quotas)] = np.asarray(quotas, np.int32)
+    return np.asarray(_quota_program(vt, q))[:len(tenant_ids)]
+
+
+# ---- host-side builders (call under the owning encoder's lock) --------------
+
+def _fresh_tables(enc, V: int, IMG: int):
+    """label_value_num/image_sizes rebuilt from the encoder's CURRENT
+    intern tables at the resident bucket widths; None when either table
+    outgrew its resident bucket (structural — the next full encode will
+    widen it)."""
+    if len(enc.values) > V or len(enc._image_sizes) > IMG:
+        return None
+    lvn = np.full(V, np.nan, np.float32)
+    nums = enc.values.numeric_values()
+    lvn[:len(nums)] = np.asarray(nums, np.float32)
+    isz = np.zeros(IMG, np.float32)
+    isz[:len(enc._image_sizes)] = enc._image_sizes
+    return lvn, isz
+
+
+def _template_planes(enc, resources, ct, templates) -> Optional[dict]:
+    """Node-axis plane rows for K hypothetical template nodes at the
+    RESIDENT bucket widths (same fill logic as ``with_hypothetical``'s
+    numpy path), plus fresh tables. None when a template overflows a
+    resident bucket (new label key past K, taints past T, value past V)."""
+    from kubernetes_tpu.sched.volumebinding import node_attach_limit
+    Kdev = ct.node_labels.shape[1]
+    Tdev = ct.taint_key.shape[1]
+    PRT = ct.port_proto.shape[1]
+    I = ct.node_images.shape[1]
+    VN = ct.used_rwo.shape[1]
+    R = ct.allocatable.shape[1]
+    tmpl_labels = [enc._label_ids(n.metadata.labels,
+                                  {NODE_NAME_LABEL: n.metadata.name})
+                   for n in templates]
+    tmpl_taints = [[(enc.keys.intern(t.key), enc.values.intern(t.value),
+                     EFFECTC.get(t.effect, 0)) for t in n.spec.taints]
+                   for n in templates]
+    # only the TEMPLATES' label keys must address node_labels columns —
+    # pod-side keys interned after the cluster encode (e.g. a gang label)
+    # grow the shared table past Kdev without touching any node row
+    if any(kid >= Kdev for ids in tmpl_labels for kid in ids):
+        return None
+    if max((len(t) for t in tmpl_taints), default=0) > Tdev:
+        return None
+    tables = _fresh_tables(enc, ct.label_value_num.shape[0],
+                           ct.image_sizes.shape[0])
+    if tables is None:
+        return None
+    KB = next_bucket(len(templates), minimum=1)
+    planes = dict(
+        allocatable=np.zeros((KB, R), np.int32),
+        requested=np.zeros((KB, R), np.int32),
+        node_valid=np.zeros(KB, bool),
+        unschedulable=np.zeros(KB, bool),
+        node_labels=np.full((KB, Kdev), -1, np.int32),
+        taint_key=np.full((KB, Tdev), -1, np.int32),
+        taint_val=np.full((KB, Tdev), -1, np.int32),
+        taint_effect=np.full((KB, Tdev), -1, np.int32),
+        taint_valid=np.zeros((KB, Tdev), bool),
+        port_proto=np.full((KB, PRT), -1, np.int32),
+        port_port=np.full((KB, PRT), -1, np.int32),
+        port_ip=np.full((KB, PRT), -1, np.int32),
+        port_valid=np.zeros((KB, PRT), bool),
+        node_images=np.full((KB, I), -1, np.int32),
+        used_rwo=np.full((KB, VN), -1, np.int32),
+        used_rwo_valid=np.zeros((KB, VN), bool),
+        attach_used=np.zeros(KB, np.int32),
+        attach_limit=np.full(KB, UNLIMITED, np.int32),
+    )
+    for k, n in enumerate(templates):
+        planes["node_valid"][k] = True
+        planes["unschedulable"][k] = n.spec.unschedulable
+        alloc = n.allocatable_canonical()
+        for r_idx, r in enumerate(resources):
+            if r in alloc:
+                planes["allocatable"][k, r_idx] = min(
+                    scale_allocatable(r, alloc[r]), UNLIMITED)
+            elif r == "pods":
+                planes["allocatable"][k, r_idx] = UNLIMITED
+        for kid, vid in tmpl_labels[k].items():
+            planes["node_labels"][k, kid] = vid
+        for t_idx, (tk, tv, te) in enumerate(tmpl_taints[k]):
+            planes["taint_key"][k, t_idx] = tk
+            planes["taint_val"][k, t_idx] = tv
+            planes["taint_effect"][k, t_idx] = te
+            planes["taint_valid"][k, t_idx] = True
+        lim = node_attach_limit(n.status.allocatable)
+        if lim >= 0:
+            planes["attach_limit"][k] = lim
+    planes["label_value_num"], planes["image_sizes"] = tables
+    return planes
+
+
+def resident_with_hypothetical(encoder, ct, meta, nodes):
+    """``with_hypothetical`` against a device-resident encoding: template
+    planes host-built at the resident widths, appended by ONE jitted
+    concatenate — the image never round-trips. Returns (ct_over, rows)
+    with ct_over still resident, or None on bucket overflow (the encoder
+    method then falls back to the host path). Call under whatever lock
+    guards the encoder's intern tables."""
+    planes = _template_planes(encoder, meta.resources, ct, nodes)
+    if planes is None:
+        return None
+    N = ct.node_valid.shape[0]
+    return _overlay_ct_program(ct, planes), list(range(N, N + len(nodes)))
+
+
+def resident_without_pods(st, ct, pod_keys):
+    """``without_pods`` against a device-resident encoding: the victims'
+    request vectors leave ``requested`` and their epod rows invalidate via
+    one jitted scatter. ``st``: the encoder's patch state (the caller
+    already validated generation/patchability/slot membership)."""
+    keys = sorted(set(pod_keys))
+    B = next_bucket(len(keys), minimum=1)
+    E = ct.epod_valid.shape[0]
+    N, R = ct.requested.shape
+    slot_rows = np.full(B, E, np.int32)   # out-of-bounds pad: dropped
+    node_rows = np.full(B, N, np.int32)
+    req_delta = np.zeros((B, R), np.int32)
+    for i, k in enumerate(keys):
+        slot_rows[i] = st.slot_of[k]
+        node_rows[i] = st.slot_node[k]
+        req_delta[i] = st.slot_req[k]
+    return _without_program(ct, slot_rows, node_rows, req_delta)
+
+
+# ---- compile accounting -----------------------------------------------------
+
+class CompileCounter:
+    """Counts XLA ``backend_compile`` events inside armed windows via
+    ``jax.monitoring`` — the FleetChurn compile gate generalized so the
+    BackgroundPlanner cadence and the PlannerLoop bench share one
+    mechanism for proving a zero-compile steady window."""
+
+    def __init__(self):
+        self.count = 0
+        self._armed = False
+        self._lock = threading.Lock()
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(self._on_event)
+
+    def _on_event(self, event, duration, **kwargs):
+        if "backend_compile" in event:
+            with self._lock:
+                if self._armed:
+                    self.count += 1
+
+    def arm(self) -> None:
+        with self._lock:
+            self._armed = True
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed = False
+
+    def take(self) -> int:
+        with self._lock:
+            return self.count
+
+
+# ---- the planner adapter ----------------------------------------------------
+
+class ResidentPlanner:
+    """Adapter giving the three background planners resident fast paths.
+
+    ``view_source``: ``Scheduler.resident_plan_view`` — ``() -> (view |
+    None, reason)``, the PR-17 ``_resident_wave_view`` contract (untainted
+    + mesh-epoch-current + all-folded delta log) with decline reasons.
+    ``cache``: the scheduler cache owning the live encoder and encode lock.
+
+    Every method returns None on decline and counts (planner, reason);
+    the caller then runs its existing cold-encode path, which produces a
+    bit-identical plan — residency is a latency optimization, never a
+    semantic fork. Callers report success with ``hit(ctx)`` exactly once
+    per fully-resident plan.
+    """
+
+    def __init__(self, view_source: Callable, cache):
+        self._view_source = view_source
+        self.cache = cache
+        self.hits: dict = {}
+        self.declines: dict = {}
+
+    # -- accounting ---------------------------------------------------------
+
+    def _decline(self, planner: str, reason: str):
+        d = self.declines.setdefault(planner, {})
+        d[reason] = d.get(reason, 0) + 1
+        SCHEDULER_PLANNER_OVERLAY.inc({"planner": planner,
+                                       "outcome": "decline"})
+        return None
+
+    def hit(self, ctx: dict) -> None:
+        planner = ctx["planner"]
+        self.hits[planner] = self.hits.get(planner, 0) + 1
+        SCHEDULER_PLANNER_OVERLAY.inc({"planner": planner, "outcome": "hit"})
+
+    def stats(self) -> dict:
+        return {"hits": dict(self.hits),
+                "declines": {k: dict(v) for k, v in self.declines.items()}}
+
+    # -- view ---------------------------------------------------------------
+
+    def plan_view(self, nodes, bound_pods, planner: str) -> Optional[dict]:
+        """The resident image row-permuted onto THIS planner's observed
+        node list, or None. Beyond the scheduler-side freshness checks,
+        the planner's observation must agree with the image: same node
+        set, same bound-pod set (the planners observe through the API
+        client; any skew vs. the cache means the cold encode would see a
+        different cluster than the image holds)."""
+        view, reason = self._view_source()
+        if view is None:
+            return self._decline(planner, reason)
+        meta = view["meta"]
+        cs = view["cs"]
+        names = {n.metadata.name for n in nodes}
+        if names != {n.metadata.name for n in view["nodes"]}:
+            return self._decline(planner, "node_set_skew")
+        bound_keys = {p.key for p in bound_pods
+                      if p.spec.node_name in names}
+        if bound_keys != set(cs.slot_of):
+            return self._decline(planner, "bound_set_skew")
+        rows = np.asarray([meta.node_index[n.metadata.name] for n in nodes],
+                          np.int32)
+        plan_meta = PlanMeta(
+            resources=list(cs.resources),
+            node_names=[n.metadata.name for n in nodes],
+            node_index={n.metadata.name: i for i, n in enumerate(nodes)},
+            generation=meta.generation)
+        return {"view": view, "ct": view["ct"], "meta": meta, "cs": cs,
+                "rows": rows, "mesh": view.get("mesh"),
+                "plan_meta": plan_meta, "planner": planner}
+
+    # -- cluster totals ------------------------------------------------------
+
+    def cluster_arrays(self, ctx: dict):
+        """(allocatable, requested) int64 [N_live, R_resident] in the
+        planner's node order — served from the staging shadow's host
+        mirrors (zero device traffic) or one device_get fallback."""
+        view = ctx["view"]
+        cs = ctx["cs"]
+        got = None
+        shadow = view.get("shadow")
+        if shadow is not None:
+            shadow.catch_up(
+                lambda p: self.cache.request_vector(p, cs.resources))
+            got = shadow.arrays()
+        if got is None:
+            try:
+                # ktpu-lint: disable=KTL005 -- shadow-miss fallback only; steady state serves totals from the staging shadow's host mirrors (PlannerLoop gates the window at zero declines)
+                got = jax.device_get(
+                    (ctx["ct"].allocatable, ctx["ct"].requested))
+            except Exception:
+                _LOG.exception("resident totals readback failed; planner "
+                               "falls back to the cold encode")
+                return self._decline(ctx["planner"], "readback")
+        alloc_res, req_res = got
+        rows = ctx["rows"]
+        return (np.asarray(alloc_res, np.int64)[rows],
+                np.asarray(req_res, np.int64)[rows])
+
+    # -- derived pod batches -------------------------------------------------
+
+    def _covered(self, enc, pods, resources) -> bool:
+        res = set(resources)
+        for p in pods:
+            if any(r not in res for r in enc._effective_requests(p)):
+                return False
+        return True
+
+    def pod_batch(self, ctx: dict, pods):
+        """Encode a derived batch (unpinned victims, gang pods, pending
+        pods) against the RESIDENT meta under the encode lock, plus fresh
+        tables. Declines when a pod requests a resource off the resident
+        axis (encode_pods would silently drop it) or a table outgrew its
+        bucket. The meta is shallow-copied: encode_pods stamps
+        ``meta.pod_keys`` and the drain's own meta must not see it."""
+        meta = ctx["meta"]
+        ct = ctx["ct"]
+        V = ct.label_value_num.shape[0]
+        IMG = ct.image_sizes.shape[0]
+
+        def fn(enc):
+            if not self._covered(enc, pods, meta.resources):
+                return "resource_axis"
+            pb = enc.encode_pods(list(pods), copy.copy(meta),
+                                 cache_rows=False)
+            tables = _fresh_tables(enc, V, IMG)
+            if tables is None:
+                return "table_bucket"
+            return pb, tables
+
+        out = self.cache.with_encoder(fn)
+        if isinstance(out, str):
+            return self._decline(ctx["planner"], out)
+        return out
+
+    # -- warm dispatches -----------------------------------------------------
+
+    def mask_scores(self, ctx: dict, pods, enabled=None,
+                    want_scores: bool = False):
+        """ONE jitted dispatch answering a batch's feasibility (and
+        optionally scores) against the resident image. Returns
+        (mask [P, N_live], scores [P, N_live] | None, reqs [P, R] int64)
+        gathered into the planner's node order, or None on decline."""
+        if not pods:
+            n = len(ctx["plan_meta"].node_names)
+            return (np.zeros((0, n), bool), None,
+                    np.zeros((0, len(ctx["plan_meta"].resources)), np.int64))
+        out = self.pod_batch(ctx, pods)
+        if out is None:
+            return None
+        pb, (lvn, isz) = out
+        P = len(pods)
+        reqs = np.asarray(pb.requests[:P], np.int64)
+        mesh = ctx.get("mesh")
+        if mesh is not None:
+            from kubernetes_tpu.parallel.mesh import replicated, shard_batch
+            pb = shard_batch(mesh, pb)
+            rep = replicated(mesh)
+            lvn = jax.device_put(lvn, rep)
+            isz = jax.device_put(isz, rep)
+        res = _plan_mask_program(ctx["ct"], pb, lvn, isz, enabled,
+                                 want_scores)
+        rows = ctx["rows"]
+        if want_scores:
+            mask, scores = res
+            return (np.asarray(mask)[:P][:, rows],
+                    np.asarray(scores)[:P][:, rows], reqs)
+        return np.asarray(res)[:P][:, rows], None, reqs
+
+    def overlay_mask(self, ctx: dict, templates, pods):
+        """Scale-up: K template rows appended to the resident image, ONE
+        jitted run_filters over every (pending pod x candidate) question.
+        Returns (mask [P, N_live + K] — live columns first, template
+        columns after in group order — caps [K, R] and reqs [P, R], both
+        int64 on the resident resource axis), or None."""
+        if not pods or not templates:
+            return None
+        meta = ctx["meta"]
+        ct = ctx["ct"]
+
+        def fn(enc):
+            if not self._covered(enc, pods, meta.resources):
+                return "resource_axis"
+            planes = _template_planes(enc, meta.resources, ct, templates)
+            if planes is None:
+                return "template_bucket"
+            pb = enc.encode_pods(list(pods), copy.copy(meta),
+                                 cache_rows=False)
+            return planes, pb
+
+        out = self.cache.with_encoder(fn)
+        if isinstance(out, str):
+            return self._decline(ctx["planner"], out)
+        planes, pb = out
+        P = len(pods)
+        K = len(templates)
+        caps = planes["allocatable"][:K].astype(np.int64)
+        reqs = np.asarray(pb.requests[:P], np.int64)
+        mesh = ctx.get("mesh")
+        planes_in = planes
+        if mesh is not None:
+            from kubernetes_tpu.parallel.mesh import replicated, shard_batch
+            pb = shard_batch(mesh, pb)
+            rep = replicated(mesh)
+            planes_in = {k: jax.device_put(v, rep)
+                         for k, v in planes.items()}
+        mask = np.asarray(_overlay_mask_program(ctx["ct"], planes_in, pb))
+        N = ct.node_valid.shape[0]
+        live = mask[:P][:, ctx["rows"]]
+        tmpl = mask[:P, N:N + K]
+        return np.concatenate([live, tmpl], axis=1), caps, reqs

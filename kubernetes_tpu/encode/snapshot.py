@@ -327,6 +327,13 @@ class SnapshotMeta:
     generation: int = 0
 
 
+def _is_device_backed(ct: ClusterTensors) -> bool:
+    """True when the encoding's arrays live on device (a drain-context
+    resident image) rather than host numpy — the overlay methods route
+    these through encode/overlay.py so the image never round-trips."""
+    return not isinstance(ct.node_valid, np.ndarray)
+
+
 def _resource_union(nodes: list[Node], pods: list[Pod]) -> list[str]:
     seen = ["cpu", "memory", "pods"]
     seen_set = set(seen)
@@ -457,6 +464,18 @@ class SnapshotEncoder:
         slices extend node allocatable, claim demands extend pod requests."""
         self._dra = catalog
         self._pod_epoch += 1  # precompiled pod records may embed stale state
+
+    @property
+    def dra(self):
+        """The attached DRA catalog (or None). Background planners sync
+        their cold-fallback encoders to the cache encoder's catalogs so a
+        resident overlay and its cold baseline gate claims identically."""
+        return self._dra
+
+    @property
+    def volumes(self):
+        """The attached volume catalog (or None); see ``dra``."""
+        return self._volumes
 
     @property
     def cluster_depends_on_namespace_labels(self) -> bool:
@@ -754,10 +773,26 @@ class SnapshotEncoder:
         encode the cluster with the pending pods so R already covers them.
 
         Returns (overlaid tensors, row index per hypothetical node).
+
+        Handed a DEVICE-RESIDENT encoding (the scheduler's drain-context
+        tensors), the overlay stays resident: template planes are built
+        host-side at the resident bucket widths and appended with ONE
+        jitted concatenate program — no device_get of the cluster image.
+        A template that overflows a resident bucket (new label key past K,
+        more taints than T, a value past V) falls back to pulling the
+        tensors host-side and running the numpy path below — correct,
+        just cold (encode/overlay.py's planners decline instead).
         """
         K = len(nodes)
         if K == 0:
             return ct, []
+        if _is_device_backed(ct):
+            from kubernetes_tpu.encode import overlay
+            out = overlay.resident_with_hypothetical(self, ct, meta, nodes)
+            if out is not None:
+                return out
+            import jax
+            ct = jax.tree_util.tree_map(np.asarray, ct)
         N = ct.node_valid.shape[0]
         N2 = next_bucket(N + K, minimum=1)
         rows = list(range(N, N + K))
@@ -858,6 +893,10 @@ class SnapshotEncoder:
         key is outside the current patch state or carries port/volume node
         state an overlay cannot reconstruct — callers fall back to a full
         re-encode without the victims.
+
+        A DEVICE-RESIDENT encoding stays resident: the subtraction runs as
+        one jitted scatter against the live tensors (the planners' "what
+        if these evictions happened" view without a device_get).
         """
         st = self._patch
         if st is None or st.generation != meta.generation:
@@ -866,6 +905,9 @@ class SnapshotEncoder:
             return None
         if any(k not in st.slot_of for k in pod_keys):
             return None
+        if _is_device_backed(ct):
+            from kubernetes_tpu.encode import overlay
+            return overlay.resident_without_pods(st, ct, pod_keys)
         requested = np.array(ct.requested)
         epod_valid = np.array(ct.epod_valid)
         for k in set(pod_keys):

@@ -515,6 +515,25 @@ DESCHEDULER_LOOP_DURATION = REGISTRY.histogram(
     "descheduler_loop_duration_seconds",
     "One descheduler cycle by phase (plan|evict)")
 
+# The resident background-planner loop (sched/bgplanner.py + encode/
+# overlay.py): the three planners' what-if questions answered as warm
+# dispatches on the device-resident cluster image, with decline-to-cold
+# fallbacks and a compile gate over the steady window.
+SCHEDULER_PLANNER_OVERLAY = REGISTRY.counter(
+    "scheduler_planner_overlay_total",
+    "Resident-overlay planning attempts by planner (autoscaler|"
+    "descheduler|gangDefrag) and outcome (hit|decline) — a decline falls "
+    "back to the cold-encode path with a bit-identical plan")
+SCHEDULER_PLANNER_CYCLE_DURATION = REGISTRY.histogram(
+    "scheduler_planner_cycle_duration_seconds",
+    "One BackgroundPlanner sub-cycle by planner (autoscaler|descheduler|"
+    "gangDefrag) — the per-planner span accounting the PlannerLoop bench "
+    "reads")
+SCHEDULER_PLANNER_COMPILES = REGISTRY.counter(
+    "scheduler_planner_compiles_total",
+    "XLA backend_compile events observed inside armed BackgroundPlanner "
+    "windows (must stay 0 in the steady window)")
+
 # The read-replica serving plane ("front door"): sharded watch fan-out with
 # bounded per-watcher queues on every apiserver, follower replicas serving
 # list/watch with a bounded-staleness contract.

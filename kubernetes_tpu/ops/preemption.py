@@ -68,16 +68,25 @@ def _dry_run(allocatable, requested, static_mask, vic_req, vic_valid,
     return any_f, k_min, take(viol_cum), take(prio_cummax)
 
 
-def _static_mask(nodes: list[Node], pod: Pod) -> np.ndarray:
+def _static_mask(nodes: list[Node], pod: Pod, dra=None) -> np.ndarray:
     """Victim-independent filters: unschedulable, nodeName, taints, node
-    affinity. Relational/ports/volume feasibility is settled by the exact
-    host verification of the winning candidate (removing victims can only
-    HELP those, so this mask never wrongly excludes a candidate — except
-    taint/affinity, which victims cannot change)."""
+    affinity, DRA claim state. Relational/ports/volume feasibility is
+    settled by the exact host verification of the winning candidate
+    (removing victims can only HELP those, so this mask never wrongly
+    excludes a candidate — except taint/affinity/claims, which victims
+    cannot change)."""
     from kubernetes_tpu.sched.oracle import (
         UNSCHED_TAINT, OracleScheduler, tolerates_all)
     orc = OracleScheduler(nodes, [])
     out = np.zeros(len(nodes), bool)
+    # claim state is victim-independent: an unready claim holds the pod
+    # everywhere (dynamicresources PreFilter), and a claim already
+    # allocated to node X pins the pod to X exactly like spec.nodeName
+    claim_pin = None
+    if dra is not None and pod.spec.resource_claims:
+        if not dra.pod_claims_ready(pod):
+            return out
+        claim_pin = dra.pod_allocated_node(pod)
     for i, node in enumerate(nodes):
         # fleet visibility: preemption must never target (and therefore
         # never evict victims from) a sibling tenant's node
@@ -88,6 +97,8 @@ def _static_mask(nodes: list[Node], pod: Pod) -> np.ndarray:
                 t.tolerates(UNSCHED_TAINT) for t in pod.spec.tolerations):
             continue
         if pod.spec.node_name and pod.spec.node_name != node.metadata.name:
+            continue
+        if claim_pin and claim_pin != node.metadata.name:
             continue
         if not tolerates_all(pod.spec.tolerations, node.spec.taints, EFFECTS):
             continue
@@ -343,7 +354,7 @@ def dry_run_wave(nodes: list[Node], bound_pods: list[Pod],
             budgets, dra=dra, resident_arrays=resident_arrays,
             req_lookup=req_lookup)
     if static_masks is None:
-        static_masks = np.stack([_static_mask(nodes, pod)
+        static_masks = np.stack([_static_mask(nodes, pod, dra=dra)
                                  for pod in preemptors])
     if static_masks.shape[0] < Qb:
         static_masks = np.concatenate(
@@ -404,7 +415,8 @@ def dry_run_candidates(nodes: list[Node], bound_pods: list[Pod], pod: Pod,
         return [], False
 
     staged = jax.device_put((allocatable, requested,
-                             _static_mask(nodes, pod), vic_req, vic_valid,
+                             _static_mask(nodes, pod, dra=dra),
+                             vic_req, vic_valid,
                              vic_violating, vic_prio, need))
     # ktpu-lint: disable=KTL005 -- dry-run candidate ranking: explicit put in, ONE batched fetch out (same wave transfer contract)
     any_f, k_min, viols, maxprio = jax.device_get(_dry_run(*staged))

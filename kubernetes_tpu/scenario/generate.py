@@ -246,6 +246,54 @@ def tenant_onboarding(params: dict | None = None, seed: int = 0) -> Trace:
     return Trace(manifest, events)
 
 
+def autoscaler_thrash(params: dict | None = None, seed: int = 0) -> Trace:
+    """Scale-up/scale-down oscillation: bursts of pending pods big enough
+    to overflow the base fleet arrive, bind, then vanish almost entirely a
+    beat later — the arrival pattern that whipsaws an autoscaler between
+    "add nodes NOW" and "this capacity is provably unneeded" every period.
+    A small resident floor keeps utilization non-zero so scale-down is a
+    judgment call, not a no-op; ``survivors`` pods of each burst stay
+    behind so consecutive swings compound instead of resetting."""
+    p = {"swings": 4, "burst_pods": 24, "survivors": 2, "floor_pods": 6,
+         "nodes": 6, "period_s": 2.0, "templates": 4, **(params or {})}
+    rng = random.Random(seed)
+    nt = int(p["templates"])
+    templates = _templates(rng, nt)
+    events: list[TraceEvent] = []
+    for i in range(int(p["floor_pods"])):
+        events.append(TraceEvent(
+            at_s=_r(rng.random() * 0.2), verb="create", kind="Pod",
+            ns="default", name=f"floor-{i}", template=_pick(rng, nt),
+            phase="floor"))
+    period = float(p["period_s"])
+    burst = int(p["burst_pods"])
+    survivors = min(int(p["survivors"]), burst)
+    for s in range(int(p["swings"])):
+        t0 = 0.5 + s * period
+        for j in range(burst):
+            name = f"thrash-{s}-{j}"
+            events.append(TraceEvent(
+                at_s=_r(t0 + rng.random() * 0.15), verb="create",
+                kind="Pod", ns="default", name=name,
+                template=_pick(rng, nt), phase=f"swing-{s}-up"))
+            if j >= survivors:
+                # the collapse: most of the burst evaporates mid-period,
+                # flipping the fleet from overflow to under-utilization
+                events.append(TraceEvent(
+                    at_s=_r(t0 + 0.5 * period + rng.random() * 0.15),
+                    verb="delete", kind="Pod", ns="default", name=name,
+                    phase=f"swing-{s}-down"))
+    manifest = TraceManifest(
+        name="autoscaler-thrash", seed=seed,
+        description=(f"{int(p['swings'])} scale-up/down swings of "
+                     f"{burst} pods ({survivors} survive each) over a "
+                     f"{int(p['floor_pods'])}-pod floor"),
+        fleet=[{"template": "node", "count": int(p["nodes"]),
+                "prefix": "sn"}],
+        templates=templates)
+    return Trace(manifest, events)
+
+
 def smoke(params: dict | None = None, seed: int = 0) -> Trace:
     """The committed golden fixture: a small diurnal-burst trace sized
     for tests and ``BENCH_SCENARIO=builtin:smoke``."""
@@ -261,6 +309,7 @@ BUILTINS = {
     "rolling-update": rolling_update,
     "job-waves": job_waves,
     "tenant-onboarding": tenant_onboarding,
+    "autoscaler-thrash": autoscaler_thrash,
     "smoke": smoke,
 }
 

@@ -175,6 +175,14 @@ class SchedulerCache:
         with self._encode_lock:
             return self._encoder._request_vector(pod, resources)
 
+    def with_encoder(self, fn):
+        """Run ``fn(encoder)`` under the encode lock — the resident
+        planners (encode/overlay.py) encode derived pod batches and build
+        template planes against the LIVE encoder's intern tables, which
+        must not interleave with snapshot/overlay work on other threads."""
+        with self._encode_lock:
+            return fn(self._encoder)
+
     # ---- delta log (drain-context patch feed) ----------------------------
 
     def _log_locked(self, op: str, payload):

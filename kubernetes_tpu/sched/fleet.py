@@ -109,6 +109,58 @@ def _rekey_pod_affinity_terms(terms: list, pref: str) -> list:
     return out
 
 
+def _map_pv_terms(terms: list, fn) -> list:
+    """Apply ``fn`` to the node-name matchFields values and zone-label
+    matchExpressions values of PV nodeSelectorTerms. CSI topology names
+    nodes and zones inside PV nodeAffinity; both are per-tenant names, so
+    they cross the fleet boundary through the same rewrite as nodeName —
+    two tenants publishing the same zone string must NOT appear co-located
+    in the shared view."""
+    from kubernetes_tpu.sched.volumebinding import ZONE_LABELS
+    out = []
+    for t in terms:
+        t = dict(t)
+        mf = t.get("matchFields")
+        if mf:
+            t["matchFields"] = [
+                (dict(e, values=[fn(v) for v in e.get("values") or []])
+                 if e.get("key") == "metadata.name" else e)
+                for e in mf]
+        me = t.get("matchExpressions")
+        if me:
+            t["matchExpressions"] = [
+                (dict(e, values=[fn(v) for v in e.get("values") or []])
+                 if e.get("key") in ZONE_LABELS else e)
+                for e in me]
+        out.append(t)
+    return out
+
+
+def _map_pv_node_affinity(spec: dict, fn) -> dict:
+    na = spec.get("nodeAffinity")
+    req = (na or {}).get("required")
+    if not (req or {}).get("nodeSelectorTerms"):
+        return spec
+    spec["nodeAffinity"] = dict(na, required=dict(
+        req, nodeSelectorTerms=_map_pv_terms(req["nodeSelectorTerms"], fn)))
+    return spec
+
+
+def _map_zone_labels(md: dict, fn) -> dict:
+    """Rewrite CSI topology label VALUES on the object's metadata (nodes
+    and PVs carry zone/region labels that volume binding compares)."""
+    from kubernetes_tpu.sched.volumebinding import ZONE_LABELS
+    labels = md.get("labels")
+    if not labels or not any(labels.get(z) for z in ZONE_LABELS):
+        return md
+    labels = dict(labels)
+    for z in ZONE_LABELS:
+        if labels.get(z):
+            labels[z] = fn(labels[z])
+    md["labels"] = labels
+    return md
+
+
 def _rekey_match_fields(term: dict, pref: str) -> dict:
     mf = term.get("matchFields")
     if not mf:
@@ -194,7 +246,11 @@ def rekey_for_tenant(tid: int, plural: str, obj: Optional[dict]
         cr = spec.get("claimRef")
         if cr and cr.get("namespace"):
             spec["claimRef"] = dict(cr, namespace=pref + cr["namespace"])
+        spec = _map_pv_node_affinity(spec, lambda v: pref + v)
         out["spec"] = spec
+        out["metadata"] = _map_zone_labels(md, lambda v: pref + v)
+    elif plural == "nodes":
+        out["metadata"] = _map_zone_labels(md, lambda v: pref + v)
     return out
 
 
@@ -246,7 +302,11 @@ def unrekey_for_tenant(tid: int, plural: str, obj: Optional[dict]
         if cr and cr.get("namespace"):
             spec["claimRef"] = dict(cr, namespace=_strip(cr["namespace"],
                                                          tid))
+        spec = _map_pv_node_affinity(spec, lambda v: _strip(v, tid))
         out["spec"] = spec
+        out["metadata"] = _map_zone_labels(md, lambda v: _strip(v, tid))
+    elif plural == "nodes":
+        out["metadata"] = _map_zone_labels(md, lambda v: _strip(v, tid))
     elif plural == "resourceclaims":
         # the scheduler's PreBind allocation embeds the node name
         st = out.get("status")

@@ -2141,44 +2141,58 @@ class Scheduler:
                 bound_left = [p for p in bound_left if p.key not in gone]
         return results
 
-    def _resident_wave_view(self) -> Optional[dict]:
-        """The preemption wave's view of the DEVICE-RESIDENT drain context,
-        or None when the resident encoding cannot stand in for a fresh
-        snapshot. Valid only when the context is accountable (untainted),
-        staged under the CURRENT mesh epoch, and current with the cache —
-        every unconsumed delta-log entry is an assume the context already
-        folded. That is exactly the state at a drain resolve, which is
-        where preemption failures are handled: the wave then shares the
-        sharded resident cluster image (static masks run on it in place,
-        per-node totals read back from it, victim request vectors served
-        from its fold ledger) instead of re-staging tensors the device
-        already holds."""
+    def resident_plan_view(self) -> tuple[Optional[dict], str]:
+        """(view, reason) for consumers of the DEVICE-RESIDENT drain
+        context — the preemption wave and the three background planners
+        (encode/overlay.ResidentPlanner). ``view`` is None when the
+        resident encoding cannot stand in for a fresh snapshot, with
+        ``reason`` naming why (decline accounting for ``ktpu status``
+        and the PlannerLoop bench). Valid only when the context is
+        accountable (untainted), staged under the CURRENT mesh epoch,
+        and current with the cache — every unconsumed delta-log entry is
+        an assume the context already folded. That is exactly the state
+        at a drain resolve and between quiesced planner cycles: consumers
+        then share the sharded resident cluster image (masks run on it in
+        place, per-node totals read back from it or its host shadow,
+        victim request vectors served from its fold ledger) instead of
+        re-staging tensors the device already holds. Reads are GIL-atomic
+        snapshots of the context fields, safe from the planner threads."""
         import numpy as np
         from kubernetes_tpu.encode.patch import entries_all_folded
         ctx = self._drain_ctx
-        if ctx is None or self._pending:
+        if ctx is None:
+            return None, "no_ctx"
+        if self._pending:
             # in-flight drains' winners are folded into the resident
             # requested[N,R] but not yet in the cache's bound view — the
-            # wave's semantics (judge against bound+assumed, like the
+            # consumers' semantics (judge against bound+assumed, like the
             # snapshot path) require the two to agree
-            return None
+            return None, "in_flight"
         cs = ctx["cs"]
-        if cs.tainted or ctx.get("mesh_epoch") != self._mesh_epoch:
-            return None
+        if cs.tainted:
+            return None, "tainted"
+        if ctx.get("mesh_epoch") != self._mesh_epoch:
+            return None, "mesh_epoch"
         entries = self.cache.deltas_since(ctx["seq"])
         if entries is None or not entries_all_folded(cs, entries):
-            return None
+            return None, "stale_log"
         nodes = self.cache.list_nodes()
         meta = ctx["meta"]
         rows = []
         for n in nodes:
             ni = meta.node_index.get(n.metadata.name, -1)
             if ni < 0:
-                return None  # node the context has not absorbed: stale
+                return None, "missing_node"  # node the context has not absorbed
             rows.append(ni)
         return {"ct": ctx["ct"], "meta": meta, "cs": cs,
                 "nodes": nodes, "rows": np.asarray(rows, np.int32),
-                "shadow": ctx.get("shadow")}
+                "shadow": ctx.get("shadow"), "mesh": self._mesh}, "ok"
+
+    def _resident_wave_view(self) -> Optional[dict]:
+        """The preemption wave's view of the resident drain context (see
+        resident_plan_view) — the wave has no decline accounting."""
+        view, _reason = self.resident_plan_view()
+        return view
 
     def _resident_cluster_arrays(self, view: dict):
         """``fn(resources) -> (allocatable, requested) | None`` for

@@ -166,9 +166,19 @@ def test_publisher_writes_frontdoor_configmap(cluster):
     c = cluster.client()
     pub = FrontDoorPublisher(c, cluster.endpoints)
     assert pub.publish_once()
-    cm = c.resource("configmaps", FRONTDOOR_NAMESPACE).get(
-        FRONTDOOR_CONFIGMAP)
-    data = cm["data"]
+
+    def fetch():
+        # the spread client may read a follower that hasn't replayed the
+        # publish yet — the plane promises bounded staleness, not
+        # read-your-writes, so poll until the write is visible
+        try:
+            return c.resource("configmaps", FRONTDOOR_NAMESPACE).get(
+                FRONTDOOR_CONFIGMAP)
+        except Exception:
+            return None
+
+    assert wait_until(lambda: fetch() is not None)
+    data = fetch()["data"]
     assert data["leader"] == cluster.leader_api.url
     assert data["replicas"] == "2"
     nodes = json.loads(data["nodes"])

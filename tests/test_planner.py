@@ -1,0 +1,551 @@
+"""Resident what-if planning: the three background planners (autoscaler,
+descheduler, gang defrag) riding the scheduler's device-resident cluster
+image through ``encode/overlay.ResidentPlanner`` overlay views.
+
+What tier-1 proves here:
+  * resident-overlay plans are BIT-EQUAL to today's cold-encode plans for
+    all three planners (randomized parity fuzz),
+  * every staleness condition (no ctx / in-flight drain / tainted context /
+    mesh epoch / stale delta log / observation skew) declines into the cold
+    path with the reason recorded,
+  * per-tenant drain-slot quotas are enforced device-side in ONE dispatch —
+    admission is a pure function of its verdicts,
+  * the ``BackgroundPlanner`` cadence wires both planners to one shared
+    ResidentPlanner, counts steady-window compiles, and publishes status
+    the ``ktpu status`` "Planners:" line renders.
+"""
+
+import io
+import json
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.autoscaler.nodegroup import NODE_GROUP_LABEL, NodeGroup
+from kubernetes_tpu.autoscaler.simulator import (
+    simulate_scale_down,
+    simulate_scale_up,
+)
+from kubernetes_tpu.config.types import SchedulerConfiguration
+from kubernetes_tpu.descheduler import (
+    CandidateSet,
+    GANG_LABEL,
+    plan_evictions,
+    plan_gang_defrag,
+)
+from kubernetes_tpu.encode.overlay import ResidentPlanner, tenant_quota_mask
+from kubernetes_tpu.encode.snapshot import SnapshotEncoder, TENANT_LABEL
+from kubernetes_tpu.sched.cache import SchedulerCache
+from kubernetes_tpu.sched.queue import SchedulingQueue
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+pytestmark = pytest.mark.planner
+
+
+def _sched(nodes, bound=()):
+    """A warm scheduler over ``nodes``+``bound`` whose resident drain
+    context can hand out plan views (the product's warm_drain arming)."""
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in bound:
+        cache.add_pod(p)
+    queue = SchedulingQueue(backoff_initial=0.01, backoff_max=0.05)
+    cfg = SchedulerConfiguration(batch_size=4, max_drain_batches=2,
+                                 backoff_initial_s=0.01, backoff_max_s=0.05)
+    sched = Scheduler(cfg, cache, queue, lambda pod, node: True)
+    warm = [make_pod(f"__warm{i}").req({"cpu": "100m"}).obj()
+            for i in range(4)]
+    assert sched.warm_drain(warm, slot_headroom=64)
+    return sched, cache
+
+
+def _norm_up(options):
+    return [(o.group.name, sorted(o.pod_indices), o.nodes_needed,
+             round(float(o.waste), 9)) for o in options]
+
+
+def _norm_down(plan):
+    return (sorted(plan.removable),
+            {n: sorted(m) for n, m in plan.placements.items()},
+            dict(plan.blocked))
+
+
+def _norm_ev(plan):
+    return ([(s.name, s.strategy, sorted(p.key for p in s.victims),
+              sorted(s.moves)) for s in plan.accepted],
+            dict(plan.blocked), plan.batch_victims, plan.batch_sets)
+
+
+def _norm_gang(plan):
+    acc = None
+    if plan.accepted is not None:
+        acc = (plan.accepted.name, sorted(p.key for p in plan.accepted.victims),
+               sorted(plan.accepted.moves))
+    return (plan.gang, acc, sorted(plan.gang_moves),
+            plan.fits_without_evictions, dict(plan.blocked))
+
+
+def _fuzz_cluster(seed):
+    """8 nodes / random load; shapes stay in one jit bucket across seeds."""
+    rng = random.Random(seed)
+    nodes, bound = [], []
+    for i in range(8):
+        cpu, mem = rng.choice([("8", "16Gi"), ("4", "8Gi"), ("16", "32Gi")])
+        # kubelet/autoscaler stamp hostname + group on every real node —
+        # the templates' label keys must live in the node bucket
+        nodes.append(make_node(f"f{i}")
+                     .capacity({"cpu": cpu, "memory": mem, "pods": "32"})
+                     .label("disk", rng.choice(["ssd", "hdd"]))
+                     .label("kubernetes.io/hostname", f"f{i}")
+                     .label(NODE_GROUP_LABEL, "fuzz-pool")
+                     .obj())
+    k = 0
+    for i in range(8):
+        for _ in range(rng.randint(0, 2)):
+            bound.append(make_pod(f"fb{k}")
+                         .req({"cpu": rng.choice(["500m", "1", "2"])})
+                         .node(f"f{i}").obj())
+            k += 1
+    return nodes, bound
+
+
+# ------------------------------------------------- resident/cold parity
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_resident_vs_cold_plan_parity_fuzz(seed):
+    """All three planners, same observation, overlay view vs cold encode:
+    plans must be bit-equal, and the overlay path must actually be taken
+    (a decline would make the comparison vacuous cold-vs-cold)."""
+    rng = random.Random(1000 + seed)
+    nodes, bound = _fuzz_cluster(seed)
+    sched, cache = _sched(nodes, bound)
+    try:
+        rp = ResidentPlanner(sched.resident_plan_view, cache)
+        cold = SnapshotEncoder()
+
+        # scale-up: pending pods only a template can absorb (plus a gang
+        # label no NODE carries — pod-side keys past the node bucket must
+        # not decline the template overlay)
+        pending = [make_pod(f"pend{j}")
+                   .req({"cpu": rng.choice(["20", "24"])})
+                   .label(GANG_LABEL, "noise").obj()
+                   for j in range(rng.randint(1, 3))]
+        groups = [NodeGroup(name="ng-big", min_size=0, max_size=4,
+                            template=make_node("ng-big-t").capacity(
+                                {"cpu": "32", "memory": "64Gi",
+                                 "pods": "32"}).obj())]
+        up_r = simulate_scale_up(nodes, bound, pending, groups,
+                                 encoder=cold, resident=rp)
+        up_c = simulate_scale_up(nodes, bound, pending, groups,
+                                 encoder=cold, resident=None)
+        assert rp.stats()["hits"].get("autoscaler") == 1, rp.stats()
+        assert _norm_up(up_r) == _norm_up(up_c)
+        assert up_c, "scale-up parity must compare a real option"
+
+        # scale-down: every node a candidate, shared-ledger drain proof
+        cands = [n.metadata.name for n in nodes]
+        down_r = simulate_scale_down(nodes, bound, cands,
+                                     utilization_threshold=0.6,
+                                     encoder=cold, resident=rp)
+        down_c = simulate_scale_down(nodes, bound, cands,
+                                     utilization_threshold=0.6,
+                                     encoder=cold, resident=None)
+        assert rp.stats()["hits"].get("autoscaler") == 2
+        assert _norm_down(down_r) == _norm_down(down_c)
+
+        # descheduler: drain the least-loaded occupied node
+        per_node = {}
+        for p in bound:
+            per_node.setdefault(p.spec.node_name, []).append(p)
+        if per_node:
+            victim_node = min(per_node, key=lambda n: len(per_node[n]))
+            sets = [CandidateSet(name=f"drain-{victim_node}",
+                                 strategy="Fuzz",
+                                 victims=per_node[victim_node],
+                                 exclude_targets={victim_node})]
+            ev_r = plan_evictions(nodes, bound, sets, encoder=cold,
+                                  resident=rp)
+            ev_c = plan_evictions(nodes, bound, sets, encoder=cold,
+                                  resident=None)
+            assert rp.stats()["hits"].get("descheduler") == 1
+            assert _norm_ev(ev_r) == _norm_ev(ev_c)
+
+        # gang defrag: a pending gang + the same consolidation candidates
+        gang_pods = [make_pod(f"g{j}").req({"cpu": "2"})
+                     .label(GANG_LABEL, "fuzz").obj() for j in range(2)]
+        gsets = [CandidateSet(name=f"consolidate-{n}", strategy="GangFuzz",
+                              victims=list(ps), exclude_targets={n})
+                 for n, ps in sorted(per_node.items())]
+        gp_r = plan_gang_defrag(nodes, bound, gang_pods, "fuzz", gsets,
+                                encoder=cold, resident=rp)
+        gp_c = plan_gang_defrag(nodes, bound, gang_pods, "fuzz", gsets,
+                                encoder=cold, resident=None)
+        assert rp.stats()["hits"].get("gangDefrag") == 1
+        assert _norm_gang(gp_r) == _norm_gang(gp_c)
+        assert not rp.stats()["declines"], rp.stats()
+    finally:
+        sched.close()
+
+
+# --------------------------------------------------------- decline matrix
+
+def test_decline_matrix_every_staleness_reason():
+    """Each freshness violation must decline (cold fallback) with its
+    reason recorded — never serve a view of a cluster the image doesn't
+    hold."""
+    nodes = [make_node(f"d{i}")
+             .capacity({"cpu": "8", "memory": "16Gi", "pods": "32"}).obj()
+             for i in range(4)]
+    bound = [make_pod("db0").req({"cpu": "1"}).node("d0").obj()]
+    sched, cache = _sched(nodes, bound)
+    try:
+        rp = ResidentPlanner(sched.resident_plan_view, cache)
+        live = cache.list_nodes()
+        assert rp.plan_view(live, bound, planner="t") is not None
+
+        # planner observed fewer nodes than the image holds (and vice
+        # versa the image must cover every observed node)
+        assert rp.plan_view(live[:-1], bound, planner="t") is None
+        # planner observed a bound pod the image doesn't hold
+        ghost = make_pod("ghost").req({"cpu": "1"}).node("d1").obj()
+        assert rp.plan_view(live, bound + [ghost], planner="t") is None
+
+        # a drain in flight: winners folded device-side but not yet bound
+        sched._pending.append({"sentinel": True})
+        assert rp.plan_view(live, bound, planner="t") is None
+        sched._pending.clear()
+
+        # mesh epoch moved under the staged context
+        sched._mesh_epoch += 1
+        assert rp.plan_view(live, bound, planner="t") is None
+        sched._mesh_epoch -= 1
+        assert rp.plan_view(live, bound, planner="t") is not None
+
+        # unconsumed delta-log entries the context never folded
+        cache.add_node(make_node("d-new").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": "32"}).obj())
+        assert rp.plan_view(cache.list_nodes(), bound, planner="t") is None
+
+        # context taint wins over everything downstream
+        sched._drain_ctx["cs"].tainted = True
+        assert rp.plan_view(live, bound, planner="t") is None
+
+        assert rp.stats()["declines"]["t"] == {
+            "node_set_skew": 1, "bound_set_skew": 1, "in_flight": 1,
+            "mesh_epoch": 1, "stale_log": 1, "tainted": 1}
+    finally:
+        sched.close()
+
+
+def test_no_ctx_declines_before_warmup():
+    cache = SchedulerCache()
+    for n in [make_node("c0").capacity({"cpu": "4", "memory": "8Gi",
+                                        "pods": "16"}).obj()]:
+        cache.add_node(n)
+    sched = Scheduler(SchedulerConfiguration(batch_size=4), cache,
+                      SchedulingQueue(), lambda pod, node: True)
+    try:
+        rp = ResidentPlanner(sched.resident_plan_view, cache)
+        assert rp.plan_view(cache.list_nodes(), [], planner="t") is None
+        assert rp.stats()["declines"]["t"] == {"no_ctx": 1}
+    finally:
+        sched.close()
+
+
+# ------------------------------------------------- tenant drain quotas
+
+def test_tenant_quota_mask_ranks_device_side():
+    """One-hot x cumsum ranking on device: the (quota+1)-th victim of a
+    tenant is refused, unlabeled (-1) victims are unlimited."""
+    allowed = tenant_quota_mask([0, 0, 1, -1, 0], [2, 1])
+    assert allowed.tolist() == [True, True, True, True, False]
+    # -1 quota = unlimited
+    assert tenant_quota_mask([0, 0, 0], [-1]).tolist() == [True] * 3
+
+
+def test_tenant_drain_quota_blocks_whole_set_in_one_dispatch(monkeypatch):
+    """End-to-end through Descheduler.plan: a set overdrawing a tenant's
+    drain slots blocks WHOLE, a blocked set's victims STILL consume their
+    tenants' slots (admission is a pure function of the single device
+    verdict — no host-side re-ranking after a block), and exactly ONE
+    quota dispatch serves the whole cycle."""
+    from kubernetes_tpu.client.clientset import DirectClient
+    from kubernetes_tpu.descheduler.descheduler import (
+        Descheduler,
+        DeschedulerConfiguration,
+    )
+    from kubernetes_tpu.store.store import ObjectStore
+    import kubernetes_tpu.encode.overlay as overlay
+
+    client = DirectClient(ObjectStore())
+
+    # fleet isolation's validity gate hides other tenants' nodes from a
+    # tenant-labeled pod, so every victim needs a same-tenant landing
+    # zone; the busy-pool selector keeps victims off each other's drain
+    # candidates (a cross-landing would block the later set for holding
+    # simulated re-placements, before quota admission is even consulted)
+    def node(name, tenant, pool=None, cpu="8"):
+        b = make_node(name).capacity({"cpu": cpu, "memory": "16Gi",
+                                      "pods": "32"})
+        b = b.label(TENANT_LABEL, tenant)
+        if pool:
+            b = b.label("pool", pool)
+        client.nodes().create(b.obj().to_dict())
+
+    def pod(name, tenant, on, cpu="1", selector=None):
+        b = make_pod(name).req({"cpu": cpu}).label(TENANT_LABEL, tenant)
+        if selector:
+            b = b.node_selector(selector)
+        client.pods("default").create(b.node(on).obj().to_dict())
+
+    node("q0", "acme")
+    node("q1", "acme")
+    node("q2", "acme", pool="busy")
+    node("q3", "acme", pool="busy")
+    node("q4", "zeta", pool="busy")
+    busy = {"pool": "busy"}
+    # drain/q0 (planned first): acme + zeta victims
+    pod("acme-a", "acme", "q0", selector=busy)
+    pod("zeta-a", "zeta", "q0", selector=busy)
+    # drain/q1 (planned second): two acme victims
+    pod("acme-b", "acme", "q1", selector=busy)
+    pod("acme-c", "acme", "q1", selector=busy)
+    # q2/q3 busy enough to dodge HighNodeUtilization, roomy enough to
+    # absorb every acme victim in the re-placement proof
+    for i, on in enumerate(["q2", "q2", "q3", "q3"]):
+        pod(f"load-{i}", "acme", on, cpu="3")
+
+    calls = []
+    real = overlay.tenant_quota_mask
+    monkeypatch.setattr(overlay, "tenant_quota_mask",
+                        lambda ids, quotas: calls.append(len(ids))
+                        or real(ids, quotas))
+    d = Descheduler(client, DeschedulerConfiguration(
+        strategies={"HighNodeUtilization": {"threshold": 0.3}},
+        gang_defrag=False,
+        tenant_drain_quotas={"acme": 2, "zeta": 0}))
+    plan, gangs = d.plan()
+    # drain/q0 overdraws zeta (quota 0) -> blocked whole, yet its acme
+    # victim consumed acme slot #1; drain/q1's second acme victim then
+    # lands at rank 2 >= quota 2 -> blocked too. Standalone, drain/q1
+    # would have been admitted (2 victims against quota 2) — the block
+    # proves blocked sets keep their device-assigned ranks.
+    assert plan.blocked == {
+        "drain/q0": "tenant drain quota exceeded",
+        "drain/q1": "tenant drain quota exceeded",
+    }, (plan.blocked, [s.name for s in plan.accepted])
+    assert not plan.accepted
+    assert calls == [4]  # ONE dispatch covered all four victims
+
+
+# ------------------------------------------------- BackgroundPlanner
+
+def test_background_planner_cycles_and_status():
+    """The cadence: one shared ResidentPlanner wired to both planners,
+    cycle summaries counting steady-window compiles, status published to
+    the planner ConfigMap and rendered by ``ktpu status`` (text + json)."""
+    from kubernetes_tpu.autoscaler.autoscaler import ClusterAutoscaler
+    from kubernetes_tpu.autoscaler.nodegroup import StaticNodeGroupProvider
+    from kubernetes_tpu.cli.ktpu import main as ktpu_main
+    from kubernetes_tpu.client.clientset import HTTPClient
+    from kubernetes_tpu.descheduler.descheduler import (
+        Descheduler,
+        DeschedulerConfiguration,
+    )
+    from kubernetes_tpu.sched.bgplanner import (
+        BackgroundPlanner,
+        PLANNER_CONFIGMAP,
+    )
+    from kubernetes_tpu.sched.runner import SchedulerRunner
+    from kubernetes_tpu.store.apiserver import APIServer
+
+    server = APIServer().start()
+    runner = None
+    try:
+        client = HTTPClient(server.url)
+        # group-labeled = autoscaler-managed, so scale-down has candidates
+        client.nodes().create_many(
+            [make_node(f"bg{i}").capacity({"cpu": "8", "memory": "16Gi",
+                                           "pods": "32"})
+             .label(NODE_GROUP_LABEL, "bg-pool").obj().to_dict()
+             for i in range(3)])
+        client.pods("default").create_many(
+            [make_pod(f"bgp{i}").req({"cpu": "2"}).node(f"bg{i}")
+             .obj().to_dict() for i in range(2)])
+        runner = SchedulerRunner(HTTPClient(server.url),
+                                 SchedulerConfiguration(batch_size=4))
+        runner.start(wait_sync=30.0, start_loop=False)
+        assert runner.scheduler.warm_drain(
+            [make_pod(f"bgw{i}").req({"cpu": "1"}).obj()
+             for i in range(4)], slot_headroom=32)
+        autoscaler = ClusterAutoscaler(
+            HTTPClient(server.url),
+            StaticNodeGroupProvider(HTTPClient(server.url), [NodeGroup(
+                name="bg-pool", min_size=0, max_size=4,
+                template=make_node("bg-t").capacity(
+                    {"cpu": "2", "memory": "4Gi", "pods": "16"}).obj())]),
+            scale_down_unneeded_s=10 ** 9)
+        descheduler = Descheduler(HTTPClient(server.url),
+                                  DeschedulerConfiguration())
+        planner = BackgroundPlanner(client, runner.scheduler,
+                                    autoscaler=autoscaler,
+                                    descheduler=descheduler,
+                                    descheduler_dry_run=True,
+                                    warmup_cycles=1)
+        # one planner, both consumers — the tentpole wiring
+        assert autoscaler.resident is planner.resident
+        assert descheduler.resident is planner.resident
+
+        first = planner.run_once()
+        assert "steadyCompiles" not in first      # warmup: gate unarmed
+        second = planner.run_once()
+        assert "steadyCompiles" in second          # steady: gate armed
+        st = planner.status()
+        assert st["cycles"] == 2
+        assert set(st["planners"]) == {"autoscaler", "descheduler",
+                                       "gangDefrag"}
+
+        cm = client.resource("configmaps", "default").get(PLANNER_CONFIGMAP)
+        published = json.loads(cm["data"]["status"])
+        assert published["cycles"] == 2
+        assert published["planners"]["autoscaler"]["hits"] + \
+            published["planners"]["autoscaler"]["declines"] >= 1
+
+        # ktpu status renders the Planners line, -o json carries the blob
+        out = io.StringIO()
+        assert ktpu_main(["--server", server.url, "status"], out=out) == 0
+        text = out.getvalue()
+        assert "Planners:      2 cycles" in text
+        assert "steady compiles" in text
+        out = io.StringIO()
+        assert ktpu_main(["--server", server.url, "status",
+                          "-o", "json"], out=out) == 0
+        blob = json.loads(out.getvalue())
+        assert blob["planner"]["cycles"] == 2
+        assert blob["planner"]["planners"].keys() == \
+            published["planners"].keys()
+    finally:
+        if runner is not None:
+            runner.stop()
+        server.stop()
+
+
+# ------------------------------------------------- satellites
+
+def test_auditor_emits_replayable_incident_trace(tmp_path):
+    """A repro bundle converts to an ``incident-*.trace.jsonl`` sitting
+    next to it — the ktpu scenario record --from-bundle conversion, run
+    automatically on the fail-fast path."""
+    from kubernetes_tpu.audit.auditor import InvariantAuditor
+    from kubernetes_tpu.scenario.trace import Trace
+
+    bundle = {"invariant": "capacity", "chaosSeed": 7,
+              "podBatch": ["default/x1", "default/x2"]}
+    path = tmp_path / "audit-20260807T000000Z-capacity.json"
+    path.write_text(json.dumps(bundle))
+    auditor = InvariantAuditor.__new__(InvariantAuditor)
+    out = auditor._emit_trace(str(path))
+    assert out and out.endswith(".trace.jsonl")
+    assert "incident-20260807T000000Z-capacity" in out
+    tr = Trace.load(out)
+    assert {(e.ns, e.name) for e in tr.events if e.verb == "create"} >= \
+        {("default", "x1"), ("default", "x2")}
+
+    # a bundle without a pending batch has nothing to replay
+    empty = tmp_path / "audit-20260807T000001Z-empty.json"
+    empty.write_text(json.dumps({"invariant": "empty", "podBatch": []}))
+    assert auditor._emit_trace(str(empty)) is None
+
+
+@pytest.mark.scenario
+def test_autoscaler_thrash_generator_deterministic():
+    from kubernetes_tpu.scenario.generate import BUILTINS
+
+    gen = BUILTINS["autoscaler-thrash"]
+    for seed in (0, 1, 2):
+        a = gen({}, seed=seed)
+        b = gen({}, seed=seed)
+        assert a.to_lines() == b.to_lines()
+        assert len(a.events) > 0
+    assert gen({}, seed=0).to_lines() != gen({}, seed=1).to_lines()
+    # the shape: floor pods, per-swing bursts, survivor-trimming deletes
+    tr = gen({"swings": 2, "burstPods": 6, "survivors": 1}, seed=3)
+    verbs = {}
+    for e in tr.events:
+        verbs[e.verb] = verbs.get(e.verb, 0) + 1
+    assert verbs["create"] > verbs["delete"] > 0
+
+
+def test_fleet_rekey_maps_csi_topology_per_tenant():
+    """Zone labels on nodes/PVs and PV nodeAffinity zone terms cross the
+    fleet re-keying boundary with the tenant prefix, and unrekey restores
+    the tenant's own view byte-for-byte."""
+    from kubernetes_tpu.sched.fleet import rekey_for_tenant, unrekey_for_tenant
+
+    pv = {"metadata": {"name": "pv1",
+                       "labels": {"topology.kubernetes.io/zone": "us-a",
+                                  "tier": "fast"}},
+          "spec": {"capacity": {"storage": "10Gi"},
+                   "nodeAffinity": {"required": {"nodeSelectorTerms": [
+                       {"matchExpressions": [
+                           {"key": "topology.kubernetes.io/zone",
+                            "operator": "In", "values": ["us-a"]},
+                           {"key": "tier", "operator": "In",
+                            "values": ["fast"]}]}]}}}}
+    out = rekey_for_tenant(2, "persistentvolumes", pv)
+    assert out["metadata"]["name"] == "t2.pv1"
+    assert out["metadata"]["labels"]["topology.kubernetes.io/zone"] == \
+        "t2.us-a"
+    assert out["metadata"]["labels"]["tier"] == "fast"  # not a zone key
+    exprs = out["spec"]["nodeAffinity"]["required"][
+        "nodeSelectorTerms"][0]["matchExpressions"]
+    assert {e["key"]: e["values"] for e in exprs} == {
+        "topology.kubernetes.io/zone": ["t2.us-a"], "tier": ["fast"]}
+    back = unrekey_for_tenant(2, "persistentvolumes", out)
+    assert back["metadata"]["labels"] == pv["metadata"]["labels"]
+    assert back["spec"]["nodeAffinity"] == pv["spec"]["nodeAffinity"]
+
+    node = {"metadata": {"name": "n1", "labels": {
+        "topology.kubernetes.io/region": "us", "x": "y"}}}
+    out_n = rekey_for_tenant(2, "nodes", node)
+    assert out_n["metadata"]["labels"][
+        "topology.kubernetes.io/region"] == "t2.us"
+    assert unrekey_for_tenant(
+        2, "nodes", out_n)["metadata"]["labels"] == node["metadata"]["labels"]
+
+
+def test_preemption_static_mask_respects_dra_claim_state():
+    """An unready claim holds the preemptor off every node; an allocated
+    claim pins it to the allocation's node."""
+    from kubernetes_tpu.ops.preemption import _static_mask
+    from kubernetes_tpu.sched.dra import DraCatalog
+
+    nodes = [make_node(f"p{i}").capacity({"cpu": "4", "memory": "8Gi",
+                                          "pods": "16"}).obj()
+             for i in range(3)]
+
+    def pod_with(claim_name):
+        p = make_pod("pre").req({"cpu": "1"}).priority(100).obj()
+        p.spec.resource_claims = [{"name": "dev",
+                                   "resourceClaimName": claim_name}]
+        return p
+
+    # referenced claim doesn't resolve -> unschedulable, all-False
+    cat = DraCatalog.from_lists()
+    assert not _static_mask(nodes, pod_with("missing"), dra=cat).any()
+
+    # allocated claim pins to its node
+    cat = DraCatalog.from_lists(claims=[{
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": "c1", "namespace": "default"},
+        "spec": {"devices": {"requests": []}},
+        "status": {"allocation": {"nodeName": "p1"}, "reservedFor": []}}])
+    mask = _static_mask(nodes, pod_with("c1"), dra=cat)
+    assert mask.tolist() == [False, True, False]
+
+    # no claims: unaffected
+    free = make_pod("free").req({"cpu": "1"}).priority(100).obj()
+    assert _static_mask(nodes, free, dra=cat).all()
